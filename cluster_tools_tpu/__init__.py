@@ -1,0 +1,32 @@
+"""cluster_tools_tpu — a TPU-native framework for distributed 3D bio-image segmentation.
+
+A ground-up rebuild of the capabilities of `cluster_tools`
+(constantinpape/cluster_tools, mirrored as tranorrepository/cluster_tools): resumable,
+block-decomposed workflows over chunked zarr/n5/hdf5 volumes — distance-transform
+watersheds, distributed connected components, mutex watershed, region-adjacency-graph
+extraction, edge-feature accumulation, (lifted) multicut, stitching, relabeling,
+evaluation, multiscale export and NN inference.
+
+Architecture (TPU-first, not a port):
+  * the per-block hot path is a single jit-compiled JAX/XLA program (optionally Pallas),
+    batched over blocks and sharded across a `jax.sharding.Mesh` with `shard_map`;
+  * halo exchange and label merges ride ICI collectives instead of the reference's
+    shared-filesystem data plane (reference: SURVEY.md §2.9);
+  * the resumable task DAG / JSON-config / chunked-IO architecture of the reference is
+    kept as the host-side control plane (reference: cluster_tools/cluster_tasks.py).
+"""
+
+__version__ = "0.1.0"
+
+from .runtime.task import BlockTask, Task, FailedBlocksError
+from .runtime.workflow import WorkflowBase, build
+from .runtime import config as config
+
+__all__ = [
+    "BlockTask",
+    "Task",
+    "FailedBlocksError",
+    "WorkflowBase",
+    "build",
+    "config",
+]

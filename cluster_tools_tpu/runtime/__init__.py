@@ -1,0 +1,15 @@
+from . import config
+from .task import Task, BlockTask, FailedBlocksError, Target
+from .executor import get_executor
+from .workflow import WorkflowBase, build
+
+__all__ = [
+    "config",
+    "Task",
+    "BlockTask",
+    "FailedBlocksError",
+    "Target",
+    "get_executor",
+    "WorkflowBase",
+    "build",
+]

@@ -1,0 +1,84 @@
+"""Two-level JSON configuration: one global config + one config per task.
+
+Keeps the reference's config ergonomics (SURVEY.md §5 "Config / flag system";
+reference cluster_tasks.py:180-248): ``global.config`` carries volume decomposition
+and scheduling knobs, ``<task_name>.config`` carries per-task behavior, and task
+*parameters* (paths/keys) stay constructor arguments — config files carry behavior,
+parameters carry wiring.
+
+TPU-specific knobs replace the reference's Slurm fields: ``target`` selects the
+execution backend (``tpu`` = batched jit dispatch over a device mesh, ``local`` =
+host loop, the parity oracle), ``device_batch_size`` controls how many blocks ride
+one device dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+# reference default production block shape: cluster_tasks.py:225
+DEFAULT_GLOBAL_CONFIG: Dict[str, Any] = {
+    "block_shape": [50, 512, 512],
+    "roi_begin": None,
+    "roi_end": None,
+    "block_list_path": None,
+    "target": "local",
+    "max_jobs": 1,
+    "max_num_retries": 0,
+    "retry_failure_fraction": 0.5,
+    "device_batch_size": 8,
+    "devices": None,  # None = all jax.devices()
+    "seed": 0,
+}
+
+DEFAULT_TASK_CONFIG: Dict[str, Any] = {
+    "threads_per_job": 1,
+    "time_limit": 60,
+    "mem_limit": 2,
+}
+
+
+def _config_path(config_dir: str, name: str) -> str:
+    return os.path.join(config_dir, f"{name}.config")
+
+
+def write_config(config_dir: str, name: str, conf: Dict[str, Any]) -> str:
+    os.makedirs(config_dir, exist_ok=True)
+    path = _config_path(config_dir, name)
+    with open(path, "w") as f:
+        json.dump(conf, f, indent=2, sort_keys=True)
+    return path
+
+def write_global_config(config_dir: str, conf: Optional[Dict[str, Any]] = None) -> str:
+    merged = dict(DEFAULT_GLOBAL_CONFIG)
+    if conf:
+        merged.update(conf)
+    return write_config(config_dir, "global", merged)
+
+
+def read_config(config_dir: Optional[str], name: str) -> Dict[str, Any]:
+    if config_dir is None:
+        return {}
+    path = _config_path(config_dir, name)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def global_config(config_dir: Optional[str]) -> Dict[str, Any]:
+    conf = dict(DEFAULT_GLOBAL_CONFIG)
+    conf.update(read_config(config_dir, "global"))
+    return conf
+
+
+def task_config(
+    config_dir: Optional[str], task_name: str, defaults: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    conf = dict(DEFAULT_TASK_CONFIG)
+    if defaults:
+        conf.update(defaults)
+    conf.update(read_config(config_dir, task_name))
+    return conf

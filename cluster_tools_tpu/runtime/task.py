@@ -1,0 +1,284 @@
+"""Task protocol: resumable block tasks with positive per-block completion records.
+
+Re-expression of the reference's ``BaseClusterTask`` lifecycle
+(reference cluster_tasks.py:27-159: init → prepare_jobs → submit_jobs →
+wait_for_jobs → check_jobs) without the scheduler CLIs and log-grepping:
+
+  * success is recorded *positively* in a JSON status file per task
+    (``done`` block list + per-attempt runtimes) instead of magic
+    ``"processed job N"`` log lines parsed back (reference parse_utils.py:76-135);
+  * retry re-runs exactly the failed blocks, with the reference's safety heuristic
+    (skip retry when a large fraction of blocks failed — something fundamental broke,
+    reference cluster_tasks.py:140-142);
+  * the compute inside a task is dispatched by an executor backend (`local` host
+    loop or `tpu` batched device dispatch) rather than N scheduler processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import config as cfg
+from ..utils.blocking import Blocking, blocks_in_volume
+
+
+class FailedBlocksError(RuntimeError):
+    """Raised when blocks remain failed after exhausting retries
+    (the analog of the reference's FailedJobsError, cluster_tasks.py:21)."""
+
+
+class Target:
+    """Completion marker of a task: a JSON status file in the tmp folder.
+
+    Plays the role of the reference's luigi ``LocalTarget`` on the task log file
+    (cluster_tasks.py:257-258), but carries machine-readable per-block state.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def exists(self) -> bool:
+        if not os.path.exists(self.path):
+            return False
+        try:
+            with open(self.path) as f:
+                return bool(json.load(f).get("complete", False))
+        except (json.JSONDecodeError, OSError):
+            return False
+
+    def read(self) -> Dict[str, Any]:
+        if not os.path.exists(self.path):
+            return {}
+        with open(self.path) as f:
+            return json.load(f)
+
+    def write(self, status: Dict[str, Any]) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(status, f, indent=2)
+        os.replace(tmp, self.path)
+
+
+class Task:
+    """A node in the workflow DAG."""
+
+    task_name: str = "task"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        dependencies: Sequence["Task"] = (),
+    ):
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.dependencies = list(dependencies)
+
+    # -- identity ------------------------------------------------------------
+
+    @property
+    def identifier(self) -> str:
+        """Distinguishes instances of the same task class (scale/prefix variants
+        override this — the analog of the reference's per-scale log names,
+        e.g. merge_sub_graphs.py:100-101)."""
+        return self.task_name
+
+    # -- DAG protocol --------------------------------------------------------
+
+    def requires(self) -> Sequence["Task"]:
+        return self.dependencies
+
+    def output(self) -> Target:
+        return Target(
+            os.path.join(self.tmp_folder, "status", f"{self.identifier}.status.json")
+        )
+
+    def complete(self) -> bool:
+        return self.output().exists()
+
+    def run(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- config --------------------------------------------------------------
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        return dict(cfg.DEFAULT_TASK_CONFIG)
+
+    def get_task_config(self) -> Dict[str, Any]:
+        return cfg.task_config(self.config_dir, self.task_name, self.default_task_config())
+
+    def global_config(self) -> Dict[str, Any]:
+        conf = cfg.global_config(self.config_dir)
+        if self.max_jobs is not None:
+            conf["max_jobs"] = self.max_jobs
+        return conf
+
+    # -- logging -------------------------------------------------------------
+
+    @property
+    def log_path(self) -> str:
+        return os.path.join(self.tmp_folder, "logs", f"{self.identifier}.log")
+
+    def log(self, msg: str) -> None:
+        os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        with open(self.log_path, "a") as f:
+            f.write(f"{stamp}: {msg}\n")
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.identifier})"
+
+
+class SimpleTask(Task):
+    """A single-shot (non-blockwise) task: subclasses implement ``run_impl``."""
+
+    def run(self) -> None:
+        t0 = time.time()
+        self.log(f"start {self.identifier}")
+        self.run_impl()
+        status = {
+            "task": self.identifier,
+            "complete": True,
+            "runtime_s": time.time() - t0,
+        }
+        self.output().write(status)
+        self.log(f"done {self.identifier} in {status['runtime_s']:.2f}s")
+
+    def run_impl(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class BlockTask(Task):
+    """A block-parallel task over a volume decomposition.
+
+    Subclasses implement:
+      * ``get_shape()``      — volume shape that defines the blocking;
+      * ``process_block(block_id, blocking, config)``  — per-block host path;
+      * optionally ``process_block_batch(block_ids, blocking, config)`` — a
+        device-batched path the ``tpu`` executor prefers (blocks padded to a static
+        shape, vmapped/sharded over the mesh);
+      * optionally ``prepare(blocking, config)`` / ``finalize(blocking, config,
+        block_ids)`` — host-side setup (e.g. output dataset creation) and reduction.
+
+    ``allow_retry=False`` marks tasks whose block outputs cannot safely be redone
+    (reference block_components.py:27).
+    """
+
+    allow_retry: bool = True
+
+    def get_shape(self) -> Sequence[int]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def process_block(self, block_id: int, blocking: Blocking, config: Dict[str, Any]):
+        raise NotImplementedError  # pragma: no cover - abstract
+
+    def prepare(self, blocking: Blocking, config: Dict[str, Any]) -> None:
+        pass
+
+    def finalize(
+        self, blocking: Blocking, config: Dict[str, Any], block_ids: List[int]
+    ) -> None:
+        pass
+
+    def get_block_shape(self, gconf: Dict[str, Any]) -> List[int]:
+        return list(gconf["block_shape"])
+
+    def get_block_list(self, blocking: Blocking, gconf: Dict[str, Any]) -> List[int]:
+        return blocks_in_volume(
+            blocking.shape,
+            blocking.block_shape,
+            gconf.get("roi_begin"),
+            gconf.get("roi_end"),
+            gconf.get("block_list_path"),
+        )
+
+    # -- main lifecycle ------------------------------------------------------
+
+    def run(self) -> None:
+        from .executor import get_executor  # local import to avoid cycle
+
+        t_start = time.time()
+        gconf = self.global_config()
+        tconf = self.get_task_config()
+        config = {**gconf, **tconf}
+
+        shape = tuple(self.get_shape())
+        block_shape = self.get_block_shape(gconf)
+        blocking = Blocking(shape, block_shape)
+        block_ids = self.get_block_list(blocking, gconf)
+
+        target = self.output()
+        status = target.read()
+        done = set(status.get("done", []))
+        todo = [b for b in block_ids if b not in done]
+        self.log(
+            f"start {self.identifier}: {len(todo)}/{len(block_ids)} blocks to process"
+        )
+
+        self.prepare(blocking, config)
+        executor = get_executor(config["target"], config)
+
+        max_retries = int(config.get("max_num_retries", 0))
+        failure_fraction = float(config.get("retry_failure_fraction", 0.5))
+        runtimes: List[float] = list(status.get("block_runtimes", []))
+
+        attempt = 0
+        while todo:
+            t0 = time.time()
+            newly_done, failed, errors = executor.run_blocks(
+                self, blocking, todo, config
+            )
+            runtimes.append(time.time() - t0)
+            done.update(newly_done)
+            self._write_status(target, block_ids, done, failed, runtimes, False)
+            for bid, err in errors.items():
+                self.log(f"block {bid} failed: {err}")
+            if not failed:
+                break
+            frac = len(failed) / max(len(block_ids), 1)
+            if attempt >= max_retries:
+                raise FailedBlocksError(
+                    f"{self.identifier}: {len(failed)} blocks failed after "
+                    f"{attempt + 1} attempts; see {self.log_path}"
+                )
+            if not self.allow_retry:
+                raise FailedBlocksError(
+                    f"{self.identifier}: {len(failed)} blocks failed and task "
+                    "does not allow retry"
+                )
+            if frac >= failure_fraction:
+                # reference heuristic: too many failures means something fundamental
+                # broke — don't burn retries (cluster_tasks.py:140-142)
+                raise FailedBlocksError(
+                    f"{self.identifier}: {len(failed)}/{len(block_ids)} blocks failed "
+                    f"(≥{failure_fraction:.0%}) — refusing retry"
+                )
+            attempt += 1
+            self.log(f"retry {attempt}/{max_retries}: {len(failed)} failed blocks")
+            todo = failed
+
+        self.finalize(blocking, config, block_ids)
+        self._write_status(target, block_ids, done, [], runtimes, True)
+        self.log(f"done {self.identifier} in {time.time() - t_start:.2f}s")
+
+    def _write_status(self, target, block_ids, done, failed, runtimes, complete):
+        target.write(
+            {
+                "task": self.identifier,
+                "n_blocks": len(block_ids),
+                "done": sorted(int(b) for b in done),
+                "failed": sorted(int(b) for b in failed),
+                "block_runtimes": [float(r) for r in runtimes],
+                "complete": bool(complete),
+            }
+        )

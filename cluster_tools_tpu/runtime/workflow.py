@@ -1,0 +1,101 @@
+"""Workflow DAG runner: topological execution with resume-from-checkpoint.
+
+The analog of running luigi with the local scheduler in the reference
+(reference workflows.py + cluster_tasks.py:644-675): a workflow's ``requires()``
+builds a dependency chain; ``build([task])`` executes incomplete tasks in
+topological order, skipping tasks whose completion target already exists —
+re-running a workflow resumes from the first incomplete task.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from . import config as cfg
+from .task import Target, Task
+
+
+class WorkflowBase(Task):
+    """A composite task: ``requires()`` returns the dependency chain, completion
+    mirrors the last member task (reference cluster_tasks.py:667-669)."""
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        target: Optional[str] = None,
+        dependencies: Sequence[Task] = (),
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, dependencies)
+        self.target = target  # informational; the global config decides
+
+    def run(self) -> None:
+        pass  # members do the work
+
+    def output(self) -> Target:
+        reqs = list(self.requires())
+        if reqs:
+            return reqs[-1].output()
+        return super().output()
+
+    def complete(self) -> bool:
+        reqs = list(self.requires())
+        if reqs:
+            return all(r.complete() for r in reqs)
+        return super().complete()
+
+    @classmethod
+    def get_config(cls) -> Dict[str, dict]:
+        """Default configs of all member tasks, for users to edit and write to the
+        config dir (reference workflows.py:102-107)."""
+        return {"global": dict(cfg.DEFAULT_GLOBAL_CONFIG)}
+
+
+def _toposort(roots: Sequence[Task]) -> List[Task]:
+    order: List[Task] = []
+    seen: Dict[str, Task] = {}
+    visiting: set = set()
+
+    def visit(task: Task) -> None:
+        key = f"{type(task).__module__}.{type(task).__qualname__}:{task.output().path}"
+        if key in seen:
+            return
+        if key in visiting:
+            raise RuntimeError(f"dependency cycle at {task!r}")
+        visiting.add(key)
+        for dep in task.requires():
+            visit(dep)
+        visiting.discard(key)
+        seen[key] = task
+        order.append(task)
+
+    for t in roots:
+        visit(t)
+    return order
+
+
+def build(tasks: Sequence[Task], raise_on_failure: bool = True) -> bool:
+    """Run a set of root tasks and their dependencies.  Returns success."""
+    order = _toposort(tasks)
+    for task in order:
+        if task.complete():
+            continue
+        try:
+            task.run()
+        except Exception:
+            if raise_on_failure:
+                raise
+            import traceback
+
+            traceback.print_exc()
+            return False
+        if isinstance(task, WorkflowBase):
+            continue
+        if not task.complete():
+            msg = f"task {task!r} ran but did not reach completion"
+            if raise_on_failure:
+                raise RuntimeError(msg)
+            print(msg)
+            return False
+    return True

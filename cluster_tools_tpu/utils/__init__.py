@@ -1,0 +1,17 @@
+from .blocking import (
+    Blocking,
+    blocks_in_volume,
+    block_to_bb,
+    make_checkerboard_block_lists,
+)
+from . import store
+from .store import file_reader
+
+__all__ = [
+    "Blocking",
+    "blocks_in_volume",
+    "block_to_bb",
+    "make_checkerboard_block_lists",
+    "store",
+    "file_reader",
+]

@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax is imported.
+
+Multi-chip sharding is validated on virtual CPU devices (no multi-chip TPU hardware
+in CI); the real-TPU path is exercised by bench.py.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_env(tmp_path):
+    """tmp_folder + config_dir pair with a default global config written."""
+    from cluster_tools_tpu.runtime import config as cfg
+
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "configs")
+    os.makedirs(tmp_folder, exist_ok=True)
+    cfg.write_global_config(config_dir, {"block_shape": [16, 32, 32]})
+    return tmp_folder, config_dir

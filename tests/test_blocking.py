@@ -1,0 +1,74 @@
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.utils.blocking import (
+    Blocking,
+    blocks_in_volume,
+    make_checkerboard_block_lists,
+)
+
+
+def test_grid_shape_and_ids():
+    b = Blocking((100, 100, 100), (50, 30, 100))
+    assert b.grid_shape == (2, 4, 1)
+    assert b.n_blocks == 8
+    for bid in range(b.n_blocks):
+        assert b.block_id_from_grid_position(b.block_grid_position(bid)) == bid
+
+
+def test_blocks_cover_volume_disjointly():
+    shape = (53, 41, 17)
+    b = Blocking(shape, (16, 16, 16))
+    cover = np.zeros(shape, dtype=np.int32)
+    for bid in range(b.n_blocks):
+        cover[b.block(bid).slicing] += 1
+    assert (cover == 1).all()
+
+
+def test_halo_geometry():
+    b = Blocking((100, 100), (50, 50))
+    bh = b.block_with_halo(3, (10, 10))  # last block, clipped at upper border
+    assert bh.inner.begin == (50, 50)
+    assert bh.outer.begin == (40, 40)
+    assert bh.outer.end == (100, 100)
+    assert bh.inner_local.begin == (10, 10)
+    assert bh.inner_local.end == (60, 60)
+    # interior block of a 3x3 grid has symmetric halo
+    b2 = Blocking((150, 150), (50, 50))
+    bh2 = b2.block_with_halo(4, (5, 5))
+    assert bh2.outer.begin == (45, 45) and bh2.outer.end == (105, 105)
+    assert bh2.inner_local.begin == (5, 5) and bh2.inner_local.end == (55, 55)
+
+
+def test_neighbors_and_faces():
+    b = Blocking((100, 100), (50, 50))
+    assert b.neighbor_id(0, 0, lower=True) is None
+    assert b.neighbor_id(0, 0, lower=False) == 2
+    assert b.neighbor_id(0, 1, lower=False) == 1
+    faces = list(b.iterate_faces(0))
+    assert len(faces) == 2
+    axis, ngb, bb = faces[0]
+    assert axis == 0 and ngb == 2
+    assert bb.begin == (49, 0) and bb.end == (51, 50)
+    # upper-right block has no upper faces
+    assert list(b.iterate_faces(3)) == []
+
+
+def test_roi_restriction():
+    shape = (100, 100, 100)
+    ids = blocks_in_volume(shape, (50, 50, 50), (0, 0, 0), (50, 100, 100))
+    assert ids == [0, 1, 2, 3]
+    ids = blocks_in_volume(shape, (50, 50, 50), (25, 25, 25), (75, 75, 75))
+    assert ids == list(range(8))
+
+
+def test_checkerboard_no_adjacent_same_color():
+    b = Blocking((90, 90, 90), (30, 30, 30))
+    white, black = make_checkerboard_block_lists(b)
+    assert len(white) + len(black) == b.n_blocks
+    wset = set(white)
+    for bid in white:
+        for axis in range(3):
+            for lower in (True, False):
+                ngb = b.neighbor_id(bid, axis, lower)
+                assert ngb is None or ngb not in wset

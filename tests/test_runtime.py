@@ -1,0 +1,149 @@
+"""Runtime tests: task lifecycle, resume, retry with fault injection.
+
+The fault-injection pattern mirrors the reference's test/retry/failing_task.py
+(odd blocks fail on the first attempt; the retry machinery must re-run exactly
+those and converge).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.runtime.task import BlockTask, FailedBlocksError
+from cluster_tools_tpu.runtime.workflow import WorkflowBase
+
+
+class RecordingTask(BlockTask):
+    task_name = "recording"
+
+    def __init__(self, *args, shape=(32, 32, 32), out=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.shape = shape
+        self.out = out if out is not None else {}
+
+    def get_shape(self):
+        return self.shape
+
+    def process_block(self, block_id, blocking, config):
+        self.out.setdefault("calls", []).append(block_id)
+
+
+class FailingTask(RecordingTask):
+    task_name = "failing"
+
+    def process_block(self, block_id, blocking, config):
+        attempts = self.out.setdefault("attempts", {})
+        n = attempts.get(block_id, 0)
+        attempts[block_id] = n + 1
+        if block_id % 2 == 1 and n == 0:
+            raise RuntimeError(f"injected failure for block {block_id}")
+        self.out.setdefault("calls", []).append(block_id)
+
+
+def test_block_task_runs_all_blocks(tmp_env):
+    tmp_folder, config_dir = tmp_env
+    out = {}
+    t = RecordingTask(tmp_folder, config_dir, out=out)
+    build([t])
+    assert sorted(out["calls"]) == [0, 1]  # (32,32,32) / (16,32,32) = 2 blocks
+    status = t.output().read()
+    assert status["complete"] and len(status["done"]) == len(out["calls"])
+
+
+def test_retry_reruns_only_failed_blocks(tmp_env):
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(
+        config_dir,
+        {"block_shape": [8, 16, 16], "max_num_retries": 2,
+         # half the blocks fail on attempt 1; allow retry anyway
+         "retry_failure_fraction": 0.6},
+    )
+    out = {}
+    t = FailingTask(tmp_folder, config_dir, shape=(32, 32, 32), out=out)
+    build([t])
+    n_blocks = 4 * 2 * 2
+    assert sorted(out["calls"]) == list(range(n_blocks))
+    # odd blocks ran twice, even blocks once
+    for bid, n in out["attempts"].items():
+        assert n == (2 if bid % 2 == 1 else 1)
+
+
+def test_no_retry_raises(tmp_env):
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(
+        config_dir, {"block_shape": [8, 16, 16], "max_num_retries": 0,
+                     "retry_failure_fraction": 0.9}
+    )
+    t = FailingTask(tmp_folder, config_dir, shape=(32, 32, 32), out={})
+    with pytest.raises(FailedBlocksError):
+        build([t])
+    # status file records the failed blocks for inspection
+    status = t.output().read()
+    assert status["failed"] and not status["complete"]
+
+
+def test_resume_skips_done_blocks(tmp_env):
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+    out = {}
+    t = RecordingTask(tmp_folder, config_dir, shape=(32, 32, 32), out=out)
+    build([t])
+    first = len(out["calls"])
+    # a second build must skip the completed task entirely
+    build([RecordingTask(tmp_folder, config_dir, shape=(32, 32, 32), out=out)])
+    assert len(out["calls"]) == first
+
+
+def test_partial_resume_after_failure(tmp_env):
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(
+        config_dir, {"block_shape": [8, 16, 16], "max_num_retries": 0,
+                     "retry_failure_fraction": 0.9}
+    )
+    out = {}
+    t = FailingTask(tmp_folder, config_dir, shape=(32, 32, 32), out=out)
+    with pytest.raises(FailedBlocksError):
+        build([t])
+    done_first = set(t.output().read()["done"])
+    # re-running processes only the blocks that had failed
+    t2 = FailingTask(tmp_folder, config_dir, shape=(32, 32, 32), out=out)
+    build([t2])
+    assert set(t2.output().read()["done"]) == set(range(16))
+    reran = [b for b, n in out["attempts"].items() if n > 1]
+    assert set(reran).isdisjoint(done_first)
+
+
+def test_workflow_chain_order(tmp_env):
+    tmp_folder, config_dir = tmp_env
+    calls = []
+
+    class A(RecordingTask):
+        task_name = "task_a"
+
+        def process_block(self, block_id, blocking, config):
+            calls.append(("a", block_id))
+
+    class B(RecordingTask):
+        task_name = "task_b"
+
+        def process_block(self, block_id, blocking, config):
+            calls.append(("b", block_id))
+
+    class WF(WorkflowBase):
+        task_name = "wf"
+
+        def requires(self):
+            a = A(self.tmp_folder, self.config_dir)
+            b = B(self.tmp_folder, self.config_dir, dependencies=[a])
+            return [b]
+
+    wf = WF(tmp_folder, config_dir)
+    build([wf])
+    names = [c[0] for c in calls]
+    assert set(names) == {"a", "b"}
+    assert names.index("b") > names.index("a")  # all a's before any b
+    assert names == sorted(names)
+    assert wf.complete()

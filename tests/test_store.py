@@ -1,0 +1,154 @@
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.utils.store import File, RaggedDataset, file_reader
+
+
+@pytest.mark.parametrize("ext", [".zarr", ".n5"])
+@pytest.mark.parametrize(
+    "dtype", [np.uint8, np.uint32, np.uint64, np.int64, np.float32, np.float64]
+)
+def test_roundtrip(tmp_path, rng, ext, dtype):
+    path = str(tmp_path / f"data{ext}")
+    shape, chunks = (40, 33, 17), (16, 16, 16)
+    if np.issubdtype(dtype, np.floating):
+        data = rng.random(shape).astype(dtype)
+    else:
+        data = rng.integers(0, 200, shape).astype(dtype)
+    with file_reader(path) as f:
+        ds = f.create_dataset("vol", shape=shape, dtype=dtype, chunks=chunks)
+        ds[:] = data
+    with file_reader(path, "r") as f:
+        ds = f["vol"]
+        assert ds.shape == shape and ds.chunks == chunks and ds.dtype == dtype
+        np.testing.assert_array_equal(ds[:], data)
+        # partial, non-chunk-aligned read
+        np.testing.assert_array_equal(ds[3:29, 5:33, 2:17], data[3:29, 5:33, 2:17])
+
+
+@pytest.mark.parametrize("ext", [".zarr", ".n5"])
+def test_partial_write_rmw(tmp_path, rng, ext):
+    path = str(tmp_path / f"data{ext}")
+    shape = (20, 20)
+    data = rng.integers(0, 100, shape).astype(np.uint32)
+    f = file_reader(path)
+    ds = f.create_dataset("x", shape=shape, dtype=np.uint32, chunks=(8, 8))
+    ds[:] = data
+    # overwrite an unaligned region and check the rest is intact
+    patch = rng.integers(100, 200, (7, 9)).astype(np.uint32)
+    ds[5:12, 3:12] = patch
+    expected = data.copy()
+    expected[5:12, 3:12] = patch
+    np.testing.assert_array_equal(ds[:], expected)
+
+
+def test_unwritten_chunks_read_as_fill(tmp_path):
+    f = file_reader(str(tmp_path / "d.zarr"))
+    ds = f.create_dataset("x", shape=(10, 10), dtype=np.float32, chunks=(4, 4))
+    np.testing.assert_array_equal(ds[:], np.zeros((10, 10), dtype=np.float32))
+    assert ds.read_chunk((0, 0)) is None
+
+
+def test_chunk_level_io(tmp_path, rng):
+    f = file_reader(str(tmp_path / "d.zarr"))
+    ds = f.create_dataset("x", shape=(10, 10), dtype=np.uint64, chunks=(4, 4))
+    edge = rng.integers(0, 9, (2, 2)).astype(np.uint64)  # clipped edge chunk
+    ds.write_chunk((2, 2), edge)
+    np.testing.assert_array_equal(ds.read_chunk((2, 2)), edge)
+    np.testing.assert_array_equal(ds[8:10, 8:10], edge)
+
+
+def test_groups_and_attrs(tmp_path):
+    for ext in (".zarr", ".n5"):
+        f = file_reader(str(tmp_path / f"g{ext}"))
+        grp = f.require_group("volumes/seg")
+        ds = grp.create_dataset("s0", shape=(8, 8), dtype=np.uint8, chunks=(4, 4))
+        ds.attrs["maxId"] = 41
+        f.attrs["global"] = [1, 2, 3]
+        f2 = file_reader(str(tmp_path / f"g{ext}"), "r")
+        assert "volumes" in f2
+        assert f2["volumes/seg"]["s0"].attrs["maxId"] == 41
+        assert f2.attrs["global"] == [1, 2, 3]
+        assert f2["volumes/seg/s0"].shape == (8, 8)
+
+
+def test_scalar_broadcast_assignment(tmp_path):
+    f = file_reader(str(tmp_path / "d.zarr"))
+    ds = f.create_dataset("x", shape=(6, 6), dtype=np.int32, chunks=(4, 4))
+    ds[1:5, 1:5] = 7
+    expected = np.zeros((6, 6), np.int32)
+    expected[1:5, 1:5] = 7
+    np.testing.assert_array_equal(ds[:], expected)
+
+
+def test_ragged_dataset(tmp_path, rng):
+    f = file_reader(str(tmp_path / "d.zarr"))
+    rd = f.create_ragged_dataset("edges", grid_shape=(2, 2), dtype=np.int64)
+    a = rng.integers(0, 100, 17).astype(np.int64)
+    rd.write_chunk((0, 1), a)
+    rd.write_chunk(3, np.array([], dtype=np.int64))
+    # reopen through the group API
+    rd2 = file_reader(str(tmp_path / "d.zarr"))["edges"]
+    np.testing.assert_array_equal(rd2.read_chunk((0, 1)), a)
+    assert rd2.read_chunk((1, 1)).size == 0
+    assert rd2.read_chunk((0, 0)) is None
+
+
+def test_n5_zarr_cross_metadata(tmp_path):
+    # n5 metadata must be reversed relative to numpy
+    import json, os
+
+    f = file_reader(str(tmp_path / "d.n5"))
+    f.create_dataset("x", shape=(10, 20, 30), dtype=np.uint16, chunks=(5, 10, 15))
+    with open(tmp_path / "d.n5" / "x" / "attributes.json") as fh:
+        meta = json.load(fh)
+    assert meta["dimensions"] == [30, 20, 10]
+    assert meta["blockSize"] == [15, 10, 5]
+    assert meta["dataType"] == "uint16"
+
+
+def test_readonly_mode_enforced(tmp_path):
+    f = file_reader(str(tmp_path / "d.zarr"))
+    f.create_dataset("x", shape=(4, 4), dtype=np.uint8, chunks=(4, 4))
+    ro = file_reader(str(tmp_path / "d.zarr"), "r")
+    with pytest.raises(PermissionError):
+        ro.create_dataset("y", shape=(4, 4), dtype=np.uint8)
+    with pytest.raises(PermissionError):
+        ro["x"][:] = 1
+    np.testing.assert_array_equal(ro["x"][:], np.zeros((4, 4), np.uint8))
+
+
+def test_dimension_separator_slash(tmp_path):
+    # zarr arrays written by other tools commonly use dimension_separator "/"
+    import json, os
+
+    root = tmp_path / "d.zarr" / "x"
+    os.makedirs(root)
+    meta = {
+        "zarr_format": 2, "shape": [4, 4], "chunks": [2, 2], "dtype": "<u2",
+        "compressor": None, "fill_value": 3, "order": "C", "filters": None,
+        "dimension_separator": "/",
+    }
+    with open(root / ".zarray", "w") as fh:
+        json.dump(meta, fh)
+    os.makedirs(root / "1")
+    chunk = np.arange(4, dtype="<u2").reshape(2, 2)
+    with open(root / "1" / "0", "wb") as fh:
+        fh.write(chunk.tobytes())
+    with open(tmp_path / "d.zarr" / ".zgroup", "w") as fh:
+        json.dump({"zarr_format": 2}, fh)
+    ds = file_reader(str(tmp_path / "d.zarr"), "r")["x"]
+    np.testing.assert_array_equal(ds[2:4, 0:2], chunk)
+    # unwritten chunks honor fill_value
+    assert (ds[0:2, 0:2] == 3).all()
+
+
+def test_int_index_drops_axis(tmp_path, rng):
+    f = file_reader(str(tmp_path / "d.zarr"))
+    data = rng.integers(0, 99, (6, 5, 4)).astype(np.int32)
+    ds = f.create_dataset("x", data=data, chunks=(3, 3, 3))
+    np.testing.assert_array_equal(ds[2], data[2])
+    np.testing.assert_array_equal(ds[-1], data[-1])
+    np.testing.assert_array_equal(ds[1:3, -2], data[1:3, -2])
+    with pytest.raises(IndexError):
+        ds[7]
