@@ -1,0 +1,20 @@
+"""JAX/XLA kernel library — the TPU replacement for the reference's native layer.
+
+Every kernel here substitutes a C++ dependency of the reference (vigra / nifty /
+fastfilters / affogato — see SURVEY.md §2.10 for the full checklist) with a
+jit-compilable, statically-shaped XLA program:
+
+  * filters   — separable gaussian / min / max convolutions (vigra+fastfilters)
+  * dt        — Euclidean distance transform (vigra.filters.distanceTransform)
+  * cc        — connected components (skimage.morphology.label / vigra labelVolume)
+  * watershed — seeds + seeded minimax-flood watershed (vigra watershedsNew)
+  * segment   — segment reductions, contingency tables (nifty accumulators)
+  * relabel   — consecutive relabeling (vigra.relabelConsecutive)
+
+All kernels take/return plain arrays, are free of data-dependent Python control
+flow (lax.while_loop / scan inside), and are written to batch with vmap.
+"""
+
+from . import cc, dt, filters, relabel, segment, watershed
+
+__all__ = ["cc", "dt", "filters", "relabel", "segment", "watershed"]

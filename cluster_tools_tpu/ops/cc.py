@@ -1,0 +1,127 @@
+"""Connected components as an XLA program.
+
+Replaces skimage.morphology.label / vigra.labelVolumeWithBackground
+(reference thresholded_components/block_components.py:143-182,
+watershed/watershed.py:206,331).
+
+Algorithm (TPU-friendly, no data-dependent shapes): iterative *min-label
+propagation* over the neighborhood, accelerated by *pointer jumping* — after each
+local propagation every voxel re-gathers the label of the voxel its label points to,
+so label information travels exponentially per iteration (O(log diameter)
+iterations instead of O(diameter)).  This is the same union-find-by-minimum idea a
+parallel CC on GPUs uses (coarse-to-fine CCL literature), expressed as pure
+gather/min ops inside a ``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from itertools import product
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def neighbor_offsets(ndim: int, connectivity: int) -> np.ndarray:
+    """All neighbor offsets with 1 ≤ #nonzero-coords ≤ connectivity
+    (connectivity=1 → faces, ndim → full Moore neighborhood)."""
+    offs = [
+        o
+        for o in product((-1, 0, 1), repeat=ndim)
+        if 0 < sum(c != 0 for c in o) <= connectivity
+    ]
+    return np.array(offs, dtype=np.int32)
+
+
+def _shift(x: jnp.ndarray, offset, fill) -> jnp.ndarray:
+    """x shifted so out[p] = x[p + offset], `fill` outside."""
+    out = x
+    for axis, o in enumerate(offset):
+        if o == 0:
+            continue
+        out = jnp.roll(out, -o, axis=axis)
+        idx = [slice(None)] * x.ndim
+        # out[p] = x[p+o] is invalid where p+o leaves the axis: the first |o|
+        # entries for o<0, the last o entries for o>0
+        idx[axis] = slice(0, -o) if o < 0 else slice(x.shape[axis] - o, None)
+        out = out.at[tuple(idx)].set(fill)
+    return out
+
+
+@partial(jax.jit, static_argnames=("connectivity",))
+def connected_components_raw(
+    mask: jnp.ndarray, connectivity: int = 1
+) -> jnp.ndarray:
+    """Label foreground components of ``mask``.
+
+    Returns int32 labels where background = -1 and each component carries the
+    *minimal flat index* of its voxels — not consecutive; compose with
+    ``relabel.relabel_consecutive`` (or host np.unique) for 1..N labels.
+    """
+    shape = mask.shape
+    size = int(np.prod(shape))
+    sentinel = jnp.int32(size)
+    flat_ids = jnp.arange(size, dtype=jnp.int32).reshape(shape)
+    init = jnp.where(mask, flat_ids, sentinel)
+    offsets = neighbor_offsets(mask.ndim, connectivity)
+
+    def propagate(label):
+        best = label
+        for off in offsets:
+            neigh = _shift(label, off, sentinel)
+            best = jnp.minimum(best, jnp.where(mask, neigh, sentinel))
+        return jnp.where(mask, best, sentinel)
+
+    def jump(label):
+        # label[p] <- label[label[p]]: pointer jumping through the flat volume
+        flat = jnp.append(label.reshape(-1), sentinel)  # sentinel self-loops
+        jumped = flat[label.reshape(-1)].reshape(label.shape)
+        return jnp.where(mask, jumped, sentinel)
+
+    def cond(state):
+        label, prev_changed = state
+        return prev_changed
+
+    def body(state):
+        label, _ = state
+        new = propagate(label)
+        new = jump(jump(new))
+        return (new, jnp.any(new != label))
+
+    label, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
+    return jnp.where(mask, label, jnp.int32(-1))
+
+
+@partial(jax.jit, static_argnames=("connectivity",))
+def connected_components(
+    mask: jnp.ndarray, connectivity: int = 1
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Consecutive component labeling: background 0, components 1..n.
+
+    Returns ``(labels, n_components)``.  Consecutive ids come from ranking the
+    component roots (minimal flat indices) with a cumsum — no dynamic shapes.
+    """
+    raw = connected_components_raw(mask, connectivity)
+    size = int(np.prod(mask.shape))
+    flat = raw.reshape(-1)
+    # roots are voxels whose label equals their own flat index
+    is_root = flat == jnp.arange(size, dtype=jnp.int32)
+    # rank roots in flat-index order → consecutive ids 1..n
+    root_rank = jnp.cumsum(is_root.astype(jnp.int32))
+    n = root_rank[-1] if size > 0 else jnp.int32(0)
+    # every voxel looks up the rank of its root
+    safe = jnp.clip(flat, 0, size - 1)
+    labels = jnp.where(flat >= 0, root_rank[safe], 0).reshape(mask.shape)
+    return labels.astype(jnp.int32), n.astype(jnp.int32)
+
+
+def connected_components_np(mask: np.ndarray, connectivity: int = 1):
+    """Host oracle via scipy (used by tests and the local parity path)."""
+    from scipy import ndimage
+
+    structure = ndimage.generate_binary_structure(mask.ndim, connectivity)
+    labels, n = ndimage.label(mask, structure=structure)
+    return labels.astype(np.int32), int(n)

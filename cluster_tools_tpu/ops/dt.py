@@ -1,0 +1,122 @@
+"""Euclidean distance transform as an XLA program.
+
+Replaces vigra.filters.distanceTransform (reference watershed/watershed.py:155-159,
+distances/object_distances.py:112).
+
+The squared EDT is separable over axes as a min-plus ("parabola") reduction:
+
+    g_axis(i) = min_j [ f(j) + pitch² · (i-j)² ]
+
+The first axis is seeded with exact 1d line distances (two directional scans);
+every further axis applies the parabola reduction.  On TPU the reduction is
+evaluated as a *tiled dense min-plus product* — a (i, j) cost tile broadcast +
+min-reduce, scanned over j-tiles so peak memory stays bounded — instead of the
+sequential lower-envelope algorithm (Felzenszwalb), which does not vectorize.
+O(n²) work per axis but fully parallel on the VPU; block side lengths are ≤512
+so the constant is small.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_BIG = jnp.float32(1e10)
+
+
+def _line_scan_distance(bg: jnp.ndarray, pitch: float) -> jnp.ndarray:
+    """Exact 1d distance (in `pitch` units) to the nearest True along the last axis."""
+
+    def directional(b):
+        def step(carry, is_bg):
+            d = jnp.where(is_bg, 0.0, carry + pitch)
+            return d, d
+
+        init = jnp.full(b.shape[:-1], _BIG, dtype=jnp.float32)
+        _, ds = lax.scan(step, init, jnp.moveaxis(b, -1, 0))
+        return jnp.moveaxis(ds, 0, -1)
+
+    fwd = directional(bg)
+    bwd = jnp.flip(directional(jnp.flip(bg, -1)), -1)
+    return jnp.minimum(fwd, bwd)
+
+
+def _parabola_pass(f: jnp.ndarray, pitch: float, tile: int) -> jnp.ndarray:
+    """g(i) = min_j f(j) + (pitch·(i-j))² along the last axis, j-tiled."""
+    n = f.shape[-1]
+    n_pad = -n % tile
+    fp = jnp.concatenate(
+        [f, jnp.full(f.shape[:-1] + (n_pad,), _BIG, f.dtype)], axis=-1
+    ) if n_pad else f
+    n_t = fp.shape[-1] // tile
+    i_idx = jnp.arange(n, dtype=jnp.float32)
+    f_tiles = jnp.moveaxis(fp.reshape(f.shape[:-1] + (n_t, tile)), -2, 0)
+
+    def step(carry, inputs):
+        f_tile, j0 = inputs  # f_tile: (..., tile)
+        j_idx = j0 + jnp.arange(tile, dtype=jnp.float32)
+        # cost: (..., n_i, tile)
+        diff = (i_idx[:, None] - j_idx[None, :]) * pitch
+        cost = f_tile[..., None, :] + diff * diff
+        carry = jnp.minimum(carry, cost.min(axis=-1))
+        return carry, None
+
+    init = jnp.full(f.shape[:-1] + (n,), _BIG, f.dtype)
+    j0s = (jnp.arange(n_t) * tile).astype(jnp.float32)
+    out, _ = lax.scan(step, init, (f_tiles, j0s))
+    return out
+
+
+def distance_transform(
+    fg: jnp.ndarray,
+    pixel_pitch: Optional[Sequence[float]] = None,
+    tile: int = 32,
+) -> jnp.ndarray:
+    """Euclidean distance of each True voxel to the nearest False voxel.
+
+    ``pixel_pitch`` gives per-axis anisotropic spacing (reference ws config
+    ``pixel_pitch``, watershed.py:149-159).  Matches
+    scipy.ndimage.distance_transform_edt(sampling=pixel_pitch).
+    """
+    if pixel_pitch is not None:
+        pixel_pitch = tuple(float(p) for p in pixel_pitch)
+    return _distance_transform(fg, pixel_pitch, tile)
+
+
+@partial(jax.jit, static_argnames=("pixel_pitch", "tile"))
+def _distance_transform(
+    fg: jnp.ndarray,
+    pixel_pitch: Optional[Sequence[float]] = None,
+    tile: int = 32,
+) -> jnp.ndarray:
+    ndim = fg.ndim
+    pitch = (1.0,) * ndim if pixel_pitch is None else tuple(float(p) for p in pixel_pitch)
+    if len(pitch) != ndim:
+        raise ValueError(f"pixel_pitch must have {ndim} entries")
+    bg = ~fg.astype(bool)
+
+    # axis 0 (as last): exact line distances, squared
+    x = jnp.moveaxis(bg, 0, -1)
+    g = _line_scan_distance(x, pitch[0]) ** 2
+    g = jnp.moveaxis(g, -1, 0)
+
+    for axis in range(1, ndim):
+        g = jnp.moveaxis(g, axis, -1)
+        g = _parabola_pass(g, pitch[axis], tile)
+        g = jnp.moveaxis(g, -1, axis)
+    return jnp.sqrt(jnp.minimum(g, _BIG)).astype(jnp.float32)
+
+
+def distance_transform_2d_stack(
+    fg: jnp.ndarray, pixel_pitch: Optional[Sequence[float]] = None, tile: int = 32
+) -> jnp.ndarray:
+    """Per-z-slice 2d distance transform (the reference's ``two_d`` watershed
+    mode, watershed.py:140-150): vmap of the 2d kernel over the stack axis."""
+    pitch = None if pixel_pitch is None else tuple(pixel_pitch)
+    fn = partial(distance_transform, pixel_pitch=pitch, tile=tile)
+    return jax.vmap(fn)(fg)

@@ -13,6 +13,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# this image injects a TPU platform plugin via sitecustomize that pre-imports jax
+# and pins JAX_PLATFORMS=axon; the env var alone is too late, force it via config
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 
