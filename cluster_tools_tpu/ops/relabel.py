@@ -25,6 +25,11 @@ def relabel_consecutive(
     < iinfo(dtype).max, which is used as the pad sentinel).  With ``keep_zero``
     label 0 stays 0 and the others become 1..n; otherwise ranks are 0..n-1.
     Returns ``(relabeled, n_labels)`` where n excludes zero when ``keep_zero``.
+
+    Overflow contract: if the input holds more than ``max_labels`` distinct
+    values, the surplus labels alias together (a jitted kernel cannot raise on
+    data).  Callers MUST treat ``n_labels == max_labels`` (or == max_labels - 1
+    with ``keep_zero``) as saturation and re-run with a larger bound.
     """
     flat = labels.reshape(-1)
     # sentinel must be an array of the label dtype: a Python-int iinfo.max would
@@ -66,6 +71,9 @@ def apply_assignment_table_np(
 ) -> np.ndarray:
     """Apply a 2-column (old_id, new_id) assignment table
     (reference write.py:157-181 'node label assignment' modes)."""
+    if table.shape[0] == 0:
+        out = np.zeros_like(labels) if default_zero else labels.copy()
+        return out
     old, new = table[:, 0], table[:, 1]
     order = np.argsort(old)
     old, new = old[order], new[order]

@@ -34,7 +34,7 @@ import numpy as np
 from jax import lax
 
 from .cc import connected_components, neighbor_offsets, _shift
-from .filters import gaussian, maximum_filter
+from .filters import gaussian, maximum_filter, normalize
 
 _BIG = jnp.float32(3.0e38)
 
@@ -132,7 +132,7 @@ def make_hmap(
 ) -> jnp.ndarray:
     """Height map α·input + (1-α)·(1 - normalize(dt))
     (reference ``_make_hmap``, watershed.py:164-170)."""
-    dtn = dt / jnp.maximum(dt.max(), 1e-6)
+    dtn = normalize(dt)
     hmap = alpha * input_ + (1.0 - alpha) * (1.0 - dtn)
     if sigma and sigma > 0:
         hmap = gaussian(hmap, sigma)
@@ -150,7 +150,10 @@ def apply_size_filter(
 ) -> jnp.ndarray:
     """Remove segments smaller than ``size_filter`` voxels and re-flood the freed
     voxels from the surviving segments (reference ``_apply_watershed``
-    size-filter step, watershed.py:242-250)."""
+    size-filter step, watershed.py:242-250).
+
+    ``num_segments`` is the *exclusive* upper bound on label values, i.e.
+    max_label + 1 (pass ``n + 1`` for labels 1..n from dt_seeds)."""
     counts = jnp.bincount(labels.reshape(-1), length=num_segments)
     too_small = counts[labels] < size_filter
     kept = jnp.where(too_small, 0, labels)

@@ -1,0 +1,11 @@
+from .mesh import get_mesh, shard_batch, local_device_count
+from .dispatch import BlockBatch, read_block_batch, write_block_batch
+
+__all__ = [
+    "get_mesh",
+    "shard_batch",
+    "local_device_count",
+    "BlockBatch",
+    "read_block_batch",
+    "write_block_batch",
+]

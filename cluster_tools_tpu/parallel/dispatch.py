@@ -1,0 +1,94 @@
+"""Host↔device block batching: read N blocks, run one jit program, write back.
+
+The static-shape contract: every block in a batch is padded to the full
+(halo-extended) block shape so XLA compiles exactly one program per block
+geometry; validity masks carry the true extent.  Edge blocks therefore cost the
+same as interior blocks — the TPU trade the reference never has to make, but the
+win is that a whole batch is one dispatch instead of N python loop iterations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.blocking import Blocking, BlockWithHalo
+
+
+@dataclass
+class BlockBatch:
+    """A stacked batch of (possibly halo'd) blocks plus their geometry."""
+
+    data: np.ndarray  # [B, *padded_shape] (+ leading channel dim inside shape)
+    valid: np.ndarray  # [B, ndim, 2] valid [begin, end) inside the padded block
+    blocks: List[BlockWithHalo]
+    block_ids: List[int]
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.block_ids)
+
+
+def read_block_batch(
+    ds,
+    blocking: Blocking,
+    block_ids: Sequence[int],
+    halo: Optional[Sequence[int]] = None,
+    pad_to: Optional[int] = None,
+    dtype=None,
+) -> BlockBatch:
+    """Read blocks (outer boxes when ``halo``), pad each to the static shape,
+    stack.  ``pad_to`` pads the batch axis (repeating the last block) so the
+    batch divides the device count."""
+    ndim = blocking.ndim
+    halo = tuple(halo) if halo is not None else (0,) * ndim
+    full_shape = tuple(bs + 2 * h for bs, h in zip(blocking.block_shape, halo))
+
+    datas, valids, blocks, ids = [], [], [], []
+    for bid in block_ids:
+        bh = blocking.block_with_halo(bid, halo)
+        arr = ds[bh.outer.slicing]
+        if dtype is not None:
+            arr = arr.astype(dtype, copy=False)
+        pad_width = [(0, fs - s) for fs, s in zip(full_shape, arr.shape)]
+        if any(p[1] for p in pad_width):
+            arr = np.pad(arr, pad_width)
+        datas.append(arr)
+        valids.append([[0, e - b] for b, e in zip(bh.outer.begin, bh.outer.end)])
+        blocks.append(bh)
+        ids.append(bid)
+
+    if pad_to is not None and len(datas) % pad_to:
+        n_extra = pad_to - len(datas) % pad_to
+        for _ in range(n_extra):
+            datas.append(datas[-1])
+            valids.append(valids[-1])
+
+    return BlockBatch(
+        data=np.stack(datas),
+        valid=np.asarray(valids, dtype=np.int32),
+        blocks=blocks,
+        block_ids=list(ids),
+    )
+
+
+def write_block_batch(
+    ds,
+    batch: BlockBatch,
+    results: np.ndarray,
+    cast=None,
+) -> None:
+    """Write each block's *inner* region back (halo cropped, padding dropped).
+
+    Only the inner box is written — overlap is re-read, never written, the
+    reference's no-write-race construction (SURVEY.md §2.8.2).
+    """
+    for i, bh in enumerate(batch.blocks):
+        arr = results[i]
+        local = bh.inner_local
+        arr = np.asarray(arr[local.slicing])
+        if cast is not None:
+            arr = arr.astype(cast)
+        ds[bh.inner.slicing] = arr

@@ -1,0 +1,37 @@
+"""Device mesh utilities.
+
+Blocks are the unit of data parallelism (the analog of the reference's
+round-robin job assignment, cluster_tasks.py:331): a batch of blocks is stacked
+on the leading axis and sharded over a 1d ``data`` mesh; per-block kernels are
+vmapped so XLA compiles one program for the whole batch and partitions it over
+ICI.  Cross-block reductions (label merges, feature merges) then ride XLA
+collectives instead of the reference's filesystem round-trips (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def get_mesh(devices: Optional[Sequence] = None, axis_name: str = "data") -> Mesh:
+    """1d mesh over the given (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def shard_batch(batch, mesh: Optional[Mesh] = None, axis_name: str = "data"):
+    """Place a [B, ...] stacked block batch with the leading axis sharded over
+    the mesh.  B must be divisible by the mesh size (callers pad)."""
+    if mesh is None:
+        mesh = get_mesh(axis_name=axis_name)
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.device_put(batch, sharding)

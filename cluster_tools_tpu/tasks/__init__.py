@@ -1,0 +1,30 @@
+"""Task library: block-parallel tasks over chunked volumes.
+
+One module per component family, mirroring the reference's component inventory
+(SURVEY.md §2) re-expressed on the TPU runtime: per-block compute is a batched
+jit program, merges are host reductions (or device collectives), and every task
+records per-block completion for retry/resume.
+"""
+
+from .base import VolumeTask
+from .threshold import ThresholdTask
+from .thresholded_components import (
+    BlockComponentsTask,
+    MergeOffsetsTask,
+    BlockFacesTask,
+    MergeAssignmentsTask,
+)
+from .write import WriteTask
+from .relabel import FindUniquesTask, FindLabelingTask
+
+__all__ = [
+    "VolumeTask",
+    "ThresholdTask",
+    "BlockComponentsTask",
+    "MergeOffsetsTask",
+    "BlockFacesTask",
+    "MergeAssignmentsTask",
+    "WriteTask",
+    "FindUniquesTask",
+    "FindLabelingTask",
+]

@@ -1,0 +1,106 @@
+"""Shared plumbing for volume-to-volume block tasks."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..runtime.task import BlockTask, SimpleTask
+from ..utils import store
+from ..utils.blocking import Blocking
+
+
+class VolumeTask(BlockTask):
+    """A block task reading ``input_path/input_key`` and writing
+    ``output_path/output_key``.
+
+    The blocking is derived from the input dataset shape (the last ``ndim``
+    axes when the input carries leading channel axes).
+    """
+
+    output_dtype = None  # subclasses set to create the output dataset
+    output_chunks_from_blocks = True
+    space_ndim = 3  # spatial rank; inputs may have extra leading channel axes
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        dependencies: Sequence = (),
+        input_path: str = None,
+        input_key: str = None,
+        output_path: Optional[str] = None,
+        output_key: Optional[str] = None,
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+    # -- datasets ------------------------------------------------------------
+
+    def input_ds(self, mode: str = "r"):
+        return store.file_reader(self.input_path, mode)[self.input_key]
+
+    def output_ds(self, mode: str = "a"):
+        return store.file_reader(self.output_path, mode)[self.output_key]
+
+    def get_shape(self) -> Sequence[int]:
+        shape = self.input_ds().shape
+        return shape[-self.space_ndim :] if len(shape) > self.space_ndim else shape
+
+    def prepare(self, blocking: Blocking, config: Dict[str, Any]) -> None:
+        if self.output_path is None or self.output_dtype is None:
+            return
+        f = store.file_reader(self.output_path, "a")
+        chunks = (
+            tuple(blocking.block_shape)
+            if self.output_chunks_from_blocks
+            else None
+        )
+        f.require_dataset(
+            self.output_key,
+            shape=tuple(blocking.shape),
+            dtype=self.output_dtype,
+            chunks=chunks,
+            compression="gzip",
+        )
+
+    # -- scratch data --------------------------------------------------------
+
+    @property
+    def tmp_store_path(self) -> str:
+        return os.path.join(self.tmp_folder, "data.zarr")
+
+    def tmp_store(self):
+        return store.file_reader(self.tmp_store_path, "a")
+
+    def tmp_ragged(self, key: str, grid_size: int, dtype):
+        return self.tmp_store().create_ragged_dataset(key, (grid_size,), dtype)
+
+
+class VolumeSimpleTask(SimpleTask):
+    """Single-shot reduction task with access to the shared scratch store."""
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        dependencies: Sequence = (),
+        **params,
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, dependencies)
+        for k, v in params.items():
+            setattr(self, k, v)
+
+    @property
+    def tmp_store_path(self) -> str:
+        return os.path.join(self.tmp_folder, "data.zarr")
+
+    def tmp_store(self):
+        return store.file_reader(self.tmp_store_path, "a")

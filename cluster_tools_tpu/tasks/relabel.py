@@ -1,0 +1,63 @@
+"""Relabeling tasks: make block-offset labels consecutive.
+
+Reference relabel/{find_uniques,find_labeling}.py (SURVEY.md §2.4): per-block
+uniques → merged sparse id set → (old → consecutive new) assignment table →
+applied by the write task.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask
+
+UNIQUES_KEY = "relabel/uniques"
+LABELING_NAME = "relabel_assignments.npy"
+
+
+class FindUniquesTask(VolumeTask):
+    """Per-block unique labels → ragged scratch (reference find_uniques.py:26)."""
+
+    task_name = "find_uniques"
+    output_dtype = None
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        ds = self.input_ds()
+        bb = blocking.block(block_id).slicing
+        uniques = np.unique(ds[bb])
+        store = self.tmp_ragged(UNIQUES_KEY, blocking.n_blocks, np.uint64)
+        store.write_chunk((block_id,), uniques.astype(np.uint64))
+
+
+class FindLabelingTask(VolumeSimpleTask):
+    """Merge uniques → dense consecutive assignment table
+    (reference find_labeling.py:100-125)."""
+
+    task_name = "find_labeling"
+
+    def __init__(self, *args, n_blocks: int = None, **kwargs):
+        super().__init__(*args, n_blocks=n_blocks, **kwargs)
+
+    def run_impl(self) -> None:
+        uniques_ds = self.tmp_store()[UNIQUES_KEY]
+        collected = []
+        for bid in range(self.n_blocks):
+            chunk = uniques_ds.read_chunk((bid,))
+            if chunk is not None and chunk.size:
+                collected.append(chunk)
+        uniques = (
+            np.unique(np.concatenate(collected))
+            if collected
+            else np.array([], dtype=np.uint64)
+        )
+        nonzero = uniques[uniques > 0]
+        new_ids = np.arange(1, nonzero.size + 1, dtype=np.uint64)
+        table = np.stack([nonzero, new_ids], axis=1) if nonzero.size else np.zeros(
+            (0, 2), dtype=np.uint64
+        )
+        np.save(os.path.join(self.tmp_folder, LABELING_NAME), table)
+        self.log(f"relabeling {nonzero.size} ids to consecutive")

@@ -1,0 +1,209 @@
+"""Distributed connected components over thresholded volumes.
+
+The reference pipeline (SURVEY.md §3.4, thresholded_components/*.py):
+
+  1. block_components  — per block: threshold (+smooth) → CC label → write local
+                         labels, record per-block max id
+  2. merge_offsets     — exclusive prefix sum of max ids → per-block offsets
+  3. block_faces       — per inter-block face: touching (a+off_a, b+off_b) label
+                         pairs
+  4. merge_assignments — union-find over all pairs → dense assignment table
+  5. write             — apply offsets + assignment (tasks/write.py)
+
+Here step 1 is a device-batched jit program (CC is the pointer-jumping kernel,
+one dispatch per block batch); steps 2/4 are host reductions (1-job merge tasks
+in the reference too); step 3 reads thin face slabs host-side.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import cc as cc_ops
+from ..ops import filters
+from ..ops.unionfind import merge_assignments_np
+from ..parallel.dispatch import read_block_batch, write_block_batch
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask
+
+MAX_IDS_KEY = "thresholded_components/max_ids"
+FACES_KEY = "thresholded_components/faces"
+OFFSETS_NAME = "thresholded_components_offsets.npz"
+ASSIGNMENTS_NAME = "thresholded_components_assignments.npy"
+
+
+@partial(jax.jit, static_argnames=("mode", "sigma", "connectivity"))
+def _components_batch(batch, threshold, mode, sigma, connectivity):
+    x = batch
+    if sigma:
+        x = jax.vmap(lambda b: filters.gaussian(b, sigma))(x)
+    if mode == "greater":
+        mask = x > threshold
+    elif mode == "less":
+        mask = x < threshold
+    else:
+        mask = x == threshold
+    labels, n = jax.vmap(lambda m: cc_ops.connected_components(m, connectivity))(mask)
+    return labels, n
+
+
+class BlockComponentsTask(VolumeTask):
+    """Step 1: per-block CC with local consecutive labels
+    (reference block_components.py:25)."""
+
+    task_name = "block_components"
+    output_dtype = "uint64"
+
+    def __init__(self, *args, mask_path: str = None, mask_key: str = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update(
+            {
+                "threshold": 0.5,
+                "threshold_mode": "greater",
+                "sigma": 0.0,
+                "connectivity": 1,
+            }
+        )
+        return conf
+
+    def _run_batch(self, block_ids: List[int], blocking: Blocking, config):
+        sigma = config.get("sigma", 0.0) or 0.0
+        if isinstance(sigma, list):
+            sigma = tuple(sigma)
+        in_ds = self.input_ds()
+        out_ds = self.output_ds()
+        batch = read_block_batch(in_ds, blocking, block_ids, dtype="float32")
+        labels, _ = _components_batch(
+            jnp.asarray(batch.data),
+            float(config.get("threshold", 0.5)),
+            config.get("threshold_mode", "greater"),
+            sigma,
+            int(config.get("connectivity", 1)),
+        )
+        labels = np.asarray(labels)
+        if self.mask_path:
+            from ..utils import store as _store
+
+            mask_ds = _store.file_reader(self.mask_path, "r")[self.mask_key]
+            for i, bh in enumerate(batch.blocks):
+                m = mask_ds[bh.outer.slicing].astype(bool)
+                sl = tuple(slice(0, s) for s in m.shape)
+                labels[i][sl] = np.where(m, labels[i][sl], 0)
+        write_block_batch(out_ds, batch, labels, cast="uint64")
+        max_ids = self.tmp_ragged(MAX_IDS_KEY, blocking.n_blocks, np.int64)
+        for i, bid in enumerate(batch.block_ids):
+            bh = batch.blocks[i]
+            inner = labels[i][bh.inner_local.slicing]
+            max_ids.write_chunk((bid,), np.array([inner.max()], dtype=np.int64))
+
+    def process_block(self, block_id, blocking, config):
+        self._run_batch([block_id], blocking, config)
+
+    def process_block_batch(self, block_ids, blocking, config):
+        self._run_batch(block_ids, blocking, config)
+
+
+class MergeOffsetsTask(VolumeSimpleTask):
+    """Step 2: exclusive prefix sum of per-block max ids
+    (reference merge_offsets.py:96-125)."""
+
+    task_name = "merge_offsets"
+
+    def __init__(self, *args, n_blocks: int = None, **kwargs):
+        super().__init__(*args, n_blocks=n_blocks, **kwargs)
+
+    def run_impl(self) -> None:
+        import os
+
+        max_ids_ds = self.tmp_store()[MAX_IDS_KEY]
+        max_ids = np.zeros(self.n_blocks, dtype=np.int64)
+        for bid in range(self.n_blocks):
+            chunk = max_ids_ds.read_chunk((bid,))
+            if chunk is not None:
+                max_ids[bid] = chunk[0]
+        offsets = np.roll(np.cumsum(max_ids), 1)
+        offsets[0] = 0
+        empty_blocks = np.nonzero(max_ids == 0)[0]
+        out = os.path.join(self.tmp_folder, OFFSETS_NAME)
+        np.savez(
+            out,
+            offsets=offsets,
+            empty_blocks=empty_blocks,
+            n_labels=np.int64(max_ids.sum()),
+        )
+
+
+def load_offsets(tmp_folder: str):
+    import os
+
+    with np.load(os.path.join(tmp_folder, OFFSETS_NAME)) as f:
+        return f["offsets"], f["empty_blocks"], int(f["n_labels"])
+
+
+class BlockFacesTask(VolumeTask):
+    """Step 3: cross-block label equivalences over 1-voxel-halo faces
+    (reference block_faces.py:87-137)."""
+
+    task_name = "block_faces"
+    output_dtype = None  # writes only scratch data
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        labels_ds = self.input_ds()
+        offsets, _, _ = load_offsets(self.tmp_folder)
+        pairs = []
+        for axis, ngb_id, face in blocking.iterate_faces(block_id, halo=1):
+            slab = labels_ds[face.slicing]
+            lo, hi = np.split(slab, 2, axis=axis)
+            both = (lo > 0) & (hi > 0)
+            if not both.any():
+                continue
+            a = lo[both].astype(np.int64) + offsets[block_id]
+            b = hi[both].astype(np.int64) + offsets[ngb_id]
+            pairs.append(np.unique(np.stack([a, b], axis=1), axis=0))
+        faces = self.tmp_ragged(FACES_KEY, blocking.n_blocks, np.int64)
+        out = (
+            np.concatenate(pairs, axis=0).reshape(-1)
+            if pairs
+            else np.array([], dtype=np.int64)
+        )
+        faces.write_chunk((block_id,), out)
+
+
+class MergeAssignmentsTask(VolumeSimpleTask):
+    """Step 4: global union-find over face pairs → dense assignment table
+    (reference merge_assignments.py:88-146)."""
+
+    task_name = "merge_assignments"
+
+    def __init__(self, *args, n_blocks: int = None, **kwargs):
+        super().__init__(*args, n_blocks=n_blocks, **kwargs)
+
+    def run_impl(self) -> None:
+        import os
+
+        _, _, n_labels = load_offsets(self.tmp_folder)
+        faces = self.tmp_store()[FACES_KEY]
+        all_pairs = []
+        for bid in range(self.n_blocks):
+            chunk = faces.read_chunk((bid,))
+            if chunk is not None and chunk.size:
+                all_pairs.append(chunk.reshape(-1, 2))
+        pairs = (
+            np.concatenate(all_pairs, axis=0)
+            if all_pairs
+            else np.zeros((0, 2), dtype=np.int64)
+        )
+        assignment, n_new = merge_assignments_np(n_labels + 1, pairs)
+        np.save(os.path.join(self.tmp_folder, ASSIGNMENTS_NAME), assignment)
+        self.log(f"merged {n_labels} block-local labels into {n_new} components")

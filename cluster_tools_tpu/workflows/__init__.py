@@ -1,0 +1,7 @@
+from .thresholded_components import ThresholdedComponentsWorkflow
+from .relabel import RelabelWorkflow
+
+__all__ = [
+    "ThresholdedComponentsWorkflow",
+    "RelabelWorkflow",
+]

@@ -1,0 +1,68 @@
+"""Relabel workflow: find_uniques → find_labeling → write
+(reference relabel_workflow.py:10-74)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..runtime import config as cfg
+from ..runtime.workflow import WorkflowBase
+from ..tasks.relabel import LABELING_NAME, FindLabelingTask, FindUniquesTask
+from ..tasks.write import WriteTask
+from ..utils import store
+from ..utils.blocking import Blocking
+
+
+class RelabelWorkflow(WorkflowBase):
+    task_name = "relabel_workflow"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        target: Optional[str] = None,
+        input_path: str = None,
+        input_key: str = None,
+        output_path: str = None,
+        output_key: str = None,
+        dependencies=(),
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+    def requires(self):
+        shape = store.file_reader(self.input_path, "r")[self.input_key].shape
+        gconf = cfg.global_config(self.config_dir)
+        n_blocks = Blocking(shape, gconf["block_shape"]).n_blocks
+        uniques = FindUniquesTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            dependencies=list(self.dependencies),
+            input_path=self.input_path,
+            input_key=self.input_key,
+        )
+        labeling = FindLabelingTask(
+            self.tmp_folder,
+            self.config_dir,
+            dependencies=[uniques],
+            n_blocks=n_blocks,
+        )
+        write = WriteTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            dependencies=[labeling],
+            input_path=self.input_path,
+            input_key=self.input_key,
+            output_path=self.output_path,
+            output_key=self.output_key,
+            assignment_path=os.path.join(self.tmp_folder, LABELING_NAME),
+            identifier="relabel",
+        )
+        return [write]

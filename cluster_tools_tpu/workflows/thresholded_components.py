@@ -1,0 +1,110 @@
+"""Distributed thresholded connected components workflow
+(reference thresholded_components_workflow.py:17-105)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from ..runtime import config as cfg
+from ..runtime.workflow import WorkflowBase
+from ..tasks.thresholded_components import (
+    ASSIGNMENTS_NAME,
+    OFFSETS_NAME,
+    BlockComponentsTask,
+    BlockFacesTask,
+    MergeAssignmentsTask,
+    MergeOffsetsTask,
+)
+from ..tasks.write import WriteTask
+from ..utils import store
+from ..utils.blocking import Blocking
+
+
+class ThresholdedComponentsWorkflow(WorkflowBase):
+    """threshold → block CC → offsets → faces → union-find → write."""
+
+    task_name = "thresholded_components_workflow"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        target: Optional[str] = None,
+        input_path: str = None,
+        input_key: str = None,
+        output_path: str = None,
+        output_key: str = None,
+        assignment_path: Optional[str] = None,
+        mask_path: str = None,
+        mask_key: str = None,
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+
+    def _n_blocks(self) -> int:
+        shape = store.file_reader(self.input_path, "r")[self.input_key].shape
+        gconf = cfg.global_config(self.config_dir)
+        return Blocking(shape, gconf["block_shape"]).n_blocks
+
+    def requires(self):
+        n_blocks = self._n_blocks()
+        blocks_key = self.output_key + "_blocks"
+        components = BlockComponentsTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            input_path=self.input_path,
+            input_key=self.input_key,
+            output_path=self.output_path,
+            output_key=blocks_key,
+            mask_path=self.mask_path,
+            mask_key=self.mask_key,
+        )
+        offsets = MergeOffsetsTask(
+            self.tmp_folder,
+            self.config_dir,
+            dependencies=[components],
+            n_blocks=n_blocks,
+        )
+        faces = BlockFacesTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            dependencies=[offsets],
+            input_path=self.output_path,
+            input_key=blocks_key,
+        )
+        assignments = MergeAssignmentsTask(
+            self.tmp_folder,
+            self.config_dir,
+            dependencies=[faces],
+            n_blocks=n_blocks,
+        )
+        write = WriteTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            dependencies=[assignments],
+            input_path=self.output_path,
+            input_key=blocks_key,
+            output_path=self.output_path,
+            output_key=self.output_key,
+            assignment_path=os.path.join(self.tmp_folder, ASSIGNMENTS_NAME),
+            offsets_path=os.path.join(self.tmp_folder, OFFSETS_NAME),
+            identifier="thresholded_components",
+        )
+        return [write]
+
+    @classmethod
+    def get_config(cls):
+        conf = super().get_config()
+        conf["block_components"] = BlockComponentsTask.default_task_config()
+        conf["write"] = WriteTask.default_task_config()
+        return conf

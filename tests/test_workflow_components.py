@@ -1,0 +1,103 @@
+"""End-to-end ThresholdedComponentsWorkflow test: the first full slice
+(SURVEY.md §7 minimum end-to-end slice) with a recompute oracle — the result
+must be the same partition scipy.ndimage.label produces on the whole volume."""
+
+import os
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import RelabelWorkflow, ThresholdedComponentsWorkflow
+
+
+def _make_volume(tmp_path, rng, shape=(40, 40, 40)):
+    path = str(tmp_path / "data.n5")
+    # smooth random field → nontrivial components crossing block borders
+    raw = ndimage.gaussian_filter(rng.random(shape), 1.0)
+    raw = (raw - raw.min()) / (raw.max() - raw.min())
+    f = file_reader(path)
+    f.create_dataset("raw", data=raw.astype("float32"), chunks=(16, 16, 16))
+    return path, raw
+
+
+def _assert_same_partition(got, want):
+    assert got.shape == want.shape
+    assert ((got > 0) == (want > 0)).all()
+    fg = want > 0
+    pairs = np.unique(np.stack([got[fg], want[fg]], axis=1), axis=0)
+    n_got = len(np.unique(got[fg]))
+    n_want = len(np.unique(want[fg]))
+    assert len(pairs) == n_want == n_got
+
+
+@pytest.mark.parametrize("target", ["local", "tpu"])
+def test_thresholded_components_matches_scipy(tmp_path, rng, target):
+    path, raw = _make_volume(tmp_path, rng)
+    tmp_folder = str(tmp_path / f"tmp_{target}")
+    config_dir = str(tmp_path / f"configs_{target}")
+    cfg.write_global_config(
+        config_dir, {"block_shape": [16, 16, 16], "target": target}
+    )
+    threshold = 0.55
+    cfg.write_config(config_dir, "block_components", {"threshold": threshold})
+
+    wf = ThresholdedComponentsWorkflow(
+        tmp_folder,
+        config_dir,
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key="components",
+    )
+    assert build([wf])
+
+    got = file_reader(path, "r")["components"][:]
+    want, n_want = ndimage.label(raw > threshold)
+    assert n_want > 5  # fixture sanity: nontrivial component structure
+    _assert_same_partition(got, want)
+
+
+def test_relabel_workflow_makes_consecutive(tmp_path, rng):
+    path = str(tmp_path / "data.zarr")
+    labels = rng.choice([0, 7, 1000, 123456789], size=(24, 24, 24)).astype("uint64")
+    f = file_reader(path)
+    f.create_dataset("seg", data=labels, chunks=(12, 12, 12))
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "configs")
+    cfg.write_global_config(config_dir, {"block_shape": [12, 12, 12]})
+
+    wf = RelabelWorkflow(
+        tmp_folder,
+        config_dir,
+        input_path=path,
+        input_key="seg",
+        output_path=path,
+        output_key="seg_relabeled",
+    )
+    assert build([wf])
+    out = file_reader(path, "r")["seg_relabeled"][:]
+    assert set(np.unique(out)) == {0, 1, 2, 3}
+    # same partition as input
+    for old, new in [(7, None), (1000, None), (123456789, None)]:
+        vals = np.unique(out[labels == old])
+        assert len(vals) == 1 and vals[0] > 0
+    assert (out[labels == 0] == 0).all()
+
+
+def test_components_workflow_is_resumable(tmp_path, rng):
+    path, raw = _make_volume(tmp_path, rng, shape=(32, 32, 32))
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "configs")
+    cfg.write_global_config(config_dir, {"block_shape": [16, 16, 16]})
+    wf = ThresholdedComponentsWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="raw",
+        output_path=path, output_key="components",
+    )
+    assert build([wf])
+    # completed workflow: a fresh build() call must be a no-op (complete targets)
+    assert wf.complete()
+    assert build([wf])
