@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Benchmark: DT-watershed voxels/sec/chip (the BASELINE.md headline metric).
+
+Runs the fused per-block DT-watershed XLA program (threshold → EDT → seeds →
+height map → seeded flood → size filter) on the default device (the TPU chip
+under the driver) over a CREMI-like synthetic boundary volume, and compares
+against a single-core host implementation of the same pipeline (scipy EDT +
+gaussian + maxima + heapq priority-flood — the moral equivalent of the
+reference's vigra path, which is not installable here; reference
+cluster_tools/watershed/watershed.py:286-344).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import argparse
+import heapq
+import json
+import sys
+import time
+
+import numpy as np
+from scipy import ndimage
+
+
+def make_volume(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    raw = ndimage.gaussian_filter(rng.random(shape), (1.0, 4.0, 4.0))
+    raw = (raw - raw.min()) / (raw.max() - raw.min())
+    return raw.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# host baseline: the reference's per-block pipeline with scipy + heapq flood
+# ---------------------------------------------------------------------------
+
+
+def cpu_watershed_flood(hmap, seeds, mask):
+    """Sequential priority-flood (vigra watershedsNew equivalent)."""
+    labels = seeds.copy()
+    visited = seeds > 0
+    heap = []
+    coords = np.argwhere(seeds > 0)
+    for z, y, x in coords:
+        heapq.heappush(heap, (hmap[z, y, x], z, y, x))
+    shape = hmap.shape
+    offs = [(-1, 0, 0), (1, 0, 0), (0, -1, 0), (0, 1, 0), (0, 0, -1), (0, 0, 1)]
+    while heap:
+        h, z, y, x = heapq.heappop(heap)
+        lab = labels[z, y, x]
+        for dz, dy, dx in offs:
+            nz, ny, nx = z + dz, y + dy, x + dx
+            if not (0 <= nz < shape[0] and 0 <= ny < shape[1] and 0 <= nx < shape[2]):
+                continue
+            if visited[nz, ny, nx] or not mask[nz, ny, nx]:
+                continue
+            visited[nz, ny, nx] = True
+            labels[nz, ny, nx] = lab
+            heapq.heappush(heap, (hmap[nz, ny, nx], nz, ny, nx))
+    return labels
+
+
+def cpu_dt_watershed(x, threshold=0.5, sigma_seeds=2.0, sigma_weights=2.0, alpha=0.8):
+    fg = x < threshold
+    dt = ndimage.distance_transform_edt(fg).astype(np.float32)
+    smoothed = ndimage.gaussian_filter(dt, sigma_seeds)
+    maxima = (ndimage.maximum_filter(smoothed, 3) == smoothed) & (dt > 0)
+    seeds, _ = ndimage.label(maxima, structure=np.ones((3, 3, 3)))
+    dtn = (dt - dt.min()) / max(dt.max() - dt.min(), 1e-6)
+    hmap = ndimage.gaussian_filter(alpha * x + (1 - alpha) * (1 - dtn), sigma_weights)
+    return cpu_watershed_flood(hmap, seeds.astype(np.int32), fg)
+
+
+# ---------------------------------------------------------------------------
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true", help="small shapes")
+    parser.add_argument("--repeats", type=int, default=5)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.ops.watershed import dt_watershed
+
+    # block geometry: reference test block shape is [32, 256, 256]
+    # (test/base.py:28); quick mode shrinks it
+    shape = (16, 64, 64) if args.quick else (32, 256, 256)
+    vol = make_volume(shape)
+    vox = float(np.prod(shape))
+
+    params = dict(
+        threshold=0.5,
+        apply_dt_2d=False,
+        apply_ws_2d=False,
+        sigma_seeds=2.0,
+        sigma_weights=2.0,
+        alpha=0.8,
+        size_filter=25,
+    )
+
+    x = jnp.asarray(vol)
+    labels, _ = dt_watershed(x, **params)  # compile
+    labels.block_until_ready()
+    t0 = time.time()
+    for _ in range(args.repeats):
+        labels, _ = dt_watershed(x, **params)
+        labels.block_until_ready()
+    t_device = (time.time() - t0) / args.repeats
+    device_voxps = vox / t_device
+
+    # host baseline on a smaller crop, scaled by voxel count (the flood is
+    # O(n log n); slight optimism in the baseline's favor)
+    base_shape = (16, 64, 64) if not args.quick else (8, 32, 32)
+    base_vol = vol[tuple(slice(0, s) for s in base_shape)]
+    t0 = time.time()
+    cpu_dt_watershed(base_vol, **{k: params[k] for k in
+                                  ("threshold", "sigma_seeds", "sigma_weights", "alpha")})
+    t_host = time.time() - t0
+    host_voxps = float(np.prod(base_shape)) / t_host
+
+    result = {
+        "metric": "dt_watershed_throughput",
+        "value": round(device_voxps / 1e6, 3),
+        "unit": "Mvox/s/chip",
+        "vs_baseline": round(device_voxps / host_voxps, 2),
+        "detail": {
+            "block_shape": list(shape),
+            "device": str(jax.devices()[0]),
+            "device_ms_per_block": round(t_device * 1e3, 1),
+            "host_baseline_Mvox_s": round(host_voxps / 1e6, 3),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

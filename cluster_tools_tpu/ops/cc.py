@@ -25,14 +25,20 @@ import numpy as np
 from jax import lax
 
 
-def neighbor_offsets(ndim: int, connectivity: int) -> np.ndarray:
+def neighbor_offsets(
+    ndim: int, connectivity: int, per_slice: bool = False
+) -> np.ndarray:
     """All neighbor offsets with 1 ≤ #nonzero-coords ≤ connectivity
-    (connectivity=1 → faces, ndim → full Moore neighborhood)."""
+    (connectivity=1 → faces, ndim → full Moore neighborhood).  ``per_slice``
+    drops offsets crossing axis 0, so each z-slice is an independent domain
+    (the reference's 2d watershed/labeling modes)."""
     offs = [
         o
         for o in product((-1, 0, 1), repeat=ndim)
         if 0 < sum(c != 0 for c in o) <= connectivity
     ]
+    if per_slice:
+        offs = [o for o in offs if o[0] == 0]
     return np.array(offs, dtype=np.int32)
 
 
@@ -51,28 +57,40 @@ def _shift(x: jnp.ndarray, offset, fill) -> jnp.ndarray:
     return out
 
 
-@partial(jax.jit, static_argnames=("connectivity",))
+@partial(jax.jit, static_argnames=("connectivity", "per_slice"))
 def connected_components_raw(
-    mask: jnp.ndarray, connectivity: int = 1
+    mask: jnp.ndarray,
+    connectivity: int = 1,
+    partition: Optional[jnp.ndarray] = None,
+    per_slice: bool = False,
 ) -> jnp.ndarray:
     """Label foreground components of ``mask``.
 
     Returns int32 labels where background = -1 and each component carries the
     *minimal flat index* of its voxels — not consecutive; compose with
     ``relabel.relabel_consecutive`` (or host np.unique) for 1..N labels.
+
+    With ``partition`` (an int array), voxels only merge when their partition
+    values are equal — i.e. CC *within* existing labels, the equivalent of
+    vigra.labelMultiArrayWithBackground on a segmentation (used to re-close
+    labels after halo cropping, reference watershed.py:329-333).
     """
     shape = mask.shape
     size = int(np.prod(shape))
     sentinel = jnp.int32(size)
     flat_ids = jnp.arange(size, dtype=jnp.int32).reshape(shape)
     init = jnp.where(mask, flat_ids, sentinel)
-    offsets = neighbor_offsets(mask.ndim, connectivity)
+    offsets = neighbor_offsets(mask.ndim, connectivity, per_slice)
 
     def propagate(label):
         best = label
         for off in offsets:
             neigh = _shift(label, off, sentinel)
-            best = jnp.minimum(best, jnp.where(mask, neigh, sentinel))
+            ok = mask
+            if partition is not None:
+                same = _shift(partition, off, jnp.asarray(-1, partition.dtype)) == partition
+                ok = ok & same
+            best = jnp.minimum(best, jnp.where(ok, neigh, sentinel))
         return jnp.where(mask, best, sentinel)
 
     def jump(label):
@@ -95,16 +113,20 @@ def connected_components_raw(
     return jnp.where(mask, label, jnp.int32(-1))
 
 
-@partial(jax.jit, static_argnames=("connectivity",))
+@partial(jax.jit, static_argnames=("connectivity", "per_slice"))
 def connected_components(
-    mask: jnp.ndarray, connectivity: int = 1
+    mask: jnp.ndarray,
+    connectivity: int = 1,
+    partition: Optional[jnp.ndarray] = None,
+    per_slice: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Consecutive component labeling: background 0, components 1..n.
 
     Returns ``(labels, n_components)``.  Consecutive ids come from ranking the
     component roots (minimal flat indices) with a cumsum — no dynamic shapes.
+    See ``connected_components_raw`` for ``partition`` / ``per_slice``.
     """
-    raw = connected_components_raw(mask, connectivity)
+    raw = connected_components_raw(mask, connectivity, partition, per_slice)
     size = int(np.prod(mask.shape))
     flat = raw.reshape(-1)
     # roots are voxels whose label equals their own flat index
@@ -116,6 +138,16 @@ def connected_components(
     safe = jnp.clip(flat, 0, size - 1)
     labels = jnp.where(flat >= 0, root_rank[safe], 0).reshape(mask.shape)
     return labels.astype(jnp.int32), n.astype(jnp.int32)
+
+
+def connected_components_labels(
+    labels: jnp.ndarray, connectivity: int = 1, per_slice: bool = False
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Split a label image into its connected pieces (CC within equal labels,
+    background 0) — vigra.labelMultiArrayWithBackground equivalent."""
+    return connected_components(
+        labels > 0, connectivity, partition=labels, per_slice=per_slice
+    )
 
 
 def connected_components_np(mask: np.ndarray, connectivity: int = 1):

@@ -39,18 +39,20 @@ from .filters import gaussian, maximum_filter, normalize
 _BIG = jnp.float32(3.0e38)
 
 
-@partial(jax.jit, static_argnames=("connectivity", "max_iter"))
+@partial(jax.jit, static_argnames=("connectivity", "max_iter", "per_slice"))
 def seeded_watershed(
     hmap: jnp.ndarray,
     seeds: jnp.ndarray,
     mask: Optional[jnp.ndarray] = None,
     connectivity: int = 1,
     max_iter: int = 0,
+    per_slice: bool = False,
 ) -> jnp.ndarray:
     """Flood ``seeds`` (int32, 0 = unlabeled) over height map ``hmap``.
 
     Voxels outside ``mask`` stay 0 and do not conduct floods.  ``max_iter=0``
-    iterates to the fixpoint.
+    iterates to the fixpoint.  ``per_slice`` floods each z-slice independently
+    (the reference's 2d watershed mode, watershed.py:120-137).
     """
     hmap = hmap.astype(jnp.float32)
     if mask is None:
@@ -58,7 +60,7 @@ def seeded_watershed(
     else:
         mask = mask.astype(bool)
     seeds = jnp.where(mask, seeds.astype(jnp.int32), 0)
-    offsets = neighbor_offsets(hmap.ndim, connectivity)
+    offsets = neighbor_offsets(hmap.ndim, connectivity, per_slice)
     is_seed = seeds > 0
 
     big_dist = jnp.int32(np.iinfo(np.int32).max - 1)
@@ -112,34 +114,125 @@ def seeded_watershed(
     return label
 
 
-@partial(jax.jit, static_argnames=("sigma",))
-def dt_seeds(dt: jnp.ndarray, sigma: float = 2.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+@partial(jax.jit, static_argnames=("sigma", "per_slice"))
+def dt_seeds(
+    dt: jnp.ndarray, sigma: float = 2.0, per_slice: bool = False
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Seeds from a distance transform: smooth → local maxima (plateaus merged by
     full-connectivity CC over the maxima mask) → consecutive labels.
 
     Mirrors reference ``_make_seeds`` (watershed.py:180-208): gaussian(dt) then
-    localMaxima with allowAtBorder/allowPlateaus.
+    localMaxima with allowAtBorder/allowPlateaus.  ``per_slice`` detects maxima
+    and labels seeds within each z-slice independently (2d seed mode).
     """
-    smoothed = gaussian(dt, sigma) if sigma and sigma > 0 else dt
-    local_max = (maximum_filter(smoothed, 3) == smoothed) & (dt > 0)
-    seeds, n = connected_components(local_max, connectivity=dt.ndim)
+    if sigma and sigma > 0:
+        # per-slice mode smooths within slices only (reference 2d seed path)
+        sig = (0.0,) + (sigma,) * (dt.ndim - 1) if per_slice else sigma
+        smoothed = gaussian(dt, sig)
+    else:
+        smoothed = dt
+    window = (1,) + (3,) * (dt.ndim - 1) if per_slice else 3
+    local_max = (maximum_filter(smoothed, window) == smoothed) & (dt > 0)
+    seeds, n = connected_components(
+        local_max, connectivity=dt.ndim, per_slice=per_slice
+    )
     return seeds, n
 
 
-@partial(jax.jit, static_argnames=("alpha", "sigma"))
+@partial(
+    jax.jit,
+    static_argnames=(
+        "threshold",
+        "apply_dt_2d",
+        "apply_ws_2d",
+        "pixel_pitch",
+        "sigma_seeds",
+        "sigma_weights",
+        "alpha",
+        "size_filter",
+        "invert_input",
+    ),
+)
+def dt_watershed(
+    input_: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    threshold: float = 0.25,
+    apply_dt_2d: bool = True,
+    apply_ws_2d: bool = True,
+    pixel_pitch: Optional[Tuple[float, ...]] = None,
+    sigma_seeds: float = 2.0,
+    sigma_weights: float = 2.0,
+    alpha: float = 0.8,
+    size_filter: int = 25,
+    invert_input: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The full per-block DT-watershed — one fused XLA program.
+
+    threshold → distance transform (2d or 3d) → smoothed-maxima seeds → height
+    map α·input + (1-α)·(1-dt) → seeded flood → size filter.  Mirrors the
+    reference hot loop ``_ws_block`` (watershed.py:286-344) minus IO and offsets
+    (applied host-side).  Returns ``(labels int32, n_seeds)``.
+
+    NB: the reference's optional seed non-maximum-suppression
+    (nifty.filters.nonMaximumDistanceSuppression, watershed.py:22) is not
+    implemented; plateau-merged maxima over-seed slightly, the size filter and
+    downstream agglomeration absorb the difference.
+    """
+    from .dt import _distance_transform, distance_transform_2d_stack
+
+    if pixel_pitch is not None and apply_dt_2d:
+        # mirror the reference's assertion (watershed.py:149-153): anisotropic
+        # pitch only applies to the 3d distance transform
+        raise ValueError("pixel_pitch requires apply_dt_2d=False")
+
+    x = input_.astype(jnp.float32)
+    if invert_input:
+        x = 1.0 - x
+    fg = x < threshold
+    if mask is not None:
+        fg = fg & mask.astype(bool)
+
+    if apply_dt_2d and x.ndim == 3:
+        dt = distance_transform_2d_stack(fg, pixel_pitch=None)
+    else:
+        dt = _distance_transform(fg, pixel_pitch)
+
+    per_slice_seeds = apply_ws_2d and x.ndim == 3
+    seeds, n_seeds = dt_seeds(dt, sigma_seeds, per_slice=per_slice_seeds)
+    hmap = make_hmap(x, dt, alpha, sigma_weights, per_slice=per_slice_seeds)
+    labels = seeded_watershed(hmap, seeds, mask=fg, per_slice=per_slice_seeds)
+    if size_filter > 0:
+        num_segments = int(np.prod(x.shape)) // 2 + 2
+        labels = apply_size_filter(
+            labels, hmap, size_filter, num_segments, mask=fg,
+            per_slice=per_slice_seeds,
+        )
+    return labels, n_seeds
+
+
+@partial(jax.jit, static_argnames=("alpha", "sigma", "per_slice"))
 def make_hmap(
-    input_: jnp.ndarray, dt: jnp.ndarray, alpha: float, sigma: float = 0.0
+    input_: jnp.ndarray,
+    dt: jnp.ndarray,
+    alpha: float,
+    sigma: float = 0.0,
+    per_slice: bool = False,
 ) -> jnp.ndarray:
     """Height map α·input + (1-α)·(1 - normalize(dt))
-    (reference ``_make_hmap``, watershed.py:164-170)."""
-    dtn = normalize(dt)
+    (reference ``_make_hmap``, watershed.py:164-170).  ``per_slice`` normalizes
+    the distances and smooths within each z-slice (2d mode)."""
+    dtn = jax.vmap(normalize)(dt) if per_slice else normalize(dt)
     hmap = alpha * input_ + (1.0 - alpha) * (1.0 - dtn)
     if sigma and sigma > 0:
-        hmap = gaussian(hmap, sigma)
+        sig = (0.0,) + (sigma,) * (dt.ndim - 1) if per_slice else sigma
+        hmap = gaussian(hmap, sig)
     return hmap
 
 
-@partial(jax.jit, static_argnames=("size_filter", "num_segments", "connectivity"))
+@partial(
+    jax.jit,
+    static_argnames=("size_filter", "num_segments", "connectivity", "per_slice"),
+)
 def apply_size_filter(
     labels: jnp.ndarray,
     hmap: jnp.ndarray,
@@ -147,6 +240,7 @@ def apply_size_filter(
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
     connectivity: int = 1,
+    per_slice: bool = False,
 ) -> jnp.ndarray:
     """Remove segments smaller than ``size_filter`` voxels and re-flood the freed
     voxels from the surviving segments (reference ``_apply_watershed``
@@ -157,4 +251,6 @@ def apply_size_filter(
     counts = jnp.bincount(labels.reshape(-1), length=num_segments)
     too_small = counts[labels] < size_filter
     kept = jnp.where(too_small, 0, labels)
-    return seeded_watershed(hmap, kept, mask=mask, connectivity=connectivity)
+    return seeded_watershed(
+        hmap, kept, mask=mask, connectivity=connectivity, per_slice=per_slice
+    )

@@ -1,0 +1,82 @@
+"""Watershed workflow (reference watershed/watershed_workflow.py:10).
+
+Single-pass (blockwise DT-WS with block-id offsets) or checkerboard two-pass
+(boundary-consistent labels), optionally followed by relabeling to consecutive
+ids."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..runtime.workflow import WorkflowBase
+from ..tasks.watershed import TwoPassWatershedTask, WatershedTask
+
+
+class WatershedWorkflow(WorkflowBase):
+    task_name = "watershed_workflow"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        target: Optional[str] = None,
+        input_path: str = None,
+        input_key: str = None,
+        output_path: str = None,
+        output_key: str = None,
+        mask_path: str = None,
+        mask_key: str = None,
+        two_pass: bool = False,
+        dependencies=(),
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        self.two_pass = two_pass
+
+    def requires(self):
+        kwargs = dict(
+            input_path=self.input_path,
+            input_key=self.input_key,
+            output_path=self.output_path,
+            output_key=self.output_key,
+            mask_path=self.mask_path,
+            mask_key=self.mask_key,
+        )
+        if self.two_pass:
+            pass1 = TwoPassWatershedTask(
+                self.tmp_folder,
+                self.config_dir,
+                self.max_jobs,
+                dependencies=list(self.dependencies),
+                pass_id=0,
+                **kwargs,
+            )
+            pass2 = TwoPassWatershedTask(
+                self.tmp_folder,
+                self.config_dir,
+                self.max_jobs,
+                dependencies=[pass1],
+                pass_id=1,
+                **kwargs,
+            )
+            return [pass2]
+        ws = WatershedTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            dependencies=list(self.dependencies),
+            **kwargs,
+        )
+        return [ws]
+
+    @classmethod
+    def get_config(cls):
+        conf = super().get_config()
+        conf["watershed"] = WatershedTask.default_task_config()
+        return conf
