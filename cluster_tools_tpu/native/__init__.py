@@ -1,0 +1,122 @@
+"""ctypes bindings for the native C++ solver library.
+
+Builds ``libctt_solvers.so`` from ``solvers.cpp`` with g++ on first use (no
+pybind11 in this environment; plain C ABI + ctypes instead).  ``available()``
+reports whether the native library could be built/loaded; callers fall back to
+the pure-python implementations in ``ops.multicut`` / ``ops.mws``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "solvers.cpp")
+_LIB = os.path.join(_HERE, "libctt_solvers.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired) as e:
+        stderr = getattr(e, "stderr", b"")
+        print(f"[native] build failed ({e}); falling back to python solvers\n"
+              f"{stderr.decode() if isinstance(stderr, bytes) else stderr}")
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_LIB)
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.gaec_multicut.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, i64p, f64p, i64p,
+        ]
+        lib.agglomerative_clustering.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, i64p, f64p, ctypes.c_void_p,
+            ctypes.c_double, i64p,
+        ]
+        lib.mutex_watershed.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, i64p, f64p, u8p, i64p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gaec_multicut(n_nodes: int, uv: np.ndarray, costs: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native solver library unavailable")
+    uv = np.ascontiguousarray(uv, dtype=np.int64)
+    costs = np.ascontiguousarray(costs, dtype=np.float64)
+    labels = np.empty(n_nodes, dtype=np.int64)
+    lib.gaec_multicut(n_nodes, uv.shape[0], uv.reshape(-1), costs, labels)
+    return labels
+
+
+def agglomerative_clustering(
+    n_nodes: int,
+    uv: np.ndarray,
+    weights: np.ndarray,
+    threshold: float,
+    sizes: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native solver library unavailable")
+    uv = np.ascontiguousarray(uv, dtype=np.int64)
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    labels = np.empty(n_nodes, dtype=np.int64)
+    if sizes is None:
+        sizes_ptr = None
+    else:
+        sizes = np.ascontiguousarray(sizes, dtype=np.float64)
+        sizes_ptr = sizes.ctypes.data_as(ctypes.c_void_p)
+    lib.agglomerative_clustering(
+        n_nodes, uv.shape[0], uv.reshape(-1), weights, sizes_ptr,
+        float(threshold), labels,
+    )
+    return labels
+
+
+def mutex_watershed(
+    n_nodes: int, uv: np.ndarray, weights: np.ndarray, attractive: np.ndarray
+) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native solver library unavailable")
+    uv = np.ascontiguousarray(uv, dtype=np.int64)
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    attractive = np.ascontiguousarray(attractive, dtype=np.uint8)
+    labels = np.empty(n_nodes, dtype=np.int64)
+    lib.mutex_watershed(
+        n_nodes, uv.shape[0], uv.reshape(-1), weights, attractive, labels
+    )
+    return labels

@@ -1,0 +1,8 @@
+"""Prebuild the native solver library: ``python -m cluster_tools_tpu.native.build``."""
+
+from . import _build, available
+
+if __name__ == "__main__":
+    ok = available()
+    print("native solvers:", "OK" if ok else "BUILD FAILED (python fallbacks active)")
+    raise SystemExit(0 if ok else 1)

@@ -1,0 +1,246 @@
+// Native combinatorial graph solvers.
+//
+// The TPU framework keeps inherently sequential, pointer-chasing graph
+// algorithms on the host in C++ (the role nifty/affogato play for the
+// reference — SURVEY.md §2.10): greedy additive edge contraction (GAEC)
+// multicut, threshold agglomerative clustering, and the mutex watershed.
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+//
+// Reference behaviors mirrored:
+//   * GAEC: elf.segmentation.multicut 'greedy-additive' solver
+//     (multicut/solve_subproblems.py:184, solve_global.py:147-153)
+//   * agglomerative clustering: elf mala_clustering / agglomerative_clustering
+//     (watershed/agglomerate.py:190-198, agglomerative_clustering.py:138)
+//   * mutex watershed: affogato compute_mws_segmentation
+//     (mutex_watershed/mws_blocks.py:11)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct UnionFind {
+    std::vector<int64_t> parent;
+    std::vector<int64_t> rank_;
+
+    explicit UnionFind(int64_t n) : parent(n), rank_(n, 0) {
+        for (int64_t i = 0; i < n; ++i) parent[i] = i;
+    }
+
+    int64_t find(int64_t x) {
+        while (parent[x] != x) {
+            parent[x] = parent[parent[x]];
+            x = parent[x];
+        }
+        return x;
+    }
+
+    // returns the new root (or -1 if already merged)
+    int64_t merge(int64_t a, int64_t b) {
+        a = find(a);
+        b = find(b);
+        if (a == b) return -1;
+        if (rank_[a] < rank_[b]) std::swap(a, b);
+        parent[b] = a;
+        if (rank_[a] == rank_[b]) ++rank_[a];
+        return a;
+    }
+};
+
+struct HeapEntry {
+    double priority;
+    int64_t u, v;
+    uint64_t stamp;  // lazy invalidation: entry valid iff stamp matches edge stamp
+
+    bool operator<(const HeapEntry& o) const { return priority < o.priority; }
+};
+
+struct EdgeVal {
+    double w;  // accumulated value: sum (additive) or weighted mean (mean mode)
+    double c;  // accumulated multiplicity (edge count / size)
+};
+
+// Dynamic contracted graph: per-root adjacency map root -> (neighbor -> EdgeVal).
+struct DynamicGraph {
+    std::vector<std::unordered_map<int64_t, EdgeVal>> adj;
+    std::unordered_map<uint64_t, uint64_t> edge_stamp;  // key(u,v) -> stamp
+    uint64_t stamp_counter = 0;
+
+    explicit DynamicGraph(int64_t n) : adj(n) {}
+
+    static uint64_t key(int64_t u, int64_t v, int64_t n) {
+        if (u > v) std::swap(u, v);
+        return static_cast<uint64_t>(u) * static_cast<uint64_t>(n) +
+               static_cast<uint64_t>(v);
+    }
+};
+
+// Core greedy agglomeration: repeatedly contract the max-priority edge while
+// priority > stop_priority.  Parallel edges accumulate additively
+// (mean_mode=false, GAEC) or by count-weighted mean (mean_mode=true,
+// mala-style clustering; priority = -mean so the *lowest* boundary merges
+// first).  Returns node -> root labels in `labels`.
+void greedy_agglomeration(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
+                          const double* weights, const double* counts,
+                          bool mean_mode, double stop_priority,
+                          int64_t* labels) {
+    UnionFind uf(n_nodes);
+    DynamicGraph g(n_nodes);
+    std::priority_queue<HeapEntry> heap;
+
+    auto combine = [mean_mode](const EdgeVal& a, const EdgeVal& b) {
+        if (mean_mode)
+            return EdgeVal{(a.w * a.c + b.w * b.c) / (a.c + b.c), a.c + b.c};
+        return EdgeVal{a.w + b.w, a.c + b.c};
+    };
+    auto priority = [mean_mode](const EdgeVal& e) {
+        return mean_mode ? -e.w : e.w;
+    };
+
+    for (int64_t e = 0; e < n_edges; ++e) {
+        int64_t u = uv[2 * e], v = uv[2 * e + 1];
+        if (u == v) continue;
+        EdgeVal val{weights[e], counts ? counts[e] : 1.0};
+        auto it = g.adj[u].find(v);
+        if (it == g.adj[u].end()) {
+            g.adj[u][v] = val;
+            g.adj[v][u] = val;
+        } else {
+            EdgeVal merged = combine(it->second, val);
+            it->second = merged;
+            g.adj[v][u] = merged;
+        }
+    }
+    for (int64_t u = 0; u < n_nodes; ++u) {
+        for (const auto& kv : g.adj[u]) {
+            if (kv.first > u) {
+                uint64_t k = DynamicGraph::key(u, kv.first, n_nodes);
+                g.edge_stamp[k] = 0;
+                heap.push({priority(kv.second), u, kv.first, 0});
+            }
+        }
+    }
+
+    while (!heap.empty()) {
+        HeapEntry top = heap.top();
+        heap.pop();
+        int64_t u = uf.find(top.u), v = uf.find(top.v);
+        if (u == v) continue;
+        uint64_t k = DynamicGraph::key(u, v, n_nodes);
+        auto st = g.edge_stamp.find(k);
+        if (st == g.edge_stamp.end() || st->second != top.stamp) continue;
+        if (top.priority <= stop_priority) break;
+
+        // contract v into u (keep the larger adjacency as the base)
+        if (g.adj[u].size() < g.adj[v].size()) std::swap(u, v);
+        int64_t root = uf.merge(u, v);
+        if (root != u) {  // union-by-rank picked v's tree; relabel so data at u
+            std::swap(u, v);
+        }
+        // move v's edges into u
+        g.adj[u].erase(v);
+        g.adj[v].erase(u);
+        for (const auto& kv : g.adj[v]) {
+            int64_t w = kv.first;
+            g.adj[w].erase(v);
+            auto it = g.adj[u].find(w);
+            EdgeVal merged;
+            if (it == g.adj[u].end()) {
+                merged = kv.second;
+                g.adj[u][w] = merged;
+                g.adj[w][u] = merged;
+            } else {
+                merged = combine(it->second, kv.second);
+                it->second = merged;
+                g.adj[w][u] = merged;
+            }
+            uint64_t nk = DynamicGraph::key(u, w, n_nodes);
+            uint64_t stamp = ++g.stamp_counter;
+            g.edge_stamp[nk] = stamp;
+            heap.push({priority(merged), u, w, stamp});
+        }
+        g.adj[v].clear();
+    }
+
+    for (int64_t i = 0; i < n_nodes; ++i) labels[i] = uf.find(i);
+}
+
+}  // namespace
+
+extern "C" {
+
+// GAEC multicut: contract while the best merge has positive cost.
+// labels receives the root id per node (not consecutive).
+void gaec_multicut(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
+                   const double* costs, int64_t* labels) {
+    greedy_agglomeration(n_nodes, n_edges, uv, costs, nullptr,
+                         /*mean_mode=*/false, 0.0, labels);
+}
+
+// Threshold agglomeration on edge weights where LOW weight = merge first and
+// parallel edges combine by size-weighted mean (mala semantics: weights are
+// boundary probabilities).  Merges until the cheapest remaining mean boundary
+// exceeds `threshold`.  `sizes` may be null (unit sizes).
+void agglomerative_clustering(int64_t n_nodes, int64_t n_edges,
+                              const int64_t* uv, const double* weights,
+                              const double* sizes, double threshold,
+                              int64_t* labels) {
+    greedy_agglomeration(n_nodes, n_edges, uv, weights, sizes,
+                         /*mean_mode=*/true, -threshold, labels);
+}
+
+// Mutex watershed on a weighted graph: edges sorted by |weight| descending are
+// processed Kruskal-style; attractive edges (attractive[e] != 0) merge unless a
+// mutex exists, repulsive edges install mutexes between clusters.
+// (affogato's graph MWS algorithm.)
+void mutex_watershed(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
+                     const double* weights, const uint8_t* attractive,
+                     int64_t* labels) {
+    std::vector<int64_t> order(n_edges);
+    for (int64_t i = 0; i < n_edges; ++i) order[i] = i;
+    std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+        return weights[a] > weights[b];
+    });
+
+    UnionFind uf(n_nodes);
+    // per-root mutex partner sets
+    std::vector<std::unordered_set<int64_t>> mutexes(n_nodes);
+
+    auto have_mutex = [&](int64_t ra, int64_t rb) {
+        const auto& small = mutexes[ra].size() < mutexes[rb].size() ? mutexes[ra]
+                                                                    : mutexes[rb];
+        int64_t other = (&small == &mutexes[ra]) ? rb : ra;
+        return small.count(other) > 0;
+    };
+
+    for (int64_t idx : order) {
+        int64_t ra = uf.find(uv[2 * idx]);
+        int64_t rb = uf.find(uv[2 * idx + 1]);
+        if (ra == rb) continue;
+        if (attractive[idx]) {
+            if (have_mutex(ra, rb)) continue;
+            int64_t root = uf.merge(ra, rb);
+            int64_t child = (root == ra) ? rb : ra;
+            // merge mutex sets into the root; update partners' entries
+            if (mutexes[child].size() > mutexes[root].size())
+                std::swap(mutexes[child], mutexes[root]);
+            for (int64_t m : mutexes[child]) {
+                mutexes[root].insert(m);
+                mutexes[m].erase(child);
+                mutexes[m].insert(root);
+            }
+            mutexes[child].clear();
+        } else {
+            mutexes[ra].insert(rb);
+            mutexes[rb].insert(ra);
+        }
+    }
+    for (int64_t i = 0; i < n_nodes; ++i) labels[i] = uf.find(i);
+}
+
+}  // extern "C"

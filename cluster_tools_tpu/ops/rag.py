@@ -1,0 +1,197 @@
+"""Region adjacency graph extraction and edge-feature accumulation.
+
+Replaces nifty.distributed's graph/feature layer (SURVEY.md §2.10:
+computeMergeableRegionGraph, extractBlockFeaturesFromBoundaryMaps,
+mergeFeatureBlocks, Graph).
+
+Design: face-pair extraction is vectorized (adjacent-voxel label pairs per
+axis); uniquing and per-edge statistics run as sort-based host reductions
+(np.lexsort + reduceat) — the data is ragged (edge lists vary per block), which
+is exactly what the host handles while the device does the dense voxel work.
+
+Edge features (10 per edge, the reference's default feature width —
+block_edge_features.py:146-148):
+  [mean, variance, min, q10, q25, q50, q75, q90, max, count]
+accumulated over the boundary-map values sampled on both sides of each label
+face.  Cross-block merging combines (count, mean, var, min, max) exactly and
+quantiles by count-weighted mean (documented approximation — exact global
+quantiles would require keeping all samples).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+N_FEATURES = 10
+
+
+def block_edges(labels: np.ndarray, ignore_zero: bool = True) -> np.ndarray:
+    """Unique adjacent label pairs (u < v) over face-neighbor voxels."""
+    pairs = []
+    for axis in range(labels.ndim):
+        lo = np.moveaxis(labels, axis, 0)[:-1].reshape(-1)
+        hi = np.moveaxis(labels, axis, 0)[1:].reshape(-1)
+        sel = lo != hi
+        if ignore_zero:
+            sel &= (lo != 0) & (hi != 0)
+        if sel.any():
+            a, b = lo[sel], hi[sel]
+            pairs.append(np.stack([np.minimum(a, b), np.maximum(a, b)], axis=1))
+    if not pairs:
+        return np.zeros((0, 2), dtype=labels.dtype)
+    return np.unique(np.concatenate(pairs, axis=0), axis=0)
+
+
+def _face_values(labels: np.ndarray, values: np.ndarray):
+    """(u, v, sample) triples: for every face between two different labels, the
+    boundary-map values on both sides of the face."""
+    us, vs, samples = [], [], []
+    for axis in range(labels.ndim):
+        lab0 = np.moveaxis(labels, axis, 0)
+        val0 = np.moveaxis(values, axis, 0)
+        lo, hi = lab0[:-1].reshape(-1), lab0[1:].reshape(-1)
+        vlo, vhi = val0[:-1].reshape(-1), val0[1:].reshape(-1)
+        sel = (lo != hi) & (lo != 0) & (hi != 0)
+        if not sel.any():
+            continue
+        a = np.minimum(lo[sel], hi[sel])
+        b = np.maximum(lo[sel], hi[sel])
+        # both side values are samples of the boundary evidence for this edge
+        us.append(np.concatenate([a, a]))
+        vs.append(np.concatenate([b, b]))
+        samples.append(np.concatenate([vlo[sel], vhi[sel]]))
+    if not us:
+        return (
+            np.zeros(0, dtype=labels.dtype),
+            np.zeros(0, dtype=labels.dtype),
+            np.zeros(0, dtype=np.float64),
+        )
+    return np.concatenate(us), np.concatenate(vs), np.concatenate(samples)
+
+
+def boundary_edge_features(
+    labels: np.ndarray, boundary_map: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-edge feature matrix over the label faces of one block.
+
+    Returns ``(edges [m,2], features [m,10])`` with edges sorted lexicographically.
+    """
+    u, v, s = _face_values(labels, boundary_map.astype(np.float64))
+    if u.size == 0:
+        return np.zeros((0, 2), dtype=labels.dtype), np.zeros((0, N_FEATURES))
+    order = np.lexsort((s, v, u))
+    u, v, s = u[order], v[order], s[order]
+    first = np.concatenate([[True], (u[1:] != u[:-1]) | (v[1:] != v[:-1])])
+    starts = np.nonzero(first)[0]
+    edges = np.stack([u[starts], v[starts]], axis=1)
+    counts = np.diff(np.append(starts, u.size)).astype(np.float64)
+
+    sums = np.add.reduceat(s, starts)
+    sums2 = np.add.reduceat(s * s, starts)
+    mean = sums / counts
+    var = np.maximum(sums2 / counts - mean**2, 0.0)
+    mins = np.minimum.reduceat(s, starts)
+    maxs = np.maximum.reduceat(s, starts)
+    # quantiles: values are sorted within each edge group (lexsort key order)
+    qs = []
+    for q in (0.1, 0.25, 0.5, 0.75, 0.9):
+        pos = starts + np.minimum(
+            (q * (counts - 1)).astype(np.int64), (counts - 1).astype(np.int64)
+        )
+        qs.append(s[pos])
+    feats = np.stack([mean, var, mins, qs[0], qs[1], qs[2], qs[3], qs[4], maxs, counts], axis=1)
+    return edges, feats
+
+
+def affinity_edge_features(
+    labels: np.ndarray, affs: np.ndarray, offsets: Sequence[Sequence[int]]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Edge features from an affinity map [C, *spatial] with per-channel offsets
+    (reference extractBlockFeaturesFromAffinityMaps).  Samples the affinity
+    value at the source voxel of each offset-crossing label pair."""
+    offsets = np.asarray(offsets, dtype=np.int64)
+    us, vs, samples = [], [], []
+    for c, off in enumerate(offsets):
+        src = tuple(
+            slice(max(-o, 0), s - max(o, 0)) for o, s in zip(off, labels.shape)
+        )
+        dst = tuple(
+            slice(max(o, 0), s - max(-o, 0)) for o, s in zip(off, labels.shape)
+        )
+        lo, hi = labels[src].reshape(-1), labels[dst].reshape(-1)
+        val = affs[c][src].reshape(-1).astype(np.float64)
+        sel = (lo != hi) & (lo != 0) & (hi != 0)
+        if sel.any():
+            us.append(np.minimum(lo[sel], hi[sel]))
+            vs.append(np.maximum(lo[sel], hi[sel]))
+            samples.append(val[sel])
+    if not us:
+        return np.zeros((0, 2), dtype=labels.dtype), np.zeros((0, N_FEATURES))
+    u = np.concatenate(us)
+    v = np.concatenate(vs)
+    s = np.concatenate(samples)
+    order = np.lexsort((s, v, u))
+    u, v, s = u[order], v[order], s[order]
+    first = np.concatenate([[True], (u[1:] != u[:-1]) | (v[1:] != v[:-1])])
+    starts = np.nonzero(first)[0]
+    edges = np.stack([u[starts], v[starts]], axis=1)
+    counts = np.diff(np.append(starts, u.size)).astype(np.float64)
+    sums = np.add.reduceat(s, starts)
+    sums2 = np.add.reduceat(s * s, starts)
+    mean = sums / counts
+    var = np.maximum(sums2 / counts - mean**2, 0.0)
+    mins = np.minimum.reduceat(s, starts)
+    maxs = np.maximum.reduceat(s, starts)
+    qs = []
+    for q in (0.1, 0.25, 0.5, 0.75, 0.9):
+        pos = starts + np.minimum(
+            (q * (counts - 1)).astype(np.int64), (counts - 1).astype(np.int64)
+        )
+        qs.append(s[pos])
+    feats = np.stack(
+        [mean, var, mins, qs[0], qs[1], qs[2], qs[3], qs[4], maxs, counts], axis=1
+    )
+    return edges, feats
+
+
+def merge_edge_features(
+    edge_ids_list: Sequence[np.ndarray], feats_list: Sequence[np.ndarray], n_edges: int
+) -> np.ndarray:
+    """Merge per-block partial features into the global [n_edges, 10] matrix.
+
+    count/mean/var/min/max merge exactly (parallel-variance formula); quantile
+    columns merge by count-weighted average (approximation, see module doc).
+    """
+    out = np.zeros((n_edges, N_FEATURES))
+    count = np.zeros(n_edges)
+    mean = np.zeros(n_edges)
+    m2 = np.zeros(n_edges)
+    mins = np.full(n_edges, np.inf)
+    maxs = np.full(n_edges, -np.inf)
+    qsum = np.zeros((n_edges, 5))
+
+    for ids, feats in zip(edge_ids_list, feats_list):
+        if ids.size == 0:
+            continue
+        c = feats[:, 9]
+        m = feats[:, 0]
+        v = feats[:, 1]
+        tot = count[ids] + c
+        delta = m - mean[ids]
+        m2[ids] += v * c + delta**2 * count[ids] * c / np.maximum(tot, 1)
+        mean[ids] += delta * c / np.maximum(tot, 1)
+        count[ids] = tot
+        mins[ids] = np.minimum(mins[ids], feats[:, 2])
+        maxs[ids] = np.maximum(maxs[ids], feats[:, 8])
+        qsum[ids] += feats[:, 3:8] * c[:, None]
+
+    nonzero = count > 0
+    out[:, 0] = mean
+    out[:, 1] = np.where(nonzero, m2 / np.maximum(count, 1), 0.0)
+    out[:, 2] = np.where(nonzero, mins, 0.0)
+    out[:, 3:8] = qsum / np.maximum(count, 1)[:, None]
+    out[:, 8] = np.where(nonzero, maxs, 0.0)
+    out[:, 9] = count
+    return out

@@ -83,6 +83,22 @@ class VolumeTask(BlockTask):
         return self.tmp_store().create_ragged_dataset(key, (grid_size,), dtype)
 
 
+def resolve_n_blocks(
+    config_dir, path: str, key: str, scale: int = 0, space_ndim: int = 3
+) -> int:
+    """Block count of a dataset under the global block shape.  Called at task
+    run time (the dataset may not exist when the DAG is built); leading channel
+    axes beyond ``space_ndim`` are dropped, matching ``VolumeTask.get_shape``."""
+    from ..runtime import config as cfg
+
+    shape = store.file_reader(path, "r")[key].shape
+    if len(shape) > space_ndim:
+        shape = shape[-space_ndim:]
+    gconf = cfg.global_config(config_dir)
+    block_shape = [bs * (2**scale) for bs in gconf["block_shape"]]
+    return Blocking(shape, block_shape).n_blocks
+
+
 class VolumeSimpleTask(SimpleTask):
     """Single-shot reduction task with access to the shared scratch store."""
 

@@ -1,0 +1,47 @@
+"""Edge probabilities → multicut costs (reference costs/probs_to_costs.py:22)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from ..ops.multicut import transform_probabilities_to_costs
+from .base import VolumeSimpleTask
+from .features import FEATURES_KEY
+
+COSTS_NAME = "costs.npy"
+
+
+class ProbsToCostsTask(VolumeSimpleTask):
+    task_name = "probs_to_costs"
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update(
+            {
+                "beta": 0.5,
+                "weight_edges": True,
+                "weighting_exponent": 1.0,
+                "invert_inputs": False,
+            }
+        )
+        return conf
+
+    def run_impl(self) -> None:
+        conf = self.get_task_config()
+        feats = self.tmp_store()[FEATURES_KEY][:]
+        probs = feats[:, 0]
+        if conf.get("invert_inputs", False):
+            probs = 1.0 - probs
+        sizes = feats[:, 9] if conf.get("weight_edges", True) else None
+        costs = transform_probabilities_to_costs(
+            probs,
+            beta=float(conf.get("beta", 0.5)),
+            edge_sizes=sizes,
+            weighting_exponent=float(conf.get("weighting_exponent", 1.0)),
+        )
+        np.save(os.path.join(self.tmp_folder, COSTS_NAME), costs)
+        self.log(f"computed {costs.size} edge costs (beta={conf.get('beta')})")

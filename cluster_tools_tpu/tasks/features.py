@@ -1,0 +1,133 @@
+"""Edge-feature accumulation over boundary or affinity maps.
+
+Reference features/{block_edge_features,merge_edge_features}.py via
+nifty.distributed accumulators (SURVEY.md §2.3).  10 features per edge
+(mean, var, min, q10..q90, max, count); cross-block merge is exact for the
+moment statistics and count-weighted for quantiles (ops/rag.py doc).
+
+Scratch layout:
+  features/ids     ragged per block: global edge ids
+  features/vals    ragged per block: flattened [k,10] partial features
+  features/edges   [m,10] merged feature matrix
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..ops.rag import (
+    N_FEATURES,
+    affinity_edge_features,
+    boundary_edge_features,
+    merge_edge_features,
+)
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .graph import _read_block_with_upper_halo, load_graph
+
+FEATURE_IDS_KEY = "features/ids"
+FEATURE_VALS_KEY = "features/vals"
+FEATURES_KEY = "features/edges"
+
+
+class BlockEdgeFeaturesTask(VolumeTask):
+    """Per-block edge features (reference block_edge_features.py:21).
+
+    ``input_path/key`` is the boundary/affinity map; ``labels_path/key`` the
+    segmentation whose RAG was extracted.
+    """
+
+    task_name = "block_edge_features"
+    output_dtype = None
+
+    def __init__(self, *args, labels_path: str = None, labels_key: str = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.labels_path = labels_path
+        self.labels_key = labels_key
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update({"offsets": None})  # affinity offsets, None → boundary map
+        return conf
+
+    def labels_ds(self):
+        from ..utils import store
+
+        return store.file_reader(self.labels_path, "r")[self.labels_key]
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        seg = _read_block_with_upper_halo(
+            self.labels_ds(), blocking, block_id
+        ).astype(np.uint64)
+        data_ds = self.input_ds()
+        offsets = config.get("offsets")
+        block = blocking.block(block_id)
+        end = tuple(min(e + 1, s) for e, s in zip(block.end, blocking.shape))
+        bb = tuple(slice(b, e) for b, e in zip(block.begin, end))
+        if offsets is not None:
+            data = data_ds[(slice(0, len(offsets)),) + bb]
+            data = self._normalize(data)
+            edges, feats = affinity_edge_features(seg, data, offsets)
+        else:
+            data = self._normalize(data_ds[bb])
+            edges, feats = boundary_edge_features(seg, data)
+
+        store = self.tmp_store()
+        nodes, gedges = load_graph(store)
+        ids_out = self.tmp_ragged(FEATURE_IDS_KEY, blocking.n_blocks, np.int64)
+        vals_out = self.tmp_ragged(FEATURE_VALS_KEY, blocking.n_blocks, np.float64)
+        if edges.shape[0] == 0:
+            ids_out.write_chunk((block_id,), np.array([], dtype=np.int64))
+            vals_out.write_chunk((block_id,), np.array([], dtype=np.float64))
+            return
+        pairs = np.searchsorted(nodes, edges).astype(np.int64)
+        keys = gedges[:, 0] * (nodes.size + 1) + gedges[:, 1]
+        want = pairs[:, 0] * (nodes.size + 1) + pairs[:, 1]
+        ids = np.searchsorted(keys, want)
+        valid = keys[np.clip(ids, 0, keys.size - 1)] == want
+        ids_out.write_chunk((block_id,), ids[valid].astype(np.int64))
+        vals_out.write_chunk((block_id,), feats[valid].reshape(-1))
+
+    @staticmethod
+    def _normalize(data: np.ndarray) -> np.ndarray:
+        if data.dtype == np.uint8:
+            return data.astype(np.float64) / 255.0
+        return data.astype(np.float64)
+
+
+class MergeEdgeFeaturesTask(VolumeSimpleTask):
+    """Merge per-block partial features (reference merge_edge_features.py:17)."""
+
+    task_name = "merge_edge_features"
+
+    def __init__(self, *args, labels_path: str = None, labels_key: str = None,
+                 **kwargs):
+        super().__init__(*args, labels_path=labels_path, labels_key=labels_key,
+                         **kwargs)
+
+    def run_impl(self) -> None:
+        n_blocks = resolve_n_blocks(self.config_dir, self.labels_path, self.labels_key)
+        store = self.tmp_store()
+        n_edges = store["graph/edges"].attrs["n_edges"]
+        ids_ds = store[FEATURE_IDS_KEY]
+        vals_ds = store[FEATURE_VALS_KEY]
+        ids_list, feats_list = [], []
+        for bid in range(n_blocks):
+            ids = ids_ds.read_chunk((bid,))
+            vals = vals_ds.read_chunk((bid,))
+            if ids is None or ids.size == 0:
+                continue
+            ids_list.append(ids)
+            feats_list.append(vals.reshape(-1, N_FEATURES))
+        merged = merge_edge_features(ids_list, feats_list, n_edges)
+        store.create_dataset(
+            FEATURES_KEY,
+            data=merged,
+            chunks=(max(merged.shape[0], 1), N_FEATURES),
+            exist_ok=True,
+        )
+        self.log(f"merged features for {n_edges} edges")

@@ -1,0 +1,129 @@
+"""Distributed region-adjacency-graph extraction.
+
+Reference graph/{initial_sub_graphs,merge_sub_graphs,map_edge_ids}.py via
+nifty.distributed (SURVEY.md §2.3): per-block subgraphs → merged global graph →
+block-local → global edge-id maps.
+
+Storage layout in the scratch store (``tmp_folder/data.zarr``):
+  graph/sub_edges        ragged per block: flattened (u,v) label pairs (uint64)
+  graph/nodes            [n] sorted unique node labels (uint64)
+  graph/edges            [m,2] dense node-index pairs, lexicographically sorted
+  graph/block_edge_ids   ragged per block: global edge id per block edge
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..ops.rag import block_edges
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+
+SUB_EDGES_KEY = "graph/sub_edges"
+NODES_KEY = "graph/nodes"
+EDGES_KEY = "graph/edges"
+BLOCK_EDGE_IDS_KEY = "graph/block_edge_ids"
+
+
+def _read_block_with_upper_halo(ds, blocking: Blocking, block_id: int):
+    """Block plus one voxel towards the upper neighbors, so cross-block label
+    faces are captured (clipped at the volume border)."""
+    block = blocking.block(block_id)
+    end = tuple(min(e + 1, s) for e, s in zip(block.end, blocking.shape))
+    return ds[tuple(slice(b, e) for b, e in zip(block.begin, end))]
+
+
+def load_graph(tmp_store):
+    """Returns (nodes [n] uint64, edges [m,2] int64 dense indices)."""
+    nodes = tmp_store[NODES_KEY][:]
+    edges = tmp_store[EDGES_KEY][:]
+    return nodes, edges
+
+
+class InitialSubGraphsTask(VolumeTask):
+    """Per-block RAG edges (reference initial_sub_graphs.py:25)."""
+
+    task_name = "initial_sub_graphs"
+    output_dtype = None
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        seg = _read_block_with_upper_halo(self.input_ds(), blocking, block_id)
+        edges = block_edges(seg.astype(np.uint64))
+        sub = self.tmp_ragged(SUB_EDGES_KEY, blocking.n_blocks, np.uint64)
+        sub.write_chunk((block_id,), edges.reshape(-1))
+
+
+class MergeSubGraphsTask(VolumeSimpleTask):
+    """Merge block subgraphs into the global graph
+    (reference merge_sub_graphs.py:24; the scale pyramid of the reference is
+    collapsed into one sort-based merge — host np.unique over all block edges)."""
+
+    task_name = "merge_sub_graphs"
+
+    def __init__(self, *args, input_path: str = None, input_key: str = None,
+                 **kwargs):
+        super().__init__(*args, input_path=input_path, input_key=input_key,
+                         **kwargs)
+
+    def run_impl(self) -> None:
+        n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
+        store = self.tmp_store()
+        sub = store[SUB_EDGES_KEY]
+        collected = []
+        for bid in range(n_blocks):
+            chunk = sub.read_chunk((bid,))
+            if chunk is not None and chunk.size:
+                collected.append(chunk.reshape(-1, 2))
+        if collected:
+            label_edges = np.unique(np.concatenate(collected, axis=0), axis=0)
+        else:
+            label_edges = np.zeros((0, 2), dtype=np.uint64)
+        nodes = np.unique(label_edges.reshape(-1)) if label_edges.size else np.zeros(
+            0, dtype=np.uint64
+        )
+        dense = np.searchsorted(nodes, label_edges).astype(np.int64)
+        # lexicographic edge order (u, then v) — defines global edge ids
+        order = np.lexsort((dense[:, 1], dense[:, 0]))
+        dense = dense[order]
+        store.create_dataset(
+            NODES_KEY, data=nodes, chunks=(max(nodes.size, 1),), exist_ok=True
+        )
+        store.create_dataset(
+            EDGES_KEY,
+            data=dense,
+            chunks=(max(dense.shape[0], 1), 2),
+            exist_ok=True,
+        )
+        g = store[EDGES_KEY]
+        g.attrs["n_nodes"] = int(nodes.size)
+        g.attrs["n_edges"] = int(dense.shape[0])
+        self.log(f"graph: {nodes.size} nodes, {dense.shape[0]} edges")
+
+
+class MapEdgeIdsTask(VolumeTask):
+    """Per-block map of block edges → global edge ids
+    (reference map_edge_ids.py:23)."""
+
+    task_name = "map_edge_ids"
+    output_dtype = None
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        store = self.tmp_store()
+        nodes, edges = load_graph(store)
+        sub = store[SUB_EDGES_KEY].read_chunk((block_id,))
+        out = self.tmp_ragged(BLOCK_EDGE_IDS_KEY, blocking.n_blocks, np.int64)
+        if sub is None or sub.size == 0:
+            out.write_chunk((block_id,), np.array([], dtype=np.int64))
+            return
+        pairs = np.searchsorted(nodes, sub.reshape(-1, 2)).astype(np.int64)
+        # edge id = position in the lexicographically sorted global edge list
+        keys = edges[:, 0] * (nodes.size + 1) + edges[:, 1]
+        want = pairs[:, 0] * (nodes.size + 1) + pairs[:, 1]
+        ids = np.searchsorted(keys, want)
+        if not (keys[np.clip(ids, 0, keys.size - 1)] == want).all():
+            raise RuntimeError(
+                f"block {block_id}: edges missing from the global graph"
+            )
+        out.write_chunk((block_id,), ids.astype(np.int64))

@@ -1,0 +1,204 @@
+"""Hierarchical multicut solve (ICCV'17 domain decomposition).
+
+Reference multicut/{solve_subproblems,reduce_problem,solve_global}.py
+(SURVEY.md §3.5): per scale, blocks extract and solve their node-induced
+subproblems; cut edges are collected; non-cut edges are union-find-merged and
+the graph contracted with accumulated costs; block shape doubles per scale;
+the final reduced graph is solved globally and composed back to scale 0.
+
+Scratch layout:
+  multicut/s{s}/cut_edges   ragged per (scale-s) block: cut edge ids
+  multicut/s{s}.npz         reduced problem: edges, costs, node_labeling
+                            (scale-0 dense node → scale-s cluster)
+  multicut_assignments.npy  final (label, segment) table for the write task
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..ops.multicut import solve_multicut
+from ..ops.unionfind import UnionFindNp
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .costs import COSTS_NAME
+from .graph import load_graph
+
+ASSIGNMENTS_NAME = "multicut_assignments.npy"
+
+
+def _scale_problem_path(tmp_folder: str, scale: int) -> str:
+    return os.path.join(tmp_folder, f"multicut_s{scale}.npz")
+
+
+def load_scale_problem(task, scale: int):
+    """Graph at a scale: (edges [m,2] dense ids, costs [m], node_labeling
+    [n_s0_nodes] → current cluster ids)."""
+    if scale == 0:
+        _, edges = load_graph(task.tmp_store())
+        costs = np.load(os.path.join(task.tmp_folder, COSTS_NAME))
+        n_nodes = int(task.tmp_store()["graph/edges"].attrs["n_nodes"])
+        return edges, costs, np.arange(n_nodes, dtype=np.int64)
+    with np.load(_scale_problem_path(task.tmp_folder, scale)) as f:
+        return f["edges"], f["costs"], f["node_labeling"]
+
+
+class SolveSubproblemsTask(VolumeTask):
+    """Per-block subgraph extraction + solve (reference solve_subproblems.py:31).
+
+    ``input_path/key`` is the watershed label volume — a block's node set is the
+    set of (current-scale clusters of) labels present in its bounding box.
+    """
+
+    task_name = "solve_subproblems"
+    output_dtype = None
+
+    def __init__(self, *args, scale: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.scale = scale
+
+    @property
+    def identifier(self) -> str:
+        return f"{self.task_name}_s{self.scale}"
+
+    def get_block_shape(self, gconf):
+        # block shape doubles per scale (reference reduce_problem.py:246-258)
+        return [bs * (2**self.scale) for bs in gconf["block_shape"]]
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        store = self.tmp_store()
+        nodes, _ = load_graph(store)
+        edges, costs, node_labeling = load_scale_problem(self, self.scale)
+
+        seg = self.input_ds()[blocking.block(block_id).slicing]
+        block_labels = np.unique(seg)
+        block_labels = block_labels[block_labels > 0]
+        out = self.tmp_ragged(
+            f"multicut/s{self.scale}/cut_edges", blocking.n_blocks, np.int64
+        )
+        if block_labels.size == 0 or edges.shape[0] == 0:
+            out.write_chunk((block_id,), np.array([], dtype=np.int64))
+            return
+        dense = np.searchsorted(nodes, block_labels)
+        # guard labels missing from the graph (e.g. isolated segments)
+        in_range = dense < nodes.size
+        dense, block_labels = dense[in_range], block_labels[in_range]
+        found = nodes[dense] == block_labels
+        dense = dense[found]
+        if dense.size == 0:
+            out.write_chunk((block_id,), np.array([], dtype=np.int64))
+            return
+        current = np.unique(node_labeling[dense])
+
+        member = np.zeros(int(node_labeling.max()) + 2, dtype=bool)
+        member[current] = True
+        cur_u = node_labeling[edges[:, 0]]
+        cur_v = node_labeling[edges[:, 1]]
+        in_sub = member[cur_u] & member[cur_v] & (cur_u != cur_v)
+        sub_edge_ids = np.nonzero(in_sub)[0]
+        if sub_edge_ids.size == 0:
+            out.write_chunk((block_id,), np.array([], dtype=np.int64))
+            return
+        # contract to current-scale clusters, then relabel to a local problem
+        su, sv = cur_u[in_sub], cur_v[in_sub]
+        uniq, inv = np.unique(np.stack([su, sv]), return_inverse=True)
+        local_uv = inv.reshape(2, -1).T
+        result = solve_multicut(uniq.size, local_uv, costs[sub_edge_ids])
+        cut = result[local_uv[:, 0]] != result[local_uv[:, 1]]
+        out.write_chunk((block_id,), sub_edge_ids[cut].astype(np.int64))
+
+
+class ReduceProblemTask(VolumeSimpleTask):
+    """Merge non-cut edges, contract the graph, emit the next-scale problem
+    (reference reduce_problem.py:30)."""
+
+    task_name = "reduce_problem"
+
+    def __init__(self, *args, scale: int = 0, input_path: str = None,
+                 input_key: str = None, **kwargs):
+        super().__init__(*args, scale=scale, input_path=input_path,
+                         input_key=input_key, **kwargs)
+
+    @property
+    def identifier(self) -> str:
+        return f"{self.task_name}_s{self.scale}"
+
+    def run_impl(self) -> None:
+        n_blocks = resolve_n_blocks(
+            self.config_dir, self.input_path, self.input_key, scale=self.scale
+        )
+        edges, costs, node_labeling = load_scale_problem(self, self.scale)
+        store = self.tmp_store()
+        cut_ds = store[f"multicut/s{self.scale}/cut_edges"]
+        cut = np.zeros(edges.shape[0], dtype=bool)
+        for bid in range(n_blocks):
+            chunk = cut_ds.read_chunk((bid,))
+            if chunk is not None and chunk.size:
+                cut[chunk] = True
+
+        n_current = int(node_labeling.max()) + 1
+        uf = UnionFindNp(n_current)
+        cur_u = node_labeling[edges[:, 0]]
+        cur_v = node_labeling[edges[:, 1]]
+        keep = ~cut & (cur_u != cur_v)
+        uf.merge(cur_u[keep], cur_v[keep])
+        roots = uf.compress()
+        _, new_ids = np.unique(roots, return_inverse=True)
+        merged_labeling = new_ids[node_labeling].astype(np.int64)
+
+        new_u = new_ids[cur_u]
+        new_v = new_ids[cur_v]
+        live = new_u != new_v
+        nu, nv = new_u[live], new_v[live]
+        swap = nu > nv
+        nu[swap], nv[swap] = nv[swap], nu[swap]
+        pair_keys = nu.astype(np.int64) * (int(new_ids.max()) + 2) + nv
+        uniq_keys, inv = np.unique(pair_keys, return_inverse=True)
+        new_costs = np.zeros(uniq_keys.size)
+        np.add.at(new_costs, inv, costs[live])
+        new_edges = np.stack(
+            [uniq_keys // (int(new_ids.max()) + 2), uniq_keys % (int(new_ids.max()) + 2)],
+            axis=1,
+        ).astype(np.int64)
+
+        np.savez(
+            _scale_problem_path(self.tmp_folder, self.scale + 1),
+            edges=new_edges,
+            costs=new_costs,
+            node_labeling=merged_labeling,
+        )
+        self.log(
+            f"scale {self.scale}: {edges.shape[0]} edges / "
+            f"{n_current} nodes → {new_edges.shape[0]} edges / "
+            f"{int(new_ids.max()) + 1} nodes"
+        )
+
+
+class SolveGlobalTask(VolumeSimpleTask):
+    """Solve the final reduced problem and emit the (label → segment) table
+    (reference solve_global.py:25)."""
+
+    task_name = "solve_global"
+
+    def __init__(self, *args, scale: int = 0, **kwargs):
+        super().__init__(*args, scale=scale, **kwargs)
+
+    def run_impl(self) -> None:
+        edges, costs, node_labeling = load_scale_problem(self, self.scale)
+        n_current = int(node_labeling.max()) + 1
+        result = solve_multicut(n_current, edges, costs)
+        final = result[node_labeling]  # scale-0 dense node → segment
+        nodes, _ = load_graph(self.tmp_store())
+        # segments 1-based; node label 0 (if present) stays 0
+        table = np.stack(
+            [nodes, (final + 1).astype(np.uint64)], axis=1
+        ).astype(np.uint64)
+        if nodes.size and nodes[0] == 0:
+            table[0, 1] = 0
+        np.save(os.path.join(self.tmp_folder, ASSIGNMENTS_NAME), table)
+        self.log(
+            f"global solve: {n_current} nodes → {int(result.max()) + 1} segments"
+        )

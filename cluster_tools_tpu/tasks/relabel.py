@@ -13,7 +13,7 @@ from typing import Any, Dict
 import numpy as np
 
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask
+from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
 
 UNIQUES_KEY = "relabel/uniques"
 LABELING_NAME = "relabel_assignments.npy"
@@ -39,13 +39,16 @@ class FindLabelingTask(VolumeSimpleTask):
 
     task_name = "find_labeling"
 
-    def __init__(self, *args, n_blocks: int = None, **kwargs):
-        super().__init__(*args, n_blocks=n_blocks, **kwargs)
+    def __init__(self, *args, input_path: str = None, input_key: str = None,
+                 **kwargs):
+        super().__init__(*args, input_path=input_path, input_key=input_key,
+                         **kwargs)
 
     def run_impl(self) -> None:
+        n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         uniques_ds = self.tmp_store()[UNIQUES_KEY]
         collected = []
-        for bid in range(self.n_blocks):
+        for bid in range(n_blocks):
             chunk = uniques_ds.read_chunk((bid,))
             if chunk is not None and chunk.size:
                 collected.append(chunk)

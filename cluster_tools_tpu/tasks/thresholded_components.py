@@ -120,15 +120,20 @@ class MergeOffsetsTask(VolumeSimpleTask):
 
     task_name = "merge_offsets"
 
-    def __init__(self, *args, n_blocks: int = None, **kwargs):
-        super().__init__(*args, n_blocks=n_blocks, **kwargs)
+    def __init__(self, *args, input_path: str = None, input_key: str = None,
+                 **kwargs):
+        super().__init__(*args, input_path=input_path, input_key=input_key,
+                         **kwargs)
 
     def run_impl(self) -> None:
         import os
 
+        from .base import resolve_n_blocks
+
+        n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         max_ids_ds = self.tmp_store()[MAX_IDS_KEY]
-        max_ids = np.zeros(self.n_blocks, dtype=np.int64)
-        for bid in range(self.n_blocks):
+        max_ids = np.zeros(n_blocks, dtype=np.int64)
+        for bid in range(n_blocks):
             chunk = max_ids_ds.read_chunk((bid,))
             if chunk is not None:
                 max_ids[bid] = chunk[0]
@@ -186,16 +191,21 @@ class MergeAssignmentsTask(VolumeSimpleTask):
 
     task_name = "merge_assignments"
 
-    def __init__(self, *args, n_blocks: int = None, **kwargs):
-        super().__init__(*args, n_blocks=n_blocks, **kwargs)
+    def __init__(self, *args, input_path: str = None, input_key: str = None,
+                 **kwargs):
+        super().__init__(*args, input_path=input_path, input_key=input_key,
+                         **kwargs)
 
     def run_impl(self) -> None:
         import os
 
+        from .base import resolve_n_blocks
+
+        n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         _, _, n_labels = load_offsets(self.tmp_folder)
         faces = self.tmp_store()[FACES_KEY]
         all_pairs = []
-        for bid in range(self.n_blocks):
+        for bid in range(n_blocks):
             chunk = faces.read_chunk((bid,))
             if chunk is not None and chunk.size:
                 all_pairs.append(chunk.reshape(-1, 2))

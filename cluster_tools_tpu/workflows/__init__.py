@@ -1,7 +1,19 @@
-from .thresholded_components import ThresholdedComponentsWorkflow
+from .multicut import (
+    EdgeFeaturesWorkflow,
+    GraphWorkflow,
+    MulticutSegmentationWorkflow,
+    MulticutWorkflow,
+)
 from .relabel import RelabelWorkflow
+from .thresholded_components import ThresholdedComponentsWorkflow
+from .watershed import WatershedWorkflow
 
 __all__ = [
-    "ThresholdedComponentsWorkflow",
+    "EdgeFeaturesWorkflow",
+    "GraphWorkflow",
+    "MulticutSegmentationWorkflow",
+    "MulticutWorkflow",
     "RelabelWorkflow",
+    "ThresholdedComponentsWorkflow",
+    "WatershedWorkflow",
 ]

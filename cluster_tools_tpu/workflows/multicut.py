@@ -1,0 +1,205 @@
+"""The "Problem" pipeline and multicut segmentation workflows.
+
+Reference workflows.py:28-235 and multicut/multicut_workflow.py:11-61:
+
+  GraphWorkflow:        initial_sub_graphs → merge_sub_graphs → map_edge_ids
+  EdgeFeaturesWorkflow: block_edge_features → merge_edge_features
+  EdgeCostsWorkflow:    probs_to_costs
+  MulticutWorkflow:     [solve_subproblems(s) → reduce_problem(s)] × n_scales
+                        → solve_global
+  MulticutSegmentationWorkflow: watershed → problem → multicut → write
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..runtime.workflow import WorkflowBase
+from ..tasks.costs import ProbsToCostsTask
+from ..tasks.features import BlockEdgeFeaturesTask, MergeEdgeFeaturesTask
+from ..tasks.graph import InitialSubGraphsTask, MapEdgeIdsTask, MergeSubGraphsTask
+from ..tasks.multicut import (
+    ASSIGNMENTS_NAME,
+    ReduceProblemTask,
+    SolveGlobalTask,
+    SolveSubproblemsTask,
+)
+from ..tasks.watershed import WatershedTask
+from ..tasks.write import WriteTask
+
+
+class GraphWorkflow(WorkflowBase):
+    """Distributed RAG extraction (reference graph_workflow.py:9)."""
+
+    task_name = "graph_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
+                 input_path=None, input_key=None, dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+
+    def requires(self):
+        sub = InitialSubGraphsTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=list(self.dependencies),
+            input_path=self.input_path, input_key=self.input_key,
+        )
+        merge = MergeSubGraphsTask(
+            self.tmp_folder, self.config_dir, dependencies=[sub],
+            input_path=self.input_path, input_key=self.input_key,
+        )
+        map_ids = MapEdgeIdsTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=[merge],
+            input_path=self.input_path, input_key=self.input_key,
+        )
+        return [map_ids]
+
+
+class EdgeFeaturesWorkflow(WorkflowBase):
+    """reference features_workflow.py:12."""
+
+    task_name = "edge_features_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
+                 input_path=None, input_key=None, labels_path=None,
+                 labels_key=None, dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.labels_path = labels_path
+        self.labels_key = labels_key
+
+    def requires(self):
+        block = BlockEdgeFeaturesTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=list(self.dependencies),
+            input_path=self.input_path, input_key=self.input_key,
+            labels_path=self.labels_path, labels_key=self.labels_key,
+        )
+        merge = MergeEdgeFeaturesTask(
+            self.tmp_folder, self.config_dir, dependencies=[block],
+            labels_path=self.labels_path, labels_key=self.labels_key,
+        )
+        return [merge]
+
+
+class MulticutWorkflow(WorkflowBase):
+    """Hierarchical multicut solve (reference multicut_workflow.py:45)."""
+
+    task_name = "multicut_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
+                 input_path=None, input_key=None, n_scales: int = 1,
+                 dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.n_scales = n_scales
+
+    def requires(self):
+        dep = list(self.dependencies)
+        for scale in range(self.n_scales):
+            solve = SolveSubproblemsTask(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                dependencies=dep, scale=scale,
+                input_path=self.input_path, input_key=self.input_key,
+            )
+            reduce_ = ReduceProblemTask(
+                self.tmp_folder, self.config_dir,
+                dependencies=[solve], scale=scale,
+                input_path=self.input_path, input_key=self.input_key,
+            )
+            dep = [reduce_]
+        solve_global = SolveGlobalTask(
+            self.tmp_folder, self.config_dir, dependencies=dep,
+            scale=self.n_scales,
+        )
+        return [solve_global]
+
+
+class MulticutSegmentationWorkflow(WorkflowBase):
+    """watershed → graph → features → costs → multicut → write
+    (reference workflows.py:203-233)."""
+
+    task_name = "multicut_segmentation_workflow"
+
+    def __init__(
+        self,
+        tmp_folder,
+        config_dir=None,
+        max_jobs=None,
+        target=None,
+        input_path: str = None,       # boundary / affinity map
+        input_key: str = None,
+        ws_path: str = None,          # watershed volume (created if missing)
+        ws_key: str = None,
+        output_path: str = None,      # final segmentation
+        output_key: str = None,
+        mask_path: str = None,
+        mask_key: str = None,
+        n_scales: int = 1,
+        skip_ws: bool = False,
+        dependencies=(),
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        self.n_scales = n_scales
+        self.skip_ws = skip_ws
+
+    def requires(self):
+        dep = list(self.dependencies)
+        if not self.skip_ws:
+            ws = WatershedTask(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                dependencies=dep,
+                input_path=self.input_path, input_key=self.input_key,
+                output_path=self.ws_path, output_key=self.ws_key,
+                mask_path=self.mask_path, mask_key=self.mask_key,
+            )
+            dep = [ws]
+        graph = GraphWorkflow(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            input_path=self.ws_path, input_key=self.ws_key,
+            dependencies=dep,
+        )
+        feats = EdgeFeaturesWorkflow(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            input_path=self.input_path, input_key=self.input_key,
+            labels_path=self.ws_path, labels_key=self.ws_key,
+            dependencies=[graph],
+        )
+        costs = ProbsToCostsTask(
+            self.tmp_folder, self.config_dir, dependencies=[feats]
+        )
+        mc = MulticutWorkflow(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            input_path=self.ws_path, input_key=self.ws_key,
+            n_scales=self.n_scales, dependencies=[costs],
+        )
+        write = WriteTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=[mc],
+            input_path=self.ws_path, input_key=self.ws_key,
+            output_path=self.output_path, output_key=self.output_key,
+            assignment_path=os.path.join(self.tmp_folder, ASSIGNMENTS_NAME),
+            identifier="multicut",
+        )
+        return [write]
+
+    @classmethod
+    def get_config(cls):
+        conf = super().get_config()
+        conf["watershed"] = WatershedTask.default_task_config()
+        conf["block_edge_features"] = BlockEdgeFeaturesTask.default_task_config()
+        conf["probs_to_costs"] = ProbsToCostsTask.default_task_config()
+        return conf

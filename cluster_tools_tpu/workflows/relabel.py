@@ -6,12 +6,9 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from ..runtime import config as cfg
 from ..runtime.workflow import WorkflowBase
 from ..tasks.relabel import LABELING_NAME, FindLabelingTask, FindUniquesTask
 from ..tasks.write import WriteTask
-from ..utils import store
-from ..utils.blocking import Blocking
 
 
 class RelabelWorkflow(WorkflowBase):
@@ -36,9 +33,6 @@ class RelabelWorkflow(WorkflowBase):
         self.output_key = output_key
 
     def requires(self):
-        shape = store.file_reader(self.input_path, "r")[self.input_key].shape
-        gconf = cfg.global_config(self.config_dir)
-        n_blocks = Blocking(shape, gconf["block_shape"]).n_blocks
         uniques = FindUniquesTask(
             self.tmp_folder,
             self.config_dir,
@@ -51,7 +45,8 @@ class RelabelWorkflow(WorkflowBase):
             self.tmp_folder,
             self.config_dir,
             dependencies=[uniques],
-            n_blocks=n_blocks,
+            input_path=self.input_path,
+            input_key=self.input_key,
         )
         write = WriteTask(
             self.tmp_folder,

@@ -6,7 +6,7 @@ from __future__ import annotations
 import os
 from typing import Optional, Sequence
 
-from ..runtime import config as cfg
+
 from ..runtime.workflow import WorkflowBase
 from ..tasks.thresholded_components import (
     ASSIGNMENTS_NAME,
@@ -17,8 +17,8 @@ from ..tasks.thresholded_components import (
     MergeOffsetsTask,
 )
 from ..tasks.write import WriteTask
-from ..utils import store
-from ..utils.blocking import Blocking
+
+
 
 
 class ThresholdedComponentsWorkflow(WorkflowBase):
@@ -48,13 +48,7 @@ class ThresholdedComponentsWorkflow(WorkflowBase):
         self.mask_path = mask_path
         self.mask_key = mask_key
 
-    def _n_blocks(self) -> int:
-        shape = store.file_reader(self.input_path, "r")[self.input_key].shape
-        gconf = cfg.global_config(self.config_dir)
-        return Blocking(shape, gconf["block_shape"]).n_blocks
-
     def requires(self):
-        n_blocks = self._n_blocks()
         blocks_key = self.output_key + "_blocks"
         components = BlockComponentsTask(
             self.tmp_folder,
@@ -71,7 +65,8 @@ class ThresholdedComponentsWorkflow(WorkflowBase):
             self.tmp_folder,
             self.config_dir,
             dependencies=[components],
-            n_blocks=n_blocks,
+            input_path=self.input_path,
+            input_key=self.input_key,
         )
         faces = BlockFacesTask(
             self.tmp_folder,
@@ -85,7 +80,8 @@ class ThresholdedComponentsWorkflow(WorkflowBase):
             self.tmp_folder,
             self.config_dir,
             dependencies=[faces],
-            n_blocks=n_blocks,
+            input_path=self.input_path,
+            input_key=self.input_key,
         )
         write = WriteTask(
             self.tmp_folder,
