@@ -1,0 +1,107 @@
+"""Per-segment morphology: sizes, centers of mass, bounding boxes.
+
+Reference morphology/{block_morphology,merge_morphology}.py via
+nifty.distributed (SURVEY.md §2.4).  Output table columns follow the reference
+layout (block_morphology.py:128-134):
+
+  [id, size, com_z, com_y, com_x, bb_begin_z, .., bb_end_z, .., bb_end_x]
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+
+MORPHOLOGY_KEY = "morphology/blocks"
+MORPHOLOGY_NAME = "morphology.npy"
+N_COLS = 11  # id, size, com*3, bb_begin*3, bb_end*3
+
+
+def block_morphology(seg: np.ndarray, offset) -> np.ndarray:
+    """Per-id partial morphology of one block (global coordinates)."""
+    ids, inv = np.unique(seg, return_inverse=True)
+    inv = inv.reshape(seg.shape)
+    n = ids.size
+    counts = np.bincount(inv.reshape(-1), minlength=n).astype(np.float64)
+    out = np.zeros((n, N_COLS))
+    out[:, 0] = ids
+    out[:, 1] = counts
+    coords = np.indices(seg.shape).reshape(3, -1)
+    flat = inv.reshape(-1)
+    for d in range(3):
+        sums = np.bincount(flat, weights=coords[d], minlength=n)
+        out[:, 2 + d] = sums / counts + offset[d]
+        mins = np.full(n, np.inf)
+        maxs = np.full(n, -np.inf)
+        np.minimum.at(mins, flat, coords[d])
+        np.maximum.at(maxs, flat, coords[d])
+        out[:, 5 + d] = mins + offset[d]
+        out[:, 8 + d] = maxs + offset[d] + 1
+    return out
+
+
+def merge_morphology(partials) -> np.ndarray:
+    """Combine per-block partial tables: sizes sum, COM weighted, bbox min/max."""
+    all_rows = np.concatenate(partials, axis=0)
+    ids = np.unique(all_rows[:, 0])
+    out = np.zeros((ids.size, N_COLS))
+    out[:, 0] = ids
+    idx = np.searchsorted(ids, all_rows[:, 0])
+    np.add.at(out[:, 1], idx, all_rows[:, 1])
+    for d in range(3):
+        com_w = np.zeros(ids.size)
+        np.add.at(com_w, idx, all_rows[:, 2 + d] * all_rows[:, 1])
+        out[:, 2 + d] = com_w / out[:, 1]
+        mins = np.full(ids.size, np.inf)
+        maxs = np.full(ids.size, -np.inf)
+        np.minimum.at(mins, idx, all_rows[:, 5 + d])
+        np.maximum.at(maxs, idx, all_rows[:, 8 + d])
+        out[:, 5 + d] = mins
+        out[:, 8 + d] = maxs
+    return out
+
+
+class BlockMorphologyTask(VolumeTask):
+    task_name = "block_morphology"
+    output_dtype = None
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        block = blocking.block(block_id)
+        seg = self.input_ds()[block.slicing]
+        table = block_morphology(seg, block.begin)
+        out = self.tmp_ragged(MORPHOLOGY_KEY, blocking.n_blocks, np.float64)
+        out.write_chunk((block_id,), table.reshape(-1))
+
+
+class MergeMorphologyTask(VolumeSimpleTask):
+    task_name = "merge_morphology"
+
+    def __init__(self, *args, input_path: str = None, input_key: str = None,
+                 **kwargs):
+        super().__init__(*args, input_path=input_path, input_key=input_key,
+                         **kwargs)
+
+    def run_impl(self) -> None:
+        n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
+        ds = self.tmp_store()[MORPHOLOGY_KEY]
+        partials = []
+        for bid in range(n_blocks):
+            chunk = ds.read_chunk((bid,))
+            if chunk is not None and chunk.size:
+                partials.append(chunk.reshape(-1, N_COLS))
+        table = (
+            merge_morphology(partials)
+            if partials
+            else np.zeros((0, N_COLS))
+        )
+        np.save(os.path.join(self.tmp_folder, MORPHOLOGY_NAME), table)
+        self.log(f"morphology for {table.shape[0]} segments")
+
+
+def load_morphology(tmp_folder: str) -> np.ndarray:
+    return np.load(os.path.join(tmp_folder, MORPHOLOGY_NAME))

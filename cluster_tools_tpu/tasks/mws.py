@@ -1,0 +1,104 @@
+"""Blockwise mutex watershed tasks (reference mutex_watershed/mws_blocks.py:26).
+
+Per halo'd block: MWS on long-range affinities (native Kruskal-with-mutex, the
+sequential kernel — SURVEY.md §7 hard-parts #2), crop inner, block-id offsets;
+boundary consistency comes from the stitching workflow downstream (reference
+mws_workflow.py:53-68).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..ops.mws import compute_mws_segmentation
+from ..utils.blocking import Blocking
+from .base import VolumeTask
+from .watershed import MAX_IDS_KEY
+
+
+class MwsBlocksTask(VolumeTask):
+    task_name = "mws_blocks"
+    output_dtype = "uint64"
+
+    def __init__(self, *args, mask_path: str = None, mask_key: str = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update(
+            {
+                # default CREMI-style long-range offsets (z, y, x)
+                "offsets": [
+                    [-1, 0, 0], [0, -1, 0], [0, 0, -1],
+                    [-2, 0, 0], [0, -3, 0], [0, 0, -3],
+                    [-3, -3, -3], [-3, 3, 3],
+                ],
+                "strides": [1, 2, 2],
+                "randomize_strides": False,
+                "noise_level": 0.0,
+                "halo": [2, 4, 4],
+            }
+        )
+        return conf
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        in_ds = self.input_ds()
+        out_ds = self.output_ds()
+        offsets = config.get("offsets")
+        halo = config.get("halo") or [0, 0, 0]
+        bh = blocking.block_with_halo(block_id, halo)
+        affs = in_ds[(slice(0, len(offsets)),) + bh.outer.slicing]
+        if affs.dtype == np.uint8:
+            affs = affs.astype(np.float32) / 255.0
+        mask = None
+        if self.mask_path:
+            from ..utils import store as _store
+
+            mask = _store.file_reader(self.mask_path, "r")[self.mask_key][
+                bh.outer.slicing
+            ].astype(bool)
+            if not mask.any():
+                out_ds[bh.inner.slicing] = np.zeros(
+                    bh.inner.shape, dtype=np.uint64
+                )
+                return
+        seg = compute_mws_segmentation(
+            affs,
+            offsets,
+            strides=config.get("strides"),
+            randomize_strides=bool(config.get("randomize_strides", False)),
+            mask=mask,
+            noise_level=float(config.get("noise_level", 0.0)),
+            seed=block_id,
+        )
+        # relabel the full outer region consecutively, then offset into the
+        # block's id namespace (reference mws_blocks.py:164-166); the outer
+        # labeling is ALSO saved so stitch_faces can compare both blocks'
+        # labelings of the shared halo region
+        from .stitching import save_block_overlap
+
+        uniq, inv = np.unique(seg, return_inverse=True)
+        inv = inv.reshape(seg.shape).astype(np.uint64)
+        lab_outer = inv if uniq[0] == 0 else inv + 1
+        # namespace sized by the FULL outer region: labels are consecutive over
+        # the halo'd box, so an inner-sized namespace (the reference crops to the
+        # inner block first, mws_blocks.py:161-166) could spill into the next
+        # block's range here
+        outer_full = [bs + 2 * h for bs, h in zip(blocking.block_shape, halo)]
+        offset_unit = np.uint64(block_id * int(np.prod(outer_full)))
+        lab_outer = np.where(lab_outer > 0, lab_outer + offset_unit, 0).astype(
+            np.uint64
+        )
+        lab = lab_outer[bh.inner_local.slicing]
+        out_ds[bh.inner.slicing] = lab
+        save_block_overlap(
+            self.tmp_folder, block_id, bh.outer.begin, bh.outer.end, lab_outer
+        )
+        max_ids = self.tmp_ragged(MAX_IDS_KEY, blocking.n_blocks, np.int64)
+        max_ids.write_chunk((block_id,), np.array([lab.max()], dtype=np.int64))

@@ -1,0 +1,179 @@
+"""Postprocessing: size filters, id filters, orphan handling, graph components.
+
+Reference postprocess/*.py (SURVEY.md §2.4, 1798 LoC family):
+  * size_filter           — discard segments below/above size bounds
+    (size_filter_blocks.py:23 + background_size_filter/filling_size_filter)
+  * id_filter             — remove an explicit id list (id_filter.py:22)
+  * graph_watershed_assignments — reassign discarded segments to their
+    strongest-connected kept neighbor by edge-weighted graph watershed
+    (graph_watershed_assignments.py:172)
+  * graph_connected_components  — CC over the node graph
+    (graph_connected_components.py:25)
+  * orphan_assignments    — merge orphans (segments without kept neighbors)
+    into their largest neighbor (orphan_assignments.py:26)
+
+All emit (old_id → new_id) assignment tables consumed by the write task.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..ops.unionfind import UnionFindNp
+from .base import VolumeSimpleTask
+from .morphology import MORPHOLOGY_NAME
+
+SIZE_FILTER_NAME = "size_filter_assignments.npy"
+ID_FILTER_NAME = "id_filter_assignments.npy"
+GRAPH_CC_NAME = "graph_cc_assignments.npy"
+GRAPH_WS_NAME = "graph_watershed_assignments.npy"
+
+
+class SizeFilterTask(VolumeSimpleTask):
+    """Assignment table zeroing segments outside [min_size, max_size]
+    (consumes the morphology table)."""
+
+    task_name = "size_filter"
+
+    def __init__(self, *args, min_size: int = 0, max_size: Optional[int] = None,
+                 relabel: bool = True, **kwargs):
+        super().__init__(*args, min_size=min_size, max_size=max_size,
+                         relabel=relabel, **kwargs)
+
+    def run_impl(self) -> None:
+        table = np.load(os.path.join(self.tmp_folder, MORPHOLOGY_NAME))
+        ids = table[:, 0].astype(np.uint64)
+        sizes = table[:, 1]
+        keep = sizes >= self.min_size
+        if self.max_size is not None:
+            keep &= sizes <= self.max_size
+        keep &= ids != 0
+        kept_ids = ids[keep]
+        new_ids = (
+            np.arange(1, kept_ids.size + 1, dtype=np.uint64)
+            if self.relabel
+            else kept_ids
+        )
+        assignment = np.stack([kept_ids, new_ids], axis=1)
+        np.save(os.path.join(self.tmp_folder, SIZE_FILTER_NAME), assignment)
+        self.log(
+            f"size filter: kept {kept_ids.size}/{ids.size} segments "
+            f"(min_size={self.min_size})"
+        )
+
+
+class IdFilterTask(VolumeSimpleTask):
+    """Remove an explicit list of ids (reference id_filter.py:22)."""
+
+    task_name = "id_filter"
+
+    def __init__(self, *args, filter_ids=(), all_ids_path: str = None, **kwargs):
+        super().__init__(*args, filter_ids=tuple(filter_ids),
+                         all_ids_path=all_ids_path, **kwargs)
+
+    def run_impl(self) -> None:
+        table = np.load(os.path.join(self.tmp_folder, MORPHOLOGY_NAME))
+        ids = table[:, 0].astype(np.uint64)
+        drop = np.isin(ids, np.asarray(self.filter_ids, dtype=np.uint64))
+        kept = ids[~drop & (ids != 0)]
+        assignment = np.stack([kept, kept], axis=1)
+        np.save(os.path.join(self.tmp_folder, ID_FILTER_NAME), assignment)
+
+
+def graph_watershed_assignments(
+    edges: np.ndarray,
+    weights: np.ndarray,
+    seeds: np.ndarray,
+    n_nodes: int,
+) -> np.ndarray:
+    """Edge-weighted graph watershed: unlabeled nodes adopt the label of the
+    neighbor reachable over the strongest path (max-min edge weight) —
+    nifty.graph.edgeWeightedWatershedsSegmentation equivalent.
+
+    ``seeds`` [n_nodes] with 0 = unlabeled.  Host Prim-style flood.
+    """
+    import heapq
+
+    labels = seeds.copy()
+    adj: list = [[] for _ in range(n_nodes)]
+    for (u, v), w in zip(edges, weights):
+        adj[int(u)].append((int(v), float(w)))
+        adj[int(v)].append((int(u), float(w)))
+    heap = []
+    for u in np.nonzero(seeds > 0)[0]:
+        for v, w in adj[u]:
+            if labels[v] == 0:
+                heapq.heappush(heap, (-w, int(u), v))
+    while heap:
+        negw, u, v = heapq.heappop(heap)
+        if labels[v] != 0:
+            continue
+        labels[v] = labels[u]
+        for x, w in adj[v]:
+            if labels[x] == 0:
+                heapq.heappush(heap, (-w, v, x))
+    return labels
+
+
+class GraphWatershedAssignmentsTask(VolumeSimpleTask):
+    """Reassign filtered-out segments to kept neighbors via graph watershed
+    (reference graph_watershed_assignments.py:25).  Needs the problem graph
+    (graph/edges) and edge costs/weights in the scratch store."""
+
+    task_name = "graph_watershed_assignments"
+
+    def __init__(self, *args, filter_path: str = None, **kwargs):
+        super().__init__(*args, filter_path=filter_path, **kwargs)
+
+    def run_impl(self) -> None:
+        from .costs import COSTS_NAME
+        from .graph import load_graph
+
+        nodes, edges = load_graph(self.tmp_store())
+        weights = np.load(os.path.join(self.tmp_folder, COSTS_NAME))
+        filtered = np.load(self.filter_path)  # ids to discard
+        drop = np.isin(nodes, filtered.astype(nodes.dtype))
+        seeds = np.arange(1, nodes.size + 1, dtype=np.int64)
+        seeds[drop] = 0
+        assigned = graph_watershed_assignments(
+            edges, np.abs(weights), seeds, nodes.size
+        )
+        # assigned holds (index+1) of the adopting node
+        target = nodes[np.maximum(assigned - 1, 0)]
+        target = np.where(assigned > 0, target, 0)
+        assignment = np.stack([nodes, target.astype(np.uint64)], axis=1)
+        np.save(os.path.join(self.tmp_folder, GRAPH_WS_NAME), assignment)
+        self.log(f"graph-watershed reassigned {int(drop.sum())} segments")
+
+
+class GraphConnectedComponentsTask(VolumeSimpleTask):
+    """Connected components over the node graph, optionally restricted to edges
+    above a merge threshold (reference graph_connected_components.py:25)."""
+
+    task_name = "graph_connected_components"
+
+    def __init__(self, *args, threshold: Optional[float] = None, **kwargs):
+        super().__init__(*args, threshold=threshold, **kwargs)
+
+    def run_impl(self) -> None:
+        from .costs import COSTS_NAME
+        from .graph import load_graph
+
+        nodes, edges = load_graph(self.tmp_store())
+        use = np.ones(edges.shape[0], dtype=bool)
+        if self.threshold is not None:
+            weights = np.load(os.path.join(self.tmp_folder, COSTS_NAME))
+            use = weights > self.threshold
+        uf = UnionFindNp(nodes.size)
+        if use.any():
+            uf.merge(edges[use, 0], edges[use, 1])
+        roots = uf.compress()
+        _, comp = np.unique(roots, return_inverse=True)
+        assignment = np.stack(
+            [nodes, (comp + 1).astype(np.uint64)], axis=1
+        )
+        np.save(os.path.join(self.tmp_folder, GRAPH_CC_NAME), assignment)
+        self.log(f"graph CC: {nodes.size} nodes → {comp.max() + 1} components")

@@ -1,0 +1,189 @@
+"""Stitching of block-wise segmentations.
+
+Reference stitching/*.py (SURVEY.md §2.4): merge block-offset labels across
+block boundaries by mutual-max overlap votes (stitch_faces.py:110-175), or by a
+multicut restricted to block-boundary edges (stitching_multicut.py:135-139).
+
+The overlap criterion compares **two labelings of the same voxels**: each block
+saves its segmentation of its halo'd outer region; for a face between blocks A
+and B, A's and B's labelings of the shared overlap region are contingency-
+matched.  A pair merges iff each segment is the other's maximal normalized
+overlap partner, both lie on the actual boundary plane, and the mean normalized
+overlap exceeds ``overlap_threshold`` (reference _stitch_face semantics).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..ops.unionfind import merge_assignments_np
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+
+STITCH_PAIRS_KEY = "stitching/face_pairs"
+STITCH_ASSIGNMENTS_NAME = "stitch_assignments.npy"
+
+
+def overlap_dir(tmp_folder: str) -> str:
+    return os.path.join(tmp_folder, "stitch_overlaps")
+
+
+def save_block_overlap(tmp_folder: str, block_id: int, outer_begin, outer_end,
+                       seg: np.ndarray) -> None:
+    """Save a block's labeling of its outer (halo'd) region for stitching."""
+    d = overlap_dir(tmp_folder)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"block_{block_id}.npz")
+    tmp = path + f".tmp{os.getpid()}.npz"
+    np.savez_compressed(
+        tmp, begin=np.asarray(outer_begin), end=np.asarray(outer_end), seg=seg
+    )
+    os.replace(tmp, path)
+
+
+def load_block_overlap(tmp_folder: str, block_id: int):
+    path = os.path.join(overlap_dir(tmp_folder), f"block_{block_id}.npz")
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as f:
+        return f["begin"], f["end"], f["seg"]
+
+
+def _mutual_max_pairs(seg_a, seg_b, boundary_a, boundary_b, threshold):
+    """Mutual-max votes between two labelings of the same region."""
+    both = (seg_a > 0) & (seg_b > 0)
+    if not both.any():
+        return []
+    a = seg_a[both].astype(np.int64)
+    b = seg_b[both].astype(np.int64)
+    pairs = np.stack([a, b], axis=1)
+    uniq, counts = np.unique(pairs, axis=0, return_counts=True)
+    ua, ub, c = uniq[:, 0], uniq[:, 1], counts.astype(np.float64)
+    size_a: Dict[int, float] = {}
+    size_b: Dict[int, float] = {}
+    for x, y, n in zip(ua, ub, c):
+        size_a[int(x)] = size_a.get(int(x), 0.0) + n
+        size_b[int(y)] = size_b.get(int(y), 0.0) + n
+    # best partner per side by count
+    order = np.argsort(c, kind="stable")
+    best_ab, best_ba = {}, {}
+    for x, y, n in zip(ua[order], ub[order], c[order]):
+        best_ab[int(x)] = (int(y), n)
+        best_ba[int(y)] = (int(x), n)
+    on_a = set(int(s) for s in np.unique(boundary_a) if s != 0)
+    on_b = set(int(s) for s in np.unique(boundary_b) if s != 0)
+    votes = []
+    for x, (y, n_xy) in best_ab.items():
+        if x not in on_a or y not in on_b:
+            continue
+        back, n_yx = best_ba.get(y, (None, 0.0))
+        if back != x:
+            continue
+        measure = 0.5 * (n_xy / size_a[x] + n_yx / size_b[y])
+        if measure > threshold:
+            votes.append((x, y))
+    return votes
+
+
+class StitchFacesTask(VolumeTask):
+    """Per-face mutual-max-overlap merge votes (reference stitch_faces.py:25)."""
+
+    task_name = "stitch_faces"
+    output_dtype = None
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update({"overlap_threshold": 0.5})
+        return conf
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        threshold = float(config.get("overlap_threshold", 0.5))
+        mine = load_block_overlap(self.tmp_folder, block_id)
+        pairs = []
+        if mine is not None:
+            my_begin, my_end, my_seg = mine
+            for axis in range(blocking.ndim):
+                ngb_id = blocking.neighbor_id(block_id, axis, lower=False)
+                if ngb_id is None:
+                    continue
+                theirs = load_block_overlap(self.tmp_folder, ngb_id)
+                if theirs is None:
+                    continue
+                nb_begin, nb_end, nb_seg = theirs
+                # intersection of the two outer regions
+                lo = np.maximum(my_begin, nb_begin)
+                hi = np.minimum(my_end, nb_end)
+                if (lo >= hi).any():
+                    continue
+                sl_a = tuple(
+                    slice(l - b, h - b) for l, h, b in zip(lo, hi, my_begin)
+                )
+                sl_b = tuple(
+                    slice(l - b, h - b) for l, h, b in zip(lo, hi, nb_begin)
+                )
+                ov_a = my_seg[sl_a]
+                ov_b = nb_seg[sl_b]
+                # boundary plane between the two inner regions, in overlap coords
+                boundary = blocking.block(block_id).end[axis]
+                plane = boundary - int(lo[axis])
+                plane_sl = [slice(None)] * blocking.ndim
+                plane_sl[axis] = slice(max(plane - 1, 0), plane + 1)
+                plane_sl = tuple(plane_sl)
+                votes = _mutual_max_pairs(
+                    ov_a, ov_b, ov_a[plane_sl], ov_b[plane_sl], threshold
+                )
+                pairs.extend(votes)
+        out = self.tmp_ragged(STITCH_PAIRS_KEY, blocking.n_blocks, np.int64)
+        arr = (
+            np.asarray(pairs, dtype=np.int64).reshape(-1)
+            if pairs
+            else np.array([], dtype=np.int64)
+        )
+        out.write_chunk((block_id,), arr)
+
+
+class StitchAssignmentsTask(VolumeSimpleTask):
+    """Union-find over stitch votes → assignment table
+    (reference simple_stitch_assignments.py:24)."""
+
+    task_name = "stitch_assignments"
+
+    def __init__(self, *args, input_path: str = None, input_key: str = None,
+                 **kwargs):
+        super().__init__(*args, input_path=input_path, input_key=input_key,
+                         **kwargs)
+
+    def run_impl(self) -> None:
+        n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
+        ds = self.tmp_store()[STITCH_PAIRS_KEY]
+        pairs = []
+        for bid in range(n_blocks):
+            chunk = ds.read_chunk((bid,))
+            if chunk is not None and chunk.size:
+                pairs.append(chunk.reshape(-1, 2))
+        all_pairs = (
+            np.concatenate(pairs, axis=0) if pairs else np.zeros((0, 2), np.int64)
+        )
+        # ids are sparse (block-offset); compact to dense for the union-find.
+        # nodes not in any vote keep their identity via the write task's
+        # identity-passthrough, so the table only needs voted ids
+        ids = np.unique(all_pairs.reshape(-1)) if all_pairs.size else np.array([], np.int64)
+        if ids.size == 0:
+            np.save(os.path.join(self.tmp_folder, STITCH_ASSIGNMENTS_NAME),
+                    np.zeros((0, 2), dtype=np.uint64))
+            return
+        dense = np.searchsorted(ids, all_pairs)
+        assignment, _ = merge_assignments_np(ids.size + 1, dense + 1)
+        # map back: voted id → smallest id in its merged group
+        group_min = np.full(int(assignment.max()) + 1, np.iinfo(np.int64).max)
+        np.minimum.at(group_min, assignment[1:], ids)
+        table = np.stack(
+            [ids.astype(np.uint64), group_min[assignment[1:]].astype(np.uint64)],
+            axis=1,
+        )
+        np.save(os.path.join(self.tmp_folder, STITCH_ASSIGNMENTS_NAME), table)
+        self.log(f"stitching merged {ids.size} voted ids")

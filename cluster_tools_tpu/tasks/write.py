@@ -3,8 +3,10 @@
 Reference write.py:29-206 (`_apply_node_labels`, `_write_block_with_offsets`).
 Assignment modes (sniffed from the array on disk):
   * dense 1d array   — ``out = assignment[labels]`` (labels must be dense ids)
-  * 2-column table   — (old_id, new_id) rows, looked up via searchsorted;
-                       ids absent from the table map to 0
+  * 2-column table   — (old_id, new_id) rows, looked up via searchsorted; ids
+                       absent from the table map to 0 (``table_default="zero"``,
+                       relabel/filter semantics) or pass through unchanged
+                       (``table_default="identity"``, stitching semantics)
 
 Optional per-block offsets (from merge_offsets) are added to non-zero labels
 before the lookup.
@@ -31,12 +33,18 @@ class WriteTask(VolumeTask):
         assignment_path: str = None,
         offsets_path: Optional[str] = None,
         identifier: Optional[str] = None,
+        table_default: str = "zero",
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
         self.assignment_path = assignment_path
         self.offsets_path = offsets_path
         self._identifier = identifier
+        if table_default not in ("zero", "identity"):
+            raise ValueError(
+                f"table_default must be 'zero' or 'identity', got {table_default!r}"
+            )
+        self.table_default = table_default
 
     @property
     def identifier(self) -> str:
@@ -63,5 +71,8 @@ class WriteTask(VolumeTask):
         if assignment.ndim == 1:
             out = assignment[labels]
         else:
-            out = apply_assignment_table_np(labels.astype(np.uint64), assignment)
+            out = apply_assignment_table_np(
+                labels.astype(np.uint64), assignment,
+                default_zero=(self.table_default == "zero"),
+            )
         out_ds[bb] = out.astype(np.uint64)

@@ -1,18 +1,24 @@
+from .evaluation import EvaluationWorkflow
+from .morphology import MorphologyWorkflow
 from .multicut import (
     EdgeFeaturesWorkflow,
     GraphWorkflow,
     MulticutSegmentationWorkflow,
     MulticutWorkflow,
 )
+from .mws import MwsWorkflow
 from .relabel import RelabelWorkflow
 from .thresholded_components import ThresholdedComponentsWorkflow
 from .watershed import WatershedWorkflow
 
 __all__ = [
+    "EvaluationWorkflow",
     "EdgeFeaturesWorkflow",
     "GraphWorkflow",
+    "MorphologyWorkflow",
     "MulticutSegmentationWorkflow",
     "MulticutWorkflow",
+    "MwsWorkflow",
     "RelabelWorkflow",
     "ThresholdedComponentsWorkflow",
     "WatershedWorkflow",

@@ -1,0 +1,30 @@
+"""Morphology workflow (reference morphology_workflow.py:11):
+per-block morphology partials → merged per-segment table."""
+
+from __future__ import annotations
+
+from ..runtime.workflow import WorkflowBase
+from ..tasks.morphology import BlockMorphologyTask, MergeMorphologyTask
+
+
+class MorphologyWorkflow(WorkflowBase):
+    task_name = "morphology_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
+                 input_path: str = None, input_key: str = None,
+                 dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+
+    def requires(self):
+        block = BlockMorphologyTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=list(self.dependencies),
+            input_path=self.input_path, input_key=self.input_key,
+        )
+        merge = MergeMorphologyTask(
+            self.tmp_folder, self.config_dir, dependencies=[block],
+            input_path=self.input_path, input_key=self.input_key,
+        )
+        return [merge]

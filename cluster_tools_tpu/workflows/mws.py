@@ -1,0 +1,84 @@
+"""Mutex watershed workflow (reference mws_workflow.py:14-78):
+blockwise MWS → face stitching → write."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..runtime.workflow import WorkflowBase
+from ..tasks.mws import MwsBlocksTask
+from ..tasks.stitching import (
+    STITCH_ASSIGNMENTS_NAME,
+    StitchAssignmentsTask,
+    StitchFacesTask,
+)
+from ..tasks.write import WriteTask
+
+
+class MwsWorkflow(WorkflowBase):
+    task_name = "mws_workflow"
+
+    def __init__(
+        self,
+        tmp_folder,
+        config_dir=None,
+        max_jobs=None,
+        target=None,
+        input_path: str = None,
+        input_key: str = None,
+        output_path: str = None,
+        output_key: str = None,
+        mask_path: str = None,
+        mask_key: str = None,
+        stitch: bool = True,
+        dependencies=(),
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        self.stitch = stitch
+
+    def requires(self):
+        blocks_key = self.output_key + ("_blocks" if self.stitch else "")
+        mws = MwsBlocksTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=list(self.dependencies),
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=blocks_key,
+            mask_path=self.mask_path, mask_key=self.mask_key,
+        )
+        if not self.stitch:
+            return [mws]
+        faces = StitchFacesTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=[mws],
+            input_path=self.output_path, input_key=blocks_key,
+        )
+        assignments = StitchAssignmentsTask(
+            self.tmp_folder, self.config_dir,
+            dependencies=[faces],
+            input_path=self.output_path, input_key=blocks_key,
+        )
+        write = WriteTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=[assignments],
+            input_path=self.output_path, input_key=blocks_key,
+            output_path=self.output_path, output_key=self.output_key,
+            assignment_path=os.path.join(self.tmp_folder, STITCH_ASSIGNMENTS_NAME),
+            identifier="mws_stitch",
+            table_default="identity",
+        )
+        return [write]
+
+    @classmethod
+    def get_config(cls):
+        conf = super().get_config()
+        conf["mws_blocks"] = MwsBlocksTask.default_task_config()
+        conf["stitch_faces"] = StitchFacesTask.default_task_config()
+        conf["write"] = WriteTask.default_task_config()
+        return conf

@@ -1,0 +1,186 @@
+"""Evaluation metrics, node labels, morphology, postprocess, stitching, MWS."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+class TestEvaluationOps:
+    def test_perfect_segmentation(self, rng):
+        from cluster_tools_tpu.ops.evaluation import evaluate_segmentation
+
+        gt = rng.integers(1, 10, (20, 20)).astype(np.uint64)
+        scores = evaluate_segmentation(gt.copy(), gt)
+        assert scores["rand_index"] == pytest.approx(1.0)
+        assert scores["adapted_rand_error"] == pytest.approx(0.0, abs=1e-12)
+        assert scores["vi"] == pytest.approx(0.0, abs=1e-12)
+
+    def test_split_increases_vi_split(self, rng):
+        from cluster_tools_tpu.ops.evaluation import evaluate_segmentation
+
+        gt = np.ones((16, 16), dtype=np.uint64)
+        seg = np.ones((16, 16), dtype=np.uint64)
+        seg[:, 8:] = 2  # over-segmentation
+        s = evaluate_segmentation(seg, gt)
+        assert s["vi_split"] > 0.5
+        assert s["vi_merge"] == pytest.approx(0.0, abs=1e-12)
+        # merge direction
+        gt2 = seg.copy()
+        seg2 = np.ones_like(gt2)
+        s2 = evaluate_segmentation(seg2, gt2)
+        assert s2["vi_merge"] > 0.5
+        assert s2["vi_split"] == pytest.approx(0.0, abs=1e-12)
+
+    def test_vi_matches_direct_formula(self, rng):
+        from cluster_tools_tpu.ops.evaluation import evaluate_segmentation
+
+        a = rng.integers(1, 6, 500).astype(np.uint64)
+        b = rng.integers(1, 4, 500).astype(np.uint64)
+        s = evaluate_segmentation(a, b, ignore_gt_zero=False)
+        # direct entropy computation
+        def entropy(x):
+            _, c = np.unique(x, return_counts=True)
+            p = c / c.sum()
+            return -(p * np.log(p)).sum()
+
+        joint = entropy(a.astype(np.uint64) * 7 + b)
+        assert s["vi"] == pytest.approx(2 * joint - entropy(a) - entropy(b), abs=1e-9)
+
+    def test_object_vi(self):
+        from cluster_tools_tpu.ops.evaluation import object_vi
+
+        gt = np.ones((8, 8), dtype=np.uint64)
+        gt[4:] = 2
+        seg = gt.copy()
+        seg[:2] = 3  # split gt object 1
+        scores = object_vi(seg, gt)
+        assert scores[1][0] > 0  # split term for object 1
+        assert scores[2][0] == pytest.approx(0.0, abs=1e-12)
+
+
+class TestEvaluationWorkflow:
+    def test_distributed_matches_direct(self, tmp_path, rng):
+        from cluster_tools_tpu.ops.evaluation import evaluate_segmentation
+        from cluster_tools_tpu.workflows import EvaluationWorkflow
+        from cluster_tools_tpu.tasks.evaluation import load_measures
+
+        shape = (24, 32, 32)
+        gt = rng.integers(0, 8, shape).astype(np.uint64)
+        seg = gt.copy()
+        flip = rng.random(shape) < 0.1
+        seg[flip] = rng.integers(1, 12, int(flip.sum())).astype(np.uint64)
+        path = str(tmp_path / "d.n5")
+        f = file_reader(path)
+        f.create_dataset("seg", data=seg, chunks=(12, 16, 16))
+        f.create_dataset("gt", data=gt, chunks=(12, 16, 16))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [12, 16, 16]})
+        wf = EvaluationWorkflow(
+            tmp_folder, config_dir,
+            seg_path=path, seg_key="seg", gt_path=path, gt_key="gt",
+        )
+        assert build([wf])
+        got = load_measures(tmp_folder)
+        want = evaluate_segmentation(seg, gt)
+        for k in ("rand_index", "adapted_rand_error", "vi_split", "vi_merge"):
+            assert got[k] == pytest.approx(want[k], abs=1e-9), k
+
+
+class TestMorphology:
+    def test_workflow_matches_direct(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.morphology import load_morphology
+        from cluster_tools_tpu.workflows import MorphologyWorkflow
+
+        shape = (16, 24, 24)
+        seg = rng.integers(0, 6, shape).astype(np.uint64)
+        path = str(tmp_path / "d.n5")
+        file_reader(path).create_dataset("seg", data=seg, chunks=(8, 12, 12))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 12, 12]})
+        wf = MorphologyWorkflow(
+            tmp_folder, config_dir, input_path=path, input_key="seg"
+        )
+        assert build([wf])
+        table = load_morphology(tmp_folder)
+        for row in table:
+            sid = int(row[0])
+            sel = seg == sid
+            assert row[1] == sel.sum()
+            com = np.argwhere(sel).mean(axis=0)
+            np.testing.assert_allclose(row[2:5], com, atol=1e-6)
+            coords = np.argwhere(sel)
+            np.testing.assert_array_equal(row[5:8], coords.min(axis=0))
+            np.testing.assert_array_equal(row[8:11], coords.max(axis=0) + 1)
+
+
+class TestPostprocess:
+    def test_graph_watershed_assignments(self):
+        from cluster_tools_tpu.tasks.postprocess import graph_watershed_assignments
+
+        # chain 0-1-2-3; seeds at ends; node 1 closer (stronger edge) to 0
+        edges = np.array([[0, 1], [1, 2], [2, 3]])
+        weights = np.array([0.9, 0.2, 0.8])
+        seeds = np.array([1, 0, 0, 2])
+        out = graph_watershed_assignments(edges, weights, seeds, 4)
+        np.testing.assert_array_equal(out, [1, 1, 2, 2])
+
+
+class TestMwsWorkflow:
+    def _make_affs(self, rng, shape=(16, 32, 32)):
+        # two halves separated along y with strong repulsion; only the
+        # y-direction long-range channel carries boundary evidence (zeroing the
+        # x channel would install x-mutexes that legitimately shatter the halves)
+        offsets = [[-1, 0, 0], [0, -1, 0], [0, 0, -1], [0, -4, 0], [0, 0, -4]]
+        affs = np.full((len(offsets),) + shape, 0.9, dtype=np.float32)
+        mid = shape[1] // 2
+        affs[:3, :, mid - 1 : mid + 1, :] = 0.05   # attractive cut at boundary
+        # y-repulsion: source rows [mid, mid+4) pair with [mid-4, mid) — every
+        # mutex crosses the boundary, none lands within a half
+        affs[3, :, mid : mid + 4, :] = 0.05
+        return affs, offsets
+
+    def test_mws_workflow_stitches(self, tmp_path, rng):
+        from cluster_tools_tpu.workflows import MwsWorkflow
+
+        affs, offsets = self._make_affs(rng)
+        path = str(tmp_path / "d.n5")
+        file_reader(path).create_dataset(
+            "affs", data=affs, chunks=(len(offsets), 8, 16, 16)
+        )
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        # dense mutexes: stride subsampling on this synthetic fixture drops all
+        # mutexes on odd columns, legitimately letting weak attractions cross
+        cfg.write_config(
+            config_dir, "mws_blocks",
+            {"offsets": offsets, "strides": [1, 1, 1], "halo": [2, 4, 4]},
+        )
+        wf = MwsWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="affs",
+            output_path=path, output_key="seg",
+        )
+        assert build([wf])
+        seg = file_reader(path, "r")["seg"][:]
+        assert (seg > 0).all()
+        # the two halves must each be stitched into a dominant segment, and the
+        # dominant segments must differ across the repulsion boundary
+        def dominant(x):
+            ids, counts = np.unique(x, return_counts=True)
+            return ids[counts.argmax()]
+
+        top = seg[:, :10, :]
+        bottom = seg[:, 22:, :]
+        dom_top = dominant(top)
+        dom_bottom = dominant(bottom)
+        assert dom_top != dom_bottom
+        assert (top == dom_top).mean() > 0.8
+        assert (bottom == dom_bottom).mean() > 0.8
