@@ -6,9 +6,14 @@ block-local → global edge-id maps.
 
 Storage layout in the scratch store (``tmp_folder/data.zarr``):
   graph/sub_edges        ragged per block: flattened (u,v) label pairs (uint64)
+  graph/sub_nodes        ragged per block: unique non-zero labels (uint64)
   graph/nodes            [n] sorted unique node labels (uint64)
   graph/edges            [m,2] dense node-index pairs, lexicographically sorted
   graph/block_edge_ids   ragged per block: global edge id per block edge
+
+Nodes are collected per block (not derived from edges) so isolated fragments —
+labels with no adjacent fragment — stay in the graph and keep their identity
+through solve/write (the reference's graph carries all nodes the same way).
 """
 
 from __future__ import annotations
@@ -22,6 +27,7 @@ from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
 
 SUB_EDGES_KEY = "graph/sub_edges"
+SUB_NODES_KEY = "graph/sub_nodes"
 NODES_KEY = "graph/nodes"
 EDGES_KEY = "graph/edges"
 BLOCK_EDGE_IDS_KEY = "graph/block_edge_ids"
@@ -50,9 +56,14 @@ class InitialSubGraphsTask(VolumeTask):
 
     def process_block(self, block_id: int, blocking: Blocking, config):
         seg = _read_block_with_upper_halo(self.input_ds(), blocking, block_id)
-        edges = block_edges(seg.astype(np.uint64))
+        seg = seg.astype(np.uint64)
+        edges = block_edges(seg)
         sub = self.tmp_ragged(SUB_EDGES_KEY, blocking.n_blocks, np.uint64)
         sub.write_chunk((block_id,), edges.reshape(-1))
+        labels = np.unique(seg)
+        labels = labels[labels > 0]
+        sub_nodes = self.tmp_ragged(SUB_NODES_KEY, blocking.n_blocks, np.uint64)
+        sub_nodes.write_chunk((block_id,), labels)
 
 
 class MergeSubGraphsTask(VolumeSimpleTask):
@@ -71,17 +82,23 @@ class MergeSubGraphsTask(VolumeSimpleTask):
         n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         store = self.tmp_store()
         sub = store[SUB_EDGES_KEY]
-        collected = []
+        sub_nodes = store[SUB_NODES_KEY]
+        collected, node_chunks = [], []
         for bid in range(n_blocks):
             chunk = sub.read_chunk((bid,))
             if chunk is not None and chunk.size:
                 collected.append(chunk.reshape(-1, 2))
+            nchunk = sub_nodes.read_chunk((bid,))
+            if nchunk is not None and nchunk.size:
+                node_chunks.append(nchunk)
         if collected:
             label_edges = np.unique(np.concatenate(collected, axis=0), axis=0)
         else:
             label_edges = np.zeros((0, 2), dtype=np.uint64)
-        nodes = np.unique(label_edges.reshape(-1)) if label_edges.size else np.zeros(
-            0, dtype=np.uint64
+        nodes = (
+            np.unique(np.concatenate(node_chunks))
+            if node_chunks
+            else np.zeros(0, dtype=np.uint64)
         )
         dense = np.searchsorted(nodes, label_edges).astype(np.int64)
         # lexicographic edge order (u, then v) — defines global edge ids

@@ -81,11 +81,6 @@ class BlockMorphologyTask(VolumeTask):
 class MergeMorphologyTask(VolumeSimpleTask):
     task_name = "merge_morphology"
 
-    def __init__(self, *args, input_path: str = None, input_key: str = None,
-                 **kwargs):
-        super().__init__(*args, input_path=input_path, input_key=input_key,
-                         **kwargs)
-
     def run_impl(self) -> None:
         n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         ds = self.tmp_store()[MORPHOLOGY_KEY]

@@ -138,8 +138,10 @@ class GraphWatershedAssignmentsTask(VolumeSimpleTask):
         drop = np.isin(nodes, filtered.astype(nodes.dtype))
         seeds = np.arange(1, nodes.size + 1, dtype=np.int64)
         seeds[drop] = 0
+        # signed costs: larger = more attractive; the flood must follow merge
+        # evidence, NOT |cost| (a strongly repulsive edge is a definite boundary)
         assigned = graph_watershed_assignments(
-            edges, np.abs(weights), seeds, nodes.size
+            edges, weights, seeds, nodes.size
         )
         # assigned holds (index+1) of the adopting node
         target = nodes[np.maximum(assigned - 1, 0)]
@@ -176,4 +178,5 @@ class GraphConnectedComponentsTask(VolumeSimpleTask):
             [nodes, (comp + 1).astype(np.uint64)], axis=1
         )
         np.save(os.path.join(self.tmp_folder, GRAPH_CC_NAME), assignment)
-        self.log(f"graph CC: {nodes.size} nodes → {comp.max() + 1} components")
+        n_comp = int(comp.max()) + 1 if comp.size else 0
+        self.log(f"graph CC: {nodes.size} nodes → {n_comp} components")

@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..ops.segment import contingency_table
 from ..ops.unionfind import merge_assignments_np
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
@@ -57,16 +58,14 @@ def _mutual_max_pairs(seg_a, seg_b, boundary_a, boundary_b, threshold):
     both = (seg_a > 0) & (seg_b > 0)
     if not both.any():
         return []
-    a = seg_a[both].astype(np.int64)
-    b = seg_b[both].astype(np.int64)
-    pairs = np.stack([a, b], axis=1)
-    uniq, counts = np.unique(pairs, axis=0, return_counts=True)
-    ua, ub, c = uniq[:, 0], uniq[:, 1], counts.astype(np.float64)
-    size_a: Dict[int, float] = {}
-    size_b: Dict[int, float] = {}
-    for x, y, n in zip(ua, ub, c):
-        size_a[int(x)] = size_a.get(int(x), 0.0) + n
-        size_b[int(y)] = size_b.get(int(y), 0.0) + n
+    ua, ub, counts = contingency_table(
+        seg_a[both].astype(np.int64), seg_b[both].astype(np.int64)
+    )
+    c = counts.astype(np.float64)
+    uniq_a, inv_a = np.unique(ua, return_inverse=True)
+    uniq_b, inv_b = np.unique(ub, return_inverse=True)
+    size_a = dict(zip(uniq_a.tolist(), np.bincount(inv_a, weights=c)))
+    size_b = dict(zip(uniq_b.tolist(), np.bincount(inv_b, weights=c)))
     # best partner per side by count
     order = np.argsort(c, kind="stable")
     best_ab, best_ba = {}, {}
@@ -151,11 +150,6 @@ class StitchAssignmentsTask(VolumeSimpleTask):
     (reference simple_stitch_assignments.py:24)."""
 
     task_name = "stitch_assignments"
-
-    def __init__(self, *args, input_path: str = None, input_key: str = None,
-                 **kwargs):
-        super().__init__(*args, input_path=input_path, input_key=input_key,
-                         **kwargs)
 
     def run_impl(self) -> None:
         n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)

@@ -187,6 +187,167 @@ class WatershedTask(VolumeTask):
         self._run_batch(block_ids, blocking, config)
 
 
+class WatershedFromSeedsTask(VolumeTask):
+    """Seeded watershed from a given (global-id) seed volume
+    (reference watershed/watershed_from_seeds.py:25).
+
+    ``input_path/key`` is the boundary/height map, ``seeds_path/key`` a label
+    volume whose non-zero ids become the seeds.  Because the seed ids are
+    global, the output is boundary-consistent across blocks without a stitching
+    step (halo'd floods agree where they overlap up to flood-order ties).
+    """
+
+    task_name = "watershed_from_seeds"
+    output_dtype = "uint64"
+
+    def __init__(self, *args, seeds_path: str = None, seeds_key: str = None,
+                 mask_path: str = None, mask_key: str = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.seeds_path = seeds_path
+        self.seeds_key = seeds_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update(
+            {
+                "sigma_weights": 2.0,
+                "halo": [2, 8, 8],
+                "invert_inputs": False,
+                "apply_ws_2d": False,
+                "size_filter": 0,
+                "channel_begin": 0,
+                "channel_end": None,
+                "agglomerate_channels": "mean",
+            }
+        )
+        return conf
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        in_ds = self.input_ds()
+        out_ds = self.output_ds()
+        seeds_ds = store.file_reader(self.seeds_path, "r")[self.seeds_key]
+        halo = config.get("halo") or [0, 0, 0]
+        bh = blocking.block_with_halo(block_id, halo)
+
+        x = _read_input_block(in_ds, bh.outer.slicing, config)
+        if config.get("invert_inputs", False):
+            x = 1.0 - x
+        seeds = seeds_ds[bh.outer.slicing].astype(np.uint64)
+
+        mask = None
+        if self.mask_path:
+            mask_ds = store.file_reader(self.mask_path, "r")[self.mask_key]
+            mask = mask_ds[bh.outer.slicing].astype(bool)
+
+        sigma = float(config.get("sigma_weights", 2.0))
+        per_slice = bool(config.get("apply_ws_2d", False)) and x.ndim == 3
+        hmap = jnp.asarray(x)
+        if sigma > 0:
+            from ..ops.filters import gaussian
+
+            sig = (0.0,) + (sigma,) * (x.ndim - 1) if per_slice else sigma
+            hmap = gaussian(hmap, sig)
+
+        # flood over compact ids (int32-safe on device), map back after
+        uniq = np.unique(seeds)
+        uniq = uniq[uniq > 0]
+        compact = np.searchsorted(uniq, seeds) + 1
+        compact = np.where(seeds > 0, compact, 0).astype(np.int32)
+        labels = ws_ops.seeded_watershed(
+            hmap,
+            jnp.asarray(compact),
+            mask=None if mask is None else jnp.asarray(mask),
+            per_slice=per_slice,
+        )
+        size_filter = int(config.get("size_filter", 0))
+        if size_filter > 0:
+            labels = ws_ops.apply_size_filter(
+                labels, hmap, size_filter, int(uniq.size + 2),
+                mask=None if mask is None else jnp.asarray(mask),
+                per_slice=per_slice,
+            )
+        labels = np.asarray(labels).astype(np.int64)
+        lookup = np.concatenate([[np.uint64(0)], uniq]).astype(np.uint64)
+        out = lookup[labels[bh.inner_local.slicing]]
+        out_ds[bh.inner.slicing] = out
+
+
+class AgglomerateTask(VolumeTask):
+    """Per-block agglomeration of watershed fragments
+    (reference watershed/agglomerate.py:33): build the block's RAG with mean
+    boundary-evidence edge weights and merge fragments below the threshold
+    (mala clustering semantics).  Fragment ids stay in the block's offset
+    namespace, so downstream stitching/relabel tasks apply unchanged.
+    """
+
+    task_name = "agglomerate"
+    output_dtype = "uint64"
+
+    def __init__(self, *args, labels_path: str = None, labels_key: str = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        # ``input_path/key`` = boundary map; ``labels_path/key`` = watershed
+        self.labels_path = labels_path
+        self.labels_key = labels_key
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update(
+            {
+                "threshold": 0.9,
+                "use_mala_agglomeration": True,
+                "channel_begin": 0,
+                "channel_end": None,
+                "agglomerate_channels": "mean",
+                "invert_inputs": False,
+            }
+        )
+        return conf
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        from ..ops.multicut import agglomerative_clustering
+        from ..ops.rag import boundary_edge_features
+
+        bb = blocking.block(block_id).slicing
+        seg = store.file_reader(self.labels_path, "r")[self.labels_key][bb]
+        seg = seg.astype(np.uint64)
+        out_ds = self.output_ds()
+        uniq = np.unique(seg)
+        uniq = uniq[uniq > 0]
+        if uniq.size == 0:
+            out_ds[bb] = seg
+            return
+        x = _read_input_block(self.input_ds(), bb, config)
+        if config.get("invert_inputs", False):
+            x = 1.0 - x
+        edges, feats = boundary_edge_features(seg, x.astype(np.float64))
+        if edges.shape[0] == 0:
+            out_ds[bb] = seg
+            return
+        # compact node ids for the local clustering problem
+        uv = np.searchsorted(uniq, edges).astype(np.int64)
+        clusters = agglomerative_clustering(
+            uniq.size,
+            uv,
+            feats[:, 0],                      # mean boundary evidence
+            float(config.get("threshold", 0.9)),
+            edge_sizes=feats[:, 9],           # face size
+        )
+        # merged fragments take the smallest member id — stays in the block's
+        # offset namespace (reference agglomerate.py relabels w/ block offset)
+        rep = np.full(int(clusters.max()) + 1, np.iinfo(np.int64).max, np.int64)
+        np.minimum.at(rep, clusters, np.arange(uniq.size, dtype=np.int64))
+        mapped = uniq[rep[clusters]]
+        lookup = np.concatenate([[np.uint64(0)], mapped]).astype(np.uint64)
+        dense = np.searchsorted(uniq, seg) + 1
+        dense = np.where(seg > 0, dense, 0)
+        out_ds[bb] = lookup[dense]
+
+
 class TwoPassWatershedTask(WatershedTask):
     """One pass of the checkerboard two-pass watershed
     (reference two_pass_watershed.py:32-99).

@@ -1,3 +1,4 @@
+from .agglomerative_clustering import AgglomerativeClusteringWorkflow
 from .evaluation import EvaluationWorkflow
 from .morphology import MorphologyWorkflow
 from .multicut import (
@@ -8,10 +9,14 @@ from .multicut import (
 )
 from .mws import MwsWorkflow
 from .relabel import RelabelWorkflow
-from .thresholded_components import ThresholdedComponentsWorkflow
+from .thresholded_components import (
+    ThresholdAndWatershedWorkflow,
+    ThresholdedComponentsWorkflow,
+)
 from .watershed import WatershedWorkflow
 
 __all__ = [
+    "AgglomerativeClusteringWorkflow",
     "EvaluationWorkflow",
     "EdgeFeaturesWorkflow",
     "GraphWorkflow",
@@ -20,6 +25,7 @@ __all__ = [
     "MulticutWorkflow",
     "MwsWorkflow",
     "RelabelWorkflow",
+    "ThresholdAndWatershedWorkflow",
     "ThresholdedComponentsWorkflow",
     "WatershedWorkflow",
 ]

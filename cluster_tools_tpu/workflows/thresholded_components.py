@@ -104,3 +104,71 @@ class ThresholdedComponentsWorkflow(WorkflowBase):
         conf["block_components"] = BlockComponentsTask.default_task_config()
         conf["write"] = WriteTask.default_task_config()
         return conf
+
+
+class ThresholdAndWatershedWorkflow(WorkflowBase):
+    """Thresholded components used as global seeds for a watershed over the
+    full boundary map (reference thresholded_components_workflow.py:107-137)."""
+
+    task_name = "threshold_and_watershed_workflow"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        target: Optional[str] = None,
+        input_path: str = None,
+        input_key: str = None,
+        output_path: str = None,
+        output_key: str = None,
+        mask_path: str = None,
+        mask_key: str = None,
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+
+    def requires(self):
+        from ..tasks.watershed import WatershedFromSeedsTask
+
+        seeds_key = self.output_key + "_seeds"
+        components = ThresholdedComponentsWorkflow(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            self.target,
+            input_path=self.input_path,
+            input_key=self.input_key,
+            output_path=self.output_path,
+            output_key=seeds_key,
+            mask_path=self.mask_path,
+            mask_key=self.mask_key,
+        )
+        ws = WatershedFromSeedsTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            dependencies=[components],
+            input_path=self.input_path,
+            input_key=self.input_key,
+            seeds_path=self.output_path,
+            seeds_key=seeds_key,
+            output_path=self.output_path,
+            output_key=self.output_key,
+            mask_path=self.mask_path,
+            mask_key=self.mask_key,
+        )
+        return [ws]
+
+    @classmethod
+    def get_config(cls):
+        from ..tasks.watershed import WatershedFromSeedsTask
+
+        conf = ThresholdedComponentsWorkflow.get_config()
+        conf["watershed_from_seeds"] = WatershedFromSeedsTask.default_task_config()
+        return conf

@@ -9,7 +9,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..runtime.workflow import WorkflowBase
-from ..tasks.watershed import TwoPassWatershedTask, WatershedTask
+from ..tasks.watershed import AgglomerateTask, TwoPassWatershedTask, WatershedTask
 
 
 class WatershedWorkflow(WorkflowBase):
@@ -28,6 +28,7 @@ class WatershedWorkflow(WorkflowBase):
         mask_path: str = None,
         mask_key: str = None,
         two_pass: bool = False,
+        agglomeration: bool = False,
         dependencies=(),
     ):
         super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
@@ -38,6 +39,7 @@ class WatershedWorkflow(WorkflowBase):
         self.mask_path = mask_path
         self.mask_key = mask_key
         self.two_pass = two_pass
+        self.agglomeration = agglomeration
 
     def requires(self):
         kwargs = dict(
@@ -66,6 +68,33 @@ class WatershedWorkflow(WorkflowBase):
                 **kwargs,
             )
             return [pass2]
+        if self.agglomeration:
+            # merge oversegmented fragments per block before any global step
+            # (reference watershed_workflow.py agglomeration option).  The
+            # fragments live under a separate key so the agglomerate step is
+            # idempotent under retry/resume (an in-place read-modify-write
+            # would double-agglomerate re-run blocks).
+            frag_key = self.output_key + "_frag"
+            ws = WatershedTask(
+                self.tmp_folder,
+                self.config_dir,
+                self.max_jobs,
+                dependencies=list(self.dependencies),
+                **{**kwargs, "output_key": frag_key},
+            )
+            agglo = AgglomerateTask(
+                self.tmp_folder,
+                self.config_dir,
+                self.max_jobs,
+                dependencies=[ws],
+                input_path=self.input_path,
+                input_key=self.input_key,
+                labels_path=self.output_path,
+                labels_key=frag_key,
+                output_path=self.output_path,
+                output_key=self.output_key,
+            )
+            return [agglo]
         ws = WatershedTask(
             self.tmp_folder,
             self.config_dir,
@@ -79,4 +108,5 @@ class WatershedWorkflow(WorkflowBase):
     def get_config(cls):
         conf = super().get_config()
         conf["watershed"] = WatershedTask.default_task_config()
+        conf["agglomerate"] = AgglomerateTask.default_task_config()
         return conf

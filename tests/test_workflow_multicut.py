@@ -98,6 +98,26 @@ class TestGraphWorkflow:
         want = {tuple(e) for e in want_edges}
         assert got == want
 
+    def test_graph_keeps_isolated_fragments(self, tmp_path):
+        # a fragment fully surrounded by background has no RAG edge but must
+        # still be a graph node, or downstream writes drop it to 0
+        labels = np.zeros((16, 32, 32), dtype=np.uint64)
+        labels[2:6, 2:8, 2:8] = 1
+        labels[2:6, 8:14, 2:8] = 2   # touches 1
+        labels[10:14, 20:26, 20:26] = 7  # isolated
+        path = str(tmp_path / "g.n5")
+        file_reader(path).create_dataset("seg", data=labels, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        wf = GraphWorkflow(tmp_folder, config_dir, input_path=path, input_key="seg")
+        assert build([wf])
+        store = file_reader(os.path.join(tmp_folder, "data.zarr"), "r")
+        nodes = store["graph/nodes"][:]
+        edges = store["graph/edges"][:]
+        np.testing.assert_array_equal(nodes, [1, 2, 7])
+        np.testing.assert_array_equal(nodes[edges], [[1, 2]])
+
 
 class TestMulticutWorkflow:
     @pytest.mark.parametrize("n_scales", [1, 2])
