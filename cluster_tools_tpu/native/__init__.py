@@ -62,6 +62,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.mutex_watershed.argtypes = [
             ctypes.c_int64, ctypes.c_int64, i64p, f64p, u8p, i64p,
         ]
+        lib.lifted_gaec.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, i64p, f64p,
+            ctypes.c_int64, i64p, f64p, i64p,
+        ]
         _lib = lib
         return _lib
 
@@ -102,6 +106,28 @@ def agglomerative_clustering(
     lib.agglomerative_clustering(
         n_nodes, uv.shape[0], uv.reshape(-1), weights, sizes_ptr,
         float(threshold), labels,
+    )
+    return labels
+
+
+def lifted_gaec(
+    n_nodes: int,
+    uv: np.ndarray,
+    costs: np.ndarray,
+    lifted_uv: np.ndarray,
+    lifted_costs: np.ndarray,
+) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native solver library unavailable")
+    uv = np.ascontiguousarray(uv, dtype=np.int64)
+    costs = np.ascontiguousarray(costs, dtype=np.float64)
+    lifted_uv = np.ascontiguousarray(lifted_uv, dtype=np.int64)
+    lifted_costs = np.ascontiguousarray(lifted_costs, dtype=np.float64)
+    labels = np.empty(n_nodes, dtype=np.int64)
+    lib.lifted_gaec(
+        n_nodes, uv.shape[0], uv.reshape(-1), costs,
+        lifted_uv.shape[0], lifted_uv.reshape(-1), lifted_costs, labels,
     )
     return labels
 

@@ -157,6 +157,32 @@ def agglomerative_clustering(
     return labels.astype(np.int64)
 
 
+def contract_edges(
+    new_u: np.ndarray, new_v: np.ndarray, values: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Contract an edge list under a node relabeling: drops edges that became
+    internal (u == v), canonicalizes pair order, and sums ``values`` over
+    duplicate pairs (the reduce step of the hierarchical solve, reference
+    reduce_problem.py:205-218 via nt.EdgeMapping).
+
+    Returns ``(edges [k,2] sorted lexicographically, summed values [k])``.
+    """
+    live = new_u != new_v
+    nu = np.asarray(new_u[live], dtype=np.int64).copy()
+    nv = np.asarray(new_v[live], dtype=np.int64).copy()
+    swap = nu > nv
+    nu[swap], nv[swap] = nv[swap], nu[swap]
+    if nu.size == 0:
+        return np.zeros((0, 2), dtype=np.int64), np.zeros(0)
+    base = int(max(nu.max(), nv.max())) + 2
+    keys = nu * base + nv
+    uniq_keys, inv = np.unique(keys, return_inverse=True)
+    summed = np.zeros(uniq_keys.size)
+    np.add.at(summed, inv, values[live])
+    edges = np.stack([uniq_keys // base, uniq_keys % base], axis=1)
+    return edges.astype(np.int64), summed
+
+
 def multicut_energy(uv: np.ndarray, costs: np.ndarray, labels: np.ndarray) -> float:
     """Energy of a node labeling: sum of costs of *cut* edges (lower = better
     when repulsive edges are cut; used by tests as a sanity oracle)."""

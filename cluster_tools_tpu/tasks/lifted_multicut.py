@@ -1,0 +1,240 @@
+"""Hierarchical lifted multicut solve.
+
+Reference lifted_multicut/{solve_lifted_subproblems,reduce_lifted_problem,
+solve_lifted_global}.py (SURVEY.md §2.3): the same domain-decomposition scheme
+as the multicut family, with the lifted edges/costs carried through every
+contraction.  Per-block subproblems include the lifted edges internal to the
+block's node set (solve_lifted_subproblems.py:205-213); the reduction contracts
+local edges, remaps lifted pairs and sum-merges duplicates; the global step
+solves the final reduced lifted problem.
+
+Scratch layout (extends tasks/multicut.py):
+  lifted_multicut/s{s}/cut_edges      ragged per block: cut LOCAL edge ids
+  lifted_multicut_s{s}.npz            reduced problem: edges, costs,
+                                      lifted_uv, lifted_costs, node_labeling
+  lifted_multicut_assignments.npy     final (label, segment) table
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from ..ops.lifted import solve_lifted_multicut
+from ..ops.multicut import contract_edges
+from ..ops.unionfind import UnionFindNp
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .costs import COSTS_NAME
+from .graph import load_graph
+from .lifted_features import load_lifted_problem
+from .multicut import load_scale_problem
+
+LIFTED_ASSIGNMENTS_NAME = "lifted_multicut_assignments.npy"
+
+
+def _lifted_scale_path(tmp_folder: str, scale: int) -> str:
+    return os.path.join(tmp_folder, f"lifted_multicut_s{scale}.npz")
+
+
+def load_lifted_scale_problem(task, scale: int, prefix: str = "lifted"):
+    """(edges, costs, lifted_uv, lifted_costs, node_labeling) at a scale."""
+    if scale == 0:
+        edges, costs, node_labeling = load_scale_problem(task, 0)
+        lifted_uv, lifted_costs = load_lifted_problem(task.tmp_folder, prefix)
+        return edges, costs, lifted_uv, lifted_costs, node_labeling
+    with np.load(_lifted_scale_path(task.tmp_folder, scale)) as f:
+        return (
+            f["edges"], f["costs"], f["lifted_uv"], f["lifted_costs"],
+            f["node_labeling"],
+        )
+
+
+class SolveLiftedSubproblemsTask(VolumeTask):
+    """Per-block lifted subproblem solve
+    (reference solve_lifted_subproblems.py:32)."""
+
+    task_name = "solve_lifted_subproblems"
+    output_dtype = None
+
+    def __init__(self, *args, scale: int = 0, prefix: str = "lifted", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.scale = scale
+        self.prefix = prefix
+
+    @property
+    def identifier(self) -> str:
+        return f"{self.task_name}_s{self.scale}"
+
+    def get_block_shape(self, gconf):
+        return [bs * (2**self.scale) for bs in gconf["block_shape"]]
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        store = self.tmp_store()
+        nodes, _ = load_graph(store)
+        edges, costs, lifted_uv, lifted_costs, node_labeling = (
+            load_lifted_scale_problem(self, self.scale, self.prefix)
+        )
+
+        seg = self.input_ds()[blocking.block(block_id).slicing]
+        block_labels = np.unique(seg)
+        block_labels = block_labels[block_labels > 0]
+        out = self.tmp_ragged(
+            f"lifted_multicut/s{self.scale}/cut_edges", blocking.n_blocks,
+            np.int64,
+        )
+
+        def emit(cut_ids):
+            out.write_chunk((block_id,), np.asarray(cut_ids, dtype=np.int64))
+
+        if block_labels.size == 0 or edges.shape[0] == 0:
+            emit([])
+            return
+        dense = np.searchsorted(nodes, block_labels)
+        in_range = dense < nodes.size
+        dense, block_labels = dense[in_range], block_labels[in_range]
+        found = nodes[dense] == block_labels
+        dense = dense[found]
+        if dense.size == 0:
+            emit([])
+            return
+        current = np.unique(node_labeling[dense])
+
+        member = np.zeros(int(node_labeling.max()) + 2, dtype=bool)
+        member[current] = True
+        cur_u = node_labeling[edges[:, 0]]
+        cur_v = node_labeling[edges[:, 1]]
+        in_sub = member[cur_u] & member[cur_v] & (cur_u != cur_v)
+        sub_edge_ids = np.nonzero(in_sub)[0]
+        if sub_edge_ids.size == 0:
+            emit([])
+            return
+        su, sv = cur_u[in_sub], cur_v[in_sub]
+        uniq, inv = np.unique(np.stack([su, sv]), return_inverse=True)
+        local_uv = inv.reshape(2, -1).T
+
+        # lifted edges inner to the block's node set, in local coordinates
+        if lifted_uv.shape[0]:
+            lu = node_labeling[lifted_uv[:, 0]]
+            lv = node_labeling[lifted_uv[:, 1]]
+            in_lift = member[lu] & member[lv] & (lu != lv)
+            llu = np.searchsorted(uniq, lu[in_lift])
+            llv = np.searchsorted(uniq, lv[in_lift])
+            # keep only pairs whose endpoints appear in the local subgraph
+            ok = (
+                (llu < uniq.size) & (llv < uniq.size)
+            )
+            ok &= uniq[np.clip(llu, 0, uniq.size - 1)] == lu[in_lift]
+            ok &= uniq[np.clip(llv, 0, uniq.size - 1)] == lv[in_lift]
+            local_lifted = np.stack([llu[ok], llv[ok]], axis=1)
+            local_lifted_costs = lifted_costs[in_lift][ok]
+        else:
+            local_lifted = np.zeros((0, 2), dtype=np.int64)
+            local_lifted_costs = np.zeros(0)
+
+        result = solve_lifted_multicut(
+            uniq.size, local_uv, costs[sub_edge_ids],
+            local_lifted, local_lifted_costs,
+        )
+        cut = result[local_uv[:, 0]] != result[local_uv[:, 1]]
+        emit(sub_edge_ids[cut])
+
+
+class ReduceLiftedProblemTask(VolumeSimpleTask):
+    """Contract non-cut local edges, carry lifted edges to the next scale
+    (reference reduce_lifted_problem.py:30)."""
+
+    task_name = "reduce_lifted_problem"
+
+    def __init__(self, *args, scale: int = 0, prefix: str = "lifted",
+                 input_path: str = None, input_key: str = None, **kwargs):
+        super().__init__(*args, scale=scale, prefix=prefix,
+                         input_path=input_path, input_key=input_key, **kwargs)
+
+    @property
+    def identifier(self) -> str:
+        return f"{self.task_name}_s{self.scale}"
+
+    def run_impl(self) -> None:
+        n_blocks = resolve_n_blocks(
+            self.config_dir, self.input_path, self.input_key, scale=self.scale
+        )
+        edges, costs, lifted_uv, lifted_costs, node_labeling = (
+            load_lifted_scale_problem(self, self.scale, self.prefix)
+        )
+        store = self.tmp_store()
+        cut_ds = store[f"lifted_multicut/s{self.scale}/cut_edges"]
+        cut = np.zeros(edges.shape[0], dtype=bool)
+        for bid in range(n_blocks):
+            chunk = cut_ds.read_chunk((bid,))
+            if chunk is not None and chunk.size:
+                cut[chunk] = True
+
+        n_current = int(node_labeling.max()) + 1
+        uf = UnionFindNp(n_current)
+        cur_u = node_labeling[edges[:, 0]]
+        cur_v = node_labeling[edges[:, 1]]
+        keep = ~cut & (cur_u != cur_v)
+        uf.merge(cur_u[keep], cur_v[keep])
+        roots = uf.compress()
+        _, new_ids = np.unique(roots, return_inverse=True)
+        merged_labeling = new_ids[node_labeling].astype(np.int64)
+
+        new_edges, new_costs = contract_edges(
+            new_ids[cur_u], new_ids[cur_v], costs
+        )
+        if lifted_uv.shape[0]:
+            cl_u = new_ids[node_labeling[lifted_uv[:, 0]]]
+            cl_v = new_ids[node_labeling[lifted_uv[:, 1]]]
+            new_lifted, new_lifted_costs = contract_edges(cl_u, cl_v, lifted_costs)
+        else:
+            new_lifted = np.zeros((0, 2), dtype=np.int64)
+            new_lifted_costs = np.zeros(0)
+
+        np.savez(
+            _lifted_scale_path(self.tmp_folder, self.scale + 1),
+            edges=new_edges,
+            costs=new_costs,
+            lifted_uv=new_lifted,
+            lifted_costs=new_lifted_costs,
+            node_labeling=merged_labeling,
+        )
+        self.log(
+            f"scale {self.scale}: {edges.shape[0]} local / "
+            f"{lifted_uv.shape[0]} lifted edges, {n_current} nodes → "
+            f"{new_edges.shape[0]} / {new_lifted.shape[0]} edges, "
+            f"{int(new_ids.max()) + 1} nodes"
+        )
+
+
+class SolveLiftedGlobalTask(VolumeSimpleTask):
+    """Solve the final reduced lifted problem
+    (reference solve_lifted_global.py:25)."""
+
+    task_name = "solve_lifted_global"
+
+    def __init__(self, *args, scale: int = 0, prefix: str = "lifted", **kwargs):
+        super().__init__(*args, scale=scale, prefix=prefix, **kwargs)
+
+    def run_impl(self) -> None:
+        edges, costs, lifted_uv, lifted_costs, node_labeling = (
+            load_lifted_scale_problem(self, self.scale, self.prefix)
+        )
+        n_current = int(node_labeling.max()) + 1
+        result = solve_lifted_multicut(
+            n_current, edges, costs, lifted_uv, lifted_costs
+        )
+        final = result[node_labeling]
+        nodes, _ = load_graph(self.tmp_store())
+        table = np.stack(
+            [nodes, (final + 1).astype(np.uint64)], axis=1
+        ).astype(np.uint64)
+        if nodes.size and nodes[0] == 0:
+            table[0, 1] = 0
+        np.save(os.path.join(self.tmp_folder, LIFTED_ASSIGNMENTS_NAME), table)
+        self.log(
+            f"lifted global solve: {n_current} nodes → "
+            f"{int(result.max()) + 1} segments"
+        )

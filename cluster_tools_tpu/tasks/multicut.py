@@ -20,7 +20,7 @@ from typing import Any, Dict, List
 
 import numpy as np
 
-from ..ops.multicut import solve_multicut
+from ..ops.multicut import contract_edges, solve_multicut
 from ..ops.unionfind import UnionFindNp
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
@@ -149,20 +149,9 @@ class ReduceProblemTask(VolumeSimpleTask):
         _, new_ids = np.unique(roots, return_inverse=True)
         merged_labeling = new_ids[node_labeling].astype(np.int64)
 
-        new_u = new_ids[cur_u]
-        new_v = new_ids[cur_v]
-        live = new_u != new_v
-        nu, nv = new_u[live], new_v[live]
-        swap = nu > nv
-        nu[swap], nv[swap] = nv[swap], nu[swap]
-        pair_keys = nu.astype(np.int64) * (int(new_ids.max()) + 2) + nv
-        uniq_keys, inv = np.unique(pair_keys, return_inverse=True)
-        new_costs = np.zeros(uniq_keys.size)
-        np.add.at(new_costs, inv, costs[live])
-        new_edges = np.stack(
-            [uniq_keys // (int(new_ids.max()) + 2), uniq_keys % (int(new_ids.max()) + 2)],
-            axis=1,
-        ).astype(np.int64)
+        new_edges, new_costs = contract_edges(
+            new_ids[cur_u], new_ids[cur_v], costs
+        )
 
         np.savez(
             _scale_problem_path(self.tmp_folder, self.scale + 1),

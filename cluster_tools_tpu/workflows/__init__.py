@@ -1,5 +1,10 @@
 from .agglomerative_clustering import AgglomerativeClusteringWorkflow
 from .evaluation import EvaluationWorkflow
+from .lifted_multicut import (
+    LiftedFeaturesFromNodeLabelsWorkflow,
+    LiftedMulticutSegmentationWorkflow,
+    LiftedMulticutWorkflow,
+)
 from .morphology import MorphologyWorkflow
 from .multicut import (
     EdgeFeaturesWorkflow,
@@ -20,6 +25,9 @@ __all__ = [
     "EvaluationWorkflow",
     "EdgeFeaturesWorkflow",
     "GraphWorkflow",
+    "LiftedFeaturesFromNodeLabelsWorkflow",
+    "LiftedMulticutSegmentationWorkflow",
+    "LiftedMulticutWorkflow",
     "MorphologyWorkflow",
     "MulticutSegmentationWorkflow",
     "MulticutWorkflow",
