@@ -16,6 +16,9 @@ from .thresholded_components import (
 )
 from .write import WriteTask
 from .relabel import FindUniquesTask, FindLabelingTask
+from .copy_volume import CopyVolumeTask
+from .transformations import LinearTransformationTask
+from .masking import BlocksFromMaskTask, MinfilterTask
 
 __all__ = [
     "VolumeTask",
@@ -27,4 +30,8 @@ __all__ = [
     "WriteTask",
     "FindUniquesTask",
     "FindLabelingTask",
+    "CopyVolumeTask",
+    "LinearTransformationTask",
+    "BlocksFromMaskTask",
+    "MinfilterTask",
 ]

@@ -1,0 +1,210 @@
+"""Volume-ops tasks: copy_volume, linear transformation, masking.
+
+Oracles are single-shot numpy/scipy recomputations over the whole volume
+(the reference test style, SURVEY.md §4 idiom 2).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+def _env(tmp_path, name, block_shape=(16, 16, 16), **extra):
+    tmp_folder = str(tmp_path / f"tmp_{name}")
+    config_dir = str(tmp_path / f"configs_{name}")
+    cfg.write_global_config(
+        config_dir, {"block_shape": list(block_shape), **extra}
+    )
+    return tmp_folder, config_dir
+
+
+class TestCopyVolume:
+    def _data(self, tmp_path, rng, shape=(32, 32, 32)):
+        path = str(tmp_path / "data.n5")
+        raw = rng.random(shape).astype("float32")
+        file_reader(path).create_dataset("raw", data=raw, chunks=(16, 16, 16))
+        return path, raw
+
+    def test_plain_copy_and_cast(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.copy_volume import CopyVolumeTask
+
+        path, raw = self._data(tmp_path, rng)
+        tmp_folder, config_dir = _env(tmp_path, "copy")
+        task = CopyVolumeTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            output_path=path, output_key="copy",
+            dtype="uint8",
+        )
+        assert build([task])
+        out = file_reader(path, "r")["copy"]
+        assert str(out.dtype) == "uint8"
+        got = out[:]
+        # uint8 cast normalizes per block then scales to 255 (the reference's
+        # cast_type applies vu.normalize to block data) — order is preserved
+        # within each block
+        assert got.shape == raw.shape
+        block = (slice(0, 16),) * 3
+        flat_r = raw[block].ravel()
+        flat_g = got[block].ravel()
+        idx = np.argsort(flat_r)
+        assert (np.diff(flat_g[idx].astype(np.int32)) >= 0).all()
+        assert flat_g.min() == 0 and flat_g.max() == 255
+
+    def test_offset_value_list_insert(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.copy_volume import CopyVolumeTask
+
+        path = str(tmp_path / "labels.n5")
+        seg = rng.integers(0, 5, size=(32, 32, 32)).astype("uint64")
+        f = file_reader(path)
+        f.create_dataset("seg", data=seg, chunks=(16, 16, 16))
+        base = np.full(seg.shape, 7, dtype="uint64")
+        f.create_dataset("out", data=base, chunks=(16, 16, 16))
+
+        tmp_folder, config_dir = _env(tmp_path, "copy2")
+        cfg.write_config(
+            config_dir, "copy_volume",
+            {"value_list": [1, 2], "offset": 100, "insert_mode": True},
+        )
+        task = CopyVolumeTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            output_path=path, output_key="out",
+        )
+        assert build([task])
+        got = file_reader(path, "r")["out"][:]
+        keep = np.isin(seg, [1, 2])
+        assert (got[keep] == seg[keep] + 100).all()
+        assert (got[~keep] == 7).all()  # insert mode keeps previous data
+
+    def test_reduce_channels(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.copy_volume import CopyVolumeTask
+
+        path = str(tmp_path / "affs.n5")
+        affs = rng.random((3, 32, 32, 32)).astype("float32")
+        file_reader(path).create_dataset(
+            "affs", data=affs, chunks=(1, 16, 16, 16)
+        )
+        tmp_folder, config_dir = _env(tmp_path, "copy3")
+        cfg.write_config(config_dir, "copy_volume", {"reduce_channels": "max"})
+        task = CopyVolumeTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="affs",
+            output_path=path, output_key="bmap",
+        )
+        assert build([task])
+        got = file_reader(path, "r")["bmap"][:]
+        assert got.shape == affs.shape[1:]
+        np.testing.assert_allclose(got, affs.max(axis=0), rtol=1e-6)
+
+
+class TestLinearTransformation:
+    def test_global_trafo(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.transformations import LinearTransformationTask
+
+        path = str(tmp_path / "data.n5")
+        raw = rng.random((32, 32, 32)).astype("float32")
+        file_reader(path).create_dataset("raw", data=raw, chunks=(16, 16, 16))
+        trafo_file = str(tmp_path / "trafo.json")
+        with open(trafo_file, "w") as f:
+            json.dump({"a": 2.0, "b": -0.5}, f)
+
+        tmp_folder, config_dir = _env(tmp_path, "linear")
+        task = LinearTransformationTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            output_path=path, output_key="out",
+            transformation=trafo_file,
+        )
+        assert build([task])
+        got = file_reader(path, "r")["out"][:]
+        np.testing.assert_allclose(got, 2.0 * raw - 0.5, rtol=1e-5)
+
+    def test_per_slice_trafo_with_mask(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.transformations import LinearTransformationTask
+
+        shape = (32, 32, 32)
+        path = str(tmp_path / "data.n5")
+        raw = rng.random(shape).astype("float32")
+        mask = (rng.random(shape) > 0.5)
+        f = file_reader(path)
+        f.create_dataset("raw", data=raw, chunks=(16, 16, 16))
+        f.create_dataset(
+            "mask", data=mask.astype("uint8"), chunks=(16, 16, 16)
+        )
+        trafo = {str(z): {"a": 1.0 + 0.1 * z, "b": 0.01 * z}
+                 for z in range(shape[0])}
+        trafo_file = str(tmp_path / "trafo.json")
+        with open(trafo_file, "w") as f2:
+            json.dump(trafo, f2)
+
+        tmp_folder, config_dir = _env(tmp_path, "linear2")
+        task = LinearTransformationTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            output_path=path, output_key="out",
+            transformation=trafo_file,
+            mask_path=path, mask_key="mask",
+        )
+        assert build([task])
+        got = file_reader(path, "r")["out"][:]
+        a = (1.0 + 0.1 * np.arange(shape[0]))[:, None, None].astype("float32")
+        b = (0.01 * np.arange(shape[0]))[:, None, None].astype("float32")
+        want = np.where(mask, a * raw + b, raw)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestMasking:
+    def test_blocks_from_mask(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.masking import BlocksFromMaskTask
+
+        shape = (32, 64, 64)
+        # low-res mask: only the first octant is active
+        mask = np.zeros((16, 32, 32), dtype="uint8")
+        mask[:8, :16, :16] = 1
+        path = str(tmp_path / "mask.n5")
+        file_reader(path).create_dataset("mask", data=mask, chunks=(8, 16, 16))
+
+        tmp_folder, config_dir = _env(tmp_path, "bfm")
+        out_path = str(tmp_path / "blocks.json")
+        task = BlocksFromMaskTask(
+            tmp_folder, config_dir,
+            mask_path=path, mask_key="mask",
+            shape=shape, output_path=out_path,
+        )
+        assert build([task])
+        with open(out_path) as f:
+            block_list = json.load(f)
+        # full grid is (2, 4, 4) = 32 blocks of [16,16,16]; active octant =
+        # z blocks 0 (z<16), y blocks 0-1 (y<32), x blocks 0-1 → 4 blocks
+        assert sorted(block_list) == [0, 1, 4, 5]
+
+    def test_minfilter_matches_scipy(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.masking import MinfilterTask
+
+        shape = (32, 32, 32)
+        mask = (ndimage.gaussian_filter(rng.random(shape), 2.0) > 0.5)
+        path = str(tmp_path / "mask.n5")
+        file_reader(path).create_dataset(
+            "mask", data=mask.astype("uint8"), chunks=(16, 16, 16)
+        )
+        tmp_folder, config_dir = _env(tmp_path, "minf")
+        filter_shape = [5, 5, 5]
+        cfg.write_config(config_dir, "minfilter", {"filter_shape": filter_shape})
+        task = MinfilterTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="mask",
+            output_path=path, output_key="min_mask",
+        )
+        assert build([task])
+        got = file_reader(path, "r")["min_mask"][:]
+        want = ndimage.minimum_filter(
+            mask.astype("float32"), size=filter_shape, mode="reflect"
+        ).astype("uint8")
+        np.testing.assert_array_equal(got, want)
