@@ -40,31 +40,40 @@ def lifted_neighborhood(
     adjacency (never a dense distance matrix), so the cost is proportional to
     the edges actually reached within ``depth``.
     """
-    from scipy.sparse import csr_matrix, identity
+    from scipy.sparse import csr_matrix
 
     part_idx = np.nonzero(participating)[0]
     if part_idx.size < 2 or edges.shape[0] == 0 or depth < 2:
         return np.zeros((0, 2), dtype=np.int64)
-    data = np.ones(edges.shape[0], dtype=np.int8)
+    # int32 path counts: int8 overflows at >=128 parallel paths through
+    # high-degree hubs, silently dropping reached nodes; per-entry counts are
+    # bounded by node degree, so int32 is safe at a quarter of int64's memory
+    data = np.ones(edges.shape[0], dtype=np.int32)
     adj = csr_matrix(
         (data, (edges[:, 0], edges[:, 1])), shape=(n_nodes, n_nodes)
     )
-    adj = ((adj + adj.T) > 0).astype(np.int8)
+    adj = ((adj + adj.T) > 0).astype(np.int32)
 
     pair_chunks = []
     chunk = 4096
     for lo in range(0, part_idx.size, chunk):
         sources = part_idx[lo : lo + chunk]
-        visited = identity(n_nodes, dtype=np.int8, format="csr")[sources]
+        visited = csr_matrix(
+            (
+                np.ones(sources.size, dtype=np.int32),
+                (np.arange(sources.size), sources),
+            ),
+            shape=(sources.size, n_nodes),
+        )
         frontier = visited
         reached = []
         for d in range(1, depth + 1):
-            frontier = ((frontier @ adj) > 0).astype(np.int8)
+            frontier = ((frontier @ adj) > 0).astype(np.int32)
             frontier = frontier - frontier.multiply(visited)
             frontier.eliminate_zeros()
             if frontier.nnz == 0:
                 break
-            visited = ((visited + frontier) > 0).astype(np.int8)
+            visited = ((visited + frontier) > 0).astype(np.int32)
             if d >= 2:
                 reached.append(frontier.tocoo())
         for coo in reached:

@@ -30,7 +30,12 @@ from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
 from .costs import COSTS_NAME
 from .graph import load_graph
 from .lifted_features import load_lifted_problem
-from .multicut import load_scale_problem
+from .multicut import (
+    block_dense_nodes,
+    extract_cluster_subgraph,
+    load_scale_problem,
+    write_assignment_table,
+)
 
 LIFTED_ASSIGNMENTS_NAME = "lifted_multicut_assignments.npy"
 
@@ -79,8 +84,6 @@ class SolveLiftedSubproblemsTask(VolumeTask):
         )
 
         seg = self.input_ds()[blocking.block(block_id).slicing]
-        block_labels = np.unique(seg)
-        block_labels = block_labels[block_labels > 0]
         out = self.tmp_ragged(
             f"lifted_multicut/s{self.scale}/cut_edges", blocking.n_blocks,
             np.int64,
@@ -89,36 +92,21 @@ class SolveLiftedSubproblemsTask(VolumeTask):
         def emit(cut_ids):
             out.write_chunk((block_id,), np.asarray(cut_ids, dtype=np.int64))
 
-        if block_labels.size == 0 or edges.shape[0] == 0:
+        dense = block_dense_nodes(nodes, seg)
+        if dense.size == 0 or edges.shape[0] == 0:
             emit([])
             return
-        dense = np.searchsorted(nodes, block_labels)
-        in_range = dense < nodes.size
-        dense, block_labels = dense[in_range], block_labels[in_range]
-        found = nodes[dense] == block_labels
-        dense = dense[found]
-        if dense.size == 0:
-            emit([])
-            return
-        current = np.unique(node_labeling[dense])
-
-        member = np.zeros(int(node_labeling.max()) + 2, dtype=bool)
-        member[current] = True
-        cur_u = node_labeling[edges[:, 0]]
-        cur_v = node_labeling[edges[:, 1]]
-        in_sub = member[cur_u] & member[cur_v] & (cur_u != cur_v)
-        sub_edge_ids = np.nonzero(in_sub)[0]
+        sub_edge_ids, uniq, local_uv, member = extract_cluster_subgraph(
+            edges, node_labeling, dense
+        )
         if sub_edge_ids.size == 0:
             emit([])
             return
-        su, sv = cur_u[in_sub], cur_v[in_sub]
-        uniq, inv = np.unique(np.stack([su, sv]), return_inverse=True)
-        local_uv = inv.reshape(2, -1).T
 
         # lifted edges inner to the block's node set, in local coordinates
+        # (lifted_uv is in current-scale cluster coordinates, like edges)
         if lifted_uv.shape[0]:
-            lu = node_labeling[lifted_uv[:, 0]]
-            lv = node_labeling[lifted_uv[:, 1]]
+            lu, lv = lifted_uv[:, 0], lifted_uv[:, 1]
             in_lift = member[lu] & member[lv] & (lu != lv)
             llu = np.searchsorted(uniq, lu[in_lift])
             llv = np.searchsorted(uniq, lv[in_lift])
@@ -174,8 +162,8 @@ class ReduceLiftedProblemTask(VolumeSimpleTask):
 
         n_current = int(node_labeling.max()) + 1
         uf = UnionFindNp(n_current)
-        cur_u = node_labeling[edges[:, 0]]
-        cur_v = node_labeling[edges[:, 1]]
+        # edges/lifted_uv are already in current-scale cluster coordinates
+        cur_u, cur_v = edges[:, 0], edges[:, 1]
         keep = ~cut & (cur_u != cur_v)
         uf.merge(cur_u[keep], cur_v[keep])
         roots = uf.compress()
@@ -186,8 +174,8 @@ class ReduceLiftedProblemTask(VolumeSimpleTask):
             new_ids[cur_u], new_ids[cur_v], costs
         )
         if lifted_uv.shape[0]:
-            cl_u = new_ids[node_labeling[lifted_uv[:, 0]]]
-            cl_v = new_ids[node_labeling[lifted_uv[:, 1]]]
+            cl_u = new_ids[lifted_uv[:, 0]]
+            cl_v = new_ids[lifted_uv[:, 1]]
             new_lifted, new_lifted_costs = contract_edges(cl_u, cl_v, lifted_costs)
         else:
             new_lifted = np.zeros((0, 2), dtype=np.int64)
@@ -227,13 +215,7 @@ class SolveLiftedGlobalTask(VolumeSimpleTask):
             n_current, edges, costs, lifted_uv, lifted_costs
         )
         final = result[node_labeling]
-        nodes, _ = load_graph(self.tmp_store())
-        table = np.stack(
-            [nodes, (final + 1).astype(np.uint64)], axis=1
-        ).astype(np.uint64)
-        if nodes.size and nodes[0] == 0:
-            table[0, 1] = 0
-        np.save(os.path.join(self.tmp_folder, LIFTED_ASSIGNMENTS_NAME), table)
+        write_assignment_table(self, final, LIFTED_ASSIGNMENTS_NAME)
         self.log(
             f"lifted global solve: {n_current} nodes → "
             f"{int(result.max()) + 1} segments"

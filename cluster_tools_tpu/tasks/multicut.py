@@ -35,8 +35,15 @@ def _scale_problem_path(tmp_folder: str, scale: int) -> str:
 
 
 def load_scale_problem(task, scale: int):
-    """Graph at a scale: (edges [m,2] dense ids, costs [m], node_labeling
-    [n_s0_nodes] → current cluster ids)."""
+    """Graph at a scale: (edges, costs, node_labeling).
+
+    Invariant: ``edges`` at scale s are in *scale-s cluster* coordinates and
+    ``node_labeling`` maps scale-0 dense node ids → scale-s cluster ids (at
+    scale 0 the clusters ARE the dense node ids, so the labeling is identity).
+    Consumers must therefore index per-edge data with the edge endpoints
+    directly — mapping them through ``node_labeling`` again would double-apply
+    the contraction.
+    """
     if scale == 0:
         _, edges = load_graph(task.tmp_store())
         costs = np.load(os.path.join(task.tmp_folder, COSTS_NAME))
@@ -44,6 +51,56 @@ def load_scale_problem(task, scale: int):
         return edges, costs, np.arange(n_nodes, dtype=np.int64)
     with np.load(_scale_problem_path(task.tmp_folder, scale)) as f:
         return f["edges"], f["costs"], f["node_labeling"]
+
+
+def block_dense_nodes(nodes: np.ndarray, seg: np.ndarray) -> np.ndarray:
+    """Dense graph ids of the (non-zero) labels present in a block, guarding
+    labels missing from the graph (e.g. isolated segments)."""
+    block_labels = np.unique(seg)
+    block_labels = block_labels[block_labels > 0]
+    if block_labels.size == 0:
+        return np.zeros(0, dtype=np.int64)
+    dense = np.searchsorted(nodes, block_labels)
+    in_range = dense < nodes.size
+    dense, block_labels = dense[in_range], block_labels[in_range]
+    found = nodes[dense] == block_labels
+    return dense[found].astype(np.int64)
+
+
+def extract_cluster_subgraph(edges, node_labeling, dense):
+    """Edges of the node-induced subproblem over current-scale clusters.
+
+    ``dense`` are scale-0 dense node ids present in the block; the member set
+    is their cluster image.  Returns (sub_edge_ids, uniq_cluster_ids,
+    local_uv, member) with ``local_uv`` relabeled to 0..len(uniq)-1 and
+    ``member`` the cluster membership mask, or ``(empty, None, None, member)``
+    when no edge is internal.
+    """
+    current = np.unique(node_labeling[dense])
+    member = np.zeros(int(node_labeling.max()) + 2, dtype=bool)
+    member[current] = True
+    cur_u, cur_v = edges[:, 0], edges[:, 1]
+    in_sub = member[cur_u] & member[cur_v] & (cur_u != cur_v)
+    sub_edge_ids = np.nonzero(in_sub)[0]
+    if sub_edge_ids.size == 0:
+        return sub_edge_ids, None, None, member
+    uniq, inv = np.unique(
+        np.stack([cur_u[in_sub], cur_v[in_sub]]), return_inverse=True
+    )
+    local_uv = inv.reshape(2, -1).T
+    return sub_edge_ids, uniq, local_uv, member
+
+
+def write_assignment_table(task, final: np.ndarray, out_name: str) -> None:
+    """(watershed label → 1-based segment) table for the write task; label 0
+    (if present in the graph) keeps segment 0."""
+    nodes, _ = load_graph(task.tmp_store())
+    table = np.stack(
+        [nodes, (final + 1).astype(np.uint64)], axis=1
+    ).astype(np.uint64)
+    if nodes.size and nodes[0] == 0:
+        table[0, 1] = 0
+    np.save(os.path.join(task.tmp_folder, out_name), table)
 
 
 class SolveSubproblemsTask(VolumeTask):
@@ -74,38 +131,19 @@ class SolveSubproblemsTask(VolumeTask):
         edges, costs, node_labeling = load_scale_problem(self, self.scale)
 
         seg = self.input_ds()[blocking.block(block_id).slicing]
-        block_labels = np.unique(seg)
-        block_labels = block_labels[block_labels > 0]
         out = self.tmp_ragged(
             f"multicut/s{self.scale}/cut_edges", blocking.n_blocks, np.int64
         )
-        if block_labels.size == 0 or edges.shape[0] == 0:
+        dense = block_dense_nodes(nodes, seg)
+        if dense.size == 0 or edges.shape[0] == 0:
             out.write_chunk((block_id,), np.array([], dtype=np.int64))
             return
-        dense = np.searchsorted(nodes, block_labels)
-        # guard labels missing from the graph (e.g. isolated segments)
-        in_range = dense < nodes.size
-        dense, block_labels = dense[in_range], block_labels[in_range]
-        found = nodes[dense] == block_labels
-        dense = dense[found]
-        if dense.size == 0:
-            out.write_chunk((block_id,), np.array([], dtype=np.int64))
-            return
-        current = np.unique(node_labeling[dense])
-
-        member = np.zeros(int(node_labeling.max()) + 2, dtype=bool)
-        member[current] = True
-        cur_u = node_labeling[edges[:, 0]]
-        cur_v = node_labeling[edges[:, 1]]
-        in_sub = member[cur_u] & member[cur_v] & (cur_u != cur_v)
-        sub_edge_ids = np.nonzero(in_sub)[0]
+        sub_edge_ids, uniq, local_uv, _ = extract_cluster_subgraph(
+            edges, node_labeling, dense
+        )
         if sub_edge_ids.size == 0:
             out.write_chunk((block_id,), np.array([], dtype=np.int64))
             return
-        # contract to current-scale clusters, then relabel to a local problem
-        su, sv = cur_u[in_sub], cur_v[in_sub]
-        uniq, inv = np.unique(np.stack([su, sv]), return_inverse=True)
-        local_uv = inv.reshape(2, -1).T
         result = solve_multicut(uniq.size, local_uv, costs[sub_edge_ids])
         cut = result[local_uv[:, 0]] != result[local_uv[:, 1]]
         out.write_chunk((block_id,), sub_edge_ids[cut].astype(np.int64))
@@ -141,8 +179,8 @@ class ReduceProblemTask(VolumeSimpleTask):
 
         n_current = int(node_labeling.max()) + 1
         uf = UnionFindNp(n_current)
-        cur_u = node_labeling[edges[:, 0]]
-        cur_v = node_labeling[edges[:, 1]]
+        # edges are already in current-scale cluster coordinates
+        cur_u, cur_v = edges[:, 0], edges[:, 1]
         keep = ~cut & (cur_u != cur_v)
         uf.merge(cur_u[keep], cur_v[keep])
         roots = uf.compress()
@@ -180,14 +218,7 @@ class SolveGlobalTask(VolumeSimpleTask):
         n_current = int(node_labeling.max()) + 1
         result = solve_multicut(n_current, edges, costs)
         final = result[node_labeling]  # scale-0 dense node → segment
-        nodes, _ = load_graph(self.tmp_store())
-        # segments 1-based; node label 0 (if present) stays 0
-        table = np.stack(
-            [nodes, (final + 1).astype(np.uint64)], axis=1
-        ).astype(np.uint64)
-        if nodes.size and nodes[0] == 0:
-            table[0, 1] = 0
-        np.save(os.path.join(self.tmp_folder, ASSIGNMENTS_NAME), table)
+        write_assignment_table(self, final, ASSIGNMENTS_NAME)
         self.log(
             f"global solve: {n_current} nodes → {int(result.max()) + 1} segments"
         )

@@ -68,6 +68,22 @@ class TestLiftedOps:
                     want.add((s, t))
         assert {tuple(e) for e in got} == want
 
+    def test_neighborhood_high_degree_hub(self):
+        # regression: >=128 parallel 2-paths through intermediates must not
+        # overflow the path-count dtype and drop the (0, 199) lifted pair
+        n = 200
+        inter = np.arange(1, 131)
+        edges = np.concatenate(
+            [
+                np.stack([np.zeros_like(inter), inter], axis=1),
+                np.stack([inter, np.full_like(inter, n - 1)], axis=1),
+            ]
+        ).astype(np.int64)
+        part = np.zeros(n, dtype=bool)
+        part[0] = part[n - 1] = True
+        got = lifted_neighborhood(n, edges, part, depth=2)
+        np.testing.assert_array_equal(got, [[0, n - 1]])
+
     def test_solver_beats_trivial_on_brute_force(self, rng):
         # 7-node random problems: lifted-GAEC energy must match or come close
         # to the brute-force optimum, and never lose to merge-all/split-all

@@ -120,6 +120,38 @@ class TestGraphWorkflow:
 
 
 class TestMulticutWorkflow:
+    def test_two_scale_matches_single_scale(self, tmp_path, cells_volume):
+        # regression for the scale>=1 id-space bug: edges at scale s are in
+        # scale-s cluster coordinates; double-mapping them through
+        # node_labeling corrupted the hierarchy.  On this easy volume the
+        # 2-scale hierarchical solve must reproduce the 1-scale partition.
+        path, bnd, gt = cells_volume
+        segs = {}
+        for n_scales in (1, 2):
+            config_dir = str(tmp_path / f"c{n_scales}")
+            tmp_folder = str(tmp_path / f"t{n_scales}")
+            cfg.write_global_config(config_dir, {"block_shape": [12, 24, 24]})
+            cfg.write_config(
+                config_dir, "watershed",
+                {"threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 5,
+                 "apply_dt_2d": False, "apply_ws_2d": False, "halo": [2, 4, 4]},
+            )
+            wf = MulticutSegmentationWorkflow(
+                tmp_folder, config_dir,
+                input_path=path, input_key="bnd",
+                ws_path=path, ws_key=f"mws{n_scales}",
+                output_path=path, output_key=f"mseg{n_scales}",
+                n_scales=n_scales,
+            )
+            assert build([wf])
+            segs[n_scales] = file_reader(path, "r")[f"mseg{n_scales}"][:]
+        a, b = segs[1], segs[2]
+        fg = (a > 0) & (b > 0)
+        pairs = np.unique(np.stack([a[fg], b[fg]], axis=1), axis=0)
+        n_a = len(np.unique(a[fg]))
+        n_b = len(np.unique(b[fg]))
+        assert len(pairs) == n_a == n_b  # identical partitions
+
     @pytest.mark.parametrize("n_scales", [1, 2])
     def test_segmentation_quality(self, tmp_path, cells_volume, n_scales):
         path, bnd, gt = cells_volume
