@@ -19,6 +19,7 @@ from .relabel import FindUniquesTask, FindLabelingTask
 from .copy_volume import CopyVolumeTask
 from .transformations import LinearTransformationTask
 from .masking import BlocksFromMaskTask, MinfilterTask
+from .downscaling import DownscalingTask, UpscalingTask, ScaleToBoundariesTask
 
 __all__ = [
     "VolumeTask",
@@ -34,4 +35,7 @@ __all__ = [
     "LinearTransformationTask",
     "BlocksFromMaskTask",
     "MinfilterTask",
+    "DownscalingTask",
+    "UpscalingTask",
+    "ScaleToBoundariesTask",
 ]

@@ -535,8 +535,14 @@ class Group:
         if not readonly:
             os.makedirs(self.path, exist_ok=True)
             fmt.init_group(self.path)
+        # groups keep the structural keys guarded (writing "dimensions" into a
+        # group's attributes.json would make is_array misclassify it) but allow
+        # "dataType", which n5 GROUP attrs legitimately carry (bdv setup meta)
+        group_reserved = tuple(
+            k for k in fmt.attrs_reserved if k != "dataType"
+        )
         self.attrs = Attributes(
-            os.path.join(self.path, fmt.attrs_file), reserved=fmt.attrs_reserved
+            os.path.join(self.path, fmt.attrs_file), reserved=group_reserved
         )
 
     # -- navigation ----------------------------------------------------------

@@ -1,4 +1,5 @@
 from .agglomerative_clustering import AgglomerativeClusteringWorkflow
+from .downscaling import DownscalingWorkflow
 from .evaluation import EvaluationWorkflow
 from .lifted_multicut import (
     LiftedFeaturesFromNodeLabelsWorkflow,
@@ -22,6 +23,7 @@ from .watershed import WatershedWorkflow
 
 __all__ = [
     "AgglomerativeClusteringWorkflow",
+    "DownscalingWorkflow",
     "EvaluationWorkflow",
     "EdgeFeaturesWorkflow",
     "GraphWorkflow",

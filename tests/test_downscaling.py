@@ -1,0 +1,217 @@
+"""Downscaling family: resample ops, pyramid workflow, metadata, upscaling,
+scale_to_boundaries."""
+
+import os
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu.ops import resample
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+class TestResampleOps:
+    def test_downscale_shape(self):
+        assert resample.downscale_shape((33, 64, 65), 2) == (17, 32, 33)
+        assert resample.downscale_shape((10, 64, 64), [1, 2, 2]) == (10, 32, 32)
+
+    def test_mean_pool_matches_reshape(self, rng):
+        x = rng.random((16, 16, 16)).astype("float32")
+        got = np.asarray(resample.downscale(x, 2, "mean"))
+        want = x.reshape(8, 2, 8, 2, 8, 2).mean(axis=(1, 3, 5))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_nearest_is_strided(self, rng):
+        labels = rng.integers(0, 100, (16, 16, 16)).astype("uint64")
+        got = np.asarray(resample.downscale(labels, [1, 2, 2], "nearest"))
+        np.testing.assert_array_equal(got, labels[:, ::2, ::2])
+
+    def test_upscale_nearest_roundtrip(self, rng):
+        labels = rng.integers(0, 50, (8, 8, 8)).astype("int32")
+        up = np.asarray(resample.upscale(labels, (16, 16, 16), "nearest"))
+        np.testing.assert_array_equal(up[::2, ::2, ::2], labels)
+
+    def test_interpolate_constant_preserved(self):
+        x = np.full((16, 16, 16), 0.7, dtype="float32")
+        got = np.asarray(resample.downscale(x, 2, "interpolate"))
+        np.testing.assert_allclose(got, 0.7, rtol=1e-5)
+
+
+class TestDownscalingWorkflow:
+    def test_paintera_pyramid(self, tmp_path, rng):
+        from cluster_tools_tpu.workflows.downscaling import DownscalingWorkflow
+
+        path = str(tmp_path / "d.n5")
+        raw = ndimage.gaussian_filter(
+            rng.random((32, 64, 64)), 1.0
+        ).astype("float32")
+        file_reader(path).create_dataset("raw", data=raw, chunks=(16, 32, 32))
+
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [16, 32, 32]})
+
+        wf = DownscalingWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            scale_factors=[[1, 2, 2], 2],
+            metadata_format="paintera",
+            metadata_dict={"resolution": [40.0, 4.0, 4.0]},
+            output_key_prefix="pyramid",
+        )
+        assert build([wf])
+
+        f = file_reader(path, "r")
+        s0 = f["pyramid/s0"]
+        s1 = f["pyramid/s1"]
+        s2 = f["pyramid/s2"]
+        assert s0.shape == (32, 64, 64)
+        assert s1.shape == (32, 32, 32)
+        assert s2.shape == (16, 16, 16)
+        # metadata: java-reversed cumulative factors
+        assert s1.attrs["downsamplingFactors"] == [2, 2, 1]
+        assert s2.attrs["downsamplingFactors"] == [4, 4, 2]
+        g = f["pyramid"]
+        assert g.attrs["multiScale"] is True
+        assert g.attrs["resolution"] == [4.0, 4.0, 40.0]
+        # content: s1 approximates the full-volume resize
+        want = np.asarray(
+            resample.downscale(raw, [1, 2, 2], "interpolate")
+        )
+        np.testing.assert_allclose(s1[:], want, atol=2e-2)
+
+    def test_bdv_n5_metadata(self, tmp_path, rng):
+        from cluster_tools_tpu.workflows.downscaling import DownscalingWorkflow
+
+        path = str(tmp_path / "bdv.n5")
+        raw = rng.random((16, 32, 32)).astype("float32")
+        src = str(tmp_path / "src.n5")
+        file_reader(src).create_dataset("raw", data=raw, chunks=(8, 16, 16))
+
+        config_dir = str(tmp_path / "configs_bdv")
+        tmp_folder = str(tmp_path / "tmp_bdv")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+
+        wf = DownscalingWorkflow(
+            tmp_folder, config_dir,
+            input_path=src, input_key="raw",
+            scale_factors=[2],
+            metadata_format="bdv.n5",
+            output_path=path,
+        )
+        assert build([wf])
+        f = file_reader(path, "r")
+        assert f["setup0/timepoint0/s0"].shape == (16, 32, 32)
+        assert f["setup0/timepoint0/s1"].shape == (8, 16, 16)
+        assert f["setup0"].attrs["downsamplingFactors"] == [[1, 1, 1], [2, 2, 2]]
+        xml = os.path.splitext(path)[0] + ".xml"
+        assert os.path.exists(xml)
+        content = open(xml).read()
+        assert "bdv.n5" in content and "32 32 16" in content
+
+
+class TestBigLabels:
+    def test_uint64_labels_survive_pyramid(self, tmp_path, rng):
+        # regression: ids >= 2**32 (e.g. paintera's ignore label) must not be
+        # truncated — nearest resampling stays on host (no x64 on device)
+        from cluster_tools_tpu.tasks.downscaling import (
+            DownscalingTask,
+            UpscalingTask,
+        )
+
+        big = np.uint64(18446744073709550592)
+        labels = rng.integers(0, 100, (16, 16, 16)).astype("uint64")
+        labels[labels == 0] = big
+        path = str(tmp_path / "big.n5")
+        file_reader(path).create_dataset("seg", data=labels, chunks=(8, 8, 8))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 8, 8]})
+        down = DownscalingTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            output_path=path, output_key="s1",
+            scale_factor=2,
+        )
+        assert build([down])
+        s1 = file_reader(path, "r")["s1"][:]
+        np.testing.assert_array_equal(s1, labels[::2, ::2, ::2])
+        up = UpscalingTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="s1",
+            output_path=path, output_key="up",
+            scale_factor=2,
+        )
+        assert build([up])
+        upv = file_reader(path, "r")["up"][:]
+        assert big in np.unique(upv)
+
+
+class TestUpscaling:
+    def test_upscale_labels(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.downscaling import UpscalingTask
+
+        path = str(tmp_path / "u.n5")
+        labels = rng.integers(0, 9, (8, 16, 16)).astype("uint32")
+        file_reader(path).create_dataset("seg", data=labels, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        cfg.write_config(
+            config_dir, "upscaling", {"library_kwargs": {"order": 0}}
+        )
+        task = UpscalingTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            output_path=path, output_key="up",
+            scale_factor=2,
+        )
+        assert build([task])
+        up = file_reader(path, "r")["up"][:]
+        assert up.shape == (16, 32, 32)
+        np.testing.assert_array_equal(up[::2, ::2, ::2], labels)
+        # nearest upsampling only repeats values
+        assert set(np.unique(up)) <= set(np.unique(labels))
+
+
+class TestScaleToBoundaries:
+    def test_objects_refit(self, tmp_path):
+        from cluster_tools_tpu.tasks.downscaling import ScaleToBoundariesTask
+
+        shape = (16, 32, 32)
+        # two slabs split at x=16 with a boundary ridge
+        gt = np.zeros(shape, dtype="uint64")
+        gt[:, :, :16] = 1
+        gt[:, :, 16:] = 2
+        xx = np.mgrid[: shape[0], : shape[1], : shape[2]][2]
+        bnd = np.exp(-((xx - 15.5) ** 2) / 4.0).astype("float32")
+        # coarse objects at half resolution, slightly misaligned
+        coarse = gt[::2, ::2, ::2].copy()
+
+        path = str(tmp_path / "s.n5")
+        f = file_reader(path)
+        f.create_dataset("objs", data=coarse, chunks=(8, 16, 16))
+        f.create_dataset("bnd", data=bnd, chunks=(8, 16, 16))
+
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [16, 32, 32]})
+        cfg.write_config(
+            config_dir, "scale_to_boundaries", {"erode_by": 3}
+        )
+        task = ScaleToBoundariesTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="objs",
+            boundaries_path=path, boundaries_key="bnd",
+            output_path=path, output_key="fitted",
+        )
+        assert build([task])
+        fitted = file_reader(path, "r")["fitted"][:]
+        assert fitted.shape == shape
+        # object ids survive and dominate their ground-truth side
+        for obj in (1, 2):
+            sel = gt == obj
+            frac = (fitted[sel] == obj).mean()
+            assert frac > 0.8, f"object {obj}: {frac}"
