@@ -254,3 +254,45 @@ def apply_size_filter(
     return seeded_watershed(
         hmap, kept, mask=mask, connectivity=connectivity, per_slice=per_slice
     )
+
+
+def fit_to_hmap(
+    objs: np.ndarray,
+    hmap: np.ndarray,
+    erode_by: int,
+    erode_3d: bool = True,
+) -> np.ndarray:
+    """Refit (possibly resampled) objects to a boundary height map: erode each
+    object, then re-grow all of them with a seeded watershed on a DT-blended
+    height map (reference volume_utils.fit_to_hmap:336-357).
+
+    Host wrapper: labels are compacted to int32 for the device flood and mapped
+    back, so uint64 ids survive.  The per-object erosion is the min==max window
+    test (a voxel is interior iff its whole window carries one label); the
+    background seed is the eroded background.  Returns the refit uint64 labels.
+    """
+    from .dt import distance_transform
+    from .filters import minimum_filter
+
+    uniq = np.unique(objs)
+    if uniq[0] != 0:
+        uniq = np.concatenate([[0], uniq])
+    local = np.searchsorted(uniq, objs).astype(np.int32)
+    bg_id = np.int32(uniq.size)
+
+    size = 2 * int(erode_by) + 1
+    win = size if erode_3d else (1, size, size)
+    labels = jnp.asarray(local)
+    mn = minimum_filter(labels, win)
+    mx = maximum_filter(labels, win)
+    interior = (mn == mx) & (labels > 0)
+    seeds = jnp.where(interior, labels, 0)
+    seeds = jnp.where(mx == 0, bg_id, seeds)
+
+    h = normalize(jnp.asarray(hmap, jnp.float32))
+    dt = distance_transform(h > 0.3)
+    h = 0.8 * h + 0.2 * (1.0 - normalize(dt))
+
+    fitted_local = np.array(seeded_watershed(h, seeds))
+    fitted_local[fitted_local == bg_id] = 0
+    return uniq[fitted_local].astype(np.uint64)

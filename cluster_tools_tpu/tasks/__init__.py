@@ -20,6 +20,11 @@ from .copy_volume import CopyVolumeTask
 from .transformations import LinearTransformationTask
 from .masking import BlocksFromMaskTask, MinfilterTask
 from .downscaling import DownscalingTask, UpscalingTask, ScaleToBoundariesTask
+from .affinities import (
+    InsertAffinitiesTask,
+    EmbeddingDistancesTask,
+    GradientsTask,
+)
 
 __all__ = [
     "VolumeTask",
@@ -38,4 +43,7 @@ __all__ = [
     "DownscalingTask",
     "UpscalingTask",
     "ScaleToBoundariesTask",
+    "InsertAffinitiesTask",
+    "EmbeddingDistancesTask",
+    "GradientsTask",
 ]

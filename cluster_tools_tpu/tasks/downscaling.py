@@ -273,9 +273,7 @@ class ScaleToBoundariesTask(VolumeTask):
         return [h, h, h] if config.get("erode_3d", True) else [0, h, h]
 
     def process_block(self, block_id: int, blocking: Blocking, config):
-        from ..ops.filters import maximum_filter, minimum_filter, normalize
-        from ..ops.dt import distance_transform
-        from ..ops.watershed import seeded_watershed
+        from ..ops.watershed import fit_to_hmap
 
         erode_by = config.get("erode_by", 6)
         if isinstance(erode_by, dict):
@@ -311,32 +309,9 @@ class ScaleToBoundariesTask(VolumeTask):
         else:
             hmap = np.asarray(bd_ds[in_bb])
 
-        # fit_to_hmap on device: erode labels (min==max window keeps interior),
-        # background seed from eroded background, seeded WS on blended hmap.
-        # The device path floods compact int32 ids; map back through uniq.
-        uniq = np.unique(objs)
-        if uniq[0] != 0:
-            uniq = np.concatenate([[0], uniq])
-        local = np.searchsorted(uniq, objs).astype(np.int32)
-        bg_id = np.int32(uniq.size)  # one past the densest local id
-
-        size = 2 * erode_by + 1
-        labels = jnp.asarray(local)
-        mn = minimum_filter(labels, size)
-        mx = maximum_filter(labels, size)
-        interior = (mn == mx) & (labels > 0)
-        bg_seed = mx == 0
-        seeds = jnp.where(interior, labels, 0)
-        seeds = jnp.where(bg_seed, bg_id, seeds)
-
-        h = normalize(jnp.asarray(hmap, jnp.float32))
-        dt = distance_transform(h > 0.3)
-        h = 0.8 * h + 0.2 * (1.0 - normalize(dt))
-
-        fitted_local = np.array(seeded_watershed(h, seeds))
-        fitted_local[fitted_local == bg_id] = 0
-        fitted = uniq[fitted_local].astype(np.uint64)
-        fitted = fitted[bh.inner_local.slicing]
+        fitted = fit_to_hmap(
+            objs, hmap, erode_by, config.get("erode_3d", True)
+        )[bh.inner_local.slicing]
 
         fg = fitted != 0
         out_ds = self.output_ds()

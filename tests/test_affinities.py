@@ -1,0 +1,183 @@
+"""Affinity ops + tasks: label affinities, embedding distances, gradients,
+insert_affinities."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu.ops import affinities as aff_ops
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+class TestAffinityOps:
+    def test_compute_affinities_oracle(self, rng):
+        labels = rng.integers(0, 4, (6, 8, 8)).astype(np.int32)
+        offsets = [[-1, 0, 0], [0, -1, 0], [0, 0, -2]]
+        affs, mask = aff_ops.compute_affinities(labels, offsets)
+        assert affs.shape == (3, 6, 8, 8)
+        for c, off in enumerate(offsets):
+            for idx in np.ndindex(*labels.shape):
+                nb = tuple(i + o for i, o in zip(idx, off))
+                if all(0 <= n < s for n, s in zip(nb, labels.shape)):
+                    assert mask[c][idx] == 1
+                    assert affs[c][idx] == float(labels[idx] == labels[nb])
+                else:
+                    assert mask[c][idx] == 0
+                    assert affs[c][idx] == 0.0
+
+    def test_compute_affinities_uint64_no_collision(self):
+        # regression: uint64 ids colliding mod 2**32 must keep their boundary
+        labels = np.zeros((2, 4, 4), dtype=np.uint64)
+        labels[:, :, :2] = 5
+        labels[:, :, 2:] = np.uint64(2**32 + 5)
+        affs, mask = aff_ops.compute_affinities(labels, [[0, 0, -1]])
+        assert affs[0, 0, 0, 2] == 0.0
+        assert mask[0, 0, 0, 2] == 1
+
+    def test_embedding_distances_l2(self, rng):
+        emb = rng.random((4, 5, 6, 6)).astype(np.float32)
+        offsets = [[0, -1, 0]]
+        d = aff_ops.embedding_distances(emb, offsets, "l2")
+        # interior oracle
+        want = np.sqrt(((emb[:, :, 1:, :] - emb[:, :, :-1, :]) ** 2).sum(0) + 1e-12)
+        # offset (0,-1,0): d[v] = dist(emb[v], emb[v + (0,-1,0)]); valid rows >= 1
+        np.testing.assert_allclose(d[0][:, 1:, :], want, rtol=1e-5)
+        assert (d[0][:, 0, :] == 0).all()
+
+    def test_dilation_matches_scipy(self, rng):
+        x = rng.random((8, 12, 12)) > 0.9
+        for it in (1, 2):
+            got = np.asarray(aff_ops.binary_dilation(x, it))
+            want = ndimage.binary_dilation(x, iterations=it)
+            np.testing.assert_array_equal(got, want)
+
+    def test_dilation_2d(self, rng):
+        x = np.zeros((3, 9, 9), dtype=bool)
+        x[1, 4, 4] = True
+        got = np.asarray(aff_ops.binary_dilation(x, 1, in_2d=True))
+        assert got[1].sum() == 5  # cross in plane
+        assert not got[0].any() and not got[2].any()  # no z growth
+
+    def test_erosion_matches_scipy(self, rng):
+        x = ndimage.binary_dilation(rng.random((8, 12, 12)) > 0.95, iterations=3)
+        for it in (1, 2):
+            got = np.asarray(aff_ops.binary_erosion(x, it))
+            want = ndimage.binary_erosion(x, iterations=it)
+            np.testing.assert_array_equal(got, want)
+
+    def test_gradient_mean(self):
+        x = np.linspace(0, 1, 8 * 8 * 8).reshape(8, 8, 8).astype(np.float32)
+        got = np.asarray(aff_ops.gradient_mean(x))
+        want = np.mean(np.stack(np.gradient(x)), axis=0)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+class TestAffinityTasks:
+    def _setup(self, tmp_path, name):
+        config_dir = str(tmp_path / f"configs_{name}")
+        tmp_folder = str(tmp_path / f"tmp_{name}")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        return tmp_folder, config_dir
+
+    def test_insert_affinities(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.affinities import InsertAffinitiesTask
+
+        shape = (16, 32, 32)
+        offsets = [[-1, 0, 0], [0, -1, 0], [0, 0, -1]]
+        affs = np.full((3,) + shape, 0.9, dtype="float32")
+        objs = np.zeros(shape, dtype="uint64")
+        objs[4:12, 8:24, 8:15] = 1
+        objs[4:12, 8:24, 17:24] = 2
+
+        path = str(tmp_path / "ins.n5")
+        f = file_reader(path)
+        f.create_dataset("affs", data=affs, chunks=(1, 8, 16, 16))
+        f.create_dataset("objs", data=objs, chunks=(8, 16, 16))
+
+        tmp_folder, config_dir = self._setup(tmp_path, "ins")
+        task = InsertAffinitiesTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="affs",
+            output_path=path, output_key="out",
+            objects_path=path, objects_key="objs",
+            offsets=offsets,
+        )
+        assert build([task])
+        out = file_reader(path, "r")["out"][:]
+        assert out.shape == affs.shape
+        # inside objects away from boundaries affinities stay attractive-high
+        assert out[2, 8, 16, 10] >= 0.9
+        # the object boundary (x ~ 15..17) must now carry a repulsive x-response
+        assert out[2, 8, 16, 17] == 1.0 or out[2, 8, 16, 16] == 1.0
+
+    def test_embedding_distances_task(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.affinities import EmbeddingDistancesTask
+
+        shape = (16, 32, 32)
+        chans = [rng.random(shape).astype("float32") for _ in range(3)]
+        path = str(tmp_path / "emb.n5")
+        f = file_reader(path)
+        for i, c in enumerate(chans):
+            f.create_dataset(f"c{i}", data=c, chunks=(8, 16, 16))
+
+        tmp_folder, config_dir = self._setup(tmp_path, "emb")
+        offsets = [[-1, 0, 0], [0, 0, -1]]
+        task = EmbeddingDistancesTask(
+            tmp_folder, config_dir,
+            input_paths=[path] * 3, input_keys=["c0", "c1", "c2"],
+            output_path=path, output_key="dist",
+            offsets=offsets,
+        )
+        assert build([task])
+        got = file_reader(path, "r")["dist"][:]
+        emb = np.stack(chans)
+        want = aff_ops.embedding_distances(emb, offsets, "l2")
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_gradients_task(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.affinities import GradientsTask
+
+        shape = (16, 32, 32)
+        x = ndimage.gaussian_filter(rng.random(shape), 2.0).astype("float32")
+        path = str(tmp_path / "g.n5")
+        file_reader(path).create_dataset("x", data=x, chunks=(8, 16, 16))
+        tmp_folder, config_dir = self._setup(tmp_path, "grad")
+        task = GradientsTask(
+            tmp_folder, config_dir,
+            input_paths=[path], input_keys=["x"],
+            output_path=path, output_key="grad",
+        )
+        assert build([task])
+        got = file_reader(path, "r")["grad"][:]
+        want = np.mean(np.stack(np.gradient(x)), axis=0)
+        # interior matches (block borders use halo'd recompute)
+        np.testing.assert_allclose(
+            got[2:-2, 2:-2, 2:-2], want[2:-2, 2:-2, 2:-2], rtol=1e-3, atol=1e-5
+        )
+
+    def test_gradients_per_channel(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.affinities import GradientsTask
+
+        shape = (16, 32, 32)
+        chans = [rng.random(shape).astype("float32") for _ in range(2)]
+        path = str(tmp_path / "gc.n5")
+        f = file_reader(path)
+        for i, c in enumerate(chans):
+            f.create_dataset(f"x{i}", data=c, chunks=(8, 16, 16))
+        tmp_folder, config_dir = self._setup(tmp_path, "gradc")
+        cfg.write_config(config_dir, "gradients", {"average_gradient": False})
+        task = GradientsTask(
+            tmp_folder, config_dir,
+            input_paths=[path] * 2, input_keys=["x0", "x1"],
+            output_path=path, output_key="grads",
+        )
+        assert build([task])
+        got = file_reader(path, "r")["grads"][:]
+        assert got.shape == (2,) + shape
+        for c, x in enumerate(chans):
+            want = np.mean(np.stack(np.gradient(x)), axis=0)
+            np.testing.assert_allclose(
+                got[c][2:-2, 2:-2, 2:-2], want[2:-2, 2:-2, 2:-2],
+                rtol=1e-3, atol=1e-5,
+            )
