@@ -1,0 +1,12 @@
+"""Neural-network models for block-wise inference.
+
+The reference ships no models of its own — it wraps external pytorch/inferno
+checkpoints (reference inference/frameworks.py).  The TPU-native build instead
+carries a first-class flax U-Net (the standard architecture those checkpoints
+have in EM segmentation) so the whole predict path is one jit-compiled XLA
+program on the MXU, plus loaders for foreign checkpoints.
+"""
+
+from .unet import UNet3D, load_checkpoint, save_checkpoint
+
+__all__ = ["UNet3D", "load_checkpoint", "save_checkpoint"]

@@ -25,6 +25,8 @@ from .affinities import (
     EmbeddingDistancesTask,
     GradientsTask,
 )
+from .inference import InferenceTask
+from .multiscale_inference import MultiscaleInferenceTask
 
 __all__ = [
     "VolumeTask",
@@ -46,4 +48,6 @@ __all__ = [
     "InsertAffinitiesTask",
     "EmbeddingDistancesTask",
     "GradientsTask",
+    "InferenceTask",
+    "MultiscaleInferenceTask",
 ]

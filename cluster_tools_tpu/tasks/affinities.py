@@ -63,11 +63,16 @@ class InsertAffinitiesTask(VolumeTask):
         )
 
     def _halo(self, config) -> List[int]:
+        # offsets + erosion + in-plane dilation all widen the region whose
+        # boundary responses can reach the inner block
         halo = _offsets_halo(self.offsets)
         erode_by = int(config.get("erode_by", 0))
+        dilate_by = int(config.get("dilate_by", 2))
         if config.get("erode_3d", False):
-            return [max(h, erode_by) for h in halo]
-        return [halo[0]] + [max(h, erode_by) for h in halo[1:]]
+            halo = [max(h, erode_by) for h in halo]
+        else:
+            halo = [halo[0]] + [max(h, erode_by) for h in halo[1:]]
+        return [halo[0]] + [h + dilate_by for h in halo[1:]]
 
     def process_block(self, block_id: int, blocking: Blocking, config):
         in_ds = self.input_ds()
@@ -116,8 +121,9 @@ class InsertAffinitiesTask(VolumeTask):
         if affs_insert.shape[0] >= 3:
             affs_insert[0] += np.mean(affs_insert[1:3], axis=0)
 
-        lo, hi = float(affs.min()), float(affs.max())
-        affs = (affs - lo) / max(hi - lo, 1e-6)
+        # the reference min-max-normalizes the block here (vu.normalize) — that
+        # collapses uniform blocks and makes output partition-dependent; the
+        # predictions are already probabilities, so clip instead
         affs = np.clip(affs + affs_insert, 0.0, 1.0)
 
         zero_list = config.get("zero_objects_list")
@@ -156,7 +162,11 @@ class EmbeddingDistancesTask(VolumeTask):
         shape = store.file_reader(self.input_paths[0], "r")[
             self.input_keys[0]
         ].shape
-        return shape[-3:] if len(shape) > 3 else shape
+        if len(shape) != 3:
+            # multi-channel embedding datasets are a reference TODO too
+            # (embedding_distances.py "TODO support multi-channel input data")
+            raise ValueError("embedding channels must be separate 3d datasets")
+        return shape
 
     def prepare(self, blocking: Blocking, config: Dict[str, Any]) -> None:
         store.file_reader(self.output_path, "a").require_dataset(
@@ -202,7 +212,9 @@ class GradientsTask(VolumeTask):
         shape = store.file_reader(self.input_paths[0], "r")[
             self.input_keys[0]
         ].shape
-        return shape[-3:] if len(shape) > 3 else shape
+        if len(shape) != 3:
+            raise ValueError("gradient channels must be separate 3d datasets")
+        return shape
 
     def prepare(self, blocking: Blocking, config: Dict[str, Any]) -> None:
         # averaged: one 3d volume; per-channel: leading channel axis

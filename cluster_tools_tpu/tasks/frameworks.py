@@ -1,0 +1,163 @@
+"""Predictor/preprocessor registry for block-wise NN inference.
+
+Reference inference/frameworks.py:38-166: thread-locked pytorch predictors with
+optional TTA and mixed precision, a preprocessor doing zero-mean/unit-variance
+or [0,1] casting, looked up by framework name.
+
+Here the first-class framework is ``jax``: the checkpoint is a flax model
+(models/unet.py) and predict is one jit program per block geometry — the
+batch rides the MXU, no thread lock needed (dispatch is async).  ``pytorch``
+wraps a TorchScript/torch.nn checkpoint on host as the compatibility path for
+foreign models (torch-cpu is in the image); ``tensorflow`` raises, as in the
+reference (frameworks.py:150-151 is a stub).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+# -- preprocessing ------------------------------------------------------------
+
+
+def preprocess_zero_mean_unit_variance(data: np.ndarray, eps: float = 1e-6):
+    data = data.astype("float32")
+    return (data - data.mean()) / (data.std() + eps)
+
+
+def preprocess_to_01(data: np.ndarray, eps: float = 1e-6):
+    data = data.astype("float32")
+    lo, hi = data.min(), data.max()
+    return (data - lo) / max(hi - lo, eps)
+
+
+PREPROCESSORS = {
+    "zero_mean_unit_variance": preprocess_zero_mean_unit_variance,
+    "to_01": preprocess_to_01,
+    "none": lambda data: data.astype("float32"),
+}
+
+
+def get_preprocessor(name: str = "zero_mean_unit_variance") -> Callable:
+    return PREPROCESSORS[name]
+
+
+# -- model surgery hooks (reference inference/prep_model.py:9-23) -------------
+
+
+def prep_add_sigmoid(apply_fn):
+    import jax
+
+    def wrapped(params, x):
+        return jax.nn.sigmoid(apply_fn(params, x))
+
+    return wrapped
+
+
+PREP_MODELS = {"add_sigmoid": prep_add_sigmoid, None: lambda f: f}
+
+
+# -- predictors ---------------------------------------------------------------
+
+
+class JaxPredictor:
+    """Batched jit forward of a flax checkpoint.
+
+    Input: [B, C?, z, y, x] host array → output [B, C_out, z, y, x] with the
+    halo already cropped (the reference predictors crop the halo too,
+    frameworks.py:87-101 via their `crop` wrapper).
+    """
+
+    def __init__(self, checkpoint_path: str, halo, prep_model: Optional[str] = None,
+                 **_unused):
+        import jax
+
+        from ..models.unet import load_checkpoint
+
+        self.model, self.params = load_checkpoint(checkpoint_path)
+        self.halo = list(halo)
+        apply_fn = PREP_MODELS[prep_model](
+            lambda params, x: self.model.apply(params, x)
+        )
+        self._apply = jax.jit(apply_fn)
+
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        squeeze_batch = data.ndim in (3, 4)
+        if data.ndim == 3:
+            data = data[None, None]
+        elif data.ndim == 4:
+            data = data[None]
+        out = np.asarray(self._apply(self.params, jnp.asarray(data)))
+        ha = self.halo
+        if any(ha):
+            crop = tuple(
+                slice(h, s - h if h else None)
+                for h, s in zip(ha, out.shape[-3:])
+            )
+            out = out[(Ellipsis,) + crop]
+        return out[0] if squeeze_batch else out
+
+
+class PytorchPredictor:
+    """Host torch forward for foreign checkpoints (compat path; the model is
+    shared across prefetch threads behind a lock like the reference's,
+    frameworks.py:63,88)."""
+
+    def __init__(self, checkpoint_path: str, halo, use_best: bool = True,
+                 **_unused):
+        import torch
+
+        self.torch = torch
+        try:
+            self.model = torch.jit.load(checkpoint_path, map_location="cpu")
+        except RuntimeError:
+            self.model = torch.load(
+                checkpoint_path, map_location="cpu", weights_only=False
+            )
+        self.model.eval()
+        self.halo = list(halo)
+        self.lock = threading.Lock()
+
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        torch = self.torch
+        squeeze_batch = data.ndim in (3, 4)
+        if data.ndim == 3:
+            data = data[None, None]
+        elif data.ndim == 4:
+            data = data[None]
+        with self.lock, torch.no_grad():
+            out = self.model(torch.from_numpy(np.ascontiguousarray(data)))
+        out = out.cpu().numpy()
+        ha = self.halo
+        if any(ha):
+            crop = tuple(
+                slice(h, s - h if h else None)
+                for h, s in zip(ha, out.shape[-3:])
+            )
+            out = out[(Ellipsis,) + crop]
+        return out[0] if squeeze_batch else out
+
+
+def _tensorflow_stub(*args, **kwargs):
+    raise NotImplementedError(
+        "tensorflow inference is not implemented (stub in the reference too, "
+        "frameworks.py:150-151)"
+    )
+
+
+PREDICTORS: Dict[str, Any] = {
+    "jax": JaxPredictor,
+    "pytorch": PytorchPredictor,
+    "inferno": PytorchPredictor,  # inferno trainers export torch models
+    "tensorflow": _tensorflow_stub,
+}
+
+
+def get_predictor(framework: str) -> Callable:
+    return PREDICTORS[framework]

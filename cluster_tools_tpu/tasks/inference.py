@@ -1,0 +1,231 @@
+"""Distributed NN inference over halo'd blocks.
+
+Reference inference/inference.py:30 ``InferenceBase`` + the per-block
+dask.delayed 5-stage pipeline (:217-327).  The TPU re-expression:
+
+  * blocks are read with reflect-padded halos (``_load_input`` semantics,
+    inference.py:175-205) by host prefetch threads;
+  * predict is a batched jit flax forward (frameworks.JaxPredictor) — the
+    device works on batch N while the host reads batch N+1 and writes batch
+    N-1 (the dask-pipeline IO/compute overlap, without dask);
+  * outputs map to one or more datasets through ``output_key`` channel ranges,
+    optionally channel-accumulated, optionally quantized to uint8 with the
+    mirrored scaling of the reference (``_to_uint8``, inference.py:208-214).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import store
+from ..utils.blocking import Blocking
+from .base import VolumeTask
+from .frameworks import get_predictor, get_preprocessor
+
+
+def load_input_with_halo(ds, begin, block_shape, halo, padding_mode="reflect"):
+    """Reflect-padded halo'd read (reference _load_input, inference.py:175-205)."""
+    shape = ds.shape[-3:]
+    starts = [b - h for b, h in zip(begin, halo)]
+    stops = [b + bs + h for b, bs, h in zip(begin, block_shape, halo)]
+    pad_left = tuple(max(0, -s) for s in starts)
+    pad_right = tuple(max(0, st - sh) for st, sh in zip(stops, shape))
+    bb = tuple(
+        slice(max(0, s), min(sh, st)) for s, st, sh in zip(starts, stops, shape)
+    )
+    if len(ds.shape) == 4:
+        bb = (slice(None),) + bb
+    data = np.asarray(ds[bb])
+    if any(pad_left) or any(pad_right):
+        pad = [(pl, pr) for pl, pr in zip(pad_left, pad_right)]
+        if data.ndim == 4:
+            pad = [(0, 0)] + pad
+        data = np.pad(data, pad, mode=padding_mode)
+    return data
+
+
+def to_uint8(data, float_range=(0.0, 1.0), safe_scale=True):
+    """Mirrored quantization (reference _to_uint8, inference.py:208-214)."""
+    if safe_scale:
+        mult = np.floor(255.0 / (float_range[1] - float_range[0]))
+    else:
+        mult = np.ceil(255.0 / (float_range[1] - float_range[0]))
+    add = 255 - mult * float_range[1]
+    return np.clip((data * mult + add).round(), 0, 255).astype("uint8")
+
+
+class InferenceTask(VolumeTask):
+    """Block-wise prediction.
+
+    ``output_key`` is a dict {dataset_key: [channel_start, channel_stop]}
+    (reference output_key DictParameter); a 3d dataset gets one channel (or an
+    accumulated reduction), a 4d dataset the full range.
+    """
+
+    task_name = "inference"
+
+    def __init__(
+        self,
+        *args,
+        checkpoint_path: str = None,
+        halo: Sequence[int] = (0, 0, 0),
+        output_key: Optional[Dict[str, Sequence[int]]] = None,
+        mask_path: str = None,
+        mask_key: str = None,
+        framework: str = "jax",
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.checkpoint_path = checkpoint_path
+        self.halo = list(halo)
+        self.output_key_map = dict(output_key or {})
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        self.framework = framework
+        self._predictor = None
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update(
+            {
+                "dtype": "uint8",
+                "compression": "gzip",
+                "chunks": None,
+                "channel_accumulation": None,
+                "prep_model": None,
+                "preprocess": "zero_mean_unit_variance",
+                "batch_size": 1,
+                "prefetch_threads": 2,
+            }
+        )
+        return conf
+
+    # -- outputs -------------------------------------------------------------
+
+    def prepare(self, blocking: Blocking, config: Dict[str, Any]) -> None:
+        dtype = config.get("dtype", "uint8")
+        chunks = config.get("chunks")
+        chunks = (
+            tuple(chunks)
+            if chunks is not None
+            else tuple(max(1, bs // 2) for bs in blocking.block_shape)
+        )
+        accumulation = config.get("channel_accumulation")
+        f = store.file_reader(self.output_path, "a")
+        for key, (c0, c1) in self.output_key_map.items():
+            n_channels = c1 - c0
+            if n_channels > 1 and accumulation is None:
+                shape = (n_channels,) + tuple(blocking.shape)
+                ds_chunks = (1,) + chunks
+            else:
+                shape = tuple(blocking.shape)
+                ds_chunks = chunks
+            f.require_dataset(
+                key,
+                shape=shape,
+                dtype=dtype,
+                chunks=tuple(min(c, s) for c, s in zip(ds_chunks, shape)),
+                compression=config.get("compression", "gzip"),
+            )
+
+    def predictor(self, config):
+        if self._predictor is None:
+            self._predictor = get_predictor(self.framework)(
+                self.checkpoint_path,
+                self.halo,
+                prep_model=config.get("prep_model"),
+                use_best=config.get("use_best", True),
+            )
+        return self._predictor
+
+    # -- per-block -----------------------------------------------------------
+
+    def _load_block(self, block_id, blocking, in_ds, mask_ds):
+        block = blocking.block(block_id)
+        if mask_ds is not None:
+            m = np.asarray(mask_ds[block.slicing]).astype(bool)
+            if not m.any():
+                return None
+        return load_input_with_halo(
+            in_ds, block.begin, blocking.block_shape, self.halo
+        )
+
+    def _write_block(self, block_id, blocking, out_datasets, output, config):
+        block = blocking.block(block_id)
+        bb = block.slicing
+        actual = tuple(b.stop - b.start for b in bb)
+        if output.ndim == 3:
+            output = output[None]
+        # crop overhanging padding at the volume end (halo itself was cropped
+        # by the predictor)
+        output = output[(slice(None),) + tuple(slice(0, a) for a in actual)]
+
+        accumulation = config.get("channel_accumulation")
+        dtype = config.get("dtype", "uint8")
+        for key, (c0, c1) in self.output_key_map.items():
+            ds = out_datasets[key]
+            chan_out = output[c0:c1]
+            if len(ds.shape) == 3:
+                if accumulation is not None and chan_out.shape[0] > 1:
+                    chan_out = getattr(np, accumulation)(chan_out, axis=0)
+                else:
+                    chan_out = chan_out[0]
+                out_bb = bb
+            else:
+                out_bb = (slice(None),) + bb
+            if dtype == "uint8" and chan_out.dtype != np.uint8:
+                chan_out = to_uint8(chan_out)
+            ds[out_bb] = chan_out.astype(ds.dtype, copy=False)
+
+    def process_block(self, block_id, blocking, config):
+        self.process_block_batch([block_id], blocking, config)
+
+    def process_block_batch(self, block_ids: List[int], blocking: Blocking, config):
+        in_ds = self.input_ds()
+        mask_ds = (
+            store.file_reader(self.mask_path, "r")[self.mask_key]
+            if self.mask_path
+            else None
+        )
+        out_datasets = {
+            key: store.file_reader(self.output_path, "a")[key]
+            for key in self.output_key_map
+        }
+        predictor = self.predictor(config)
+        preprocess = get_preprocessor(
+            config.get("preprocess", "zero_mean_unit_variance")
+        )
+        batch_size = int(config.get("batch_size", 1))
+        n_threads = int(config.get("prefetch_threads", 2))
+
+        # pipelined host IO ↔ device compute: prefetch reads ahead, the
+        # writer drains behind (reference dask pipeline, inference.py:319-327)
+        with ThreadPoolExecutor(max(1, n_threads)) as pool:
+            loads = {
+                bid: pool.submit(self._load_block, bid, blocking, in_ds, mask_ds)
+                for bid in block_ids
+            }
+            pending = []
+            for lo in range(0, len(block_ids), batch_size):
+                chunk = block_ids[lo : lo + batch_size]
+                datas = {bid: loads[bid].result() for bid in chunk}
+                live = [bid for bid in chunk if datas[bid] is not None]
+                if not live:
+                    continue
+                batch = np.stack([preprocess(datas[bid]) for bid in live])
+                if batch.ndim == 4:  # [B, z, y, x] → add channel
+                    batch = batch[:, None]
+                out = predictor(batch)
+                for i, bid in enumerate(live):
+                    pending.append(
+                        pool.submit(
+                            self._write_block, bid, blocking, out_datasets,
+                            out[i], config,
+                        )
+                    )
+            for fut in pending:
+                fut.result()

@@ -110,6 +110,9 @@ class TestAffinityTasks:
         assert out[2, 8, 16, 10] >= 0.9
         # the object boundary (x ~ 15..17) must now carry a repulsive x-response
         assert out[2, 8, 16, 17] == 1.0 or out[2, 8, 16, 16] == 1.0
+        # background away from any object keeps the raw prediction (no
+        # partition-dependent per-block renormalization)
+        assert abs(out[2, 1, 1, 1] - 0.9) < 1e-5
 
     def test_embedding_distances_task(self, tmp_path, rng):
         from cluster_tools_tpu.tasks.affinities import EmbeddingDistancesTask
