@@ -215,3 +215,11 @@ def apply_filter(x: jnp.ndarray, filter_name: str, sigma, apply_in_2d: bool = Fa
     if apply_in_2d:
         return jax.vmap(lambda sl: fn(sl, sigma))(x)
     return fn(x, sigma)
+
+
+def filter_channels(filter_name: str, ndim: int = 3, apply_in_2d: bool = False) -> int:
+    """Response channels of a named filter (hessian eigenvalues are
+    per-dimension, channels-last in apply_filter's output)."""
+    if filter_name == "hessianOfGaussianEigenvalues":
+        return 2 if apply_in_2d else ndim
+    return 1

@@ -27,6 +27,16 @@ from .affinities import (
 )
 from .inference import InferenceTask
 from .multiscale_inference import MultiscaleInferenceTask
+from .learning import (
+    EdgeLabelsTask,
+    LearnRFTask,
+    PredictEdgeProbabilitiesTask,
+)
+from .region_features import (
+    RegionFeaturesTask,
+    MergeRegionFeaturesTask,
+    ImageFilterTask,
+)
 
 __all__ = [
     "VolumeTask",
@@ -50,4 +60,10 @@ __all__ = [
     "GradientsTask",
     "InferenceTask",
     "MultiscaleInferenceTask",
+    "EdgeLabelsTask",
+    "LearnRFTask",
+    "PredictEdgeProbabilitiesTask",
+    "RegionFeaturesTask",
+    "MergeRegionFeaturesTask",
+    "ImageFilterTask",
 ]

@@ -12,6 +12,14 @@ from ..utils import store
 from ..utils.blocking import Blocking
 
 
+SCRATCH_STORE_NAME = "data.zarr"
+
+
+def scratch_store_path(tmp_folder: str) -> str:
+    """The shared per-tmp-folder scratch store (single source of truth)."""
+    return os.path.join(tmp_folder, SCRATCH_STORE_NAME)
+
+
 class VolumeTask(BlockTask):
     """A block task reading ``input_path/input_key`` and writing
     ``output_path/output_key``.
@@ -74,7 +82,7 @@ class VolumeTask(BlockTask):
 
     @property
     def tmp_store_path(self) -> str:
-        return os.path.join(self.tmp_folder, "data.zarr")
+        return scratch_store_path(self.tmp_folder)
 
     def tmp_store(self):
         return store.file_reader(self.tmp_store_path, "a")
@@ -116,7 +124,7 @@ class VolumeSimpleTask(SimpleTask):
 
     @property
     def tmp_store_path(self) -> str:
-        return os.path.join(self.tmp_folder, "data.zarr")
+        return scratch_store_path(self.tmp_folder)
 
     def tmp_store(self):
         return store.file_reader(self.tmp_store_path, "a")

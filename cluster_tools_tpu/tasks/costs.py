@@ -33,7 +33,17 @@ class ProbsToCostsTask(VolumeSimpleTask):
     def run_impl(self) -> None:
         conf = self.get_task_config()
         feats = self.tmp_store()[FEATURES_KEY][:]
-        probs = feats[:, 0]
+        # probabilities: RF predictions when present (costs/predict.py path in
+        # the reference EdgeCostsWorkflow), else the mean boundary response
+        probs_path = getattr(self, "probs_path", None)
+        if probs_path:
+            probs = np.load(probs_path)
+            if probs.size != feats.shape[0]:
+                raise ValueError(
+                    f"{probs.size} probabilities vs {feats.shape[0]} edges"
+                )
+        else:
+            probs = feats[:, 0]
         if conf.get("invert_inputs", False):
             probs = 1.0 - probs
         sizes = feats[:, 9] if conf.get("weight_edges", True) else None

@@ -1,5 +1,6 @@
 from .agglomerative_clustering import AgglomerativeClusteringWorkflow
 from .downscaling import DownscalingWorkflow
+from .learning import LearningWorkflow
 from .evaluation import EvaluationWorkflow
 from .lifted_multicut import (
     LiftedFeaturesFromNodeLabelsWorkflow,
@@ -24,6 +25,7 @@ from .watershed import WatershedWorkflow
 __all__ = [
     "AgglomerativeClusteringWorkflow",
     "DownscalingWorkflow",
+    "LearningWorkflow",
     "EvaluationWorkflow",
     "EdgeFeaturesWorkflow",
     "GraphWorkflow",

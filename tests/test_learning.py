@@ -1,0 +1,195 @@
+"""Learning component: edge labels, RF train/predict, region features,
+image filter."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+@pytest.fixture
+def training_volume(tmp_path, rng):
+    """Blocky GT segmentation + noisy boundary map + watershed-ish
+    oversegmentation whose fragments respect GT boundaries."""
+    shape = (16, 32, 32)
+    gt = np.zeros(shape, dtype="uint64")
+    gt[:, :16, :16] = 1
+    gt[:, :16, 16:] = 2
+    gt[:, 16:, :16] = 3
+    gt[:, 16:, 16:] = 4
+    # oversegmentation: split each gt quadrant in z halves
+    ws = (gt * 2 + (np.arange(shape[0]) >= 8)[:, None, None]).astype("uint64")
+    # boundary map: high on gt edges
+    bnd = np.zeros(shape, dtype=bool)
+    for axis in range(3):
+        sl_a = [slice(None)] * 3
+        sl_b = [slice(None)] * 3
+        sl_a[axis] = slice(1, None)
+        sl_b[axis] = slice(None, -1)
+        edge = gt[tuple(sl_a)] != gt[tuple(sl_b)]
+        bnd[tuple(sl_a)] |= edge
+        bnd[tuple(sl_b)] |= edge
+    bnd = ndimage.gaussian_filter(bnd.astype("float32"), 1.0)
+    bnd += 0.05 * rng.random(shape).astype("float32")
+    path = str(tmp_path / "train.n5")
+    f = file_reader(path)
+    f.create_dataset("gt", data=gt, chunks=(8, 16, 16))
+    f.create_dataset("ws", data=ws, chunks=(8, 16, 16))
+    f.create_dataset("bnd", data=bnd, chunks=(8, 16, 16))
+    return path
+
+
+class TestLearningWorkflow:
+    def test_rf_learns_boundaries(self, tmp_path, training_volume):
+        from cluster_tools_tpu.tasks.costs import ProbsToCostsTask
+        from cluster_tools_tpu.tasks.learning import (
+            EDGE_LABELS_NAME,
+            EDGE_PROBS_NAME,
+            PredictEdgeProbabilitiesTask,
+        )
+        from cluster_tools_tpu.workflows.learning import LearningWorkflow
+
+        path = training_volume
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        rf_path = str(tmp_path / "rf.pkl")
+
+        wf = LearningWorkflow(
+            tmp_folder, config_dir,
+            input_dict={"ds0": (path, "bnd")},
+            labels_dict={"ds0": (path, "ws")},
+            groundtruth_dict={"ds0": (path, "gt")},
+            output_path=rf_path,
+        )
+        assert build([wf])
+        assert os.path.exists(rf_path)
+        with open(rf_path, "rb") as f:
+            rf = pickle.load(f)
+
+        sub = os.path.join(tmp_folder, "ds0")
+        labels = np.load(os.path.join(sub, EDGE_LABELS_NAME))
+        assert set(np.unique(labels)) <= {0, 1}
+        assert (labels == 1).sum() > 0 and (labels == 0).sum() > 0
+
+        # predict on the training problem: the RF must separate the classes
+        predict = PredictEdgeProbabilitiesTask(
+            sub, config_dir, rf_path=rf_path,
+            input_path=path, input_key="ws",
+        )
+        assert build([predict])
+        probs = np.load(os.path.join(sub, EDGE_PROBS_NAME))
+        assert probs.shape == labels.shape
+        assert probs[labels == 1].mean() > 0.7
+        assert probs[labels == 0].mean() < 0.3
+
+        # costs from RF probabilities: repulsive on boundaries
+        costs_task = ProbsToCostsTask(
+            sub, config_dir,
+            probs_path=os.path.join(sub, EDGE_PROBS_NAME),
+        )
+        assert build([costs_task])
+        costs = np.load(os.path.join(sub, "costs.npy"))
+        assert (costs[labels == 1] < 0).mean() > 0.9
+        assert (costs[labels == 0] > 0).mean() > 0.9
+
+
+class TestRegionFeatures:
+    def test_matches_numpy_groupby(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.region_features import (
+            MergeRegionFeaturesTask,
+            RegionFeaturesTask,
+            load_region_features,
+        )
+
+        shape = (16, 32, 32)
+        labels = rng.integers(1, 20, shape).astype("uint64")
+        values = rng.random(shape).astype("float32")
+        path = str(tmp_path / "rf.n5")
+        f = file_reader(path)
+        f.create_dataset("seg", data=labels, chunks=(8, 16, 16))
+        f.create_dataset("raw", data=values, chunks=(8, 16, 16))
+
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        block = RegionFeaturesTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            labels_path=path, labels_key="seg",
+        )
+        merge = MergeRegionFeaturesTask(
+            tmp_folder, config_dir, dependencies=[block],
+            input_path=path, input_key="raw",
+        )
+        assert build([merge])
+        feats = load_region_features(tmp_folder)
+        for seg_id in range(1, 20):
+            sel = labels == seg_id
+            np.testing.assert_allclose(feats[seg_id, 0], sel.sum(), rtol=1e-6)
+            np.testing.assert_allclose(
+                feats[seg_id, 1], values[sel].mean(), rtol=1e-4
+            )
+            np.testing.assert_allclose(
+                feats[seg_id, 2], values[sel].min(), rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                feats[seg_id, 3], values[sel].max(), rtol=1e-5
+            )
+
+
+class TestImageFilter:
+    def test_gaussian_response(self, tmp_path, rng):
+        from cluster_tools_tpu.ops import filters as filter_ops
+        from cluster_tools_tpu.tasks.region_features import ImageFilterTask
+
+        import jax.numpy as jnp
+
+        shape = (16, 32, 32)
+        raw = rng.random(shape).astype("float32")
+        path = str(tmp_path / "if.n5")
+        file_reader(path).create_dataset("raw", data=raw, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        task = ImageFilterTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            output_path=path, output_key="smoothed",
+            filter_name="gaussianSmoothing", sigma=1.5,
+        )
+        assert build([task])
+        got = file_reader(path, "r")["smoothed"][:]
+        want = np.asarray(filter_ops.gaussian(jnp.asarray(raw), 1.5))
+        c = 8  # away from volume borders where halo padding differs
+        np.testing.assert_allclose(
+            got[4:-4, c:-c, c:-c], want[4:-4, c:-c, c:-c], rtol=1e-3, atol=1e-4
+        )
+
+    def test_hessian_multichannel(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.region_features import ImageFilterTask
+
+        shape = (8, 16, 16)
+        raw = rng.random(shape).astype("float32")
+        path = str(tmp_path / "ih.n5")
+        file_reader(path).create_dataset("raw", data=raw, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs_h")
+        tmp_folder = str(tmp_path / "tmp_h")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        task = ImageFilterTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            output_path=path, output_key="hess",
+            filter_name="hessianOfGaussianEigenvalues", sigma=1.0,
+        )
+        assert build([task])
+        hess = file_reader(path, "r")["hess"]
+        assert hess.shape == (3,) + shape
+        got = hess[:]
+        # eigenvalues sorted descending along the channel axis
+        assert (got[0] >= got[1]).all() and (got[1] >= got[2]).all()
