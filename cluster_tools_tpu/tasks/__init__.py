@@ -37,6 +37,13 @@ from .region_features import (
     MergeRegionFeaturesTask,
     ImageFilterTask,
 )
+from .skeletons import (
+    SkeletonizeTask,
+    UpsampleSkeletonsTask,
+    SkeletonEvaluationTask,
+)
+from .distances import ObjectDistancesTask, MergeObjectDistancesTask
+from .meshes import ComputeMeshesTask
 
 __all__ = [
     "VolumeTask",
@@ -66,4 +73,10 @@ __all__ = [
     "RegionFeaturesTask",
     "MergeRegionFeaturesTask",
     "ImageFilterTask",
+    "SkeletonizeTask",
+    "UpsampleSkeletonsTask",
+    "SkeletonEvaluationTask",
+    "ObjectDistancesTask",
+    "MergeObjectDistancesTask",
+    "ComputeMeshesTask",
 ]

@@ -17,6 +17,14 @@ COSTS_NAME = "costs.npy"
 class ProbsToCostsTask(VolumeSimpleTask):
     task_name = "probs_to_costs"
 
+    @property
+    def identifier(self) -> str:
+        # RF-probability runs must not be satisfied by a completed
+        # boundary-mean run in the same tmp_folder
+        if getattr(self, "probs_path", None):
+            return f"{self.task_name}_rf"
+        return self.task_name
+
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
         conf = super().default_task_config()

@@ -171,7 +171,8 @@ class ImageFilterTask(VolumeTask):
             else "x".join(str(s) for s in self.sigma)
         )
         suffix = "_2d" if self.apply_in_2d else ""
-        return f"{self.task_name}_{self.filter_name}_{sig}{suffix}"
+        out = str(self.output_key or "").replace("/", "-")
+        return f"{self.task_name}_{self.filter_name}_{sig}{suffix}_{out}"
 
     def prepare(self, blocking: Blocking, config: Dict[str, Any]) -> None:
         n_chan = filter_ops.filter_channels(

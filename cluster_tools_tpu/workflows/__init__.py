@@ -1,6 +1,12 @@
 from .agglomerative_clustering import AgglomerativeClusteringWorkflow
 from .downscaling import DownscalingWorkflow
 from .learning import LearningWorkflow
+from .skeletons import (
+    DistanceWorkflow,
+    MeshWorkflow,
+    SkeletonEvaluationWorkflow,
+    SkeletonWorkflow,
+)
 from .evaluation import EvaluationWorkflow
 from .lifted_multicut import (
     LiftedFeaturesFromNodeLabelsWorkflow,
@@ -26,6 +32,10 @@ __all__ = [
     "AgglomerativeClusteringWorkflow",
     "DownscalingWorkflow",
     "LearningWorkflow",
+    "DistanceWorkflow",
+    "MeshWorkflow",
+    "SkeletonEvaluationWorkflow",
+    "SkeletonWorkflow",
     "EvaluationWorkflow",
     "EdgeFeaturesWorkflow",
     "GraphWorkflow",

@@ -1,0 +1,191 @@
+"""Skeletons, meshes, distances: ops oracles + workflow runs."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+def _two_rod_volume():
+    """Two straight rods along x at known distance (gap 6 voxels in y)."""
+    shape = (12, 24, 40)
+    seg = np.zeros(shape, dtype="uint64")
+    seg[4:8, 4:8, 4:36] = 1
+    seg[4:8, 14:18, 4:36] = 2
+    return shape, seg
+
+
+class TestSkeletonOps:
+    def test_rod_skeleton_spans(self):
+        from cluster_tools_tpu.ops.skeleton import skeletonize
+
+        obj = np.zeros((7, 7, 40), dtype=bool)
+        obj[2:5, 2:5, 2:38] = True
+        nodes, edges = skeletonize(obj)
+        assert nodes.shape[0] >= 2
+        assert nodes[:, 2].max() - nodes[:, 2].min() > 25
+        assert edges.shape[0] >= nodes.shape[0] - 1
+        # nodes stay inside the object
+        vox = np.round(nodes).astype(int)
+        assert obj[tuple(vox.T)].all()
+
+    def test_resolution_scaling(self):
+        from cluster_tools_tpu.ops.skeleton import skeletonize
+
+        obj = np.zeros((5, 5, 20), dtype=bool)
+        obj[1:4, 1:4, 1:19] = True
+        nodes_v, _ = skeletonize(obj)
+        nodes_p, _ = skeletonize(obj, resolution=[10.0, 4.0, 4.0])
+        np.testing.assert_allclose(nodes_p, nodes_v * [10.0, 4.0, 4.0])
+
+
+class TestMeshOps:
+    def test_ball_mesh_properties(self):
+        from cluster_tools_tpu.ops.mesh import marching_cubes
+
+        zz, yy, xx = np.mgrid[:16, :16, :16]
+        ball = ((zz - 8) ** 2 + (yy - 8) ** 2 + (xx - 8) ** 2) <= 36
+        verts, faces, normals = marching_cubes(ball, smoothing_iterations=2)
+        # watertight: V - E + F == 2
+        uedges = np.unique(
+            np.sort(
+                np.concatenate(
+                    [faces[:, [0, 1]], faces[:, [1, 2]], faces[:, [0, 2]]]
+                ),
+                axis=1,
+            ),
+            axis=0,
+        )
+        assert len(verts) - len(uedges) + len(faces) == 2
+        # outward normals
+        center = verts.mean(0)
+        d = ((verts - center) * normals).sum(1)
+        assert (d > 0).mean() == 1.0
+        # area close to the analytic sphere
+        v0, v1, v2 = verts[faces[:, 0]], verts[faces[:, 1]], verts[faces[:, 2]]
+        area = 0.5 * np.linalg.norm(np.cross(v1 - v0, v2 - v0), axis=1).sum()
+        assert abs(area - 4 * np.pi * 36) / (4 * np.pi * 36) < 0.1
+
+    def test_obj_ply_roundtrip(self, tmp_path):
+        from cluster_tools_tpu.ops.mesh import (
+            marching_cubes,
+            read_obj,
+            write_obj,
+            write_ply,
+        )
+
+        cube = np.zeros((6, 6, 6), dtype=bool)
+        cube[1:5, 1:5, 1:5] = True
+        verts, faces, normals = marching_cubes(cube)
+        p = str(tmp_path / "cube.obj")
+        write_obj(p, verts, faces, normals)
+        v2, f2, n2 = read_obj(p)
+        np.testing.assert_allclose(v2, verts, atol=1e-6)
+        np.testing.assert_array_equal(f2, faces)
+        write_ply(str(tmp_path / "cube.ply"), verts, faces, normals)
+        assert "end_header" in open(str(tmp_path / "cube.ply")).read()
+
+
+class TestWorkflows:
+    def _setup(self, tmp_path, seg, name):
+        path = str(tmp_path / f"{name}.n5")
+        file_reader(path).create_dataset("seg", data=seg, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / f"configs_{name}")
+        tmp_folder = str(tmp_path / f"tmp_{name}")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        return path, tmp_folder, config_dir
+
+    def test_skeleton_workflow_and_eval(self, tmp_path):
+        from cluster_tools_tpu.tasks.skeletons import (
+            load_skeleton_evaluation,
+            load_skeletons,
+        )
+        from cluster_tools_tpu.workflows.skeletons import (
+            SkeletonEvaluationWorkflow,
+        )
+
+        shape, seg = _two_rod_volume()
+        path, tmp_folder, config_dir = self._setup(tmp_path, seg, "skel")
+        wf = SkeletonEvaluationWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            seg_path=path, seg_key="seg",
+        )
+        assert build([wf])
+        skels = load_skeletons(tmp_folder)
+        assert set(skels) == {1, 2}
+        for sid, (nodes, edges) in skels.items():
+            assert nodes.shape[0] >= 2
+            assert nodes[:, 2].max() - nodes[:, 2].min() > 20
+        # evaluating against the segmentation itself: perfect correctness
+        ev = load_skeleton_evaluation(tmp_folder)
+        np.testing.assert_allclose(ev["correctness"], 1.0)
+        assert int(ev["n_merges"]) == 0
+
+    def test_upsample_skeletons(self, tmp_path):
+        from cluster_tools_tpu.tasks.skeletons import UpsampleSkeletonsTask
+        from cluster_tools_tpu.workflows.skeletons import SkeletonWorkflow
+
+        shape, seg = _two_rod_volume()
+        path, tmp_folder, config_dir = self._setup(tmp_path, seg, "ups")
+        assert build([
+            SkeletonWorkflow(
+                tmp_folder, config_dir, input_path=path, input_key="seg"
+            )
+        ])
+        task = UpsampleSkeletonsTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            output_path=path, output_key="skel_vol",
+        )
+        assert build([task])
+        vol = file_reader(path, "r")["skel_vol"][:]
+        assert vol.shape == shape
+        # painted voxels carry their skeleton id and lie inside the object
+        for sid in (1, 2):
+            sel = vol == sid
+            assert sel.sum() >= 2
+            assert (seg[sel] == sid).all()
+
+    def test_distance_workflow(self, tmp_path):
+        from cluster_tools_tpu.tasks.distances import load_object_distances
+        from cluster_tools_tpu.workflows.skeletons import DistanceWorkflow
+
+        shape, seg = _two_rod_volume()
+        path, tmp_folder, config_dir = self._setup(tmp_path, seg, "dist")
+        cfg.write_config(
+            config_dir, "object_distances", {"max_distance": 50.0}
+        )
+        wf = DistanceWorkflow(
+            tmp_folder, config_dir, input_path=path, input_key="seg"
+        )
+        assert build([wf])
+        dists = load_object_distances(tmp_folder)
+        assert (1, 2) in dists
+        # rods are separated by a 6-voxel gap in y (8 -> 14)
+        assert abs(dists[(1, 2)] - 6.0) <= 1.0
+
+    def test_mesh_workflow(self, tmp_path):
+        from cluster_tools_tpu.ops.mesh import read_obj
+        from cluster_tools_tpu.workflows.skeletons import MeshWorkflow
+
+        shape, seg = _two_rod_volume()
+        path, tmp_folder, config_dir = self._setup(tmp_path, seg, "mesh")
+        out_dir = str(tmp_path / "meshes")
+        wf = MeshWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg", output_dir=out_dir,
+        )
+        assert build([wf])
+        for sid in (1, 2):
+            verts, faces, normals = read_obj(
+                os.path.join(out_dir, f"{sid}.obj")
+            )
+            assert len(verts) > 10 and len(faces) > 10
+            # mesh sits inside the object's physical bounds
+            sel = np.argwhere(seg == sid)
+            assert verts[:, 2].min() >= sel[:, 2].min() - 1.5
+            assert verts[:, 2].max() <= sel[:, 2].max() + 1.5
