@@ -44,6 +44,8 @@ from .skeletons import (
 )
 from .distances import ObjectDistancesTask, MergeObjectDistancesTask
 from .meshes import ComputeMeshesTask
+from .label_multisets import CreateMultisetTask, DownscaleMultisetTask
+from .paintera import UniqueBlockLabelsTask, LabelBlockMappingTask
 
 __all__ = [
     "VolumeTask",
@@ -79,4 +81,8 @@ __all__ = [
     "ObjectDistancesTask",
     "MergeObjectDistancesTask",
     "ComputeMeshesTask",
+    "CreateMultisetTask",
+    "DownscaleMultisetTask",
+    "UniqueBlockLabelsTask",
+    "LabelBlockMappingTask",
 ]

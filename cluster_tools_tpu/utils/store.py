@@ -252,17 +252,26 @@ class _N5Format:
         }
 
     @staticmethod
-    def encode_chunk(data: np.ndarray, chunks, compression) -> bytes:
-        # header: mode(0), ndim, then per-dim sizes in n5 (reversed) order, all BE.
-        # numpy C-order bytes are already "first n5 dim fastest".
+    def pack_chunk(data: np.ndarray, dims, compression, n_varlen=None) -> bytes:
+        """Shared chunk wire format: mode-0 (default) or mode-1 (varlength,
+        ``n_varlen`` = element count) header + big-endian payload."""
         be = data.astype(_N5Format._DTYPES[data.dtype.name], copy=False)
-        header = struct.pack(">HH", 0, data.ndim) + struct.pack(
-            f">{data.ndim}I", *reversed(data.shape)
+        mode = 0 if n_varlen is None else 1
+        header = struct.pack(">HH", mode, len(dims)) + struct.pack(
+            f">{len(dims)}I", *reversed(tuple(dims))
         )
+        if n_varlen is not None:
+            header += struct.pack(">I", n_varlen)
         raw = np.ascontiguousarray(be).tobytes()
         if compression:
             raw = gzip.compress(raw, 1)
         return header + raw
+
+    @staticmethod
+    def encode_chunk(data: np.ndarray, chunks, compression) -> bytes:
+        # header: mode(0), ndim, then per-dim sizes in n5 (reversed) order, all BE.
+        # numpy C-order bytes are already "first n5 dim fastest".
+        return _N5Format.pack_chunk(data, data.shape, compression)
 
     @staticmethod
     def decode_chunk(payload: bytes, chunk_shape, dtype: np.dtype, compression):
@@ -383,6 +392,42 @@ class Dataset:
             np.asarray(data, dtype=self.dtype), self.chunks, self.compression
         )
         _atomic_write_bytes(p, payload)
+
+    def write_chunk_varlen(self, grid_pos: Sequence[int], data: np.ndarray) -> None:
+        """Write an arbitrary-length 1d payload as an n5 mode-1 (varlength)
+        chunk — the reference's ``write_chunk(..., varlen=True)`` used for
+        label multisets and graph serializations."""
+        if self._readonly:
+            raise PermissionError(f"dataset opened read-only: {self.path}")
+        if self._fmt is not _N5Format:
+            raise NotImplementedError("varlength chunks are n5-only")
+        data = np.ascontiguousarray(data, dtype=self.dtype)
+        payload = _N5Format.pack_chunk(
+            data, self.chunks, self.compression, n_varlen=data.size
+        )
+        p = self._chunk_path(grid_pos)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        _atomic_write_bytes(p, payload)
+
+    def read_chunk_varlen(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
+        """Read a mode-1 (varlength) chunk as a flat array, or None."""
+        if self._fmt is not _N5Format:
+            raise NotImplementedError("varlength chunks are n5-only")
+        p = self._chunk_path(grid_pos)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            payload = f.read()
+        mode, ndim = struct.unpack(">HH", payload[:4])
+        if mode != 1:
+            raise ValueError(f"chunk {tuple(grid_pos)} is not varlength")
+        offset = 4 + 4 * ndim
+        (n_elements,) = struct.unpack(">I", payload[offset : offset + 4])
+        raw = payload[offset + 4 :]
+        if self.compression:
+            raw = gzip.decompress(raw)
+        be_dtype = np.dtype(_N5Format._DTYPES[self.dtype.name])
+        return np.frombuffer(raw, dtype=be_dtype)[:n_elements].astype(self.dtype)
 
     # -- region level --------------------------------------------------------
 

@@ -7,6 +7,11 @@ from .skeletons import (
     SkeletonEvaluationWorkflow,
     SkeletonWorkflow,
 )
+from .paintera import (
+    LabelMultisetWorkflow,
+    PainteraConversionWorkflow,
+)
+from .bigcat import BigcatWorkflow
 from .evaluation import EvaluationWorkflow
 from .lifted_multicut import (
     LiftedFeaturesFromNodeLabelsWorkflow,
@@ -36,6 +41,9 @@ __all__ = [
     "MeshWorkflow",
     "SkeletonEvaluationWorkflow",
     "SkeletonWorkflow",
+    "LabelMultisetWorkflow",
+    "PainteraConversionWorkflow",
+    "BigcatWorkflow",
     "EvaluationWorkflow",
     "EdgeFeaturesWorkflow",
     "GraphWorkflow",

@@ -1,0 +1,176 @@
+"""Label multisets, paintera conversion, bigcat export."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.ops import label_multiset as lms
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+class TestMultisetOps:
+    def test_roundtrip(self, rng):
+        labels = rng.integers(0, 6, (4, 6, 6)).astype("uint64")
+        m = lms.create_multiset_from_labels(labels)
+        np.testing.assert_array_equal(m.argmax.reshape(labels.shape), labels)
+        ser = lms.serialize_multiset(m)
+        m2 = lms.deserialize_multiset(ser, labels.shape)
+        np.testing.assert_array_equal(
+            m2.argmax.reshape(labels.shape), labels
+        )
+        for v in range(labels.size):
+            i1, c1 = m.voxel_entries(v)
+            i2, c2 = m2.voxel_entries(v)
+            np.testing.assert_array_equal(i1, i2)
+            np.testing.assert_array_equal(c1, c2)
+
+    def test_downsample_counts(self, rng):
+        labels = rng.integers(0, 4, (4, 4, 4)).astype("uint64")
+        m = lms.create_multiset_from_labels(labels)
+        d = lms.downsample_multiset(m, [2, 2, 2])
+        assert d.shape == (2, 2, 2)
+        for coarse in np.ndindex(2, 2, 2):
+            v = int(np.ravel_multi_index(coarse, (2, 2, 2)))
+            ids, counts = d.voxel_entries(v)
+            window = labels[
+                2 * coarse[0] : 2 * coarse[0] + 2,
+                2 * coarse[1] : 2 * coarse[1] + 2,
+                2 * coarse[2] : 2 * coarse[2] + 2,
+            ]
+            want_ids, want_counts = np.unique(window, return_counts=True)
+            np.testing.assert_array_equal(np.sort(ids), want_ids)
+            assert counts.sum() == 8
+
+    def test_restrict_set(self, rng):
+        labels = np.arange(8, dtype="uint64").reshape(2, 2, 2)
+        m = lms.create_multiset_from_labels(labels)
+        d = lms.downsample_multiset(m, [2, 2, 2], restrict_set=3)
+        ids, counts = d.voxel_entries(0)
+        assert ids.size == 3
+
+
+class TestMultisetWorkflow:
+    def test_pyramid(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.label_multisets import read_multiset_region
+        from cluster_tools_tpu.workflows.paintera import LabelMultisetWorkflow
+
+        labels = rng.integers(0, 50, (16, 32, 32)).astype("uint64")
+        path = str(tmp_path / "lm.n5")
+        ds = file_reader(path).create_dataset(
+            "seg", data=labels, chunks=(8, 16, 16)
+        )
+        ds.attrs["maxId"] = int(labels.max())
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        wf = LabelMultisetWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            output_path=path, output_prefix="paintera/data",
+            scale_factors=[2, 2], restrict_sets=[-1, 10],
+        )
+        assert build([wf])
+        f = file_reader(path, "r")
+        s0 = f["paintera/data/s0"]
+        assert s0.attrs["isLabelMultiset"] is True
+        assert s0.attrs["maxId"] == int(labels.max())
+        # scale-0 multiset reproduces the labels
+        m = read_multiset_region(s0, tuple(slice(0, s) for s in labels.shape))
+        np.testing.assert_array_equal(
+            m.argmax.reshape(labels.shape), labels
+        )
+        # scale-1: counts pool 2x2x2 children
+        s1 = f["paintera/data/s1"]
+        assert s1.shape == (8, 16, 16)
+        assert s1.attrs["downsamplingFactors"] == [2.0, 2.0, 2.0]
+        m1 = read_multiset_region(s1, (slice(0, 4), slice(0, 4), slice(0, 4)))
+        ids, counts = m1.voxel_entries(0)
+        assert counts.sum() == 8
+
+
+class TestPainteraConversion:
+    def test_conversion_container(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.paintera import read_label_block_mapping
+        from cluster_tools_tpu.workflows.paintera import (
+            PainteraConversionWorkflow,
+        )
+
+        labels = rng.integers(0, 20, (16, 32, 32)).astype("uint64")
+        path = str(tmp_path / "pc.n5")
+        ds = file_reader(path).create_dataset(
+            "seg", data=labels, chunks=(8, 16, 16)
+        )
+        ds.attrs["maxId"] = int(labels.max())
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        wf = PainteraConversionWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            output_path=path, label_group="paintera",
+            scale_factors=[2],
+            resolution=[40, 4, 4],
+        )
+        assert build([wf])
+        f = file_reader(path, "r")
+        g = f["paintera"]
+        assert g.attrs["painteraData"] == {"type": "label"}
+        assert g.attrs["maxId"] == int(labels.max())
+        assert "scaleDatasetPattern" in g.attrs["labelBlockLookup"]
+        assert f["paintera/data"].attrs["resolution"] == [4, 4, 40]
+
+        # unique labels per block match a recompute
+        uniq_ds = f["paintera/unique-labels/s0"]
+        got = uniq_ds.read_chunk_varlen((0, 0, 0))
+        want = np.unique(labels[:8, :16, :16])
+        np.testing.assert_array_equal(got, want)
+
+        # block mapping inverts the uniques
+        mapping = read_label_block_mapping(
+            path, "paintera/label-to-block-mapping/s0"
+        )
+        lab = int(labels[0, 0, 0])
+        assert 0 in mapping[lab]
+
+        # the declared per-scale lookup datasets exist for every level
+        assert "paintera/unique-labels/s1" in f
+        assert "paintera/label-to-block-mapping/s1" in f
+        got1 = f["paintera/unique-labels/s1"].read_chunk_varlen((0, 0, 0))
+        want1 = np.unique(labels[:16, :32, :32])  # s1 block covers all of s0
+        np.testing.assert_array_equal(got1, want1)
+
+    def test_bigcat_export(self, tmp_path, rng):
+        h5py = pytest.importorskip("h5py")
+        from cluster_tools_tpu.workflows.bigcat import BigcatWorkflow
+
+        n = 50
+        assignments = rng.integers(0, 5, n).astype("uint64")
+        src = str(tmp_path / "assign.n5")
+        file_reader(src).create_dataset(
+            "assignments", data=assignments, chunks=(n,)
+        )
+        out = str(tmp_path / "bigcat.h5")
+        with h5py.File(out, "w") as f:
+            f.create_dataset("volumes/raw", data=rng.random((8, 8, 8)))
+            f.create_dataset(
+                "volumes/labels/fragments",
+                data=rng.integers(0, n, (8, 8, 8)).astype("uint64"),
+            )
+        config_dir = str(tmp_path / "configs_b")
+        tmp_folder = str(tmp_path / "tmp_b")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 8, 8]})
+        wf = BigcatWorkflow(
+            tmp_folder, config_dir,
+            assignment_path=src, assignment_key="assignments",
+            output_path=out, resolution=[40, 4, 4],
+        )
+        assert build([wf])
+        with h5py.File(out, "r") as f:
+            lut = f["fragment_segment_lut"][:]
+            assert lut.shape == (2, n)
+            np.testing.assert_array_equal(lut[0], np.arange(n))
+            np.testing.assert_array_equal(lut[1], assignments + n)
+            assert f.attrs["next_id"] == int(lut.max()) + 1
+            assert list(f["volumes/raw"].attrs["resolution"]) == [40, 4, 4]
