@@ -75,22 +75,9 @@ def compute_mws_segmentation(
             f"{affs.shape[0]} affinity channels but {offsets.shape[0]} offsets"
         )
     rng = np.random.default_rng(seed)
-    affs = affs.astype(np.float64)
-    if noise_level > 0:
-        affs = affs + noise_level * rng.standard_normal(affs.shape)
-        affs = np.clip(affs, 0.0, 1.0)
-
-    us, vs, ws, attr = [], [], [], []
-    for u, v, c, is_attractive in _grid_edges(
-        shape, offsets, strides, randomize_strides, rng, ndim
-    ):
-        us.append(u)
-        vs.append(v)
-        aff_vals = affs[c].reshape(-1)
-        # edge weight lives at the source voxel position of the offset slice
-        ws.append(aff_vals[u] if is_attractive else 1.0 - aff_vals[u])
-        attr.append(np.full(u.shape, is_attractive, dtype=np.uint8))
-
+    us, vs, ws, attr = _affinity_edge_lists(
+        affs, offsets, strides, randomize_strides, noise_level, rng, ndim
+    )
     uv = np.stack([np.concatenate(us), np.concatenate(vs)], axis=1)
     weights = np.concatenate(ws)
     attractive = np.concatenate(attr)
@@ -107,6 +94,28 @@ def compute_mws_segmentation(
     if mask is not None:
         labels[~mask.astype(bool)] = 0
     return labels
+
+
+def _affinity_edge_lists(affs, offsets, strides, randomize_strides,
+                         noise_level, rng, ndim):
+    """Shared grid-edge construction for the plain and seeded MWS variants."""
+    shape = affs.shape[1:]
+    affs = affs.astype(np.float64)
+    if noise_level > 0:
+        affs = np.clip(
+            affs + noise_level * rng.standard_normal(affs.shape), 0.0, 1.0
+        )
+    us, vs, ws, attr = [], [], [], []
+    for u, v, c, is_attractive in _grid_edges(
+        shape, offsets, strides, randomize_strides, rng, ndim
+    ):
+        us.append(u)
+        vs.append(v)
+        aff_vals = affs[c].reshape(-1)
+        # edge weight lives at the source voxel position of the offset slice
+        ws.append(aff_vals[u] if is_attractive else 1.0 - aff_vals[u])
+        attr.append(np.full(u.shape, is_attractive, dtype=np.uint8))
+    return us, vs, ws, attr
 
 
 def mutex_watershed_graph(
@@ -154,3 +163,98 @@ def _mws_python(n_nodes, uv, weights, attractive) -> np.ndarray:
             mutexes[ra].add(rb)
             mutexes[rb].add(ra)
     return np.array([find(i) for i in range(n_nodes)], dtype=np.int64)
+
+
+def compute_mws_segmentation_with_seeds(
+    affs: np.ndarray,
+    offsets: Sequence[Sequence[int]],
+    seeds: np.ndarray,
+    strides: Optional[Sequence[int]] = None,
+    randomize_strides: bool = False,
+    mask: Optional[np.ndarray] = None,
+    noise_level: float = 0.0,
+    seed: int = 0,
+    use_native: bool = True,
+    max_mutex_ids: int = 1024,
+) -> np.ndarray:
+    """MWS constrained by pre-labeled seed voxels.
+
+    The two-pass seeding mechanism (reference two_pass_mws.py:137-193 via
+    affogato grid-graph state): voxels sharing a seed label are chained with
+    above-maximal attractive edges (processed before any affinity edge), and
+    one representative per seed label is pairwise-mutexed against every other
+    label's representative — so pass-2 blocks can neither split a neighbor's
+    segment nor merge two distinct neighbor segments.  Output voxels in a seed
+    region keep the seed label; new segments get ids past ``seeds.max()``.
+    """
+    offsets = np.asarray(offsets, dtype=np.int64)
+    ndim = affs.ndim - 1
+    shape = affs.shape[1:]
+    if offsets.shape[0] != affs.shape[0]:
+        raise ValueError(
+            f"{affs.shape[0]} affinity channels but {offsets.shape[0]} offsets"
+        )
+    rng = np.random.default_rng(seed)
+    us, vs, ws, attr = _affinity_edge_lists(
+        affs, offsets, strides, randomize_strides, noise_level, rng, ndim
+    )
+
+    # vectorized seed constraints: group seed voxels by label with one argsort
+    flat_seeds = seeds.reshape(-1).astype(np.int64)
+    seeded_vox = np.nonzero(flat_seeds > 0)[0]
+    order = seeded_vox[np.argsort(flat_seeds[seeded_vox], kind="stable")]
+    lab_sorted = flat_seeds[order]
+    new_group = np.concatenate([[True], lab_sorted[1:] != lab_sorted[:-1]])
+    seed_ids = lab_sorted[new_group]
+    reps = order[new_group]
+    if order.size:
+        # chains within each seed label (consecutive sorted voxels, skipping
+        # the group boundaries) — super-attractive, processed before any
+        # affinity edge
+        intra = ~new_group[1:]
+        if intra.any():
+            us.append(order[:-1][intra])
+            vs.append(order[1:][intra])
+            ws.append(np.full(int(intra.sum()), 2.0))
+            attr.append(np.ones(int(intra.sum()), dtype=np.uint8))
+    k = reps.size
+    if k > 1:
+        if k <= max_mutex_ids:
+            ru, rv = np.triu_indices(k, k=1)
+        else:
+            # all-pairs would be O(k^2); chain mutexes are a weaker guarantee
+            # (mutual exclusion is not transitive) but bound the edge count
+            ru = np.arange(k - 1)
+            rv = ru + 1
+        us.append(reps[ru])
+        vs.append(reps[rv])
+        ws.append(np.full(ru.size, 2.0))
+        attr.append(np.zeros(ru.size, dtype=np.uint8))
+
+    uv = np.stack([np.concatenate(us), np.concatenate(vs)], axis=1)
+    weights = np.concatenate(ws)
+    attractive = np.concatenate(attr)
+    if mask is not None:
+        m = mask.reshape(-1).astype(bool)
+        keep = m[uv[:, 0]] & m[uv[:, 1]]
+        uv, weights, attractive = uv[keep], weights[keep], attractive[keep]
+
+    size = int(np.prod(shape))
+    roots = mutex_watershed_graph(size, uv, weights, attractive, use_native)
+    _, labels = np.unique(roots, return_inverse=True)
+    labels = (labels + 1).astype(np.int64)
+
+    # vectorized relabel: clusters holding a seed representative take the seed
+    # id, the rest move past the seed id range
+    seed_base = int(seed_ids.max()) if seed_ids.size else 0
+    cluster_to_seed = np.zeros(int(labels.max()) + 1, dtype=np.int64)
+    if reps.size:
+        cluster_to_seed[labels[reps]] = seed_ids
+    out = np.where(
+        cluster_to_seed[labels] > 0, cluster_to_seed[labels],
+        labels + seed_base,
+    ).astype(np.uint64)
+    out = out.reshape(shape)
+    if mask is not None:
+        out[~mask.astype(bool)] = 0
+    return out

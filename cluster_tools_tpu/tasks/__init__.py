@@ -46,6 +46,24 @@ from .distances import ObjectDistancesTask, MergeObjectDistancesTask
 from .meshes import ComputeMeshesTask
 from .label_multisets import CreateMultisetTask, DownscaleMultisetTask
 from .paintera import UniqueBlockLabelsTask, LabelBlockMappingTask
+from .postprocess import (
+    SizeFilterTask,
+    IdFilterTask,
+    GraphWatershedAssignmentsTask,
+    GraphConnectedComponentsTask,
+    OrphanAssignmentsTask,
+    FilterBlocksTask,
+    BackgroundSizeFilterTask,
+    FillingSizeFilterTask,
+)
+from .stitching import (
+    StitchFacesTask,
+    StitchAssignmentsTask,
+    SimpleStitchEdgesTask,
+    SimpleStitchAssignmentsTask,
+    StitchingMulticutTask,
+)
+from .mws import MwsBlocksTask, TwoPassMwsTask
 
 __all__ = [
     "VolumeTask",
@@ -85,4 +103,19 @@ __all__ = [
     "DownscaleMultisetTask",
     "UniqueBlockLabelsTask",
     "LabelBlockMappingTask",
+    "SizeFilterTask",
+    "IdFilterTask",
+    "GraphWatershedAssignmentsTask",
+    "GraphConnectedComponentsTask",
+    "OrphanAssignmentsTask",
+    "FilterBlocksTask",
+    "BackgroundSizeFilterTask",
+    "FillingSizeFilterTask",
+    "StitchFacesTask",
+    "StitchAssignmentsTask",
+    "SimpleStitchEdgesTask",
+    "SimpleStitchAssignmentsTask",
+    "StitchingMulticutTask",
+    "MwsBlocksTask",
+    "TwoPassMwsTask",
 ]

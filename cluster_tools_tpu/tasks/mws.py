@@ -47,12 +47,11 @@ class MwsBlocksTask(VolumeTask):
         )
         return conf
 
-    def process_block(self, block_id: int, blocking: Blocking, config):
+    def _load_affs_and_mask(self, bh, config):
+        """Halo'd affinity read (+[0,1] cast) and optional mask; returns
+        (affs, mask, empty) where empty means the whole block is masked out."""
         in_ds = self.input_ds()
-        out_ds = self.output_ds()
         offsets = config.get("offsets")
-        halo = config.get("halo") or [0, 0, 0]
-        bh = blocking.block_with_halo(block_id, halo)
         affs = in_ds[(slice(0, len(offsets)),) + bh.outer.slicing]
         if affs.dtype == np.uint8:
             affs = affs.astype(np.float32) / 255.0
@@ -64,10 +63,18 @@ class MwsBlocksTask(VolumeTask):
                 bh.outer.slicing
             ].astype(bool)
             if not mask.any():
-                out_ds[bh.inner.slicing] = np.zeros(
-                    bh.inner.shape, dtype=np.uint64
-                )
-                return
+                return affs, mask, True
+        return affs, mask, False
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        out_ds = self.output_ds()
+        offsets = config.get("offsets")
+        halo = config.get("halo") or [0, 0, 0]
+        bh = blocking.block_with_halo(block_id, halo)
+        affs, mask, empty = self._load_affs_and_mask(bh, config)
+        if empty:
+            out_ds[bh.inner.slicing] = np.zeros(bh.inner.shape, dtype=np.uint64)
+            return
         seg = compute_mws_segmentation(
             affs,
             offsets,
@@ -102,3 +109,87 @@ class MwsBlocksTask(VolumeTask):
         )
         max_ids = self.tmp_ragged(MAX_IDS_KEY, blocking.n_blocks, np.int64)
         max_ids.write_chunk((block_id,), np.array([lab.max()], dtype=np.int64))
+
+
+class TwoPassMwsTask(MwsBlocksTask):
+    """One checkerboard pass of the two-pass mutex watershed
+    (reference two_pass_mws.py:28).
+
+    Pass 0 runs plain block MWS on one checkerboard color; pass 1 runs on the
+    other color with the already-written neighbor labels inside the halo as
+    seed constraints (compute_mws_segmentation_with_seeds), which both pins
+    the shared voxels to the neighbor ids and mutexes distinct neighbor
+    segments — the role the reference's serialized grid-graph state plays
+    (two_pass_mws.py:179-187), without the h5 state files or the separate
+    two_pass_assignments merge."""
+
+    task_name = "two_pass_mws"
+
+    def __init__(self, *args, pass_id: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.pass_id = int(pass_id)
+
+    @property
+    def identifier(self) -> str:
+        return f"{self.task_name}_pass{self.pass_id}"
+
+    def get_block_list(self, blocking: Blocking, gconf):
+        from ..utils.blocking import make_checkerboard_block_lists
+
+        blocks = super().get_block_list(blocking, gconf)
+        colors = make_checkerboard_block_lists(blocking)
+        return sorted(set(blocks) & set(colors[self.pass_id]))
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        if self.pass_id == 0:
+            super().process_block(block_id, blocking, config)
+            return
+
+        from ..ops.mws import compute_mws_segmentation_with_seeds
+
+        out_ds = self.output_ds()
+        offsets = config.get("offsets")
+        halo = config.get("halo") or [0, 0, 0]
+        bh = blocking.block_with_halo(block_id, halo)
+        affs, mask, empty = self._load_affs_and_mask(bh, config)
+        if empty:
+            out_ds[bh.inner.slicing] = np.zeros(bh.inner.shape, dtype=np.uint64)
+            return
+
+        # seeds: what pass-0 neighbors already wrote in our outer region.
+        # only FACE slabs are used — corner/edge wedges of the halo overlap
+        # diagonal neighbors, which share this pass's color and may still be
+        # writing (the 2-coloring only serializes face adjacency)
+        written = np.asarray(out_ds[bh.outer.slicing]).astype(np.uint64)
+        inner_local = bh.inner_local.slicing
+        face_seeds = np.zeros_like(written)
+        for axis in range(3):
+            for side in (0, 1):
+                slab = list(inner_local)
+                if side == 0:
+                    slab[axis] = slice(0, inner_local[axis].start)
+                else:
+                    stop = inner_local[axis].stop
+                    slab[axis] = slice(stop, written.shape[axis])
+                slab = tuple(slab)
+                face_seeds[slab] = written[slab]
+        written = face_seeds
+
+        seg = compute_mws_segmentation_with_seeds(
+            affs,
+            offsets,
+            written,
+            strides=config.get("strides"),
+            randomize_strides=bool(config.get("randomize_strides", False)),
+            mask=mask,
+            noise_level=float(config.get("noise_level", 0.0)),
+            seed=block_id,
+        )
+        # new (non-seed) segments move into this block's id namespace;
+        # seeded segments keep the neighbor ids → global consistency
+        seed_max = int(written.max())
+        outer_full = [bs + 2 * h for bs, h in zip(blocking.block_shape, halo)]
+        offset_unit = np.uint64(block_id * int(np.prod(outer_full)))
+        is_new = seg > seed_max
+        seg = np.where(is_new, seg - np.uint64(seed_max) + offset_unit, seg)
+        out_ds[bh.inner.slicing] = seg[inner_local].astype(np.uint64)

@@ -23,7 +23,8 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..ops.unionfind import UnionFindNp
-from .base import VolumeSimpleTask
+from ..utils.blocking import Blocking
+from .base import VolumeSimpleTask, VolumeTask
 from .morphology import MORPHOLOGY_NAME
 
 SIZE_FILTER_NAME = "size_filter_assignments.npy"
@@ -180,3 +181,155 @@ class GraphConnectedComponentsTask(VolumeSimpleTask):
         np.save(os.path.join(self.tmp_folder, GRAPH_CC_NAME), assignment)
         n_comp = int(comp.max()) + 1 if comp.size else 0
         self.log(f"graph CC: {nodes.size} nodes → {n_comp} components")
+
+
+ORPHANS_NAME = "orphan_assignments.npy"
+
+
+class OrphanAssignmentsTask(VolumeSimpleTask):
+    """Merge orphan segments (graph degree one after applying an assignment)
+    into their single neighbor (reference orphan_assignments.py:26-146)."""
+
+    task_name = "orphan_assignments"
+
+    def __init__(self, *args, assignment_path: str = None,
+                 relabel: bool = False, **kwargs):
+        super().__init__(*args, assignment_path=assignment_path,
+                         relabel=relabel, **kwargs)
+
+    def run_impl(self) -> None:
+        from ..ops.multicut import contract_edges
+        from .graph import load_graph
+
+        nodes, edges = load_graph(self.tmp_store())
+        # assignments: dense per-node cluster vector or (node, cluster) table;
+        # nodes absent from a sparse table keep their own label (mapping them
+        # to 0 would wipe every unlisted segment to background)
+        table = np.load(self.assignment_path)
+        if table.ndim == 2:
+            assignments = nodes.astype(np.uint64).copy()
+            idx = np.searchsorted(nodes, table[:, 0].astype(nodes.dtype))
+            ok = idx < nodes.size
+            ok &= nodes[np.clip(idx, 0, nodes.size - 1)] == table[:, 0].astype(
+                nodes.dtype
+            )
+            assignments[idx[ok]] = table[ok, 1].astype(np.uint64)
+        else:
+            assignments = table.astype(np.uint64)
+
+        cl_u = assignments[edges[:, 0]].astype(np.int64)
+        cl_v = assignments[edges[:, 1]].astype(np.int64)
+        new_uv, _ = contract_edges(cl_u, cl_v, np.ones(edges.shape[0]))
+        ids, degrees = np.unique(new_uv, return_counts=True)
+        orphans = ids[degrees == 1]
+        orphans = orphans[orphans != 0]
+        adopt = assignments.copy()
+        if orphans.size:
+            # each orphan has exactly one incident contracted edge — adopt
+            # the other endpoint (reference orphan_assignments.py:129-141)
+            flat = new_uv.reshape(-1)
+            other = new_uv[:, ::-1].reshape(-1)
+            order = np.argsort(flat, kind="stable")
+            pos = np.searchsorted(flat[order], orphans)
+            neighbor = other[order][pos]
+            remap = {int(o): int(nb) for o, nb in zip(orphans, neighbor)}
+            adopt = np.asarray(
+                [remap.get(int(a), int(a)) for a in assignments],
+                dtype=np.uint64,
+            )
+        if self.relabel:
+            uniq, inv = np.unique(adopt, return_inverse=True)
+            # keep 0 fixed, compact the rest to 1..k
+            remap_v = np.zeros(uniq.size, dtype=np.uint64)
+            nonzero = uniq != 0
+            remap_v[nonzero] = np.arange(1, int(nonzero.sum()) + 1)
+            adopt = remap_v[inv]
+        assignment = np.stack([nodes, adopt], axis=1)
+        np.save(os.path.join(self.tmp_folder, ORPHANS_NAME), assignment)
+        self.log(f"merged {orphans.size} orphans")
+
+
+class FilterBlocksTask(VolumeTask):
+    """Zero out an id list block-wise (reference filter_blocks.py:25;
+    background_size_filter.py:20 is the same apply step driven by the size
+    filter's discard list)."""
+
+    task_name = "filter_blocks"
+    output_dtype = "uint64"
+
+    def __init__(self, *args, filter_path: str = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.filter_path = filter_path
+        self._discard = None
+
+    def discard_ids(self) -> np.ndarray:
+        if self._discard is None:  # loaded once per task, not once per block
+            self._discard = np.load(self.filter_path).astype(np.uint64)
+        return self._discard
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        block = blocking.block(block_id)
+        labels = np.asarray(self.input_ds()[block.slicing]).astype(np.uint64)
+        if not labels.any():
+            return
+        labels = np.where(np.isin(labels, self.discard_ids()), 0, labels)
+        self.output_ds()[block.slicing] = labels
+
+
+class BackgroundSizeFilterTask(FilterBlocksTask):
+    """Alias task matching the reference's name for the map-to-background
+    apply step (background_size_filter.py:20)."""
+
+    task_name = "background_size_filter"
+
+
+class FillingSizeFilterTask(VolumeTask):
+    """Discarded ids are re-flooded from the surviving segments over a height
+    map instead of mapped to background (reference filling_size_filter.py:21);
+    the seeded flood is the device watershed kernel."""
+
+    task_name = "filling_size_filter"
+    output_dtype = "uint64"
+
+    def __init__(self, *args, hmap_path: str = None, hmap_key: str = None,
+                 res_path: str = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.hmap_path = hmap_path
+        self.hmap_key = hmap_key
+        self.res_path = res_path
+        self._discard = None
+
+    def discard_ids(self) -> np.ndarray:
+        if self._discard is None:
+            self._discard = np.load(self.res_path).astype(np.uint64)
+        return self._discard
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        import jax.numpy as jnp
+
+        from ..ops.watershed import seeded_watershed
+        from ..utils import store as store_mod
+
+        block = blocking.block(block_id)
+        bb = block.slicing
+        labels = np.asarray(self.input_ds()[bb]).astype(np.uint64)
+        if not labels.any():
+            return
+        discard_mask = np.isin(labels, self.discard_ids())
+        out_ds = self.output_ds()
+        if not discard_mask.any():
+            out_ds[bb] = labels
+            return
+        hmap_ds = store_mod.file_reader(self.hmap_path, "r")[self.hmap_key]
+        hmap_bb = ((slice(0, 1),) + bb) if len(hmap_ds.shape) == 4 else bb
+        hmap = np.asarray(hmap_ds[hmap_bb])
+        if hmap.ndim == 4:
+            hmap = hmap[0]
+        labels[discard_mask] = 0
+        # compact to int32 seeds for the device flood, map back after
+        uniq = np.unique(labels)
+        seeds = np.searchsorted(uniq, labels).astype(np.int32)
+        flooded = np.array(
+            seeded_watershed(jnp.asarray(hmap, jnp.float32), jnp.asarray(seeds))
+        )
+        out_ds[bb] = uniq[flooded]

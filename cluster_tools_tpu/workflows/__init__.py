@@ -25,7 +25,8 @@ from .multicut import (
     MulticutSegmentationWorkflow,
     MulticutWorkflow,
 )
-from .mws import MwsWorkflow
+from .mws import MwsWorkflow, TwoPassMwsWorkflow
+from .stitching import MulticutStitchingWorkflow, SimpleStitchingWorkflow
 from .relabel import RelabelWorkflow
 from .thresholded_components import (
     ThresholdAndWatershedWorkflow,
@@ -54,6 +55,9 @@ __all__ = [
     "MulticutSegmentationWorkflow",
     "MulticutWorkflow",
     "MwsWorkflow",
+    "TwoPassMwsWorkflow",
+    "MulticutStitchingWorkflow",
+    "SimpleStitchingWorkflow",
     "RelabelWorkflow",
     "ThresholdAndWatershedWorkflow",
     "ThresholdedComponentsWorkflow",

@@ -82,3 +82,52 @@ class MwsWorkflow(WorkflowBase):
         conf["stitch_faces"] = StitchFacesTask.default_task_config()
         conf["write"] = WriteTask.default_task_config()
         return conf
+
+
+class TwoPassMwsWorkflow(WorkflowBase):
+    """Two-pass mutex watershed (reference mws_workflow.py:80
+    TwoPassMwsWorkflow): checkerboard pass 0, then pass 1 seeded by the
+    written neighbors — globally consistent labels without stitching."""
+
+    task_name = "two_pass_mws_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
+                 input_path=None, input_key=None, output_path=None,
+                 output_key=None, mask_path=None, mask_key=None,
+                 dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+
+    def requires(self):
+        from ..tasks.mws import TwoPassMwsTask
+
+        pass0 = TwoPassMwsTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=list(self.dependencies),
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=self.output_key,
+            mask_path=self.mask_path, mask_key=self.mask_key,
+            pass_id=0,
+        )
+        pass1 = TwoPassMwsTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=[pass0],
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=self.output_key,
+            mask_path=self.mask_path, mask_key=self.mask_key,
+            pass_id=1,
+        )
+        return [pass1]
+
+    @classmethod
+    def get_config(cls):
+        from ..tasks.mws import TwoPassMwsTask
+
+        conf = super().get_config()
+        conf["two_pass_mws"] = TwoPassMwsTask.default_task_config()
+        return conf
