@@ -122,6 +122,14 @@ def object_vi(
     if ignore_gt_zero:
         keep = ib != 0
         ia, ib, counts = ia[keep], ib[keep], counts[keep]
+    return object_vi_from_contingency(ia, ib, counts)
+
+
+def object_vi_from_contingency(
+    ia: np.ndarray, ib: np.ndarray, counts: np.ndarray
+) -> Dict[int, Tuple[float, float]]:
+    """Per-gt-object VI from a merged (seg id, gt id, count) table — the
+    distributed path (reference object_vi.py:100-118)."""
     counts = counts.astype(np.float64)
     # seg marginals (global)
     seg_sizes: Dict[int, float] = {}

@@ -64,6 +64,14 @@ from .stitching import (
     StitchingMulticutTask,
 )
 from .mws import MwsBlocksTask, TwoPassMwsTask
+from .debugging import CheckComponentsTask, CheckSubGraphsTask
+from .evaluation import MeasuresTask, ObjectViTask
+from .multicut import (
+    SolveSubproblemsTask,
+    ReduceProblemTask,
+    SolveGlobalTask,
+    SubSolutionsTask,
+)
 
 __all__ = [
     "VolumeTask",
@@ -118,4 +126,12 @@ __all__ = [
     "StitchingMulticutTask",
     "MwsBlocksTask",
     "TwoPassMwsTask",
+    "CheckComponentsTask",
+    "CheckSubGraphsTask",
+    "MeasuresTask",
+    "ObjectViTask",
+    "SolveSubproblemsTask",
+    "ReduceProblemTask",
+    "SolveGlobalTask",
+    "SubSolutionsTask",
 ]

@@ -44,3 +44,30 @@ class MeasuresTask(VolumeSimpleTask):
 def load_measures(tmp_folder: str) -> Dict[str, float]:
     with open(os.path.join(tmp_folder, MEASURES_NAME)) as f:
         return json.load(f)
+
+
+class ObjectViTask(VolumeSimpleTask):
+    """Per-ground-truth-object VI scores from the merged overlap table
+    (reference object_vi.py:26)."""
+
+    task_name = "object_vi"
+
+    def run_impl(self) -> None:
+        from ..ops.evaluation import object_vi_from_contingency
+
+        with np.load(os.path.join(self.tmp_folder, OVERLAPS_MERGED_NAME)) as f:
+            ia, ib, counts = f["ids_a"], f["ids_b"], f["counts"]
+        keep = ib != 0
+        scores = object_vi_from_contingency(ia[keep], ib[keep], counts[keep])
+        path = os.path.join(self.tmp_folder, OBJECT_VI_NAME)
+        with open(path, "w") as f:
+            json.dump(
+                {int(k): [float(v[0]), float(v[1])] for k, v in scores.items()},
+                f, indent=2,
+            )
+        self.log(f"object VI scores for {len(scores)} gt objects")
+
+
+def load_object_vi(tmp_folder: str) -> Dict[int, Any]:
+    with open(os.path.join(tmp_folder, OBJECT_VI_NAME)) as f:
+        return {int(k): v for k, v in json.load(f).items()}

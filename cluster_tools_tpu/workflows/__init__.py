@@ -12,6 +12,7 @@ from .paintera import (
     PainteraConversionWorkflow,
 )
 from .bigcat import BigcatWorkflow
+from .debugging import CheckComponentsWorkflow, CheckSubGraphsWorkflow
 from .evaluation import EvaluationWorkflow
 from .lifted_multicut import (
     LiftedFeaturesFromNodeLabelsWorkflow,
@@ -45,6 +46,8 @@ __all__ = [
     "LabelMultisetWorkflow",
     "PainteraConversionWorkflow",
     "BigcatWorkflow",
+    "CheckComponentsWorkflow",
+    "CheckSubGraphsWorkflow",
     "EvaluationWorkflow",
     "EdgeFeaturesWorkflow",
     "GraphWorkflow",

@@ -1,0 +1,160 @@
+"""Debugging workflows, per-object VI, sub-solutions."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+class TestCheckSubGraphs:
+    def test_valid_graph_passes(self, tmp_path, rng):
+        from cluster_tools_tpu.workflows import CheckSubGraphsWorkflow
+
+        labels = rng.integers(1, 20, (16, 32, 32)).astype("uint64")
+        path = str(tmp_path / "c.n5")
+        file_reader(path).create_dataset("ws", data=labels, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        wf = CheckSubGraphsWorkflow(
+            tmp_folder, config_dir, ws_path=path, ws_key="ws"
+        )
+        assert build([wf])
+
+    def test_corrupted_serialization_fails(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.debugging import CheckSubGraphsTask
+        from cluster_tools_tpu.tasks.graph import SUB_NODES_KEY
+        from cluster_tools_tpu.workflows import GraphWorkflow
+
+        labels = rng.integers(1, 20, (16, 32, 32)).astype("uint64")
+        path = str(tmp_path / "cc.n5")
+        file_reader(path).create_dataset("ws", data=labels, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs2")
+        tmp_folder = str(tmp_path / "tmp2")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        graph = GraphWorkflow(
+            tmp_folder, config_dir, input_path=path, input_key="ws"
+        )
+        assert build([graph])
+        # corrupt one block's serialized node list
+        store = file_reader(os.path.join(tmp_folder, "data.zarr"), "a")
+        ds = store[SUB_NODES_KEY]
+        ds.write_chunk((0,), np.asarray([999999], dtype="uint64"))
+        check = CheckSubGraphsTask(
+            tmp_folder, config_dir, input_path=path, input_key="ws"
+        )
+        with pytest.raises(RuntimeError):
+            build([check], raise_on_failure=True)
+
+
+class TestCheckComponents:
+    def test_fragmented_label_flagged(self, tmp_path):
+        from cluster_tools_tpu.tasks.debugging import (
+            VIOLATING_IDS_NAME,
+            CheckComponentsTask,
+        )
+
+        # label 7 appears in every block; others are local
+        labels = np.zeros((16, 32, 32), dtype="uint64")
+        labels[::4] = 7
+        labels[1, :16, :16] = 2
+        path = str(tmp_path / "f.n5")
+        file_reader(path).create_dataset("seg", data=labels, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        task = CheckComponentsTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            max_blocks_per_label=4,
+        )
+        assert build([task])
+        violating = np.load(os.path.join(tmp_folder, VIOLATING_IDS_NAME))
+        assert 7 in violating[:, 0]
+        assert 2 not in violating[:, 0]
+
+
+class TestObjectVi:
+    def test_perfect_segmentation_scores_zero(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.evaluation import load_object_vi
+        from cluster_tools_tpu.tasks.evaluation import ObjectViTask
+        from cluster_tools_tpu.tasks.node_labels import (
+            BlockNodeLabelsTask,
+            MergeNodeLabelsTask,
+        )
+
+        gt = rng.integers(1, 8, (16, 32, 32)).astype("uint64")
+        path = str(tmp_path / "ov.n5")
+        f = file_reader(path)
+        f.create_dataset("seg", data=gt, chunks=(8, 16, 16))
+        f.create_dataset("gt", data=gt, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        overlaps = BlockNodeLabelsTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="seg",
+            labels_path=path, labels_key="gt",
+        )
+        merge = MergeNodeLabelsTask(
+            tmp_folder, config_dir, dependencies=[overlaps],
+            input_path=path, input_key="seg",
+        )
+        ovi = ObjectViTask(tmp_folder, config_dir, dependencies=[merge])
+        assert build([ovi])
+        scores = load_object_vi(tmp_folder)
+        assert set(scores) == set(range(1, 8))
+        for split, merge_s in scores.values():
+            assert split == pytest.approx(0.0, abs=1e-9)
+            assert merge_s == pytest.approx(0.0, abs=1e-9)
+
+
+class TestSubSolutions:
+    def test_sub_solutions_written(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.multicut import SubSolutionsTask
+        from cluster_tools_tpu.workflows import (
+            EdgeFeaturesWorkflow,
+            GraphWorkflow,
+        )
+        from cluster_tools_tpu.tasks.costs import ProbsToCostsTask
+
+        from scipy import ndimage
+
+        labels = rng.integers(1, 30, (16, 32, 32)).astype("uint64")
+        bnd = ndimage.gaussian_filter(
+            rng.random((16, 32, 32)), 1.0
+        ).astype("float32")
+        path = str(tmp_path / "ss.n5")
+        f = file_reader(path)
+        f.create_dataset("ws", data=labels, chunks=(8, 16, 16))
+        f.create_dataset("bnd", data=bnd, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs")
+        tmp_folder = str(tmp_path / "tmp")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        graph = GraphWorkflow(
+            tmp_folder, config_dir, input_path=path, input_key="ws"
+        )
+        feats = EdgeFeaturesWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="bnd",
+            labels_path=path, labels_key="ws",
+            dependencies=[graph],
+        )
+        costs = ProbsToCostsTask(tmp_folder, config_dir, dependencies=[feats])
+        sub = SubSolutionsTask(
+            tmp_folder, config_dir,
+            dependencies=[costs],
+            input_path=path, input_key="ws",
+            output_path=path, output_key="subsol",
+        )
+        assert build([sub])
+        seg = file_reader(path, "r")["subsol"][:]
+        assert seg.shape == labels.shape
+        assert seg.max() > 0
+        # within a block, voxels of one ws fragment share one sub-solution id
+        frag_mask = labels[:8, :16, :16] == labels[0, 0, 0]
+        vals = np.unique(seg[:8, :16, :16][frag_mask])
+        assert vals.size == 1
