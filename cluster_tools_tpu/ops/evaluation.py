@@ -151,3 +151,23 @@ def object_vi_from_contingency(
                 merge -= (cc / size_b) * np.log(frac)
         scores[int(b)] = (split, float(merge))
     return scores
+
+
+def same_partition(a: np.ndarray, b: np.ndarray) -> bool:
+    """True iff two label volumes induce the same partition of the foreground
+    (ids may differ; the grouping and the foreground mask must not).
+
+    The bijection test: the number of distinct (a, b) co-occurring id pairs
+    must equal the number of distinct ids on each side.  Shared oracle for
+    tests and the driver dryrun — a partition-identity check, stricter than
+    Rand/VoI parity.
+    """
+    if a.shape != b.shape:
+        return False
+    if not ((a > 0) == (b > 0)).all():
+        return False
+    fg = b > 0
+    if not fg.any():
+        return True
+    pairs = np.unique(np.stack([a[fg], b[fg]], axis=1), axis=0)
+    return len(pairs) == len(np.unique(a[fg])) == len(np.unique(b[fg]))

@@ -70,15 +70,9 @@ class UnionFindNp:
         return self.parent
 
 
-def merge_assignments_np(
-    n_labels: int, pairs: np.ndarray, consecutive: bool = True
+def _finalize_roots(
+    roots: np.ndarray, consecutive: bool
 ) -> Tuple[np.ndarray, int]:
-    """Merge equivalence ``pairs`` over ids [0, n_labels) and return a dense
-    assignment array old_id → new_id (0 fixed to 0) plus the new max id."""
-    uf = UnionFindNp(n_labels)
-    if pairs.size:
-        uf.merge(pairs[:, 0], pairs[:, 1])
-    roots = uf.compress()
     roots[0] = 0
     if not consecutive:
         return roots, int(roots.max())
@@ -91,6 +85,36 @@ def merge_assignments_np(
         n_new = uniq.size
     assignment[0] = 0
     return assignment, int(n_new)
+
+
+def merge_assignments_np(
+    n_labels: int, pairs: np.ndarray, consecutive: bool = True
+) -> Tuple[np.ndarray, int]:
+    """Merge equivalence ``pairs`` over ids [0, n_labels) and return a dense
+    assignment array old_id → new_id (0 fixed to 0) plus the new max id."""
+    uf = UnionFindNp(n_labels)
+    if pairs.size:
+        uf.merge(pairs[:, 0], pairs[:, 1])
+    return _finalize_roots(uf.compress(), consecutive)
+
+
+def merge_assignments_device(
+    n_labels: int, pairs: np.ndarray, consecutive: bool = True
+) -> Tuple[np.ndarray, int]:
+    """Device analog of ``merge_assignments_np``: the id space lives on the
+    mesh and equivalences resolve by pointer jumping (``merge_labels_device``)
+    instead of a host union-find — the ICI replacement for the reference's
+    1-job boost_ufd merge (merge_assignments.py:125-130).  Falls back to the
+    host path when the id space exceeds int32."""
+    if n_labels >= np.iinfo(np.int32).max:
+        return merge_assignments_np(n_labels, pairs, consecutive)
+    parent = jnp.arange(n_labels, dtype=jnp.int32)
+    if pairs.size:
+        edges = jnp.asarray(np.ascontiguousarray(pairs, dtype=np.int32))
+    else:
+        edges = jnp.zeros((1, 2), jnp.int32)
+    roots = np.asarray(merge_labels_device(parent, edges)).astype(np.int64)
+    return _finalize_roots(roots, consecutive)
 
 
 @partial(jax.jit)

@@ -1,10 +1,20 @@
-from .mesh import get_mesh, shard_batch, local_device_count
+from .mesh import (
+    get_mesh,
+    last_batch_sharding,
+    local_device_count,
+    put_sharded,
+    resolve_devices,
+    shard_batch,
+)
 from .dispatch import BlockBatch, read_block_batch, write_block_batch
 
 __all__ = [
     "get_mesh",
-    "shard_batch",
+    "last_batch_sharding",
     "local_device_count",
+    "put_sharded",
+    "resolve_devices",
+    "shard_batch",
     "BlockBatch",
     "read_block_batch",
     "write_block_batch",

@@ -73,27 +73,30 @@ class JaxPredictor:
     """
 
     def __init__(self, checkpoint_path: str, halo, prep_model: Optional[str] = None,
-                 **_unused):
+                 config: Optional[dict] = None, **_unused):
         import jax
 
         from ..models.unet import load_checkpoint
 
         self.model, self.params = load_checkpoint(checkpoint_path)
         self.halo = list(halo)
+        self.config = config  # carries target/devices for batch sharding
         apply_fn = PREP_MODELS[prep_model](
             lambda params, x: self.model.apply(params, x)
         )
         self._apply = jax.jit(apply_fn)
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
-        import jax.numpy as jnp
+        from ..parallel.mesh import put_sharded
 
         squeeze_batch = data.ndim in (3, 4)
         if data.ndim == 3:
             data = data[None, None]
         elif data.ndim == 4:
             data = data[None]
-        out = np.asarray(self._apply(self.params, jnp.asarray(data)))
+        # batch data-parallel over the device mesh (padded to divide)
+        xb, n = put_sharded(np.asarray(data), self.config)
+        out = np.asarray(self._apply(self.params, xb))[:n]
         ha = self.halo
         if any(ha):
             crop = tuple(

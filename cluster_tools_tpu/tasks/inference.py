@@ -139,6 +139,7 @@ class InferenceTask(VolumeTask):
                 self.halo,
                 prep_model=config.get("prep_model"),
                 use_best=config.get("use_best", True),
+                config=config,
             )
         return self._predictor
 

@@ -21,7 +21,6 @@ from functools import partial
 from typing import Any, Dict, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..ops.filters import minimum_filter
@@ -131,10 +130,13 @@ class MinfilterTask(VolumeTask):
                     [(0, f - s) for f, s in zip(full_shape, true_shape)],
                     mode="edge",
                 )
+        from ..parallel.mesh import put_sharded
+
+        xb, n = put_sharded(batch.data, config)
         out = _minfilter_batch(
-            jnp.asarray(batch.data), tuple(int(f) for f in config["filter_shape"])
+            xb, tuple(int(f) for f in config["filter_shape"])
         )
-        write_block_batch(out_ds, batch, np.asarray(out), cast="uint8")
+        write_block_batch(out_ds, batch, np.asarray(out)[:n], cast="uint8")
 
     def process_block(self, block_id, blocking, config):
         self._run_batch([block_id], blocking, config)

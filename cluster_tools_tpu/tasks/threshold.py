@@ -15,6 +15,7 @@ import numpy as np
 
 from ..ops import filters
 from ..parallel.dispatch import read_block_batch, write_block_batch
+from ..parallel.mesh import put_sharded
 from ..utils.blocking import Blocking
 from .base import VolumeTask
 
@@ -53,10 +54,11 @@ class ThresholdTask(VolumeTask):
         in_ds = self.input_ds()
         out_ds = self.output_ds()
         batch = read_block_batch(in_ds, blocking, block_ids, dtype="float32")
+        xb, n = put_sharded(batch.data, config)
         result = _threshold_batch(
-            jnp.asarray(batch.data), float(config.get("threshold", 0.5)), mode, sigma
+            xb, float(config.get("threshold", 0.5)), mode, sigma
         )
-        write_block_batch(out_ds, batch, np.asarray(result), cast="uint8")
+        write_block_batch(out_ds, batch, np.asarray(result)[:n], cast="uint8")
 
     def process_block(self, block_id, blocking, config):
         self._run_batch([block_id], blocking, config)

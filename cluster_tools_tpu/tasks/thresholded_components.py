@@ -21,13 +21,13 @@ from functools import partial
 from typing import Any, Dict, List
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..ops import cc as cc_ops
 from ..ops import filters
-from ..ops.unionfind import merge_assignments_np
+from ..ops.unionfind import merge_assignments_device, merge_assignments_np
 from ..parallel.dispatch import read_block_batch, write_block_batch
+from ..parallel.mesh import put_sharded
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask
 
@@ -84,14 +84,15 @@ class BlockComponentsTask(VolumeTask):
         in_ds = self.input_ds()
         out_ds = self.output_ds()
         batch = read_block_batch(in_ds, blocking, block_ids, dtype="float32")
+        xb, n = put_sharded(batch.data, config)
         labels, _ = _components_batch(
-            jnp.asarray(batch.data),
+            xb,
             float(config.get("threshold", 0.5)),
             config.get("threshold_mode", "greater"),
             sigma,
             int(config.get("connectivity", 1)),
         )
-        labels = np.asarray(labels)
+        labels = np.array(labels[:n])  # writable host copy (mask edit below)
         if self.mask_path:
             from ..utils import store as _store
 
@@ -214,6 +215,12 @@ class MergeAssignmentsTask(VolumeSimpleTask):
             if all_pairs
             else np.zeros((0, 2), dtype=np.int64)
         )
-        assignment, n_new = merge_assignments_np(n_labels + 1, pairs)
+        conf = {**self.global_config(), **self.get_task_config()}
+        merge = (
+            merge_assignments_device
+            if conf.get("target") == "tpu"
+            else merge_assignments_np
+        )
+        assignment, n_new = merge(n_labels + 1, pairs)
         np.save(os.path.join(self.tmp_folder, ASSIGNMENTS_NAME), assignment)
         self.log(f"merged {n_labels} block-local labels into {n_new} components")

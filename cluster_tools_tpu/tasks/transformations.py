@@ -112,9 +112,14 @@ class LinearTransformationTask(VolumeTask):
         else:
             mask = np.ones(batch.data.shape, dtype=bool)
 
-        out = _linear_batch(jnp.asarray(batch.data), jnp.asarray(a),
-                            jnp.asarray(b), jnp.asarray(mask))
-        write_block_batch(out_ds, batch, np.asarray(out), cast=out_ds.dtype)
+        from ..parallel.mesh import put_sharded
+
+        xb, n = put_sharded(batch.data, config)
+        ab, _ = put_sharded(np.asarray(a), config)
+        bb, _ = put_sharded(np.asarray(b), config)
+        mb, _ = put_sharded(mask, config)
+        out = _linear_batch(xb, ab, bb, mb)
+        write_block_batch(out_ds, batch, np.asarray(out)[:n], cast=out_ds.dtype)
 
     def process_block(self, block_id, blocking, config):
         self._run_batch([block_id], blocking, config)

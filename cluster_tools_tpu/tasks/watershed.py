@@ -24,6 +24,7 @@ import numpy as np
 from ..ops import watershed as ws_ops
 from ..ops.cc import connected_components_labels
 from ..parallel.dispatch import read_block_batch, write_block_batch
+from ..parallel.mesh import put_sharded
 from ..utils import store
 from ..utils.blocking import Blocking, make_checkerboard_block_lists
 from .base import VolumeTask
@@ -149,12 +150,13 @@ class WatershedTask(VolumeTask):
         mask = self._load_mask_batch(batch)
 
         kernel = partial(ws_ops.dt_watershed, **params)
+        xb, n_real = put_sharded(batch_arr, config)
         if mask is None:
-            labels, _ = jax.vmap(lambda x: kernel(x))(jnp.asarray(batch_arr))
+            labels, _ = jax.vmap(lambda x: kernel(x))(xb)
         else:
-            labels, _ = jax.vmap(lambda x, m: kernel(x, mask=m))(
-                jnp.asarray(batch_arr), jnp.asarray(mask)
-            )
+            mb, _ = put_sharded(mask, config)
+            labels, _ = jax.vmap(lambda x, m: kernel(x, mask=m))(xb, mb)
+        labels = np.asarray(labels)[:n_real]
 
         has_halo = any(h > 0 for h in halo)
         if has_halo:
@@ -166,7 +168,9 @@ class WatershedTask(VolumeTask):
                 inner_mask = np.zeros(labels[i].shape, dtype=bool)
                 inner_mask[bh.inner_local.slicing] = True
                 labels[i] = np.where(inner_mask, labels[i], 0)
-            labels, _ = jax.vmap(connected_components_labels)(jnp.asarray(labels))
+            lb, _ = put_sharded(labels, config)
+            labels, _ = jax.vmap(connected_components_labels)(lb)
+            labels = np.asarray(labels)[:n_real]
 
         labels = np.asarray(labels).astype(np.uint64)
         offset_unit = int(np.prod(blocking.block_shape))

@@ -146,7 +146,8 @@ class TestMwsWorkflow:
         affs[3, :, mid : mid + 4, :] = 0.05
         return affs, offsets
 
-    def test_mws_workflow_stitches(self, tmp_path, rng):
+    @pytest.mark.parametrize("target", ["local", "tpu"])
+    def test_mws_workflow_stitches(self, tmp_path, rng, target):
         from cluster_tools_tpu.workflows import MwsWorkflow
 
         affs, offsets = self._make_affs(rng)
@@ -156,7 +157,9 @@ class TestMwsWorkflow:
         )
         config_dir = str(tmp_path / "configs")
         tmp_folder = str(tmp_path / "tmp")
-        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        cfg.write_global_config(
+            config_dir, {"block_shape": [8, 16, 16], "target": target}
+        )
         # dense mutexes: stride subsampling on this synthetic fixture drops all
         # mutexes on odd columns, legitimately letting weak attractions cross
         cfg.write_config(

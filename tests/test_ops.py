@@ -293,3 +293,25 @@ class TestRelabel:
         table = np.array([[1, 10], [2, 20], [3, 30]], dtype=np.uint64)
         out = apply_assignment_table_np(labels, table)
         np.testing.assert_array_equal(out, [[10, 20], [30, 0]])
+
+
+class TestUnionFind:
+    def test_device_matches_host(self, rng):
+        from cluster_tools_tpu.ops.unionfind import (
+            merge_assignments_device,
+            merge_assignments_np,
+        )
+
+        n = 500
+        pairs = rng.integers(1, n, size=(200, 2)).astype(np.int64)
+        a_np, n_np = merge_assignments_np(n, pairs)
+        a_dev, n_dev = merge_assignments_device(n, pairs)
+        assert n_np == n_dev
+        np.testing.assert_array_equal(a_np, a_dev)
+
+    def test_device_empty_pairs(self):
+        from cluster_tools_tpu.ops.unionfind import merge_assignments_device
+
+        a, n_new = merge_assignments_device(5, np.zeros((0, 2), dtype=np.int64))
+        np.testing.assert_array_equal(a, [0, 1, 2, 3, 4])
+        assert n_new == 4

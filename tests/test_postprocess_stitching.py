@@ -137,7 +137,8 @@ class TestStitchingWorkflows:
         n_got = len(np.unique(got[got > 0]))
         assert n_got < len(np.unique(frag))
 
-    def test_multicut_stitching_recovers_gt(self, tmp_path, rng):
+    @pytest.mark.parametrize("target", ["local", "tpu"])
+    def test_multicut_stitching_recovers_gt(self, tmp_path, rng, target):
         from cluster_tools_tpu.workflows import MulticutStitchingWorkflow
 
         gt, frag = _blockwise_labels()
@@ -152,7 +153,9 @@ class TestStitchingWorkflows:
         f.create_dataset("bnd", data=bnd.astype("float32"), chunks=(8, 16, 16))
         config_dir = str(tmp_path / "configs_ms")
         tmp_folder = str(tmp_path / "tmp_ms")
-        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        cfg.write_global_config(
+            config_dir, {"block_shape": [8, 16, 16], "target": target}
+        )
         wf = MulticutStitchingWorkflow(
             tmp_folder, config_dir,
             input_path=path, input_key="bnd",

@@ -152,12 +152,16 @@ class TestMulticutWorkflow:
         n_b = len(np.unique(b[fg]))
         assert len(pairs) == n_a == n_b  # identical partitions
 
-    @pytest.mark.parametrize("n_scales", [1, 2])
-    def test_segmentation_quality(self, tmp_path, cells_volume, n_scales):
+    @pytest.mark.parametrize(
+        "n_scales,target", [(1, "local"), (2, "local"), (1, "tpu")]
+    )
+    def test_segmentation_quality(self, tmp_path, cells_volume, n_scales, target):
         path, bnd, gt = cells_volume
-        config_dir = str(tmp_path / f"configs{n_scales}")
-        tmp_folder = str(tmp_path / f"tmp{n_scales}")
-        cfg.write_global_config(config_dir, {"block_shape": [12, 24, 24]})
+        config_dir = str(tmp_path / f"configs{n_scales}{target}")
+        tmp_folder = str(tmp_path / f"tmp{n_scales}{target}")
+        cfg.write_global_config(
+            config_dir, {"block_shape": [12, 24, 24], "target": target}
+        )
         cfg.write_config(
             config_dir, "watershed",
             {"threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 5,
@@ -166,13 +170,13 @@ class TestMulticutWorkflow:
         wf = MulticutSegmentationWorkflow(
             tmp_folder, config_dir,
             input_path=path, input_key="bnd",
-            ws_path=path, ws_key=f"ws{n_scales}",
-            output_path=path, output_key=f"seg{n_scales}",
+            ws_path=path, ws_key=f"ws{n_scales}{target}",
+            output_path=path, output_key=f"seg{n_scales}{target}",
             n_scales=n_scales,
         )
         assert build([wf])
-        seg = file_reader(path, "r")[f"seg{n_scales}"][:]
-        ws = file_reader(path, "r")[f"ws{n_scales}"][:]
+        seg = file_reader(path, "r")[f"seg{n_scales}{target}"][:]
+        ws = file_reader(path, "r")[f"ws{n_scales}{target}"][:]
         n_ws = len(np.unique(ws[ws > 0]))
         n_seg = len(np.unique(seg[seg > 0]))
         # reference idiom: multicut merges fragments, keeps >some segments

@@ -64,10 +64,13 @@ def test_watershed_invariants_2d_mode(tmp_path, boundary_volume):
         assert n == 1
 
 
-def test_watershed_invariants_3d_mode(tmp_path, boundary_volume):
+@pytest.mark.parametrize("target", ["local", "tpu"])
+def test_watershed_invariants_3d_mode(tmp_path, boundary_volume, target):
     path, raw = boundary_volume
     conf = {**BASE_CONFIG, "apply_dt_2d": False, "apply_ws_2d": False}
-    ws = _run_ws(tmp_path, path, conf, key="ws3d")
+    ws = _run_ws(
+        tmp_path, path, conf, key=f"ws3d_{target}", gconf={"target": target}
+    )
     fg = raw < 0.5
     assert (ws[fg] > 0).mean() > 0.95
     assert (ws[~fg] == 0).all()
@@ -93,12 +96,16 @@ def test_watershed_block_offsets_disjoint(tmp_path, boundary_volume):
         assert ((block_ids > lo) & (block_ids <= hi)).all()
 
 
-def test_two_pass_boundary_consistency(tmp_path, boundary_volume):
+@pytest.mark.parametrize("target", ["local", "tpu"])
+def test_two_pass_boundary_consistency(tmp_path, boundary_volume, target):
     path, raw = boundary_volume
     conf = {**BASE_CONFIG, "apply_dt_2d": False, "apply_ws_2d": False,
             "halo": [4, 8, 8]}
-    ws_two = _run_ws(tmp_path, path, conf, two_pass=True, key="ws_twopass")
-    ws_one = _run_ws(tmp_path, path, conf, two_pass=False, key="ws_onepass")
+    gconf = {"target": target}
+    ws_two = _run_ws(tmp_path, path, conf, two_pass=True,
+                     key=f"ws_twopass_{target}", gconf=gconf)
+    ws_one = _run_ws(tmp_path, path, conf, two_pass=False,
+                     key=f"ws_onepass_{target}", gconf=gconf)
 
     fg = raw < 0.5
     assert (ws_two[fg] > 0).mean() > 0.9
