@@ -9,18 +9,18 @@ of the seed reachable with the lexicographically smallest path cost
 
     ( pass height = max h along the path,  hop count,  seed label )
 
-via the Bellman–Ford-style sweep
-
-    state'(p) = lexmin over neighbors q of ( max(alt(q), h(p)), dist(q)+1, label(q) )
-
-run inside ``lax.while_loop`` with pure shift/select ops, seeds pinned.  The state
-is *recomputed from neighbors every sweep* (never kept), so each fixpoint state is
-witnessed by a current neighbor; the hop-count component makes witness chains
-strictly decreasing in dist → acyclic → every voxel is connected to its seed
-through its own label (no "ghost label" fragments, no plateau cycles).  Converges
-in O(longest flood path) data-parallel sweeps.  Ties resolve to the smaller label
-id; voxel-exact boundaries can differ from vigra's sequential flood order, which
-is why parity is defined on Rand/VoI, not voxel equality (SURVEY.md §7 #1).
+The default 6-connectivity path runs *directional raster sweeps* (the chamfer /
+Gauss–Seidel scheme): ``lax.scan`` relaxes plane-by-plane along ±z, ±y, ±x, so
+each sweep carries flood fronts across the whole axis instead of one voxel —
+the outer ``lax.while_loop`` then converges in O(#bends of the steepest path)
+rounds (typically < 10) instead of O(longest flood path) sweeps.  Monotone
+label-correcting relaxation is exact: every state is witnessed by a real path
+from a seed (induction over updates), states only decrease, and the unique
+fixpoint is the lexicographic minimum over all paths — the same fixpoint the
+neighbor-sweep kernel (``_seeded_watershed_sweep``, kept for connectivity > 1)
+reaches.  Ties resolve to the smaller label id; voxel-exact boundaries can
+differ from vigra's sequential flood order, which is why parity is defined on
+Rand/VoI, not voxel equality (SURVEY.md §7 #1).
 """
 
 from __future__ import annotations
@@ -39,6 +39,155 @@ from .filters import gaussian, maximum_filter, normalize
 _BIG = jnp.float32(3.0e38)
 
 
+def _axis_views(arrs, axis, reverse):
+    """Move ``axis`` to the front (flipped when ``reverse``) for a raster scan."""
+
+    def mv(x):
+        x = jnp.moveaxis(x, axis, 0)
+        return jnp.flip(x, axis=0) if reverse else x
+
+    return tuple(mv(x) for x in arrs)
+
+
+def _axis_unview(x, axis, reverse):
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    return jnp.moveaxis(x, 0, axis)
+
+
+def _sweep_altitude(alt, hmap, is_seed, mask, axis, reverse):
+    """Gauss–Seidel raster sweep of the flood-altitude field along one axis:
+    A'(p) = min(A(p), max(A(prev plane), h(p))).  min–max composes
+    monotonically (idempotent semiring), so sweeps relax exactly — no stale
+    states are possible."""
+    h_v, a_v, sd_v, mk_v = _axis_views((hmap, alt, is_seed, mask), axis, reverse)
+    plane_shape = h_v.shape[1:]
+
+    def step(carry, x):
+        h, o_alt, sd, mk = x
+        cand = jnp.maximum(carry, h)
+        better = mk & ~sd & (cand < o_alt)
+        n_alt = jnp.where(better, cand, o_alt)
+        # voxels outside the mask must not conduct: carry _BIG past them
+        return jnp.where(mk, n_alt, _BIG), n_alt
+
+    _, alts = lax.scan(step, jnp.full(plane_shape, _BIG), (h_v, a_v, sd_v, mk_v))
+    return _axis_unview(alts, axis, reverse)
+
+
+def _sweep_assign(dist, label, alt, hmap, is_seed, mask, axis, reverse):
+    """Gauss–Seidel raster sweep of the (hops, label) assignment along one
+    axis, restricted to optimal-prefix edges q→p (A(p) == max(A(q), h(p))).
+    (dist+1, label) is monotone in (dist, label), so sweeps are exact."""
+    big_dist = jnp.int32(np.iinfo(np.int32).max - 1)
+    h_v, a_v, d_v, l_v, sd_v, mk_v = _axis_views(
+        (hmap, alt, dist, label, is_seed, mask), axis, reverse
+    )
+    plane_shape = h_v.shape[1:]
+
+    def step(carry, x):
+        c_alt, c_dist, c_lab = carry
+        h, o_alt, o_dist, o_lab, sd, mk = x
+        edge_ok = o_alt == jnp.maximum(c_alt, h)
+        cand_dist = c_dist + 1
+        valid = (c_lab > 0) & mk & ~sd & edge_ok
+        better = valid & (
+            (cand_dist < o_dist)
+            | ((cand_dist == o_dist) & ((o_lab == 0) | (c_lab < o_lab)))
+        )
+        n_dist = jnp.where(better, cand_dist, o_dist)
+        n_lab = jnp.where(better, c_lab, o_lab)
+        # carry the (fixed) altitude of this plane + its updated assignment;
+        # non-mask voxels never conduct (label 0 in carry)
+        return (
+            jnp.where(mk, o_alt, _BIG),
+            n_dist,
+            jnp.where(mk, n_lab, 0),
+        ), (n_dist, n_lab)
+
+    init = (
+        jnp.full(plane_shape, _BIG),
+        jnp.full(plane_shape, big_dist),
+        jnp.zeros(plane_shape, jnp.int32),
+    )
+    _, (dists, labs) = lax.scan(step, init, (h_v, a_v, d_v, l_v, sd_v, mk_v))
+    return (
+        _axis_unview(dists, axis, reverse),
+        _axis_unview(labs, axis, reverse),
+    )
+
+
+@partial(jax.jit, static_argnames=("max_iter", "per_slice"))
+def _seeded_watershed_scan(
+    hmap: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: jnp.ndarray,
+    max_iter: int = 0,
+    per_slice: bool = False,
+) -> jnp.ndarray:
+    """Directional-sweep flood (6-connectivity), two monotone phases:
+
+      1. flood altitude A(p) = min over paths of (max h along path) by ±axis
+         raster relaxation — a min–max problem where Gauss–Seidel sweeps are
+         exact, converging in O(#bends of the steepest path) rounds;
+      2. (hops, label) BFS over optimal-prefix edges (A(p) == max(A(q), h(p)))
+         with min-label tie-breaking — also monotone under sweeps.
+
+    The split matters: the combined (alt, hops, label) relaxation is NOT
+    monotone (max() can keep a stale alt while hops/label change beneath it),
+    which is why the neighbor-sweep kernel recomputes states from scratch.
+    Each phase alone is monotone, so every fixpoint state has an exact witness
+    chain → regions are connected, labels reach their seeds.
+    """
+    hmap = hmap.astype(jnp.float32)
+    seeds = jnp.where(mask, seeds.astype(jnp.int32), 0)
+    is_seed = seeds > 0
+    big_dist = jnp.int32(np.iinfo(np.int32).max - 1)
+    axes = tuple(range(hmap.ndim))
+    if per_slice:
+        axes = axes[1:]  # z-slices independent: never sweep across axis 0
+
+    def cond(state):
+        return state[-2] if max_iter == 0 else state[-2] & (state[-1] < max_iter)
+
+    # -- phase 1: altitude ---------------------------------------------------
+    alt0 = jnp.where(is_seed, hmap, _BIG)
+
+    def alt_body(state):
+        alt, _, it = state
+        prev = alt
+        for axis in axes:
+            for reverse in (False, True):
+                alt = _sweep_altitude(alt, hmap, is_seed, mask, axis, reverse)
+        return alt, jnp.any(alt != prev), it + 1
+
+    alt, _, _ = lax.while_loop(
+        lambda s: cond(s), alt_body, (alt0, jnp.bool_(True), jnp.int32(0))
+    )
+
+    # -- phase 2: assignment -------------------------------------------------
+    label0 = seeds
+    dist0 = jnp.where(is_seed, 0, big_dist)
+
+    def assign_body(state):
+        dist, label, _, it = state
+        prev_d, prev_l = dist, label
+        for axis in axes:
+            for reverse in (False, True):
+                dist, label = _sweep_assign(
+                    dist, label, alt, hmap, is_seed, mask, axis, reverse
+                )
+        changed = jnp.any((dist != prev_d) | (label != prev_l))
+        return dist, label, changed, it + 1
+
+    _, label, _, _ = lax.while_loop(
+        lambda s: cond(s),
+        assign_body,
+        (dist0, label0, jnp.bool_(True), jnp.int32(0)),
+    )
+    return jnp.where(mask, label, 0)
+
+
 @partial(jax.jit, static_argnames=("connectivity", "max_iter", "per_slice"))
 def seeded_watershed(
     hmap: jnp.ndarray,
@@ -54,6 +203,30 @@ def seeded_watershed(
     iterates to the fixpoint.  ``per_slice`` floods each z-slice independently
     (the reference's 2d watershed mode, watershed.py:120-137).
     """
+    if mask is None:
+        mask_arr = jnp.ones(hmap.shape, dtype=bool)
+    else:
+        mask_arr = mask.astype(bool)
+    if connectivity == 1:
+        return _seeded_watershed_scan(
+            hmap, seeds, mask_arr, max_iter=max_iter, per_slice=per_slice
+        )
+    return _seeded_watershed_sweep(
+        hmap, seeds, mask_arr, connectivity, max_iter, per_slice
+    )
+
+
+@partial(jax.jit, static_argnames=("connectivity", "max_iter", "per_slice"))
+def _seeded_watershed_sweep(
+    hmap: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: jnp.ndarray,
+    connectivity: int = 1,
+    max_iter: int = 0,
+    per_slice: bool = False,
+) -> jnp.ndarray:
+    """Neighbor-sweep Bellman–Ford flood (any connectivity): one-voxel
+    propagation per sweep, recomputed from neighbors (see module docstring)."""
     hmap = hmap.astype(jnp.float32)
     if mask is None:
         mask = jnp.ones(hmap.shape, dtype=bool)
