@@ -1,0 +1,52 @@
+"""End-to-end multicut pipeline for bench.py (config 5 of BASELINE.md).
+
+Shared by the device run (in-process) and the host-CPU baseline (subprocess
+with JAX_PLATFORMS=cpu): the full MulticutSegmentationWorkflow —
+watershed → graph → features → costs → multicut → write (reference
+workflows.py:203-233) — on a synthetic CREMI-like boundary volume.
+Returns the workflow wall-clock in seconds (data staging excluded).
+"""
+
+import os
+import tempfile
+import time
+
+import numpy as np
+
+
+def run_pipeline(vol_path, shape, block_shape, target):
+    from cluster_tools_tpu.runtime import build, config as cfg
+    from cluster_tools_tpu.utils import file_reader
+    from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+
+    vol = np.load(vol_path).astype(np.float32)
+    assert vol.shape == tuple(shape)
+
+    with tempfile.TemporaryDirectory() as td:
+        data_path = os.path.join(td, "data.n5")
+        f = file_reader(data_path)
+        f.create_dataset("bnd", data=vol, chunks=tuple(block_shape))
+
+        config_dir = os.path.join(td, "configs")
+        tmp_folder = os.path.join(td, "tmp")
+        cfg.write_global_config(
+            config_dir, {"block_shape": list(block_shape), "target": target}
+        )
+        cfg.write_config(
+            config_dir, "watershed",
+            {"threshold": 0.5, "sigma_seeds": 2.0, "size_filter": 25,
+             "halo": [2, 4, 4]},
+        )
+        wf = MulticutSegmentationWorkflow(
+            tmp_folder, config_dir,
+            input_path=data_path, input_key="bnd",
+            ws_path=data_path, ws_key="ws",
+            output_path=data_path, output_key="seg",
+            n_scales=1,
+        )
+        t0 = time.perf_counter()
+        ok = build([wf])
+        wall = time.perf_counter() - t0
+        if not ok:
+            raise RuntimeError("e2e multicut workflow failed")
+    return wall

@@ -66,6 +66,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, i64p, f64p,
             ctypes.c_int64, i64p, f64p, i64p,
         ]
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.dt_watershed_cpu.argtypes = [
+            f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int64, i32p,
+        ]
+        lib.dt_watershed_cpu.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -146,3 +154,29 @@ def mutex_watershed(
         n_nodes, uv.shape[0], uv.reshape(-1), weights, attractive, labels
     )
     return labels
+
+
+def dt_watershed_cpu(
+    input_: np.ndarray,
+    threshold: float = 0.25,
+    sigma_seeds: float = 2.0,
+    sigma_weights: float = 2.0,
+    alpha: float = 0.8,
+    size_filter: int = 25,
+) -> "tuple[np.ndarray, int]":
+    """Single-core C++ DT-watershed (per-slice 2d mode) — the honest host
+    benchmark baseline for ops.watershed.dt_watershed (vigra moral
+    equivalent, reference watershed/watershed.py:286-344)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native solver library unavailable")
+    x = np.ascontiguousarray(input_, dtype=np.float32)
+    if x.ndim != 3:
+        raise ValueError("expected a 3d (z, y, x) volume")
+    labels = np.zeros(x.shape, dtype=np.int32)
+    n_seeds = lib.dt_watershed_cpu(
+        x.reshape(-1), x.shape[0], x.shape[1], x.shape[2],
+        float(threshold), float(sigma_seeds), float(sigma_weights),
+        float(alpha), int(size_filter), labels.reshape(-1),
+    )
+    return labels, int(n_seeds)

@@ -15,6 +15,7 @@
 //     (mutex_watershed/mws_blocks.py:11)
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <queue>
@@ -255,9 +256,269 @@ void lifted_gaec_impl(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
     for (int64_t i = 0; i < n_nodes; ++i) labels[i] = uf.find(i);
 }
 
+// ---------------------------------------------------------------------------
+// Single-core DT-watershed benchmark baseline.
+//
+// The honest host comparator for the fused TPU program (ops/watershed.py
+// dt_watershed): the same per-block pipeline the reference runs through
+// vigra/C++ (watershed/watershed.py:286-344) — threshold → per-slice exact
+// 2d EDT (Felzenszwalb) → gaussian → 3x3 maxima → CC seeds → height map →
+// priority flood → size filter — implemented as plain single-thread C++.
+// ---------------------------------------------------------------------------
+
+// exact 1d squared distance transform (Felzenszwalb & Huttenlocher lower
+// envelope), f = input costs, d = output, v/z = scratch (size n / n+1)
+void edt_1d(const float* f, float* d, int64_t n, int64_t* v, float* z) {
+    int64_t k = 0;
+    v[0] = 0;
+    z[0] = -3.0e38f;
+    z[1] = 3.0e38f;
+    for (int64_t q = 1; q < n; ++q) {
+        float s;
+        while (true) {
+            int64_t p = v[k];
+            s = ((f[q] + q * q) - (f[p] + p * p)) / (2.0f * (q - p));
+            if (s > z[k]) break;
+            --k;
+        }
+        ++k;
+        v[k] = q;
+        z[k] = s;
+        z[k + 1] = 3.0e38f;
+    }
+    k = 0;
+    for (int64_t q = 0; q < n; ++q) {
+        while (z[k + 1] < q) ++k;
+        int64_t p = v[k];
+        d[q] = (q - p) * (q - p) + f[p];
+    }
+}
+
+// separable 2d squared EDT of one slice (distance to nearest background==0)
+void edt_2d(const uint8_t* fg, float* dist, int64_t ny, int64_t nx,
+            float* tmp, float* col, float* cold, int64_t* v, float* z) {
+    const float BIG = 1.0e10f;
+    for (int64_t y = 0; y < ny; ++y) {
+        // exact 1d line distance along x, squared
+        float run = BIG;
+        for (int64_t x = 0; x < nx; ++x) {
+            run = fg[y * nx + x] ? ((run >= BIG) ? BIG : run + 1.0f) : 0.0f;
+            tmp[y * nx + x] = run;
+        }
+        run = BIG;
+        for (int64_t x = nx - 1; x >= 0; --x) {
+            run = fg[y * nx + x] ? ((run >= BIG) ? BIG : run + 1.0f) : 0.0f;
+            float m = std::min(tmp[y * nx + x], run);
+            tmp[y * nx + x] = (m >= BIG) ? BIG : m * m;
+        }
+    }
+    for (int64_t x = 0; x < nx; ++x) {
+        for (int64_t y = 0; y < ny; ++y) col[y] = tmp[y * nx + x];
+        edt_1d(col, cold, ny, v, z);
+        for (int64_t y = 0; y < ny; ++y) dist[y * nx + x] = cold[y];
+    }
+}
+
+// separable gaussian blur of one slice, reflect boundary
+void gaussian_2d(const float* in, float* out, int64_t ny, int64_t nx,
+                 float sigma, float* tmp) {
+    if (sigma <= 0.0f) {
+        std::memcpy(out, in, sizeof(float) * ny * nx);
+        return;
+    }
+    int64_t radius = static_cast<int64_t>(4.0f * sigma + 0.5f);
+    std::vector<float> kern(2 * radius + 1);
+    float s2 = 2.0f * sigma * sigma, sum = 0.0f;
+    for (int64_t i = -radius; i <= radius; ++i) {
+        kern[i + radius] = std::exp(-(float)(i * i) / s2);
+        sum += kern[i + radius];
+    }
+    for (auto& k : kern) k /= sum;
+    auto reflect = [](int64_t i, int64_t n) {
+        // scipy 'reflect' mode: (d c b a | a b c d | d c b a)
+        while (i < 0 || i >= n) {
+            if (i < 0) i = -i - 1;
+            if (i >= n) i = 2 * n - i - 1;
+        }
+        return i;
+    };
+    for (int64_t y = 0; y < ny; ++y)
+        for (int64_t x = 0; x < nx; ++x) {
+            float acc = 0.0f;
+            for (int64_t k = -radius; k <= radius; ++k)
+                acc += kern[k + radius] * in[y * nx + reflect(x + k, nx)];
+            tmp[y * nx + x] = acc;
+        }
+    for (int64_t y = 0; y < ny; ++y)
+        for (int64_t x = 0; x < nx; ++x) {
+            float acc = 0.0f;
+            for (int64_t k = -radius; k <= radius; ++k)
+                acc += kern[k + radius] * tmp[reflect(y + k, ny) * nx + x];
+            out[y * nx + x] = acc;
+        }
+}
+
+struct FloodEntry {
+    float h;
+    uint64_t order;
+    int64_t idx;
+    bool operator>(const FloodEntry& o) const {
+        return h != o.h ? h > o.h : order > o.order;
+    }
+};
+
+// seeded priority-flood of one slice, 4-connectivity (vigra watershedsNew
+// moral equivalent: lowest height first, FIFO within plateaus)
+void flood_2d(const float* hmap, const uint8_t* mask, int32_t* labels,
+              int64_t ny, int64_t nx) {
+    std::priority_queue<FloodEntry, std::vector<FloodEntry>,
+                        std::greater<FloodEntry>> heap;
+    uint64_t order = 0;
+    std::vector<uint8_t> visited(ny * nx, 0);
+    for (int64_t i = 0; i < ny * nx; ++i)
+        if (labels[i] > 0) {
+            visited[i] = 1;
+            heap.push({hmap[i], order++, i});
+        }
+    const int64_t dy[4] = {-1, 1, 0, 0}, dx[4] = {0, 0, -1, 1};
+    while (!heap.empty()) {
+        FloodEntry e = heap.top();
+        heap.pop();
+        int64_t y = e.idx / nx, x = e.idx % nx;
+        int32_t lab = labels[e.idx];
+        for (int64_t d = 0; d < 4; ++d) {
+            int64_t yy = y + dy[d], xx = x + dx[d];
+            if (yy < 0 || yy >= ny || xx < 0 || xx >= nx) continue;
+            int64_t j = yy * nx + xx;
+            if (visited[j] || !mask[j]) continue;
+            visited[j] = 1;
+            labels[j] = lab;
+            heap.push({hmap[j], order++, j});
+        }
+    }
+}
+
 }  // namespace
 
 extern "C" {
+
+// Full per-block DT-watershed, single core, per-slice (2d) mode — the
+// benchmark baseline for the fused TPU program.  input: (nz, ny, nx) f32,
+// labels out: int32 (globally unique across slices).  Returns n_seeds.
+int64_t dt_watershed_cpu(const float* input, int64_t nz, int64_t ny,
+                         int64_t nx, float threshold, float sigma_seeds,
+                         float sigma_weights, float alpha, int64_t size_filter,
+                         int32_t* labels) {
+    const int64_t sz = ny * nx;
+    std::vector<uint8_t> fg(sz);
+    std::vector<float> dist(sz), smooth(sz), hmap(sz), tmp(sz);
+    std::vector<float> col(ny), cold(ny), z(ny + 1);
+    std::vector<int64_t> v(ny);
+    int32_t next_label = 1;
+    std::vector<int64_t> stack;
+
+    for (int64_t zi = 0; zi < nz; ++zi) {
+        const float* x = input + zi * sz;
+        int32_t* lab = labels + zi * sz;
+        for (int64_t i = 0; i < sz; ++i) fg[i] = x[i] < threshold;
+        edt_2d(fg.data(), dist.data(), ny, nx, tmp.data(), col.data(),
+               cold.data(), v.data(), z.data());
+        float dmax = 0.0f;
+        for (int64_t i = 0; i < sz; ++i) {
+            dist[i] = std::sqrt(dist[i]);
+            dmax = std::max(dmax, dist[i]);
+        }
+        gaussian_2d(dist.data(), smooth.data(), ny, nx, sigma_seeds,
+                    tmp.data());
+        // seeds: 3x3 local maxima of smoothed dt (dt>0), 8-conn CC label
+        std::memset(lab, 0, sizeof(int32_t) * sz);
+        std::vector<uint8_t> maxima(sz, 0);
+        for (int64_t y = 0; y < ny; ++y)
+            for (int64_t xx = 0; xx < nx; ++xx) {
+                int64_t i = y * nx + xx;
+                if (dist[i] <= 0.0f) continue;
+                float c = smooth[i];
+                bool is_max = true;
+                for (int64_t ddy = -1; ddy <= 1 && is_max; ++ddy)
+                    for (int64_t ddx = -1; ddx <= 1; ++ddx) {
+                        int64_t yy = y + ddy, xc = xx + ddx;
+                        if (yy < 0 || yy >= ny || xc < 0 || xc >= nx) continue;
+                        if (smooth[yy * nx + xc] > c) {
+                            is_max = false;
+                            break;
+                        }
+                    }
+                maxima[i] = is_max;
+            }
+        for (int64_t i = 0; i < sz; ++i) {
+            if (!maxima[i] || lab[i] != 0) continue;
+            int32_t id = next_label++;
+            stack.clear();
+            stack.push_back(i);
+            lab[i] = id;
+            while (!stack.empty()) {
+                int64_t j = stack.back();
+                stack.pop_back();
+                int64_t y = j / nx, xx = j % nx;
+                for (int64_t ddy = -1; ddy <= 1; ++ddy)
+                    for (int64_t ddx = -1; ddx <= 1; ++ddx) {
+                        int64_t yy = y + ddy, xc = xx + ddx;
+                        if (yy < 0 || yy >= ny || xc < 0 || xc >= nx) continue;
+                        int64_t k = yy * nx + xc;
+                        if (maxima[k] && lab[k] == 0) {
+                            lab[k] = id;
+                            stack.push_back(k);
+                        }
+                    }
+            }
+        }
+        // height map alpha*x + (1-alpha)*(1 - dt/dmax), smoothed
+        float inv = dmax > 1e-6f ? 1.0f / dmax : 0.0f;
+        for (int64_t i = 0; i < sz; ++i)
+            tmp[i] = alpha * x[i] + (1.0f - alpha) * (1.0f - dist[i] * inv);
+        gaussian_2d(tmp.data(), hmap.data(), ny, nx, sigma_weights,
+                    smooth.data());
+        flood_2d(hmap.data(), fg.data(), lab, ny, nx);
+    }
+    int64_t n_seeds = next_label - 1;
+
+    if (size_filter > 0) {
+        std::vector<int64_t> counts(next_label, 0);
+        const int64_t total = nz * sz;
+        for (int64_t i = 0; i < total; ++i) ++counts[labels[i]];
+        std::vector<uint8_t> drop(next_label, 0);
+        for (int64_t l = 1; l < next_label; ++l)
+            drop[l] = counts[l] < size_filter;
+        for (int64_t zi = 0; zi < nz; ++zi) {
+            const float* x = input + zi * sz;
+            int32_t* lab = labels + zi * sz;
+            bool any = false;
+            for (int64_t i = 0; i < sz; ++i) {
+                fg[i] = x[i] < threshold;
+                if (lab[i] > 0 && drop[lab[i]]) {
+                    lab[i] = 0;
+                    any = true;
+                }
+            }
+            if (!any) continue;
+            // re-flood freed voxels from the surviving labels
+            edt_2d(fg.data(), dist.data(), ny, nx, tmp.data(), col.data(),
+                   cold.data(), v.data(), z.data());
+            float dmax = 0.0f;
+            for (int64_t i = 0; i < sz; ++i) {
+                dist[i] = std::sqrt(dist[i]);
+                dmax = std::max(dmax, dist[i]);
+            }
+            float inv = dmax > 1e-6f ? 1.0f / dmax : 0.0f;
+            for (int64_t i = 0; i < sz; ++i)
+                tmp[i] = alpha * x[i] + (1.0f - alpha) * (1.0f - dist[i] * inv);
+            gaussian_2d(tmp.data(), hmap.data(), ny, nx, sigma_weights,
+                        smooth.data());
+            flood_2d(hmap.data(), fg.data(), lab, ny, nx);
+        }
+    }
+    return n_seeds;
+}
 
 // Lifted multicut via lifted-GAEC (see lifted_gaec_impl).
 void lifted_gaec(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
@@ -320,15 +581,21 @@ void mutex_watershed(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
             if (have_mutex(ra, rb)) continue;
             int64_t root = uf.merge(ra, rb);
             int64_t child = (root == ra) ? rb : ra;
-            // merge mutex sets into the root; update partners' entries
-            if (mutexes[child].size() > mutexes[root].size())
-                std::swap(mutexes[child], mutexes[root]);
-            for (int64_t m : mutexes[child]) {
-                mutexes[root].insert(m);
-                mutexes[m].erase(child);
-                mutexes[m].insert(root);
-            }
+            // Merge the child's mutex set into the root and rewrite the
+            // partners' back-references child→root.  Invariant: a root's set
+            // contains only current roots, and every partner set points back
+            // at the current root — so `have_mutex` stays exact.  Snapshot
+            // the child's set first: erasing/inserting while iterating the
+            // same hashtable is UB when a partner entry aliases it.
+            std::vector<int64_t> moved(mutexes[child].begin(),
+                                       mutexes[child].end());
             mutexes[child].clear();
+            for (int64_t m : moved) {
+                mutexes[m].erase(child);
+                if (m == root) continue;  // defensive: never self-mutex
+                mutexes[m].insert(root);
+                mutexes[root].insert(m);
+            }
         } else {
             mutexes[ra].insert(rb);
             mutexes[rb].insert(ra);

@@ -132,6 +132,47 @@ class TestPostprocess:
         np.testing.assert_array_equal(out, [1, 1, 2, 2])
 
 
+class TestMwsNativeParity:
+    def test_native_matches_python_oracle_medium_graph(self, rng):
+        """Regression for a use-after-free + stale-mutex-back-reference bug in
+        the native mutex_watershed (solvers.cpp): only surfaced at realistic
+        edge counts, so tiny workflow tests never caught it."""
+        from cluster_tools_tpu import native
+        from cluster_tools_tpu.ops.mws import _mws_python
+
+        if not native.available():
+            pytest.skip("native solvers unavailable")
+        n_nodes = 3000
+        n_edges = 30000
+        uv = rng.integers(0, n_nodes, (n_edges, 2), dtype=np.int64)
+        keep = uv[:, 0] != uv[:, 1]
+        uv = uv[keep]
+        weights = rng.random(uv.shape[0])
+        attractive = (rng.random(uv.shape[0]) < 0.7).astype(np.uint8)
+        got = native.mutex_watershed(n_nodes, uv, weights, attractive)
+        want = _mws_python(n_nodes, uv, weights, attractive)
+        # same partition (root ids may differ)
+        pairs = np.unique(np.stack([got, want], axis=1), axis=0)
+        assert len(pairs) == len(np.unique(got)) == len(np.unique(want))
+
+    def test_grid_mws_realistic_size_no_crash(self):
+        """The UAF repro shape: long-range offsets + strides on a real grid."""
+        from scipy import ndimage
+
+        from cluster_tools_tpu.ops.mws import compute_mws_segmentation
+
+        offsets = [[-1, 0, 0], [0, -1, 0], [0, 0, -1],
+                   [-2, 0, 0], [0, -4, 0], [0, 0, -4]]
+        rng = np.random.default_rng(1)
+        shape = (8, 64, 64)
+        affs = ndimage.gaussian_filter(
+            rng.random((len(offsets),) + shape).astype(np.float32), (0, 1, 2, 2)
+        )
+        seg = compute_mws_segmentation(affs, offsets, strides=[1, 2, 2])
+        assert seg.shape == shape
+        assert seg.max() > 0
+
+
 class TestMwsWorkflow:
     def _make_affs(self, rng, shape=(16, 32, 32)):
         # two halves separated along y with strong repulsion; only the
