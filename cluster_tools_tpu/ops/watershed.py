@@ -55,11 +55,48 @@ def _axis_unview(x, axis, reverse):
     return jnp.moveaxis(x, 0, axis)
 
 
-def _sweep_altitude(alt, hmap, is_seed, mask, axis, reverse):
+def _sweep_altitude_assoc(alt, hmap, is_seed, mask, axis, reverse):
     """Gauss–Seidel raster sweep of the flood-altitude field along one axis:
-    A'(p) = min(A(p), max(A(prev plane), h(p))).  min–max composes
-    monotonically (idempotent semiring), so sweeps relax exactly — no stale
-    states are possible."""
+    A'(p) = min(A(p), max(A(prev plane), h(p))).
+
+    The carry chain is a composition of per-element *clamp* transfers
+    c → min(u, max(c, l)), a family closed under composition
+    (u₂₁ = min(u₂, max(u₁, l₂)), l₂₁ = max(l₁, l₂)) — so the whole
+    sequential sweep evaluates exactly via ``lax.associative_scan`` in
+    log(n) full-array steps instead of n sequential plane steps (the scan
+    version is dispatch-bound on TPU: 256 tiny steps per sweep)."""
+    h_v, a_v, sd_v, mk_v = _axis_views((hmap, alt, is_seed, mask), axis, reverse)
+
+    # per-element transfer (u, l): carry' = min(u, max(carry, l))
+    #   outside mask: constant _BIG (doesn't conduct)
+    #   seed:         constant a (its own fixed altitude)
+    #   interior:     min(a_old, max(carry, h))
+    conduct = mk_v & ~sd_v
+    u = jnp.where(mk_v, a_v, _BIG)
+    l = jnp.where(conduct, h_v, u)
+
+    def combine(f, g):  # f earlier, g later along the sweep
+        uf, lf = f
+        ug, lg = g
+        return jnp.minimum(ug, jnp.maximum(uf, lg)), jnp.maximum(lf, lg)
+
+    u_inc, _ = lax.associative_scan(combine, (u, l), axis=0)
+    # exclusive prefix applied to the initial carry _BIG gives just u
+    carry_in = jnp.concatenate(
+        [jnp.full_like(u_inc[:1], _BIG), u_inc[:-1]], axis=0
+    )
+    n_alt = jnp.where(
+        conduct, jnp.minimum(a_v, jnp.maximum(carry_in, h_v)), a_v
+    )
+    return _axis_unview(n_alt, axis, reverse)
+
+
+
+def _sweep_altitude_seq(alt, hmap, is_seed, mask, axis, reverse):
+    """Sequential-carry variant of the altitude sweep (``lax.scan`` over
+    planes).  O(n) work but n dependent steps — faster on work-bound
+    backends (XLA-CPU), slower on dispatch-latency-bound TPUs, where
+    ``_sweep_altitude_assoc`` wins."""
     h_v, a_v, sd_v, mk_v = _axis_views((hmap, alt, is_seed, mask), axis, reverse)
     plane_shape = h_v.shape[1:]
 
@@ -75,10 +112,9 @@ def _sweep_altitude(alt, hmap, is_seed, mask, axis, reverse):
     return _axis_unview(alts, axis, reverse)
 
 
-def _sweep_assign(dist, label, alt, hmap, is_seed, mask, axis, reverse):
-    """Gauss–Seidel raster sweep of the (hops, label) assignment along one
-    axis, restricted to optimal-prefix edges q→p (A(p) == max(A(q), h(p))).
-    (dist+1, label) is monotone in (dist, label), so sweeps are exact."""
+def _sweep_assign_seq(dist, label, alt, hmap, is_seed, mask, axis, reverse):
+    """Sequential-carry variant of the assignment sweep (see
+    ``_sweep_altitude_seq`` for the backend trade-off)."""
     big_dist = jnp.int32(np.iinfo(np.int32).max - 1)
     h_v, a_v, d_v, l_v, sd_v, mk_v = _axis_views(
         (hmap, alt, dist, label, is_seed, mask), axis, reverse
@@ -97,8 +133,6 @@ def _sweep_assign(dist, label, alt, hmap, is_seed, mask, axis, reverse):
         )
         n_dist = jnp.where(better, cand_dist, o_dist)
         n_lab = jnp.where(better, c_lab, o_lab)
-        # carry the (fixed) altitude of this plane + its updated assignment;
-        # non-mask voxels never conduct (label 0 in carry)
         return (
             jnp.where(mk, o_alt, _BIG),
             n_dist,
@@ -114,6 +148,89 @@ def _sweep_assign(dist, label, alt, hmap, is_seed, mask, axis, reverse):
     return (
         _axis_unview(dists, axis, reverse),
         _axis_unview(labs, axis, reverse),
+    )
+
+
+# None = pick by backend (assoc on TPU, seq on CPU); tests override to compare
+_FORCE_SWEEP_MODE = None
+
+
+def _use_assoc() -> bool:
+    if _FORCE_SWEEP_MODE is not None:
+        return _FORCE_SWEEP_MODE == "assoc"
+    return jax.default_backend() != "cpu"
+
+
+def _minlex(d1, l1, d2, l2):
+    """Min over (dist, label) lexicographic order where label 0 = +inf
+    (the original sweep's tie-breaking: smaller hop count, then smaller
+    label; unlabeled states never win)."""
+    take1 = (l1 > 0) & ((l2 == 0) | (d1 < d2) | ((d1 == d2) & (l1 < l2)))
+    return jnp.where(take1, d1, d2), jnp.where(take1, l1, l2)
+
+
+def _sweep_assign_assoc(dist, label, alt, hmap, is_seed, mask, axis, reverse):
+    """Gauss–Seidel raster sweep of the (hops, label) assignment along one
+    axis, restricted to optimal-prefix edges q→p (A(p) == max(A(q), h(p))).
+
+    The carry chain composes per-element transfers
+        f(d, l) = minlex((D, L), (d + k, l) if pass ∧ l>0 else ∞)
+    which are closed under composition (pass' = pass_f ∧ pass_g,
+    k' = k_f + k_g, const' = minlex(const_g, const_f + k_g if pass_g)),
+    so the sweep evaluates exactly via ``lax.associative_scan`` in log(n)
+    full-array steps.  The edge-feasibility test A(p) == max(A(q), h(p))
+    only involves the *fixed* altitudes of adjacent elements, so it is
+    per-element data, not part of the recurrence state."""
+    big_dist = jnp.int32(np.iinfo(np.int32).max - 1)
+    h_v, a_v, d_v, l_v, sd_v, mk_v = _axis_views(
+        (hmap, alt, dist, label, is_seed, mask), axis, reverse
+    )
+
+    # previous element's (masked) altitude — data, shifted along the axis
+    alt_masked = jnp.where(mk_v, a_v, _BIG)
+    prev_alt = jnp.concatenate(
+        [jnp.full_like(alt_masked[:1], _BIG), alt_masked[:-1]], axis=0
+    )
+    edge_ok = a_v == jnp.maximum(prev_alt, h_v)
+    can_update = mk_v & ~sd_v & edge_ok
+
+    # per-element transfer: constant part = own pre-sweep state (masked to
+    # (big, 0) outside the mask so it never conducts), pass-through iff the
+    # optimal-prefix edge into this element exists
+    const_d = jnp.where(mk_v, d_v, big_dist)
+    const_l = jnp.where(mk_v, l_v, 0)
+    step = jnp.ones_like(d_v)
+
+    def combine(f, g):  # f earlier, g later
+        fd, fl, fk, fp = f
+        gd, gl, gk, gp = g
+        cand_d = fd + gk
+        cand_l = jnp.where(gp, fl, 0)
+        d, l = _minlex(gd, gl, cand_d, cand_l)
+        return d, l, fk + gk, fp & gp
+
+    d_inc, l_inc, _, _ = lax.associative_scan(
+        combine, (const_d, const_l, step, can_update), axis=0
+    )
+    # exclusive prefix applied to the initial carry (big, 0): the pass-through
+    # candidate has l=0, so the result is just the composed constant part
+    carry_d = jnp.concatenate(
+        [jnp.full_like(d_inc[:1], big_dist), d_inc[:-1]], axis=0
+    )
+    carry_l = jnp.concatenate(
+        [jnp.zeros_like(l_inc[:1]), l_inc[:-1]], axis=0
+    )
+
+    cand_dist = carry_d + 1
+    better = can_update & (carry_l > 0) & (
+        (cand_dist < d_v)
+        | ((cand_dist == d_v) & ((l_v == 0) | (carry_l < l_v)))
+    )
+    n_dist = jnp.where(better, cand_dist, d_v)
+    n_lab = jnp.where(better, carry_l, l_v)
+    return (
+        _axis_unview(n_dist, axis, reverse),
+        _axis_unview(n_lab, axis, reverse),
     )
 
 
@@ -146,6 +263,13 @@ def _seeded_watershed_scan(
     axes = tuple(range(hmap.ndim))
     if per_slice:
         axes = axes[1:]  # z-slices independent: never sweep across axis 0
+
+    if _use_assoc():
+        _sweep_altitude = _sweep_altitude_assoc
+        _sweep_assign = _sweep_assign_assoc
+    else:
+        _sweep_altitude = _sweep_altitude_seq
+        _sweep_assign = _sweep_assign_seq
 
     def cond(state):
         return state[-2] if max_iter == 0 else state[-2] & (state[-1] < max_iter)

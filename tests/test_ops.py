@@ -166,6 +166,41 @@ class TestWatershed:
             _, n = ndimage.label(labels == i)
             assert n == 1
 
+    def test_assoc_and_seq_sweeps_agree(self, rng):
+        """The associative-scan sweep pair (TPU default) must compute the same
+        fixpoint as the sequential lax.scan pair (CPU default): both evaluate
+        the identical Gauss–Seidel carry chain, one in log-depth, one
+        sequentially."""
+        import jax
+
+        from cluster_tools_tpu.ops import watershed as W
+
+        h = rng.random((10, 24, 24)).astype(np.float32)
+        seeds = np.zeros_like(h, dtype=np.int32)
+        for i, p in enumerate([(2, 3, 3), (8, 20, 20), (5, 3, 20), (1, 20, 4)]):
+            seeds[p] = i + 1
+        mask = rng.random(h.shape) > 0.05
+        seeds[~mask] = 0
+        results = {}
+        for mode in ("seq", "assoc"):
+            W._FORCE_SWEEP_MODE = mode
+            jax.clear_caches()
+            try:
+                for per_slice in (False, True):
+                    results[(mode, per_slice)] = np.asarray(
+                        W.seeded_watershed(
+                            jnp.asarray(h), jnp.asarray(seeds),
+                            mask=jnp.asarray(mask), per_slice=per_slice,
+                        )
+                    )
+            finally:
+                W._FORCE_SWEEP_MODE = None
+                jax.clear_caches()
+        for per_slice in (False, True):
+            np.testing.assert_array_equal(
+                results[("seq", per_slice)], results[("assoc", per_slice)]
+            )
+
     def test_all_regions_connected_realistic(self, rng):
         # ghost-label regression: every watershed region must be connected,
         # including under plateaus/ties on a realistic smoothed boundary map
