@@ -411,9 +411,58 @@ def _seeded_watershed_sweep(
     return label
 
 
-@partial(jax.jit, static_argnames=("sigma", "per_slice"))
+@partial(jax.jit, static_argnames=("per_slice", "pixel_pitch"))
+def suppress_seeds(
+    maxima: jnp.ndarray,
+    dt: jnp.ndarray,
+    per_slice: bool = False,
+    pixel_pitch: Optional[Tuple[float, ...]] = None,
+) -> jnp.ndarray:
+    """Distance-based non-maximum suppression of seed maxima, as one separable
+    XLA program (the role of nifty.filters.nonMaximumDistanceSuppression in
+    the reference seed path, watershed.py:22,200-204).
+
+    A maximum p is suppressed iff a stronger maximum q covers it with its
+    parabola: dt(q)² − ‖p−q‖² > dt(p)².  The cover field
+    G(p) = max_q over maxima of (dt(q)² − ‖p−q‖²) is a separable max-parabola
+    transform — the same tiled min-plus kernel as the EDT with the sign
+    flipped — so the whole test is O(n·side) fully-parallel work, no pairwise
+    point matrix and no data-dependent point extraction.
+
+    Equal maxima never suppress each other (the inequality is strict), so
+    plateaus survive intact and are merged by the CC pass downstream.  The
+    greedy sequential semantics of the reference differ in chains of
+    overlapping maxima (a suppressed point cannot suppress others there);
+    parity is defined on Rand/VoI, not seed identity (SURVEY.md §7 #1).
+
+    ``pixel_pitch`` keeps the units consistent with an anisotropic distance
+    transform: dt values are then in physical units, so ‖p−q‖ must be too.
+    """
+    from .dt import _parabola_pass
+
+    pitch = (1.0,) * dt.ndim if pixel_pitch is None else tuple(pixel_pitch)
+    d = dt.astype(jnp.float32)
+    d2 = d * d
+    f = jnp.where(maxima, -d2, _BIG)  # min-form: G = -min(-f + dist²)
+    axes = tuple(range(dt.ndim))
+    if per_slice:
+        axes = axes[1:]
+    g = f
+    for axis in axes:
+        g = jnp.moveaxis(g, axis, -1)
+        g = _parabola_pass(g, pitch[axis], 32)
+        g = jnp.moveaxis(g, -1, axis)
+    cover = -g
+    return maxima & (cover <= d2 * (1.0 + 1e-5) + 1e-5)
+
+
+@partial(jax.jit, static_argnames=("sigma", "per_slice", "nms", "pixel_pitch"))
 def dt_seeds(
-    dt: jnp.ndarray, sigma: float = 2.0, per_slice: bool = False
+    dt: jnp.ndarray,
+    sigma: float = 2.0,
+    per_slice: bool = False,
+    nms: bool = False,
+    pixel_pitch: Optional[Tuple[float, ...]] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Seeds from a distance transform: smooth → local maxima (plateaus merged by
     full-connectivity CC over the maxima mask) → consecutive labels.
@@ -421,6 +470,8 @@ def dt_seeds(
     Mirrors reference ``_make_seeds`` (watershed.py:180-208): gaussian(dt) then
     localMaxima with allowAtBorder/allowPlateaus.  ``per_slice`` detects maxima
     and labels seeds within each z-slice independently (2d seed mode).
+    ``nms`` additionally suppresses maxima dominated by stronger nearby maxima
+    (reference ``non_maximum_suppression`` config knob, watershed.py:182-204).
     """
     if sigma and sigma > 0:
         # per-slice mode smooths within slices only (reference 2d seed path)
@@ -430,6 +481,10 @@ def dt_seeds(
         smoothed = dt
     window = (1,) + (3,) * (dt.ndim - 1) if per_slice else 3
     local_max = (maximum_filter(smoothed, window) == smoothed) & (dt > 0)
+    if nms:
+        local_max = suppress_seeds(
+            local_max, dt, per_slice=per_slice, pixel_pitch=pixel_pitch
+        )
     seeds, n = connected_components(
         local_max, connectivity=dt.ndim, per_slice=per_slice
     )
@@ -448,6 +503,7 @@ def dt_seeds(
         "alpha",
         "size_filter",
         "invert_input",
+        "non_maximum_suppression",
     ),
 )
 def dt_watershed(
@@ -462,18 +518,15 @@ def dt_watershed(
     alpha: float = 0.8,
     size_filter: int = 25,
     invert_input: bool = False,
+    non_maximum_suppression: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The full per-block DT-watershed — one fused XLA program.
 
-    threshold → distance transform (2d or 3d) → smoothed-maxima seeds → height
-    map α·input + (1-α)·(1-dt) → seeded flood → size filter.  Mirrors the
+    threshold → distance transform (2d or 3d) → smoothed-maxima seeds
+    (optionally NMS-suppressed, see ``suppress_seeds``) → height map
+    α·input + (1-α)·(1-dt) → seeded flood → size filter.  Mirrors the
     reference hot loop ``_ws_block`` (watershed.py:286-344) minus IO and offsets
     (applied host-side).  Returns ``(labels int32, n_seeds)``.
-
-    NB: the reference's optional seed non-maximum-suppression
-    (nifty.filters.nonMaximumDistanceSuppression, watershed.py:22) is not
-    implemented; plateau-merged maxima over-seed slightly, the size filter and
-    downstream agglomeration absorb the difference.
     """
     from .dt import _distance_transform, distance_transform_2d_stack
 
@@ -495,7 +548,10 @@ def dt_watershed(
         dt = _distance_transform(fg, pixel_pitch)
 
     per_slice_seeds = apply_ws_2d and x.ndim == 3
-    seeds, n_seeds = dt_seeds(dt, sigma_seeds, per_slice=per_slice_seeds)
+    seeds, n_seeds = dt_seeds(
+        dt, sigma_seeds, per_slice=per_slice_seeds,
+        nms=non_maximum_suppression, pixel_pitch=pixel_pitch,
+    )
     hmap = make_hmap(x, dt, alpha, sigma_weights, per_slice=per_slice_seeds)
     labels = seeded_watershed(hmap, seeds, mask=fg, per_slice=per_slice_seeds)
     if size_filter > 0:

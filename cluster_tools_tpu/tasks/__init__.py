@@ -15,7 +15,7 @@ from .thresholded_components import (
     MergeAssignmentsTask,
 )
 from .write import WriteTask
-from .relabel import FindUniquesTask, FindLabelingTask
+from .relabel import FindUniquesTask, FindLabelingTask, MergeUniquesTask
 from .copy_volume import CopyVolumeTask
 from .transformations import LinearTransformationTask
 from .masking import BlocksFromMaskTask, MinfilterTask
@@ -24,6 +24,12 @@ from .affinities import (
     InsertAffinitiesTask,
     EmbeddingDistancesTask,
     GradientsTask,
+)
+from .ilastik import (
+    IlastikPredictionTask,
+    MergePredictionsTask,
+    StackPredictionsTask,
+    WriteCarvingTask,
 )
 from .inference import InferenceTask
 from .multiscale_inference import MultiscaleInferenceTask
@@ -44,6 +50,11 @@ from .skeletons import (
 )
 from .distances import ObjectDistancesTask, MergeObjectDistancesTask
 from .meshes import ComputeMeshesTask
+from .morphology import (
+    BlockMorphologyTask,
+    MergeMorphologyTask,
+    RegionCentersTask,
+)
 from .label_multisets import CreateMultisetTask, DownscaleMultisetTask
 from .paintera import UniqueBlockLabelsTask, LabelBlockMappingTask
 from .postprocess import (
@@ -83,6 +94,7 @@ __all__ = [
     "WriteTask",
     "FindUniquesTask",
     "FindLabelingTask",
+    "MergeUniquesTask",
     "CopyVolumeTask",
     "LinearTransformationTask",
     "BlocksFromMaskTask",
@@ -107,6 +119,13 @@ __all__ = [
     "ObjectDistancesTask",
     "MergeObjectDistancesTask",
     "ComputeMeshesTask",
+    "BlockMorphologyTask",
+    "MergeMorphologyTask",
+    "RegionCentersTask",
+    "IlastikPredictionTask",
+    "MergePredictionsTask",
+    "StackPredictionsTask",
+    "WriteCarvingTask",
     "CreateMultisetTask",
     "DownscaleMultisetTask",
     "UniqueBlockLabelsTask",

@@ -91,6 +91,25 @@ class VolumeTask(BlockTask):
         return self.tmp_store().create_ragged_dataset(key, (grid_size,), dtype)
 
 
+def read_ragged_chunks(ds, n_blocks: int, n_threads: int = 1) -> list:
+    """Read all per-block ragged chunks, fanned out over a thread pool when
+    ``n_threads > 1`` (the reference's ``threads_per_job`` merge pattern,
+    write.py:236-243, measures.py:121-127 — chunk decode is gzip-bound, so
+    threads overlap IO + decompression).  Returns a list indexed by block id,
+    ``None`` where a chunk is absent."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    if n_threads <= 1:
+        return [ds.read_chunk((bid,)) for bid in range(n_blocks)]
+    with ThreadPoolExecutor(n_threads) as pool:
+        return list(pool.map(lambda bid: ds.read_chunk((bid,)), range(n_blocks)))
+
+
+def merge_threads(task) -> int:
+    """The ``threads_per_job`` knob of a merge task's config."""
+    return max(int(task.get_task_config().get("threads_per_job", 1)), 1)
+
+
 def resolve_n_blocks(
     config_dir, path: str, key: str, scale: int = 0, space_ndim: int = 3
 ) -> int:

@@ -24,7 +24,7 @@ from ..ops.rag import (
     merge_edge_features,
 )
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 from .graph import _read_block_with_upper_halo, load_graph
 
 FEATURE_IDS_KEY = "features/ids"
@@ -116,9 +116,10 @@ class MergeEdgeFeaturesTask(VolumeSimpleTask):
         ids_ds = store[FEATURE_IDS_KEY]
         vals_ds = store[FEATURE_VALS_KEY]
         ids_list, feats_list = [], []
-        for bid in range(n_blocks):
-            ids = ids_ds.read_chunk((bid,))
-            vals = vals_ds.read_chunk((bid,))
+        n_thr = merge_threads(self)
+        all_ids = read_ragged_chunks(ids_ds, n_blocks, n_thr)
+        all_vals = read_ragged_chunks(vals_ds, n_blocks, n_thr)
+        for ids, vals in zip(all_ids, all_vals):
             if ids is None or ids.size == 0:
                 continue
             ids_list.append(ids)

@@ -24,7 +24,7 @@ import numpy as np
 
 from ..ops.rag import block_edges
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 
 SUB_EDGES_KEY = "graph/sub_edges"
 SUB_NODES_KEY = "graph/sub_nodes"
@@ -83,14 +83,17 @@ class MergeSubGraphsTask(VolumeSimpleTask):
         store = self.tmp_store()
         sub = store[SUB_EDGES_KEY]
         sub_nodes = store[SUB_NODES_KEY]
-        collected, node_chunks = [], []
-        for bid in range(n_blocks):
-            chunk = sub.read_chunk((bid,))
-            if chunk is not None and chunk.size:
-                collected.append(chunk.reshape(-1, 2))
-            nchunk = sub_nodes.read_chunk((bid,))
-            if nchunk is not None and nchunk.size:
-                node_chunks.append(nchunk)
+        n_thr = merge_threads(self)
+        collected = [
+            c.reshape(-1, 2)
+            for c in read_ragged_chunks(sub, n_blocks, n_thr)
+            if c is not None and c.size
+        ]
+        node_chunks = [
+            c
+            for c in read_ragged_chunks(sub_nodes, n_blocks, n_thr)
+            if c is not None and c.size
+        ]
         if collected:
             label_edges = np.unique(np.concatenate(collected, axis=0), axis=0)
         else:

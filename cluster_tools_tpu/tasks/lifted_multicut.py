@@ -26,7 +26,7 @@ from ..ops.lifted import solve_lifted_multicut
 from ..ops.multicut import contract_edges
 from ..ops.unionfind import UnionFindNp
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 from .costs import COSTS_NAME
 from .graph import load_graph
 from .lifted_features import load_lifted_problem
@@ -155,8 +155,7 @@ class ReduceLiftedProblemTask(VolumeSimpleTask):
         store = self.tmp_store()
         cut_ds = store[f"lifted_multicut/s{self.scale}/cut_edges"]
         cut = np.zeros(edges.shape[0], dtype=bool)
-        for bid in range(n_blocks):
-            chunk = cut_ds.read_chunk((bid,))
+        for chunk in read_ragged_chunks(cut_ds, n_blocks, merge_threads(self)):
             if chunk is not None and chunk.size:
                 cut[chunk] = True
 

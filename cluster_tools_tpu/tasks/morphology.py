@@ -10,12 +10,12 @@ layout (block_morphology.py:128-134):
 from __future__ import annotations
 
 import os
-from typing import Any, Dict
+from typing import Any, Dict, List, Sequence
 
 import numpy as np
 
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 
 MORPHOLOGY_KEY = "morphology/blocks"
 MORPHOLOGY_NAME = "morphology.npy"
@@ -66,6 +66,92 @@ def merge_morphology(partials) -> np.ndarray:
     return out
 
 
+def load_morphology(tmp_folder: str) -> np.ndarray:
+    return np.load(os.path.join(tmp_folder, MORPHOLOGY_NAME))
+
+
+class IdBlockTask(VolumeTask):
+    """A block task over segment-id ranges instead of voxels."""
+
+    id_chunk = 64
+    _morpho_cache = None
+
+    def get_shape(self) -> Sequence[int]:
+        morpho = load_morphology(self.tmp_folder)
+        max_id = int(morpho[:, 0].max()) if len(morpho) else 0
+        return (max_id + 1, 1, 1)
+
+    def get_block_shape(self, gconf) -> List[int]:
+        return [self.id_chunk, 1, 1]
+
+    def morphology_by_id(self) -> Dict[int, np.ndarray]:
+        """Morphology rows keyed by id, loaded once per task instance (not
+        once per block — that would be O(n_ids^2) over the id blocking)."""
+        if self._morpho_cache is None:
+            morpho = load_morphology(self.tmp_folder)
+            self._morpho_cache = {int(r[0]): r for r in morpho}
+        return self._morpho_cache
+
+
+class RegionCentersTask(IdBlockTask):
+    """Representative interior point per segment: the EDT-argmax of the
+    object mask inside its morphology bounding box
+    (reference morphology/region_centers.py:29,106-133).
+
+    The id space is blocked (reference id_chunks=2000); each object is cropped
+    by its bbox and its most interior voxel written to a (n_labels, 3) float32
+    table.  The EDT runs on host (scipy, C): per-object crops are ragged, and
+    ragged shapes would force one XLA recompile per distinct crop shape.
+    """
+
+    task_name = "region_centers"
+    id_chunk = 2000
+
+    def __init__(self, *args, ignore_label=None, resolution=(1, 1, 1),
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.ignore_label = ignore_label
+        self.resolution = list(resolution)
+
+    def prepare(self, blocking: Blocking, config: Dict[str, Any]) -> None:
+        from ..utils import store
+
+        n_labels = blocking.shape[0]
+        store.file_reader(self.output_path, "a").require_dataset(
+            self.output_key,
+            shape=(n_labels, 3),
+            dtype="float32",
+            chunks=(min(self.id_chunk, n_labels), 3),
+            compression="gzip",
+        )
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        from scipy.ndimage import distance_transform_edt
+
+        block = blocking.block(block_id)
+        label_begin, label_end = block.begin[0], block.end[0]
+        by_id = self.morphology_by_id()
+        seg_ds = self.input_ds()
+        centers = np.zeros((label_end - label_begin, 3), dtype=np.float32)
+        for label_id in range(label_begin, label_end):
+            row = by_id.get(label_id)
+            if row is None or label_id == self.ignore_label:
+                continue
+            bb = tuple(
+                slice(int(b), int(e))
+                for b, e in zip(row[5:8], row[8:11])
+            )
+            obj = seg_ds[bb] == label_id
+            if not obj.any():
+                continue
+            dist = distance_transform_edt(obj, sampling=self.resolution)
+            center = np.unravel_index(np.argmax(dist), obj.shape)
+            centers[label_id - label_begin] = [
+                c + b.start for c, b in zip(center, bb)
+            ]
+        self.output_ds()[label_begin:label_end] = centers
+
+
 class BlockMorphologyTask(VolumeTask):
     task_name = "block_morphology"
     output_dtype = None
@@ -84,11 +170,10 @@ class MergeMorphologyTask(VolumeSimpleTask):
     def run_impl(self) -> None:
         n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         ds = self.tmp_store()[MORPHOLOGY_KEY]
-        partials = []
-        for bid in range(n_blocks):
-            chunk = ds.read_chunk((bid,))
-            if chunk is not None and chunk.size:
-                partials.append(chunk.reshape(-1, N_COLS))
+        chunks = read_ragged_chunks(ds, n_blocks, merge_threads(self))
+        partials = [
+            c.reshape(-1, N_COLS) for c in chunks if c is not None and c.size
+        ]
         table = (
             merge_morphology(partials)
             if partials
@@ -96,7 +181,3 @@ class MergeMorphologyTask(VolumeSimpleTask):
         )
         np.save(os.path.join(self.tmp_folder, MORPHOLOGY_NAME), table)
         self.log(f"morphology for {table.shape[0]} segments")
-
-
-def load_morphology(tmp_folder: str) -> np.ndarray:
-    return np.load(os.path.join(tmp_folder, MORPHOLOGY_NAME))

@@ -23,7 +23,7 @@ import numpy as np
 from ..ops.multicut import contract_edges, solve_multicut
 from ..ops.unionfind import UnionFindNp
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 from .costs import COSTS_NAME
 from .graph import load_graph
 
@@ -172,8 +172,7 @@ class ReduceProblemTask(VolumeSimpleTask):
         store = self.tmp_store()
         cut_ds = store[f"multicut/s{self.scale}/cut_edges"]
         cut = np.zeros(edges.shape[0], dtype=bool)
-        for bid in range(n_blocks):
-            chunk = cut_ds.read_chunk((bid,))
+        for chunk in read_ragged_chunks(cut_ds, n_blocks, merge_threads(self)):
             if chunk is not None and chunk.size:
                 cut[chunk] = True
 

@@ -18,7 +18,7 @@ from ..ops.evaluation import merge_contingency_tables
 from ..ops.segment import contingency_table
 from ..utils import store as store_mod
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 
 OVERLAPS_KEY = "node_labels/overlaps"
 NODE_LABELS_NAME = "node_labels.npy"
@@ -73,8 +73,7 @@ class MergeNodeLabelsTask(VolumeSimpleTask):
         n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         ds = self.tmp_store()[OVERLAPS_KEY]
         tables = []
-        for bid in range(n_blocks):
-            chunk = ds.read_chunk((bid,))
+        for chunk in read_ragged_chunks(ds, n_blocks, merge_threads(self)):
             if chunk is None or chunk.size == 0:
                 continue
             t = chunk.reshape(-1, 3)

@@ -27,7 +27,7 @@ from ..ops import filters as filter_ops
 from ..ops.segment import segment_count, segment_max, segment_mean, segment_min
 from ..utils import store
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 
 PARTIAL_KEY = "region_features/partial"
 REGION_FEATURES_NAME = "region_features.npy"
@@ -109,8 +109,7 @@ class MergeRegionFeaturesTask(VolumeSimpleTask):
         ds = self.tmp_store()[PARTIAL_KEY]
         n_cols = len(FEATURE_COLUMNS) + 1
         partials = []
-        for bid in range(n_blocks):
-            chunk = ds.read_chunk((bid,))
+        for chunk in read_ragged_chunks(ds, n_blocks, merge_threads(self)):
             if chunk is not None and chunk.size:
                 partials.append(chunk.reshape(-1, n_cols))
         if not partials:

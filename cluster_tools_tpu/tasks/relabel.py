@@ -13,7 +13,7 @@ from typing import Any, Dict
 import numpy as np
 
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 
 UNIQUES_KEY = "relabel/uniques"
 LABELING_NAME = "relabel_assignments.npy"
@@ -33,6 +33,40 @@ class FindUniquesTask(VolumeTask):
         store.write_chunk((block_id,), uniques.astype(np.uint64))
 
 
+class MergeUniquesTask(VolumeSimpleTask):
+    """Merge the per-block uniques into a sorted unique-id dataset at
+    ``output_path/output_key`` (reference relabel/merge_uniques.py:24,84-120).
+
+    Unlike ``FindLabelingTask`` (which turns the merged set into a
+    consecutive assignment table for relabeling), this materializes the raw
+    sparse id set — the reference's standalone ``UniqueWorkflow`` output.
+    Ragged chunk reads fan out over ``threads_per_job``.
+    """
+
+    task_name = "merge_uniques"
+
+    def run_impl(self) -> None:
+        from ..utils import store
+
+        n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
+        uniques_ds = self.tmp_store()[UNIQUES_KEY]
+        chunks = read_ragged_chunks(uniques_ds, n_blocks, merge_threads(self))
+        collected = [c for c in chunks if c is not None and c.size]
+        uniques = (
+            np.unique(np.concatenate(collected))
+            if collected
+            else np.array([], dtype=np.uint64)
+        )
+        f = store.file_reader(self.output_path, "a")
+        f.create_dataset(
+            self.output_key,
+            data=uniques.astype(np.uint64),
+            chunks=(max(min(int(1e6), uniques.size), 1),),
+            compression="gzip",
+        )
+        self.log(f"{uniques.size} unique ids -> {self.output_path}/{self.output_key}")
+
+
 class FindLabelingTask(VolumeSimpleTask):
     """Merge uniques → dense consecutive assignment table
     (reference find_labeling.py:100-125)."""
@@ -47,11 +81,8 @@ class FindLabelingTask(VolumeSimpleTask):
     def run_impl(self) -> None:
         n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         uniques_ds = self.tmp_store()[UNIQUES_KEY]
-        collected = []
-        for bid in range(n_blocks):
-            chunk = uniques_ds.read_chunk((bid,))
-            if chunk is not None and chunk.size:
-                collected.append(chunk)
+        chunks = read_ragged_chunks(uniques_ds, n_blocks, merge_threads(self))
+        collected = [c for c in chunks if c is not None and c.size]
         uniques = (
             np.unique(np.concatenate(collected))
             if collected

@@ -18,7 +18,7 @@ from ..ops.skeleton import skeletonize
 from ..utils import store
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask
-from .morphology import load_morphology
+from .morphology import IdBlockTask, load_morphology
 
 SKELETONS_KEY = "skeletons/objects"
 SKELETON_EVAL_NAME = "skeleton_eval.npz"
@@ -43,29 +43,6 @@ def deserialize_skeleton(data: np.ndarray):
         .astype(np.int64)
     )
     return nodes, edges
-
-
-class IdBlockTask(VolumeTask):
-    """A block task over segment-id ranges instead of voxels."""
-
-    id_chunk = 64
-    _morpho_cache = None
-
-    def get_shape(self) -> Sequence[int]:
-        morpho = load_morphology(self.tmp_folder)
-        max_id = int(morpho[:, 0].max()) if len(morpho) else 0
-        return (max_id + 1, 1, 1)
-
-    def get_block_shape(self, gconf) -> List[int]:
-        return [self.id_chunk, 1, 1]
-
-    def morphology_by_id(self) -> Dict[int, np.ndarray]:
-        """Morphology rows keyed by id, loaded once per task instance (not
-        once per block — that would be O(n_ids^2) over the id blocking)."""
-        if self._morpho_cache is None:
-            morpho = load_morphology(self.tmp_folder)
-            self._morpho_cache = {int(r[0]): r for r in morpho}
-        return self._morpho_cache
 
 
 class SkeletonizeTask(IdBlockTask):

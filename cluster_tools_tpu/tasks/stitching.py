@@ -22,7 +22,7 @@ import numpy as np
 from ..ops.segment import contingency_table
 from ..ops.unionfind import merge_assignments_np
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 
 STITCH_PAIRS_KEY = "stitching/face_pairs"
 STITCH_ASSIGNMENTS_NAME = "stitch_assignments.npy"
@@ -154,11 +154,8 @@ class StitchAssignmentsTask(VolumeSimpleTask):
     def run_impl(self) -> None:
         n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         ds = self.tmp_store()[STITCH_PAIRS_KEY]
-        pairs = []
-        for bid in range(n_blocks):
-            chunk = ds.read_chunk((bid,))
-            if chunk is not None and chunk.size:
-                pairs.append(chunk.reshape(-1, 2))
+        chunks = read_ragged_chunks(ds, n_blocks, merge_threads(self))
+        pairs = [c.reshape(-1, 2) for c in chunks if c is not None and c.size]
         all_pairs = (
             np.concatenate(pairs, axis=0) if pairs else np.zeros((0, 2), np.int64)
         )
@@ -264,8 +261,7 @@ class SimpleStitchAssignmentsTask(VolumeSimpleTask):
         )
         ds = self.tmp_store()[BOUNDARY_EDGES_KEY]
         merge = np.zeros(edges.shape[0], dtype=bool)
-        for bid in range(n_blocks):
-            chunk = ds.read_chunk((bid,))
+        for chunk in read_ragged_chunks(ds, n_blocks, merge_threads(self)):
             if chunk is not None and chunk.size:
                 merge[chunk] = True
         if self.edge_size_threshold > 0:
@@ -328,8 +324,7 @@ class StitchingMulticutTask(VolumeSimpleTask):
         )
         ds = self.tmp_store()[BOUNDARY_EDGES_KEY]
         stitch = np.zeros(edges.shape[0], dtype=bool)
-        for bid in range(n_blocks):
-            chunk = ds.read_chunk((bid,))
+        for chunk in read_ragged_chunks(ds, n_blocks, merge_threads(self)):
             if chunk is not None and chunk.size:
                 stitch[chunk] = True
 

@@ -29,7 +29,7 @@ from ..ops.unionfind import merge_assignments_device, merge_assignments_np
 from ..parallel.dispatch import read_block_batch, write_block_batch
 from ..parallel.mesh import put_sharded
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks
 
 MAX_IDS_KEY = "thresholded_components/max_ids"
 FACES_KEY = "thresholded_components/faces"
@@ -134,8 +134,9 @@ class MergeOffsetsTask(VolumeSimpleTask):
         n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
         max_ids_ds = self.tmp_store()[MAX_IDS_KEY]
         max_ids = np.zeros(n_blocks, dtype=np.int64)
-        for bid in range(n_blocks):
-            chunk = max_ids_ds.read_chunk((bid,))
+        for bid, chunk in enumerate(
+            read_ragged_chunks(max_ids_ds, n_blocks, merge_threads(self))
+        ):
             if chunk is not None:
                 max_ids[bid] = chunk[0]
         offsets = np.roll(np.cumsum(max_ids), 1)
@@ -206,8 +207,7 @@ class MergeAssignmentsTask(VolumeSimpleTask):
         _, _, n_labels = load_offsets(self.tmp_folder)
         faces = self.tmp_store()[FACES_KEY]
         all_pairs = []
-        for bid in range(n_blocks):
-            chunk = faces.read_chunk((bid,))
+        for chunk in read_ragged_chunks(faces, n_blocks, merge_threads(self)):
             if chunk is not None and chunk.size:
                 all_pairs.append(chunk.reshape(-1, 2))
         pairs = (

@@ -88,6 +88,7 @@ class WatershedTask(VolumeTask):
                 "channel_begin": 0,
                 "channel_end": None,
                 "agglomerate_channels": "mean",
+                "non_maximum_suppression": False,
             }
         )
         return conf
@@ -107,6 +108,9 @@ class WatershedTask(VolumeTask):
             alpha=float(config.get("alpha", 0.8)),
             size_filter=int(config.get("size_filter", 25)),
             invert_input=bool(config.get("invert_inputs", False)),
+            non_maximum_suppression=bool(
+                config.get("non_maximum_suppression", False)
+            ),
         )
 
     def _load_mask_batch(self, batch) -> Optional[np.ndarray]:

@@ -19,7 +19,7 @@ from .lifted_multicut import (
     LiftedMulticutSegmentationWorkflow,
     LiftedMulticutWorkflow,
 )
-from .morphology import MorphologyWorkflow
+from .morphology import MorphologyWorkflow, RegionCentersWorkflow
 from .multicut import (
     EdgeFeaturesWorkflow,
     GraphWorkflow,
@@ -28,7 +28,8 @@ from .multicut import (
 )
 from .mws import MwsWorkflow, TwoPassMwsWorkflow
 from .stitching import MulticutStitchingWorkflow, SimpleStitchingWorkflow
-from .relabel import RelabelWorkflow
+from .ilastik import IlastikCarvingWorkflow, IlastikPredictionWorkflow
+from .relabel import RelabelWorkflow, UniqueWorkflow
 from .thresholded_components import (
     ThresholdAndWatershedWorkflow,
     ThresholdedComponentsWorkflow,
@@ -55,6 +56,9 @@ __all__ = [
     "LiftedMulticutSegmentationWorkflow",
     "LiftedMulticutWorkflow",
     "MorphologyWorkflow",
+    "RegionCentersWorkflow",
+    "IlastikCarvingWorkflow",
+    "IlastikPredictionWorkflow",
     "MulticutSegmentationWorkflow",
     "MulticutWorkflow",
     "MwsWorkflow",
@@ -62,6 +66,7 @@ __all__ = [
     "MulticutStitchingWorkflow",
     "SimpleStitchingWorkflow",
     "RelabelWorkflow",
+    "UniqueWorkflow",
     "ThresholdAndWatershedWorkflow",
     "ThresholdedComponentsWorkflow",
     "WatershedWorkflow",
