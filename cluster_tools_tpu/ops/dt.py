@@ -26,7 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-_BIG = jnp.float32(1e10)
+# numpy scalar, NOT jnp: a module-level jnp constant would initialize the
+# device backend at import time (see ops/watershed.py)
+_BIG = np.float32(1e10)
 
 
 def _line_scan_distance(bg: jnp.ndarray, pitch: float) -> jnp.ndarray:

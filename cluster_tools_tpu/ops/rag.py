@@ -13,9 +13,13 @@ Edge features (10 per edge, the reference's default feature width —
 block_edge_features.py:146-148):
   [mean, variance, min, q10, q25, q50, q75, q90, max, count]
 accumulated over the boundary-map values sampled on both sides of each label
-face.  Cross-block merging combines (count, mean, var, min, max) exactly and
-quantiles by count-weighted mean (documented approximation — exact global
-quantiles would require keeping all samples).
+face.  Cross-block merging combines (count, mean, var, min, max) exactly;
+quantiles merge through a per-edge ``HIST_BINS``-bin histogram sketch over the
+normalized [0, 1] value range (block partials carry the bin counts), so the
+merged quantile error is bounded by one bin width with linear interpolation —
+the mergeable-sketch answer to the reference's exact
+``ndist.mergeFeatureBlocks`` (merge_edge_features.py:141).  Partials without
+histogram columns fall back to count-weighted quantile averaging.
 """
 
 from __future__ import annotations
@@ -25,6 +29,8 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 N_FEATURES = 10
+HIST_BINS = 64
+QUANTILES = (0.1, 0.25, 0.5, 0.75, 0.9)
 
 
 def block_edges(labels: np.ndarray, ignore_zero: bool = True) -> np.ndarray:
@@ -44,9 +50,27 @@ def block_edges(labels: np.ndarray, ignore_zero: bool = True) -> np.ndarray:
     return np.unique(np.concatenate(pairs, axis=0), axis=0)
 
 
-def _face_values(labels: np.ndarray, values: np.ndarray):
+def _owner_mask(shape, owner_shape) -> Optional[np.ndarray]:
+    """True where a voxel lies inside the owning (inner) block region.
+
+    Blocks read a +1 upper halo so cross-block faces are seen; a face is
+    *owned* by the block containing its lower voxel.  Without this mask the
+    orthogonal faces inside the halo slabs are accumulated by both adjacent
+    blocks, double-counting their samples in the merged features."""
+    if owner_shape is None:
+        return None
+    owned = np.ones(shape, dtype=bool)
+    for d, s in enumerate(owner_shape):
+        owned[(slice(None),) * d + (slice(s, None),)] = False
+    return owned
+
+
+def _face_values(
+    labels: np.ndarray, values: np.ndarray, owner_shape=None
+):
     """(u, v, sample) triples: for every face between two different labels, the
     boundary-map values on both sides of the face."""
+    owned = _owner_mask(labels.shape, owner_shape)
     us, vs, samples = [], [], []
     for axis in range(labels.ndim):
         lab0 = np.moveaxis(labels, axis, 0)
@@ -54,6 +78,8 @@ def _face_values(labels: np.ndarray, values: np.ndarray):
         lo, hi = lab0[:-1].reshape(-1), lab0[1:].reshape(-1)
         vlo, vhi = val0[:-1].reshape(-1), val0[1:].reshape(-1)
         sel = (lo != hi) & (lo != 0) & (hi != 0)
+        if owned is not None:
+            sel &= np.moveaxis(owned, axis, 0)[:-1].reshape(-1)
         if not sel.any():
             continue
         a = np.minimum(lo[sel], hi[sel])
@@ -71,16 +97,23 @@ def _face_values(labels: np.ndarray, values: np.ndarray):
     return np.concatenate(us), np.concatenate(vs), np.concatenate(samples)
 
 
-def boundary_edge_features(
-    labels: np.ndarray, boundary_map: np.ndarray
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Per-edge feature matrix over the label faces of one block.
+def _edge_group_features(u, v, s, dtype, hist_bins: int = 0):
+    """Shared per-edge statistics over (u, v, sample) triples.
 
-    Returns ``(edges [m,2], features [m,10])`` with edges sorted lexicographically.
+    Returns ``(edges [m,2], features [m,10])`` with edges sorted
+    lexicographically — or ``(edges, features, hist [m,hist_bins] uint32)``
+    when ``hist_bins > 0``: the per-edge histogram of the samples (assumed in
+    [0, 1], clipped), the compact mergeable quantile sketch consumed by
+    ``merge_edge_features``.
     """
-    u, v, s = _face_values(labels, boundary_map.astype(np.float64))
     if u.size == 0:
-        return np.zeros((0, 2), dtype=labels.dtype), np.zeros((0, N_FEATURES))
+        empty = (
+            np.zeros((0, 2), dtype=dtype),
+            np.zeros((0, N_FEATURES)),
+        )
+        if hist_bins:
+            return empty + (np.zeros((0, hist_bins), dtype=np.uint32),)
+        return empty
     order = np.lexsort((s, v, u))
     u, v, s = u[order], v[order], s[order]
     first = np.concatenate([[True], (u[1:] != u[:-1]) | (v[1:] != v[:-1])])
@@ -96,22 +129,60 @@ def boundary_edge_features(
     maxs = np.maximum.reduceat(s, starts)
     # quantiles: values are sorted within each edge group (lexsort key order)
     qs = []
-    for q in (0.1, 0.25, 0.5, 0.75, 0.9):
+    for q in QUANTILES:
         pos = starts + np.minimum(
             (q * (counts - 1)).astype(np.int64), (counts - 1).astype(np.int64)
         )
         qs.append(s[pos])
-    feats = np.stack([mean, var, mins, qs[0], qs[1], qs[2], qs[3], qs[4], maxs, counts], axis=1)
+    cols = [mean, var, mins, *qs, maxs, counts]
+    feats = np.stack(cols, axis=1)
+    if hist_bins:
+        group = np.cumsum(first) - 1
+        bins = np.clip((s * hist_bins).astype(np.int64), 0, hist_bins - 1)
+        hist = np.bincount(
+            group * hist_bins + bins, minlength=edges.shape[0] * hist_bins
+        ).reshape(edges.shape[0], hist_bins).astype(np.uint32)
+        return edges, feats, hist
     return edges, feats
 
 
+def boundary_edge_features(
+    labels: np.ndarray,
+    boundary_map: np.ndarray,
+    hist_bins: int = 0,
+    owner_shape=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-edge feature matrix over the label faces of one block.
+
+    ``owner_shape`` restricts accumulation to faces owned by the inner block
+    when ``labels`` carries a +1 upper halo (see ``_owner_mask``); with
+    ``hist_bins > 0`` a third return carries the per-edge histogram sketch."""
+    u, v, s = _face_values(
+        labels, boundary_map.astype(np.float64), owner_shape
+    )
+    return _edge_group_features(u, v, s, labels.dtype, hist_bins)
+
+
 def affinity_edge_features(
-    labels: np.ndarray, affs: np.ndarray, offsets: Sequence[Sequence[int]]
+    labels: np.ndarray,
+    affs: np.ndarray,
+    offsets: Sequence[Sequence[int]],
+    hist_bins: int = 0,
+    owner_shape=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Edge features from an affinity map [C, *spatial] with per-channel offsets
     (reference extractBlockFeaturesFromAffinityMaps).  Samples the affinity
-    value at the source voxel of each offset-crossing label pair."""
+    value at the source voxel of each offset-crossing label pair.
+
+    With ``owner_shape`` a pair is accumulated iff its *min-corner* voxel
+    (elementwise min of the two endpoints) lies in the inner block — a global
+    rule assigning every pair to exactly one block regardless of offset sign,
+    so a cross-face pair of a negative offset is owned by the lower block
+    (which sees it through the +1 upper halo) instead of being dropped.
+    Cross-block pairs reaching further than the 1-voxel halo remain
+    per-block-invisible, as in the reference's blockwise accumulation."""
     offsets = np.asarray(offsets, dtype=np.int64)
+    owned = _owner_mask(labels.shape, owner_shape)
     us, vs, samples = [], [], []
     for c, off in enumerate(offsets):
         src = tuple(
@@ -123,56 +194,84 @@ def affinity_edge_features(
         lo, hi = labels[src].reshape(-1), labels[dst].reshape(-1)
         val = affs[c][src].reshape(-1).astype(np.float64)
         sel = (lo != hi) & (lo != 0) & (hi != 0)
+        if owned is not None:
+            # min-corner of (src, dst): slice [0, s - |o|) along every axis —
+            # aligned elementwise with the src/dst iteration space
+            anchor = tuple(
+                slice(0, s - abs(o)) for o, s in zip(off, labels.shape)
+            )
+            sel &= owned[anchor].reshape(-1)
         if sel.any():
             us.append(np.minimum(lo[sel], hi[sel]))
             vs.append(np.maximum(lo[sel], hi[sel]))
             samples.append(val[sel])
     if not us:
-        return np.zeros((0, 2), dtype=labels.dtype), np.zeros((0, N_FEATURES))
+        empty = (
+            np.zeros((0, 2), dtype=labels.dtype),
+            np.zeros((0, N_FEATURES)),
+        )
+        if hist_bins:
+            return empty + (np.zeros((0, hist_bins), dtype=np.uint32),)
+        return empty
     u = np.concatenate(us)
     v = np.concatenate(vs)
     s = np.concatenate(samples)
-    order = np.lexsort((s, v, u))
-    u, v, s = u[order], v[order], s[order]
-    first = np.concatenate([[True], (u[1:] != u[:-1]) | (v[1:] != v[:-1])])
-    starts = np.nonzero(first)[0]
-    edges = np.stack([u[starts], v[starts]], axis=1)
-    counts = np.diff(np.append(starts, u.size)).astype(np.float64)
-    sums = np.add.reduceat(s, starts)
-    sums2 = np.add.reduceat(s * s, starts)
-    mean = sums / counts
-    var = np.maximum(sums2 / counts - mean**2, 0.0)
-    mins = np.minimum.reduceat(s, starts)
-    maxs = np.maximum.reduceat(s, starts)
-    qs = []
-    for q in (0.1, 0.25, 0.5, 0.75, 0.9):
-        pos = starts + np.minimum(
-            (q * (counts - 1)).astype(np.int64), (counts - 1).astype(np.int64)
-        )
-        qs.append(s[pos])
-    feats = np.stack(
-        [mean, var, mins, qs[0], qs[1], qs[2], qs[3], qs[4], maxs, counts], axis=1
-    )
-    return edges, feats
+    return _edge_group_features(u, v, s, labels.dtype, hist_bins)
+
+
+def _histogram_quantiles(hist: np.ndarray, cum: np.ndarray, counts, q: float):
+    """Per-row quantile from bin counts over [0, 1], linearly interpolated
+    within the selected bin (matches the lower-index sample quantile up to one
+    bin width).  ``cum`` is the precomputed row cumsum (shared by all five
+    quantile calls)."""
+    n_bins = hist.shape[1]
+    target = q * (counts - 1)
+    # first bin whose cumulative count exceeds the target rank
+    idx = (cum <= target[:, None]).sum(axis=1)
+    idx = np.minimum(idx, n_bins - 1)
+    rows = np.arange(hist.shape[0])
+    below = np.where(idx > 0, cum[rows, np.maximum(idx - 1, 0)], 0.0)
+    in_bin = np.maximum(hist[rows, idx], 1.0)
+    frac = np.clip((target - below + 0.5) / in_bin, 0.0, 1.0)
+    return (idx + frac) / n_bins
 
 
 def merge_edge_features(
-    edge_ids_list: Sequence[np.ndarray], feats_list: Sequence[np.ndarray], n_edges: int
+    edge_ids_list: Sequence[np.ndarray],
+    feats_list: Sequence[np.ndarray],
+    n_edges: int,
+    hists_list: Optional[Sequence[Optional[np.ndarray]]] = None,
 ) -> np.ndarray:
     """Merge per-block partial features into the global [n_edges, 10] matrix.
 
-    count/mean/var/min/max merge exactly (parallel-variance formula); quantile
-    columns merge by count-weighted average (approximation, see module doc).
+    count/mean/var/min/max merge exactly (parallel-variance formula).
+    Quantiles merge exactly up to one histogram-bin width when every partial
+    comes with a histogram sketch in ``hists_list`` AND the observed value
+    range stays inside [0, 1] (the sketch's bin domain); otherwise — legacy
+    partials without sketches, or out-of-range float data — the merge
+    degrades to count-weighted quantile averaging for all edges rather than
+    producing collapsed quantiles.
     """
+    use_hist = (
+        hists_list is not None
+        and len(hists_list) == len(feats_list)
+        and all(h is not None for h in hists_list)
+        and any(h.shape[0] for h in hists_list)
+    )
+    hist_bins = (
+        next(h.shape[1] for h in hists_list if h.shape[0]) if use_hist else 0
+    )
+
     out = np.zeros((n_edges, N_FEATURES))
     count = np.zeros(n_edges)
     mean = np.zeros(n_edges)
     m2 = np.zeros(n_edges)
     mins = np.full(n_edges, np.inf)
     maxs = np.full(n_edges, -np.inf)
-    qsum = np.zeros((n_edges, 5))
+    qsum = np.zeros((n_edges, len(QUANTILES)))
+    hist = np.zeros((n_edges, hist_bins), dtype=np.int64) if use_hist else None
 
-    for ids, feats in zip(edge_ids_list, feats_list):
+    for i, (ids, feats) in enumerate(zip(edge_ids_list, feats_list)):
         if ids.size == 0:
             continue
         c = feats[:, 9]
@@ -185,13 +284,34 @@ def merge_edge_features(
         count[ids] = tot
         mins[ids] = np.minimum(mins[ids], feats[:, 2])
         maxs[ids] = np.maximum(maxs[ids], feats[:, 8])
+        # accumulate both: the hist/fallback choice is made after the observed
+        # value range is known
         qsum[ids] += feats[:, 3:8] * c[:, None]
+        if use_hist:
+            hist[ids] += hists_list[i].astype(np.int64)
 
     nonzero = count > 0
+    if use_hist and nonzero.any():
+        lo = mins[nonzero].min()
+        hi = maxs[nonzero].max()
+        if lo < -1e-9 or hi > 1.0 + 1e-9:
+            use_hist = False  # samples escape the sketch's [0, 1] bin domain
+
     out[:, 0] = mean
     out[:, 1] = np.where(nonzero, m2 / np.maximum(count, 1), 0.0)
     out[:, 2] = np.where(nonzero, mins, 0.0)
-    out[:, 3:8] = qsum / np.maximum(count, 1)[:, None]
+    if use_hist:
+        cum = np.cumsum(hist, axis=1)
+        for qi, q in enumerate(QUANTILES):
+            out[:, 3 + qi] = np.where(
+                nonzero, _histogram_quantiles(hist, cum, count, q), 0.0
+            )
+        # histogram bin centers can't leave [min, max]; clamp to the exact ends
+        out[:, 3:8] = np.clip(
+            out[:, 3:8], out[:, 2:3], np.where(nonzero, maxs, 0.0)[:, None]
+        )
+    else:
+        out[:, 3:8] = qsum / np.maximum(count, 1)[:, None]
     out[:, 8] = np.where(nonzero, maxs, 0.0)
     out[:, 9] = count
     return out

@@ -36,7 +36,10 @@ from jax import lax
 from .cc import connected_components, neighbor_offsets, _shift
 from .filters import gaussian, maximum_filter, normalize
 
-_BIG = jnp.float32(3.0e38)
+# numpy scalar, NOT jnp: a module-level jnp constant would initialize the
+# device backend at import time (breaking imports in processes without a
+# usable accelerator, e.g. batch-scheduler workers)
+_BIG = np.float32(3.0e38)
 
 
 def _axis_views(arrs, axis, reverse):

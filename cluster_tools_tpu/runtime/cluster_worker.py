@@ -1,0 +1,67 @@
+"""Worker entry point for batch-scheduler executors.
+
+One scheduler job = one invocation of this module: it loads the pickled task,
+runs its assigned blocks through the in-process local path, and writes a
+machine-readable per-job status JSON (the positive-success analog of the
+reference's ``processed job/block`` log lines, function_utils.py:11-16 —
+parsed back by the submitting process without log-grepping).
+
+    python -m cluster_tools_tpu.runtime.cluster_worker <job_dir> <job_id>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sys
+import traceback
+
+
+def job_paths(job_dir: str, job_id: int):
+    return (
+        os.path.join(job_dir, "task.pkl"),
+        os.path.join(job_dir, f"job_{job_id}.json"),
+        os.path.join(job_dir, f"job_{job_id}.status.json"),
+    )
+
+
+def run_job(job_dir: str, job_id: int) -> int:
+    task_path, config_path, status_path = job_paths(job_dir, job_id)
+    with open(task_path, "rb") as f:
+        task = pickle.load(f)
+    with open(config_path) as f:
+        job = json.load(f)
+
+    from ..utils.blocking import Blocking
+    from .executor import LocalExecutor
+
+    blocking = Blocking(job["shape"], job["block_shape"])
+    config = dict(job["config"])
+    # inside one scheduler job, blocks run through the plain local path
+    config["target"] = "local"
+    executor = LocalExecutor(config)
+    try:
+        done, failed, errors = executor.run_blocks(
+            task, blocking, job["block_ids"], config
+        )
+        status = {
+            "done": [int(b) for b in done],
+            "failed": [int(b) for b in failed],
+            "errors": {str(k): v for k, v in errors.items()},
+        }
+    except Exception:
+        status = {
+            "done": [],
+            "failed": [int(b) for b in job["block_ids"]],
+            "errors": {"job": traceback.format_exc()},
+        }
+    tmp = status_path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(status, f)
+    os.replace(tmp, status_path)
+    return 0 if not status["failed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(run_job(sys.argv[1], int(sys.argv[2])))

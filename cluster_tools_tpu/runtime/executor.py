@@ -18,6 +18,8 @@ failed blocks.
 
 from __future__ import annotations
 
+import contextlib
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Sequence, Tuple
@@ -25,6 +27,25 @@ from typing import Any, Dict, List, Sequence, Tuple
 from ..utils.blocking import Blocking
 
 RunResult = Tuple[List[int], List[int], Dict[int, str]]  # done, failed, errors
+
+
+def _record(task, label: str, n_blocks: int, seconds: float) -> None:
+    rec = getattr(task, "record_timing", None)
+    if rec is not None:
+        rec(label, n_blocks, seconds)
+
+
+def profiler_trace(config: Dict[str, Any]):
+    """jax profiler context when the ``profile_dir`` config knob is set:
+    dispatches inside are captured as a TensorBoard/XPlane trace
+    (SURVEY.md §5 — the reference has log-derived timing only; device traces
+    are the strictly-additive TPU upgrade)."""
+    profile_dir = config.get("profile_dir")
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(profile_dir)
 
 
 class BaseExecutor:
@@ -50,18 +71,28 @@ class LocalExecutor(BaseExecutor):
         failed: List[int] = []
         errors: Dict[int, str] = {}
 
+        durations: List[float] = []
+
         def _one(bid: int):
             try:
+                t0 = time.perf_counter()
                 task.process_block(bid, blocking, config)
+                durations.append(time.perf_counter() - t0)
                 return bid, None
             except Exception:
                 return bid, traceback.format_exc()
 
-        if n_workers == 1:
-            results = [_one(b) for b in block_ids]
-        else:
-            with ThreadPoolExecutor(n_workers) as pool:
-                results = list(pool.map(_one, block_ids))
+        with profiler_trace(config):
+            if n_workers == 1:
+                results = [_one(b) for b in block_ids]
+            else:
+                with ThreadPoolExecutor(n_workers) as pool:
+                    results = list(pool.map(_one, block_ids))
+        if durations:
+            # one aggregate record per dispatch round: a per-block record
+            # would make the status JSON O(n_blocks) at production scale
+            _record(task, "blocks_total", len(durations), sum(durations))
+            _record(task, "block_max", 1, max(durations))
         for bid, err in results:
             if err is None:
                 done.append(bid)
@@ -91,10 +122,29 @@ class TpuExecutor(BaseExecutor):
         failed: List[int] = []
         errors: Dict[int, str] = {}
         ids = list(block_ids)
+        trace = profiler_trace(config)
+        with trace:
+            self._run_batches(
+                task, blocking, config, ids, batch_size, batch_fn,
+                done, failed, errors,
+            )
+        return done, failed, errors
+
+    def _run_batches(
+        self, task, blocking, config, ids, batch_size, batch_fn,
+        done, failed, errors,
+    ) -> None:
         for i in range(0, len(ids), batch_size):
             chunk = ids[i : i + batch_size]
             try:
+                t0 = time.perf_counter()
                 batch_fn(chunk, blocking, config)
+                _record(
+                    task,
+                    f"batch_{chunk[0]}_{chunk[-1]}",
+                    len(chunk),
+                    time.perf_counter() - t0,
+                )
                 done.extend(chunk)
             except Exception:
                 tb = traceback.format_exc()
@@ -114,7 +164,6 @@ class TpuExecutor(BaseExecutor):
                         f"[{self.name}] batch dispatch failed, per-block fallback "
                         f"succeeded for blocks {chunk[0]}..{chunk[-1]}:\n{tb}"
                     )
-        return done, failed, errors
 
     @staticmethod
     def _n_devices(config) -> int:
@@ -136,6 +185,9 @@ _EXECUTORS = {
 
 
 def get_executor(target: str, config: Dict[str, Any]) -> BaseExecutor:
+    if target not in _EXECUTORS:
+        # the batch-scheduler backends register on import
+        from . import cluster_executor  # noqa: F401
     try:
         return _EXECUTORS[target](config)
     except KeyError:

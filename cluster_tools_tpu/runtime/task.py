@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import traceback
 from typing import Any, Dict, List, Optional, Sequence
@@ -60,7 +61,7 @@ class Target:
 
     def write(self, status: Dict[str, Any]) -> None:
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        tmp = self.path + f".tmp{os.getpid()}"
+        tmp = self.path + f".tmp{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "w") as f:
             json.dump(status, f, indent=2)
         os.replace(tmp, self.path)
@@ -82,6 +83,7 @@ class Task:
         self.config_dir = config_dir
         self.max_jobs = max_jobs
         self.dependencies = list(dependencies)
+        self._timings: List[Dict[str, Any]] = []
 
     # -- identity ------------------------------------------------------------
 
@@ -271,6 +273,14 @@ class BlockTask(Task):
         self._write_status(target, block_ids, done, [], runtimes, True)
         self.log(f"done {self.identifier} in {time.time() - t_start:.2f}s")
 
+    def record_timing(self, label: str, n_blocks: int, seconds: float) -> None:
+        """Per-dispatch timing record (one batch on the tpu executor, one
+        block on the local executor) — surfaced in the status file so perf
+        work is data-driven (SURVEY.md §5 'strictly additive' tracing)."""
+        self._timings.append(
+            {"label": label, "blocks": int(n_blocks), "seconds": float(seconds)}
+        )
+
     def _write_status(self, target, block_ids, done, failed, runtimes, complete):
         target.write(
             {
@@ -279,6 +289,7 @@ class BlockTask(Task):
                 "done": sorted(int(b) for b in done),
                 "failed": sorted(int(b) for b in failed),
                 "block_runtimes": [float(r) for r in runtimes],
+                "timings": list(self._timings),
                 "complete": bool(complete),
             }
         )

@@ -2,12 +2,16 @@
 
 Reference features/{block_edge_features,merge_edge_features}.py via
 nifty.distributed accumulators (SURVEY.md §2.3).  10 features per edge
-(mean, var, min, q10..q90, max, count); cross-block merge is exact for the
-moment statistics and count-weighted for quantiles (ops/rag.py doc).
+(mean, var, min, q10..q90, max, count); the cross-block merge is exact for
+the moment statistics, and quantiles merge through a per-edge HIST_BINS-bin
+histogram sketch carried in the block partials (exact up to one bin width;
+out-of-range or legacy 10-column partials degrade to count-weighted
+averaging — ops/rag.py doc).
 
 Scratch layout:
   features/ids     ragged per block: global edge ids
   features/vals    ragged per block: flattened [k,10] partial features
+  features/hists   ragged per block: flattened [k, HIST_BINS] uint32 sketches
   features/edges   [m,10] merged feature matrix
 """
 
@@ -22,6 +26,7 @@ from ..ops.rag import (
     affinity_edge_features,
     boundary_edge_features,
     merge_edge_features,
+    HIST_BINS,
 )
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
@@ -29,6 +34,7 @@ from .graph import _read_block_with_upper_halo, load_graph
 
 FEATURE_IDS_KEY = "features/ids"
 FEATURE_VALS_KEY = "features/vals"
+FEATURE_HISTS_KEY = "features/hists"
 FEATURES_KEY = "features/edges"
 
 
@@ -71,18 +77,25 @@ class BlockEdgeFeaturesTask(VolumeTask):
         if offsets is not None:
             data = data_ds[(slice(0, len(offsets)),) + bb]
             data = self._normalize(data)
-            edges, feats = affinity_edge_features(seg, data, offsets)
+            edges, feats, hists = affinity_edge_features(
+                seg, data, offsets, hist_bins=HIST_BINS,
+                owner_shape=block.shape,
+            )
         else:
             data = self._normalize(data_ds[bb])
-            edges, feats = boundary_edge_features(seg, data)
+            edges, feats, hists = boundary_edge_features(
+                seg, data, hist_bins=HIST_BINS, owner_shape=block.shape
+            )
 
         store = self.tmp_store()
         nodes, gedges = load_graph(store)
         ids_out = self.tmp_ragged(FEATURE_IDS_KEY, blocking.n_blocks, np.int64)
         vals_out = self.tmp_ragged(FEATURE_VALS_KEY, blocking.n_blocks, np.float64)
+        hists_out = self.tmp_ragged(FEATURE_HISTS_KEY, blocking.n_blocks, np.uint32)
         if edges.shape[0] == 0:
             ids_out.write_chunk((block_id,), np.array([], dtype=np.int64))
             vals_out.write_chunk((block_id,), np.array([], dtype=np.float64))
+            hists_out.write_chunk((block_id,), np.array([], dtype=np.uint32))
             return
         pairs = np.searchsorted(nodes, edges).astype(np.int64)
         keys = gedges[:, 0] * (nodes.size + 1) + gedges[:, 1]
@@ -91,6 +104,7 @@ class BlockEdgeFeaturesTask(VolumeTask):
         valid = keys[np.clip(ids, 0, keys.size - 1)] == want
         ids_out.write_chunk((block_id,), ids[valid].astype(np.int64))
         vals_out.write_chunk((block_id,), feats[valid].reshape(-1))
+        hists_out.write_chunk((block_id,), hists[valid].reshape(-1))
 
     @staticmethod
     def _normalize(data: np.ndarray) -> np.ndarray:
@@ -115,16 +129,25 @@ class MergeEdgeFeaturesTask(VolumeSimpleTask):
         n_edges = store["graph/edges"].attrs["n_edges"]
         ids_ds = store[FEATURE_IDS_KEY]
         vals_ds = store[FEATURE_VALS_KEY]
-        ids_list, feats_list = [], []
+        ids_list, feats_list, hists_list = [], [], []
         n_thr = merge_threads(self)
         all_ids = read_ragged_chunks(ids_ds, n_blocks, n_thr)
         all_vals = read_ragged_chunks(vals_ds, n_blocks, n_thr)
-        for ids, vals in zip(all_ids, all_vals):
+        # sketches live in their own uint32 ragged dataset; absent for scratch
+        # written before the histogram merge existed (legacy fallback)
+        if FEATURE_HISTS_KEY in store:
+            all_hists = read_ragged_chunks(store[FEATURE_HISTS_KEY], n_blocks, n_thr)
+        else:
+            all_hists = [None] * n_blocks
+        for ids, vals, hists in zip(all_ids, all_vals, all_hists):
             if ids is None or ids.size == 0:
                 continue
             ids_list.append(ids)
-            feats_list.append(vals.reshape(-1, N_FEATURES))
-        merged = merge_edge_features(ids_list, feats_list, n_edges)
+            feats_list.append(vals.reshape(ids.size, -1))
+            hists_list.append(
+                hists.reshape(ids.size, -1) if hists is not None else None
+            )
+        merged = merge_edge_features(ids_list, feats_list, n_edges, hists_list)
         store.create_dataset(
             FEATURES_KEY,
             data=merged,

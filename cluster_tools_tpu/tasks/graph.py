@@ -66,23 +66,92 @@ class InitialSubGraphsTask(VolumeTask):
         sub_nodes.write_chunk((block_id,), labels)
 
 
+def scale_keys(scale: int):
+    """Ragged sub-graph dataset keys at pyramid ``scale`` (scale 0 = the
+    per-block outputs of ``InitialSubGraphsTask``)."""
+    if scale == 0:
+        return SUB_EDGES_KEY, SUB_NODES_KEY
+    return f"{SUB_EDGES_KEY}_s{scale}", f"{SUB_NODES_KEY}_s{scale}"
+
+
+class MergeScaleSubGraphsTask(VolumeTask):
+    """One level of the sub-graph scale pyramid
+    (reference merge_sub_graphs.py:24, graph_workflow.py:36-54): each block at
+    scale ``s`` (block shape × 2^s) merges and dedups the sub-graphs of its
+    2³ child blocks at scale s-1, so the final global merge reads few large
+    chunks instead of every scale-0 chunk — not a single-node memory/IO choke
+    at production block counts."""
+
+    task_name = "merge_scale_sub_graphs"
+    output_dtype = None
+
+    def __init__(self, *args, scale: int = 1, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.scale = int(scale)
+
+    @property
+    def identifier(self) -> str:
+        return f"{self.task_name}_s{self.scale}"
+
+    def get_block_shape(self, gconf):
+        return [bs * (2 ** self.scale) for bs in gconf["block_shape"]]
+
+    def process_block(self, block_id: int, blocking: Blocking, config):
+        store = self.tmp_store()
+        in_edges_key, in_nodes_key = scale_keys(self.scale - 1)
+        out_edges_key, out_nodes_key = scale_keys(self.scale)
+        child_bs = [bs // 2 for bs in blocking.block_shape]
+        child_blocking = Blocking(blocking.shape, child_bs)
+        block = blocking.block(block_id)
+        child_ids = child_blocking.blocks_overlapping_roi(
+            block.begin, block.end
+        )
+        in_edges = store[in_edges_key]
+        in_nodes = store[in_nodes_key]
+        edge_chunks, node_chunks = [], []
+        for cid in child_ids:
+            c = in_edges.read_chunk((cid,))
+            if c is not None and c.size:
+                edge_chunks.append(c.reshape(-1, 2))
+            n = in_nodes.read_chunk((cid,))
+            if n is not None and n.size:
+                node_chunks.append(n)
+        edges = (
+            np.unique(np.concatenate(edge_chunks, axis=0), axis=0)
+            if edge_chunks
+            else np.zeros((0, 2), dtype=np.uint64)
+        )
+        nodes = (
+            np.unique(np.concatenate(node_chunks))
+            if node_chunks
+            else np.zeros(0, dtype=np.uint64)
+        )
+        out_edges = self.tmp_ragged(out_edges_key, blocking.n_blocks, np.uint64)
+        out_edges.write_chunk((block_id,), edges.reshape(-1))
+        out_nodes = self.tmp_ragged(out_nodes_key, blocking.n_blocks, np.uint64)
+        out_nodes.write_chunk((block_id,), nodes)
+
+
 class MergeSubGraphsTask(VolumeSimpleTask):
     """Merge block subgraphs into the global graph
-    (reference merge_sub_graphs.py:24; the scale pyramid of the reference is
-    collapsed into one sort-based merge — host np.unique over all block edges)."""
+    (reference merge_sub_graphs.py:24,147 with ``scale='complete'``): one
+    sort-based merge — np.unique over the chunks of the top pyramid scale."""
 
     task_name = "merge_sub_graphs"
 
     def __init__(self, *args, input_path: str = None, input_key: str = None,
-                 **kwargs):
+                 scale: int = 0, **kwargs):
         super().__init__(*args, input_path=input_path, input_key=input_key,
-                         **kwargs)
+                         scale=scale, **kwargs)
 
     def run_impl(self) -> None:
-        n_blocks = resolve_n_blocks(self.config_dir, self.input_path, self.input_key)
+        n_blocks = resolve_n_blocks(
+            self.config_dir, self.input_path, self.input_key, scale=self.scale
+        )
         store = self.tmp_store()
-        sub = store[SUB_EDGES_KEY]
-        sub_nodes = store[SUB_NODES_KEY]
+        edges_key, nodes_key = scale_keys(self.scale)
+        sub = store[edges_key]
+        sub_nodes = store[nodes_key]
         n_thr = merge_threads(self)
         collected = [
             c.reshape(-1, 2)

@@ -262,7 +262,9 @@ class WriteCarvingTask(SimpleTask):
         feats = scratch[FEATURES_KEY][:]
         uv = nodes[edge_idx].astype(np.uint32)
 
-        max_node = int(uv.max()) if uv.size else int(nodes.max(initial=0))
+        # size by the full node set, not edge endpoints: isolated fragments
+        # are graph nodes too and need seed/result-table slots
+        max_node = int(nodes.max()) if nodes.size else 0
         n_nodes = max_node + 1
         n_edges = uv.shape[0]
 

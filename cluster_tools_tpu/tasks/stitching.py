@@ -15,6 +15,7 @@ overlap exceeds ``overlap_threshold`` (reference _stitch_face semantics).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -38,7 +39,7 @@ def save_block_overlap(tmp_folder: str, block_id: int, outer_begin, outer_end,
     d = overlap_dir(tmp_folder)
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, f"block_{block_id}.npz")
-    tmp = path + f".tmp{os.getpid()}.npz"
+    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}.npz"
     np.savez_compressed(
         tmp, begin=np.asarray(outer_begin), end=np.asarray(outer_end), seg=seg
     )

@@ -26,6 +26,7 @@ import gzip
 import json
 import os
 import struct
+import threading
 import zlib
 from itertools import product
 from typing import Any, Dict, Optional, Sequence, Tuple
@@ -43,7 +44,10 @@ __all__ = ["file_reader", "File", "Dataset", "RaggedDataset"]
 
 
 def _atomic_write_bytes(path: str, payload: bytes) -> None:
-    tmp = path + f".tmp{os.getpid()}"
+    # tmp name must be unique per pid AND thread: concurrent block threads
+    # writing the same meta file (e.g. two workers group-initializing the
+    # shared scratch store) would otherwise replace each other's tmp away
+    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "wb") as f:
         f.write(payload)
     os.replace(tmp, path)

@@ -18,7 +18,12 @@ from typing import Optional
 from ..runtime.workflow import WorkflowBase
 from ..tasks.costs import ProbsToCostsTask
 from ..tasks.features import BlockEdgeFeaturesTask, MergeEdgeFeaturesTask
-from ..tasks.graph import InitialSubGraphsTask, MapEdgeIdsTask, MergeSubGraphsTask
+from ..tasks.graph import (
+    InitialSubGraphsTask,
+    MapEdgeIdsTask,
+    MergeScaleSubGraphsTask,
+    MergeSubGraphsTask,
+)
 from ..tasks.multicut import (
     ASSIGNMENTS_NAME,
     ReduceProblemTask,
@@ -30,25 +35,42 @@ from ..tasks.write import WriteTask
 
 
 class GraphWorkflow(WorkflowBase):
-    """Distributed RAG extraction (reference graph_workflow.py:9)."""
+    """Distributed RAG extraction (reference graph_workflow.py:9).
+
+    ``n_scales > 1`` merges the per-block sub-graphs through a scale pyramid
+    (each level dedups 2³ children, reference graph_workflow.py:36-66) before
+    the final global merge, bounding the chunk count the single-node merge
+    reads at production block counts."""
 
     task_name = "graph_workflow"
 
     def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
-                 input_path=None, input_key=None, dependencies=()):
+                 input_path=None, input_key=None, n_scales: int = 1,
+                 dependencies=()):
         super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
         self.input_path = input_path
         self.input_key = input_key
+        if int(n_scales) < 1:
+            raise ValueError(f"n_scales must be >= 1, got {n_scales}")
+        self.n_scales = int(n_scales)
 
     def requires(self):
-        sub = InitialSubGraphsTask(
+        dep = InitialSubGraphsTask(
             self.tmp_folder, self.config_dir, self.max_jobs,
             dependencies=list(self.dependencies),
             input_path=self.input_path, input_key=self.input_key,
         )
+        for scale in range(1, self.n_scales):
+            dep = MergeScaleSubGraphsTask(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                dependencies=[dep],
+                input_path=self.input_path, input_key=self.input_key,
+                scale=scale,
+            )
         merge = MergeSubGraphsTask(
-            self.tmp_folder, self.config_dir, dependencies=[sub],
+            self.tmp_folder, self.config_dir, dependencies=[dep],
             input_path=self.input_path, input_key=self.input_key,
+            scale=self.n_scales - 1,
         )
         map_ids = MapEdgeIdsTask(
             self.tmp_folder, self.config_dir, self.max_jobs,

@@ -147,3 +147,35 @@ def test_workflow_chain_order(tmp_env):
     assert names.index("b") > names.index("a")  # all a's before any b
     assert names == sorted(names)
     assert wf.complete()
+
+
+def test_status_records_per_dispatch_timings(tmp_env):
+    """VERDICT item 9: per-block (local) / per-batch (tpu) device timings
+    land in the status file."""
+    tmp_folder, config_dir = tmp_env
+    t = RecordingTask(tmp_folder, config_dir, out={})
+    build([t])
+    timings = t.output().read()["timings"]
+    # local executor: one aggregate + one max record per dispatch round
+    # (per-block records would make the status JSON O(n_blocks))
+    by_label = {rec["label"]: rec for rec in timings}
+    assert by_label["blocks_total"]["blocks"] == 2
+    assert by_label["blocks_total"]["seconds"] >= 0.0
+    assert by_label["block_max"]["blocks"] == 1
+
+
+def test_profile_dir_writes_trace(tmp_env, tmp_path):
+    """profile_dir config knob captures a jax profiler trace around the
+    dispatches."""
+    tmp_folder, config_dir = tmp_env
+    profile_dir = str(tmp_path / "prof")
+    cfg.write_config(config_dir, "recording", {"profile_dir": profile_dir})
+    t = RecordingTask(tmp_folder, config_dir, out={})
+    build([t])
+    assert os.path.isdir(profile_dir)
+    found = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(profile_dir)
+        for f in fs
+    ]
+    assert found  # trace artifacts written
