@@ -197,7 +197,7 @@ def bench_rag(x, repeats):
     lab_d = jnp.asarray(labels.astype(np.int32))
     x_d = jnp.asarray(x)
     t_dev = timeit(
-        lambda: dev_fn(lab_d, x_d),
+        lambda: dev_fn(lab_d, x_d, max_edges=65536),
         repeats,
         sync=lambda r: r[0].block_until_ready(),
     )

@@ -24,6 +24,7 @@ histogram columns fall back to count-weighted quantile averaging.
 
 from __future__ import annotations
 
+from functools import lru_cache, partial
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -315,3 +316,207 @@ def merge_edge_features(
     out[:, 8] = np.where(nonzero, maxs, 0.0)
     out[:, 9] = count
     return out
+
+
+# ---------------------------------------------------------------------------
+# device kernel: RAG extraction + feature accumulation as one XLA program
+# ---------------------------------------------------------------------------
+
+
+def _boundary_edge_features_device_impl(
+    labels, values, max_edges, hist_bins, owner_shape=None
+):
+    """One fused XLA program: face-pair extraction → 3-key lexicographic sort
+    (u, v, sample) → segment reductions (count/mean/var/min/max), in-segment
+    rank gathers for the five sample quantiles, and the per-edge histogram
+    sketch.  Fixed shapes throughout: outputs are padded to ``max_edges``
+    (ragged edge counts are the host's problem — SURVEY.md §7 #4).
+
+    The device-side answer to ndist.extractBlockFeaturesFromBoundaryMaps
+    (reference block_edge_features.py:127-148) — no int64 keys needed, so it
+    runs under the default x64-disabled jax config.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ndim = labels.ndim
+    owned = None
+    if owner_shape is not None:
+        # face ownership (see _owner_mask): lower voxel inside the inner block
+        owned = jnp.ones(labels.shape, dtype=bool)
+        for d, lim in enumerate(owner_shape):
+            ax_idx = lax.broadcasted_iota(jnp.int32, labels.shape, d)
+            owned &= ax_idx < lim
+    us, vs, ss = [], [], []
+    for axis in range(ndim):
+        lab0 = jnp.moveaxis(labels, axis, 0)
+        val0 = jnp.moveaxis(values, axis, 0)
+        lo = lab0[:-1].reshape(-1)
+        hi = lab0[1:].reshape(-1)
+        vlo = val0[:-1].reshape(-1)
+        vhi = val0[1:].reshape(-1)
+        sel = (lo != hi) & (lo != 0) & (hi != 0)
+        if owned is not None:
+            sel &= jnp.moveaxis(owned, axis, 0)[:-1].reshape(-1)
+        a = jnp.minimum(lo, hi)
+        b = jnp.maximum(lo, hi)
+        # invalid pairs get the sentinel key (int32 max) and sort to the end
+        big = jnp.int32(np.iinfo(np.int32).max)
+        a = jnp.where(sel, a, big)
+        b = jnp.where(sel, b, big)
+        us += [a, a]
+        vs += [b, b]
+        ss += [vlo, vhi]
+    u = jnp.concatenate(us)
+    v = jnp.concatenate(vs)
+    s = jnp.concatenate(ss).astype(jnp.float32)
+
+    u, v, s = lax.sort((u, v, s), num_keys=3)
+    big = jnp.int32(np.iinfo(np.int32).max)
+    valid = u != big
+    n_samples = valid.sum()
+
+    first = jnp.concatenate(
+        [valid[:1], (u[1:] != u[:-1]) | (v[1:] != v[:-1])]
+    ) & valid
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1  # -1 before first edge
+    seg = jnp.where(valid, seg, max_edges)  # invalid → overflow bucket
+    n_edges = first.sum()
+
+    ones = valid.astype(jnp.float32)
+    count = jax.ops.segment_sum(ones, seg, num_segments=max_edges + 1)
+    ssum = jax.ops.segment_sum(s * ones, seg, num_segments=max_edges + 1)
+    ssum2 = jax.ops.segment_sum(s * s * ones, seg, num_segments=max_edges + 1)
+    smin = jax.ops.segment_min(
+        jnp.where(valid, s, jnp.inf), seg, num_segments=max_edges + 1
+    )
+    smax = jax.ops.segment_max(
+        jnp.where(valid, s, -jnp.inf), seg, num_segments=max_edges + 1
+    )
+    idx = jnp.arange(u.shape[0], dtype=jnp.int32)
+    starts = jax.ops.segment_min(
+        jnp.where(valid, idx, jnp.int32(np.iinfo(np.int32).max)),
+        seg,
+        num_segments=max_edges + 1,
+    )
+
+    count_e = count[:max_edges]
+    safe_count = jnp.maximum(count_e, 1.0)
+    mean = ssum[:max_edges] / safe_count
+    var = jnp.maximum(ssum2[:max_edges] / safe_count - mean**2, 0.0)
+    present = count_e > 0
+    starts_e = jnp.where(present, starts[:max_edges], 0)
+
+    # quantiles: values are sorted within each segment (3rd sort key)
+    qcols = []
+    for q in QUANTILES:
+        pos = starts_e + jnp.minimum(
+            (q * (count_e - 1)).astype(jnp.int32),
+            jnp.maximum(count_e - 1, 0).astype(jnp.int32),
+        )
+        qcols.append(jnp.where(present, s[pos], 0.0))
+
+    feats = jnp.stack(
+        [
+            jnp.where(present, mean, 0.0),
+            jnp.where(present, var, 0.0),
+            jnp.where(present, smin[:max_edges], 0.0),
+            *qcols,
+            jnp.where(present, smax[:max_edges], 0.0),
+            count_e,
+        ],
+        axis=1,
+    )
+
+    # per-edge histogram sketch over [0, 1]
+    bins = jnp.clip((s * hist_bins).astype(jnp.int32), 0, hist_bins - 1)
+    flat = jnp.where(valid, seg * hist_bins + bins, max_edges * hist_bins)
+    hist = jax.ops.segment_sum(
+        valid.astype(jnp.uint32), flat,
+        num_segments=max_edges * hist_bins + 1,
+    )[: max_edges * hist_bins].reshape(max_edges, hist_bins)
+
+    edge_u = jax.ops.segment_min(
+        jnp.where(valid, u, big), seg, num_segments=max_edges + 1
+    )[:max_edges]
+    edge_v = jax.ops.segment_min(
+        jnp.where(valid, v, big), seg, num_segments=max_edges + 1
+    )[:max_edges]
+    return edge_u, edge_v, feats, hist, n_edges, n_samples
+
+
+@lru_cache(maxsize=32)
+def _jitted_device_features(max_edges: int, hist_bins: int, owner_shape):
+    """One cached jitted kernel per static configuration — a fresh jax.jit
+    per call would re-trace and re-compile for every block."""
+    import jax
+
+    fn = partial(
+        _boundary_edge_features_device_impl,
+        max_edges=max_edges,
+        hist_bins=hist_bins,
+        owner_shape=owner_shape,
+    )
+    return jax.jit(fn)
+
+
+def boundary_edge_features_device(
+    labels,
+    values,
+    max_edges: int = 16384,
+    hist_bins: int = HIST_BINS,
+    owner_shape=None,
+):
+    """Jitted device RAG accumulator; see ``_boundary_edge_features_device_impl``.
+
+    ``labels`` must be int32 (compact per-block ids — the host wrapper
+    ``boundary_edge_features_tpu`` handles uint64 global labels).
+    """
+    fn = _jitted_device_features(
+        int(max_edges),
+        int(hist_bins),
+        None if owner_shape is None else tuple(owner_shape),
+    )
+    return fn(labels, values)
+
+
+def boundary_edge_features_tpu(
+    labels: np.ndarray,
+    boundary_map: np.ndarray,
+    hist_bins: int = 0,
+    owner_shape=None,
+    max_edges: int = 16384,
+):
+    """Drop-in device-backed replacement for ``boundary_edge_features``:
+    compacts uint64 labels to int32 on the host (SURVEY.md §7 #3: labels are
+    uint64 with block offsets; the device program works on dense ids), runs
+    the fused kernel, and crops the padded outputs.
+
+    Moment statistics accumulate in float32 on device (TPUs have no native
+    f64) — parity with the numpy path is to ~1e-5 relative, not bitwise.
+    """
+    import jax.numpy as jnp
+
+    uniq, inv = np.unique(labels, return_inverse=True)
+    compact = inv.reshape(labels.shape).astype(np.int32)
+    # keep 0 → 0 so the kernel's background skip applies
+    if uniq.size == 0 or uniq[0] != 0:
+        compact = compact + 1
+        # dtype-preserving prepend: a bare [0] would promote uint64 → float64
+        uniq = np.concatenate([np.zeros(1, dtype=uniq.dtype), uniq])
+    eu, ev, feats, hist, n_edges, _ = boundary_edge_features_device(
+        jnp.asarray(compact), jnp.asarray(boundary_map, jnp.float32),
+        max_edges=max_edges, hist_bins=hist_bins or HIST_BINS,
+        owner_shape=owner_shape,
+    )
+    n = int(n_edges)
+    if n > max_edges:
+        raise ValueError(
+            f"block has {n} edges > max_edges={max_edges}; raise max_edges"
+        )
+    edges = uniq[np.stack([np.asarray(eu[:n]), np.asarray(ev[:n])], axis=1)]
+    feats = np.asarray(feats[:n], dtype=np.float64)
+    if hist_bins:
+        return edges, feats, np.asarray(hist[:n], dtype=np.uint32)
+    return edges, feats

@@ -57,7 +57,16 @@ class BlockEdgeFeaturesTask(VolumeTask):
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
         conf = super().default_task_config()
-        conf.update({"offsets": None})  # affinity offsets, None → boundary map
+        conf.update(
+            {
+                "offsets": None,  # affinity offsets, None → boundary map
+                # fused device accumulator (ops/rag.boundary_edge_features_tpu)
+                # for boundary-map blocks without halos; numpy path otherwise.
+                # Off by default: wins on TPU (hardware sort), loses on XLA-CPU
+                "device_accumulation": False,
+                "max_edges_per_block": 16384,
+            }
+        )
         return conf
 
     def labels_ds(self):
@@ -80,6 +89,14 @@ class BlockEdgeFeaturesTask(VolumeTask):
             edges, feats, hists = affinity_edge_features(
                 seg, data, offsets, hist_bins=HIST_BINS,
                 owner_shape=block.shape,
+            )
+        elif config.get("device_accumulation"):
+            from ..ops.rag import boundary_edge_features_tpu
+
+            data = self._normalize(data_ds[bb])
+            edges, feats, hists = boundary_edge_features_tpu(
+                seg, data, hist_bins=HIST_BINS, owner_shape=block.shape,
+                max_edges=int(config.get("max_edges_per_block", 16384)),
             )
         else:
             data = self._normalize(data_ds[bb])
