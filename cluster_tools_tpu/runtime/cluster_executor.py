@@ -55,7 +55,13 @@ class ClusterExecutor(BaseExecutor):
     def run_blocks(
         self, task, blocking: Blocking, block_ids: Sequence[int], config: Dict[str, Any]
     ) -> RunResult:
-        job_dir = os.path.join(task.tmp_folder, "cluster_jobs", task.identifier)
+        from . import config as cfg
+
+        pid, num = cfg.process_topology(config)
+        # namespace per host process: under multi-host topology each driver
+        # submits its own jobs and must not clobber peers' task.pkl/configs
+        name = task.identifier if num <= 1 else f"{task.identifier}_p{pid}"
+        job_dir = os.path.join(task.tmp_folder, "cluster_jobs", name)
         os.makedirs(job_dir, exist_ok=True)
         max_jobs = int(task.max_jobs or config.get("max_jobs", 1) or 1)
         ids = list(block_ids)

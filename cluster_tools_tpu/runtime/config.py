@@ -31,7 +31,26 @@ DEFAULT_GLOBAL_CONFIG: Dict[str, Any] = {
     "device_batch_size": 8,
     "devices": None,  # None = all jax.devices()
     "seed": 0,
+    # multi-host scale-out: run the SAME driver script on every host with
+    # process_id 0..num_processes-1 (or set CTT_PROCESS_ID / CTT_NUM_PROCESSES
+    # in each host's environment).  Blocks shard round-robin over processes,
+    # the chunked store on the shared filesystem is the data plane, and
+    # single-shot merge tasks run on process 0 while peers wait on its status
+    # file — the DCN-free control plane the reference uses (SURVEY.md §2.9)
+    "num_processes": 1,
+    "process_id": 0,
+    "peer_wait_timeout_s": 3600.0,
 }
+
+
+def process_topology(gconf: Dict[str, Any]):
+    """(process_id, num_processes) from the global config, overridable via the
+    CTT_PROCESS_ID / CTT_NUM_PROCESSES environment (one driver per host)."""
+    num = int(os.environ.get("CTT_NUM_PROCESSES", gconf.get("num_processes", 1) or 1))
+    pid = int(os.environ.get("CTT_PROCESS_ID", gconf.get("process_id", 0) or 0))
+    if not 0 <= pid < max(num, 1):
+        raise ValueError(f"process_id {pid} out of range for {num} processes")
+    return pid, max(num, 1)
 
 DEFAULT_TASK_CONFIG: Dict[str, Any] = {
     "threads_per_job": 1,

@@ -107,6 +107,65 @@ class Task:
     def complete(self) -> bool:
         return self.output().exists()
 
+    # -- multi-host topology ---------------------------------------------------
+
+    def topology(self):
+        return cfg.process_topology(self.global_config())
+
+    def _peer_wait(
+        self, targets, timeout_s: float, what: str, stage: str = "complete"
+    ) -> None:
+        """Block until every target reports the given status ``stage``
+        (the cross-process barrier of the shared-filesystem control plane).
+        A peer that recorded an abort fails the waiter immediately instead of
+        letting it spin to the timeout."""
+        deadline = time.time() + timeout_s
+        while True:
+            missing = []
+            for t in targets:
+                status = t.read()
+                if status.get("aborted"):
+                    raise FailedBlocksError(
+                        f"{self.identifier}: peer process aborted "
+                        f"({t.path}): {status.get('error', 'unknown error')}"
+                    )
+                if not status.get(stage, False):
+                    missing.append(t.path)
+            if not missing:
+                return
+            if time.time() > deadline:
+                raise FailedBlocksError(
+                    f"{self.identifier}: timed out after {timeout_s:.0f}s "
+                    f"waiting for {what}: {missing[:3]}"
+                )
+            time.sleep(1.0)
+
+    def _write_abort(self, error: str) -> None:
+        """Record this process's failure so peers at a barrier fail fast."""
+        status = self.output().read()
+        status.update(
+            {"task": self.identifier, "aborted": True, "error": error[-2000:]}
+        )
+        status.setdefault("complete", False)
+        self.output().write(status)
+
+    def clear_stale_abort(self) -> None:
+        """Drop an ``aborted`` flag left by a previous failed run from the
+        status files this process owns, so a resumed multi-host build doesn't
+        fail peers' barriers on stale state.  Called by ``build()`` before any
+        task runs.  (A per-process BlockTask status is owned by this process;
+        a shared SimpleTask status is owned by process 0.)"""
+        pid, num = self.topology()
+        target = self.output()
+        status = target.read()
+        if status.get("aborted") and (num <= 1 or self._owns_status(pid)):
+            status.pop("aborted", None)
+            status.pop("error", None)
+            target.write(status)
+
+    def _owns_status(self, pid: int) -> bool:
+        return pid == 0  # SimpleTask statuses are shared; p0 runs/owns them
+
     def run(self) -> None:  # pragma: no cover - abstract
         raise NotImplementedError
 
@@ -120,10 +179,14 @@ class Task:
         return cfg.task_config(self.config_dir, self.task_name, self.default_task_config())
 
     def global_config(self) -> Dict[str, Any]:
-        conf = cfg.global_config(self.config_dir)
-        if self.max_jobs is not None:
-            conf["max_jobs"] = self.max_jobs
-        return conf
+        # cached per task instance: completion polls under multi-host topology
+        # would otherwise re-read the config JSON on every status check
+        if getattr(self, "_gconf_cache", None) is None:
+            conf = cfg.global_config(self.config_dir)
+            if self.max_jobs is not None:
+                conf["max_jobs"] = self.max_jobs
+            self._gconf_cache = conf
+        return dict(self._gconf_cache)
 
     # -- logging -------------------------------------------------------------
 
@@ -142,12 +205,28 @@ class Task:
 
 
 class SimpleTask(Task):
-    """A single-shot (non-blockwise) task: subclasses implement ``run_impl``."""
+    """A single-shot (non-blockwise) task: subclasses implement ``run_impl``.
+
+    Under multi-host topology the merge runs on process 0 only (the
+    reference's 1-job merge semantics); peers wait for its status file."""
 
     def run(self) -> None:
+        gconf = self.global_config()
+        pid, num = cfg.process_topology(gconf)
+        if num > 1 and pid != 0:
+            timeout = float(gconf.get("peer_wait_timeout_s", 3600.0))
+            self.log(f"process {pid}: waiting for process 0 to run "
+                     f"{self.identifier}")
+            self._peer_wait([self.output()], timeout, f"{self.identifier} on p0")
+            return
         t0 = time.time()
-        self.log(f"start {self.identifier}")
-        self.run_impl()
+        try:
+            self.log(f"start {self.identifier}")
+            self.run_impl()
+        except Exception as e:
+            if num > 1:
+                self._write_abort(f"{type(e).__name__}: {e}")
+            raise
         status = {
             "task": self.identifier,
             "complete": True,
@@ -178,6 +257,33 @@ class BlockTask(Task):
 
     allow_retry: bool = True
 
+    # -- multi-host: per-process status + all-process completion -------------
+
+    def _status_path(self, pid: int, num: int) -> str:
+        name = (
+            f"{self.identifier}.status.json"
+            if num <= 1
+            else f"{self.identifier}.p{pid}.status.json"
+        )
+        return os.path.join(self.tmp_folder, "status", name)
+
+    def output(self) -> Target:
+        pid, num = self.topology()
+        return Target(self._status_path(pid, num))
+
+    def peer_outputs(self):
+        _, num = self.topology()
+        return [Target(self._status_path(i, num)) for i in range(num)]
+
+    # NB: complete() stays per-process (the inherited own-output check).
+    # Cross-process consistency is enforced *inside* run() — the blocks_done
+    # barrier plus the finalize-on-p0 wait guarantee all peers' data is on
+    # disk before this process stamps complete — so the local DAG runner can
+    # proceed without waiting for peers' bookkeeping to catch up.
+
+    def _owns_status(self, pid: int) -> bool:
+        return True  # block-task statuses are per-process
+
     def get_shape(self) -> Sequence[int]:  # pragma: no cover - abstract
         raise NotImplementedError
 
@@ -207,17 +313,71 @@ class BlockTask(Task):
     # -- main lifecycle ------------------------------------------------------
 
     def run(self) -> None:
-        from .executor import get_executor  # local import to avoid cycle
-
         t_start = time.time()
         gconf = self.global_config()
+        pid, num = cfg.process_topology(gconf)
+        try:
+            # everything — setup included — aborts visibly: a peer failing in
+            # get_shape/prepare must not leave others spinning to the timeout
+            blocking, all_block_ids, block_ids, config, done, runtimes = (
+                self._run_blocks_phase(gconf, pid, num)
+            )
+        except Exception as e:
+            if num > 1:
+                self._write_abort(f"{type(e).__name__}: {e}")
+            raise
+        target = self.output()
+
+        if num <= 1:
+            self.finalize(blocking, config, block_ids)
+            self._write_status(target, block_ids, done, [], runtimes, True)
+            self.log(f"done {self.identifier} in {time.time() - t_start:.2f}s")
+            return
+
+        # multi-host completion protocol: blocks_done → all-process barrier →
+        # finalize on process 0 over the FULL block list (reducing finalizers
+        # must see global state, not a shard) → staged complete markers so
+        # downstream tasks start only after the finalize is on disk
+        timeout = float(gconf.get("peer_wait_timeout_s", 3600.0))
+        self._write_status(
+            target, block_ids, done, [], runtimes, False, blocks_done=True
+        )
+        try:
+            self._peer_wait(
+                self.peer_outputs(), timeout,
+                f"{self.identifier} peers", stage="blocks_done",
+            )
+            if pid == 0:
+                self.finalize(blocking, config, all_block_ids)
+            else:
+                self._peer_wait(
+                    [Target(self._status_path(0, num))], timeout,
+                    f"{self.identifier} finalize on p0",
+                )
+        except Exception as e:
+            self._write_abort(f"{type(e).__name__}: {e}")
+            raise
+        self._write_status(
+            target, block_ids, done, [], runtimes, True, blocks_done=True
+        )
+        self.log(f"done {self.identifier} in {time.time() - t_start:.2f}s")
+
+    def _run_blocks_phase(self, gconf, pid: int, num: int):
+        """Setup + block execution (incl. retries) for this process's shard."""
+        from .executor import get_executor  # local import to avoid cycle
+
         tconf = self.get_task_config()
         config = {**gconf, **tconf}
 
         shape = tuple(self.get_shape())
         block_shape = self.get_block_shape(gconf)
         blocking = Blocking(shape, block_shape)
-        block_ids = self.get_block_list(blocking, gconf)
+        all_block_ids = self.get_block_list(blocking, gconf)
+        block_ids = all_block_ids
+        if num > 1:
+            # round-robin block shard per host process (the multi-host analog
+            # of the reference's per-job assignment, cluster_tasks.py:331)
+            block_ids = all_block_ids[pid::num]
 
         target = self.output()
         status = target.read()
@@ -233,7 +393,16 @@ class BlockTask(Task):
         max_retries = int(config.get("max_num_retries", 0))
         failure_fraction = float(config.get("retry_failure_fraction", 0.5))
         runtimes: List[float] = list(status.get("block_runtimes", []))
+        self._run_attempts(
+            target, blocking, config, executor, block_ids, todo, done,
+            runtimes, max_retries, failure_fraction,
+        )
+        return blocking, all_block_ids, block_ids, config, done, runtimes
 
+    def _run_attempts(
+        self, target, blocking, config, executor, block_ids, todo, done,
+        runtimes, max_retries, failure_fraction,
+    ) -> None:
         attempt = 0
         while todo:
             t0 = time.time()
@@ -269,10 +438,6 @@ class BlockTask(Task):
             self.log(f"retry {attempt}/{max_retries}: {len(failed)} failed blocks")
             todo = failed
 
-        self.finalize(blocking, config, block_ids)
-        self._write_status(target, block_ids, done, [], runtimes, True)
-        self.log(f"done {self.identifier} in {time.time() - t_start:.2f}s")
-
     def record_timing(self, label: str, n_blocks: int, seconds: float) -> None:
         """Per-dispatch timing record (one batch on the tpu executor, one
         block on the local executor) — surfaced in the status file so perf
@@ -281,7 +446,10 @@ class BlockTask(Task):
             {"label": label, "blocks": int(n_blocks), "seconds": float(seconds)}
         )
 
-    def _write_status(self, target, block_ids, done, failed, runtimes, complete):
+    def _write_status(
+        self, target, block_ids, done, failed, runtimes, complete,
+        blocks_done: bool = False,
+    ):
         target.write(
             {
                 "task": self.identifier,
@@ -290,6 +458,7 @@ class BlockTask(Task):
                 "failed": sorted(int(b) for b in failed),
                 "block_runtimes": [float(r) for r in runtimes],
                 "timings": list(self._timings),
+                "blocks_done": bool(blocks_done or complete),
                 "complete": bool(complete),
             }
         )

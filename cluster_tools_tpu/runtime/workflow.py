@@ -79,6 +79,10 @@ def build(tasks: Sequence[Task], raise_on_failure: bool = True) -> bool:
     """Run a set of root tasks and their dependencies.  Returns success."""
     order = _toposort(tasks)
     for task in order:
+        # resume after a multi-host failure: stale aborted flags from the
+        # prior run would otherwise fail peers' barriers immediately
+        task.clear_stale_abort()
+    for task in order:
         if task.complete():
             continue
         try:

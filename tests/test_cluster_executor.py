@@ -119,3 +119,89 @@ def test_cluster_failure_surfaces_failed_blocks(tmp_path, rng):
     )
     with pytest.raises((FailedBlocksError, RuntimeError)):
         task.run()
+
+
+def test_multihost_topology_two_processes(tmp_path, rng):
+    """Multi-host scale-out (SURVEY.md §2.9): the SAME driver script runs as
+    two real OS processes sharing tmp/config dirs; blocks shard round-robin,
+    per-process status files barrier the merge, the merge runs on process 0
+    while process 1 waits — combined output identical to a numpy oracle."""
+    import subprocess
+    import sys
+
+    labels = rng.integers(0, 500, (16, 24, 24)).astype(np.uint64) * 3
+    path = str(tmp_path / "d.n5")
+    file_reader(path).create_dataset("seg", data=labels, chunks=(4, 12, 12))
+    config_dir = str(tmp_path / "configs")
+    tmp_folder = str(tmp_path / "tmp")
+    cfg.write_global_config(
+        config_dir,
+        {"block_shape": [4, 12, 12], "num_processes": 2,
+         "peer_wait_timeout_s": 120.0},
+    )
+    script = str(tmp_path / "driver.py")
+    with open(script, "w") as f:
+        f.write(
+            "import sys\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from cluster_tools_tpu.runtime import build\n"
+            "from cluster_tools_tpu.workflows import UniqueWorkflow\n"
+            f"wf = UniqueWorkflow({tmp_folder!r}, {config_dir!r},\n"
+            f"    input_path={path!r}, input_key='seg',\n"
+            f"    output_path={path!r}, output_key='uniques')\n"
+            "assert build([wf])\n"
+        )
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep workers off the accelerator tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(cfg.__file__))
+    )
+    env["PYTHONPATH"] = (
+        os.path.dirname(pkg_root) + os.pathsep + env.get("PYTHONPATH", "")
+    )
+
+    procs = []
+    for pid in range(2):
+        penv = dict(env)
+        penv["CTT_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, script], env=penv,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+        )
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err.decode()[-2000:]
+    got = file_reader(path, "r")["uniques"][:]
+    np.testing.assert_array_equal(got, np.unique(labels))
+    # both processes really did disjoint shares
+    statuses = os.listdir(os.path.join(tmp_folder, "status"))
+    assert "find_uniques.p0.status.json" in statuses
+    assert "find_uniques.p1.status.json" in statuses
+    import json as _json
+
+    s0 = _json.load(open(os.path.join(tmp_folder, "status",
+                                      "find_uniques.p0.status.json")))
+    s1 = _json.load(open(os.path.join(tmp_folder, "status",
+                                      "find_uniques.p1.status.json")))
+    assert s0["done"] and s1["done"]
+    assert not set(s0["done"]) & set(s1["done"])
+
+
+def test_peer_abort_fails_waiters_fast(tmp_path):
+    """A peer that recorded an abort fails the barrier immediately (not after
+    the full peer_wait_timeout_s)."""
+    import time
+
+    from cluster_tools_tpu.runtime.task import FailedBlocksError, Target, Task
+
+    cfg.write_global_config(str(tmp_path / "configs"), {"num_processes": 2})
+    t = Task(str(tmp_path / "tmp"), str(tmp_path / "configs"))
+    aborted = Target(str(tmp_path / "tmp/status/task.p1.status.json"))
+    aborted.write({"complete": False, "aborted": True, "error": "boom"})
+    t0 = time.time()
+    with pytest.raises(FailedBlocksError, match="peer process aborted"):
+        t._peer_wait([aborted], timeout_s=60.0, what="peers")
+    assert time.time() - t0 < 5.0  # fail-fast, not the 60s timeout
