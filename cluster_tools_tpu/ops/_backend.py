@@ -1,0 +1,21 @@
+"""Shared backend-mode switch for the log-depth sweep kernels.
+
+The flood (ops/watershed.py) and connected-components (ops/cc.py) sweeps both
+choose between ``lax.associative_scan`` (log-depth, full-array work — wins on
+dispatch/latency-bound TPUs) and sequential carry chains (O(n) work — wins on
+work-bound XLA-CPU).  One switch keeps the two kernels on the same path;
+tools/tpu_validate.py measures both on real hardware.
+"""
+
+from __future__ import annotations
+
+# None = pick by backend; tests/benchmarks override to "assoc" / "seq"
+FORCE_SWEEP_MODE = None
+
+
+def use_assoc() -> bool:
+    if FORCE_SWEEP_MODE is not None:
+        return FORCE_SWEEP_MODE == "assoc"
+    import jax
+
+    return jax.default_backend() != "cpu"

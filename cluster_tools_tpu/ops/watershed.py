@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from . import _backend
 from .cc import connected_components, neighbor_offsets, _shift
 from .filters import gaussian, maximum_filter, normalize
 
@@ -154,14 +155,8 @@ def _sweep_assign_seq(dist, label, alt, hmap, is_seed, mask, axis, reverse):
     )
 
 
-# None = pick by backend (assoc on TPU, seq on CPU); tests override to compare
-_FORCE_SWEEP_MODE = None
-
-
 def _use_assoc() -> bool:
-    if _FORCE_SWEEP_MODE is not None:
-        return _FORCE_SWEEP_MODE == "assoc"
-    return jax.default_backend() != "cpu"
+    return _backend.use_assoc()
 
 
 def _minlex(d1, l1, d2, l2):

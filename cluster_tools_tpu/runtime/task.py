@@ -150,21 +150,23 @@ class Task:
         self.output().write(status)
 
     def clear_stale_abort(self) -> None:
-        """Drop an ``aborted`` flag left by a previous failed run from the
-        status files this process owns, so a resumed multi-host build doesn't
-        fail peers' barriers on stale state.  Called by ``build()`` before any
-        task runs.  (A per-process BlockTask status is owned by this process;
-        a shared SimpleTask status is owned by process 0.)"""
-        pid, num = self.topology()
-        target = self.output()
-        status = target.read()
-        if status.get("aborted") and (num <= 1 or self._owns_status(pid)):
-            status.pop("aborted", None)
-            status.pop("error", None)
-            target.write(status)
+        """Drop ``aborted`` flags left by a previous failed run from ALL of
+        this task's status files, so a resumed multi-host build doesn't fail
+        peers' barriers on stale state.  Called by ``build()`` before any task
+        runs.  Every process clears every file (not just its own): hosts start
+        with arbitrary skew, and a fast peer must not trip over a slow peer's
+        leftover abort before that peer's own build() has begun.  The tiny
+        race with a *fresh* abort written concurrently degrades to the barrier
+        timeout — recoverable — whereas stale flags would fail every resume."""
+        for target in self._all_status_targets():
+            status = target.read()
+            if status.get("aborted"):
+                status.pop("aborted", None)
+                status.pop("error", None)
+                target.write(status)
 
-    def _owns_status(self, pid: int) -> bool:
-        return pid == 0  # SimpleTask statuses are shared; p0 runs/owns them
+    def _all_status_targets(self):
+        return [self.output()]
 
     def run(self) -> None:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -281,8 +283,8 @@ class BlockTask(Task):
     # disk before this process stamps complete — so the local DAG runner can
     # proceed without waiting for peers' bookkeeping to catch up.
 
-    def _owns_status(self, pid: int) -> bool:
-        return True  # block-task statuses are per-process
+    def _all_status_targets(self):
+        return self.peer_outputs()
 
     def get_shape(self) -> Sequence[int]:  # pragma: no cover - abstract
         raise NotImplementedError

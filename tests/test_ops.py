@@ -166,6 +166,46 @@ class TestWatershed:
             _, n = ndimage.label(labels == i)
             assert n == 1
 
+    def test_cc_sweep_and_propagate_agree(self, rng):
+        """Sweep-based CC (TPU path) must match neighbor-propagation CC and
+        the scipy oracle across connectivities and modes."""
+        import jax
+
+        from cluster_tools_tpu.ops import _backend
+        from cluster_tools_tpu.ops import cc as C
+
+        mask = rng.random((10, 20, 20)) > 0.55
+        results = {}
+        for mode in ("seq", "assoc"):
+            _backend.FORCE_SWEEP_MODE = mode
+            jax.clear_caches()
+            try:
+                for conn in (1, 3):
+                    for per_slice in (False, True):
+                        labels, n = C.connected_components(
+                            jnp.asarray(mask), connectivity=conn,
+                            per_slice=per_slice,
+                        )
+                        results[(mode, conn, per_slice)] = (
+                            np.asarray(labels), int(n)
+                        )
+            finally:
+                _backend.FORCE_SWEEP_MODE = None
+                jax.clear_caches()
+        for key in [k for k in results if k[0] == "seq"]:
+            got, n_got = results[("assoc",) + key[1:]]
+            want, n_want = results[key]
+            np.testing.assert_array_equal(got, want)
+            assert n_got == n_want
+        # oracle
+        want, n_want = C.connected_components_np(mask, connectivity=1)
+        got, n_got = results[("assoc", 1, False)]
+        assert n_got == n_want
+        pairs = np.unique(
+            np.stack([got[mask], want[mask]], axis=1), axis=0
+        )
+        assert len(pairs) == n_want
+
     def test_assoc_and_seq_sweeps_agree(self, rng):
         """The associative-scan sweep pair (TPU default) must compute the same
         fixpoint as the sequential lax.scan pair (CPU default): both evaluate
@@ -173,6 +213,7 @@ class TestWatershed:
         sequentially."""
         import jax
 
+        from cluster_tools_tpu.ops import _backend
         from cluster_tools_tpu.ops import watershed as W
 
         h = rng.random((10, 24, 24)).astype(np.float32)
@@ -183,7 +224,7 @@ class TestWatershed:
         seeds[~mask] = 0
         results = {}
         for mode in ("seq", "assoc"):
-            W._FORCE_SWEEP_MODE = mode
+            _backend.FORCE_SWEEP_MODE = mode
             jax.clear_caches()
             try:
                 for per_slice in (False, True):
@@ -194,7 +235,7 @@ class TestWatershed:
                         )
                     )
             finally:
-                W._FORCE_SWEEP_MODE = None
+                _backend.FORCE_SWEEP_MODE = None
                 jax.clear_caches()
         for per_slice in (False, True):
             np.testing.assert_array_equal(

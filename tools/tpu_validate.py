@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""One-shot TPU validation of the backend-dependent kernel choices.
+
+The flood and CC kernels pick between log-depth ``lax.associative_scan``
+sweeps and sequential ``lax.scan`` / neighbor propagation by backend
+(assoc on TPU, seq on CPU) — equivalence is CPU-tested, but the *perf* of
+the assoc path needs real hardware.  Run this when the chip is reachable:
+
+    python tools/tpu_validate.py
+
+It times both modes for the flood and CC, the fused DT-watershed, and the
+device RAG kernel, prints a table, and writes tools/tpu_validate.json.
+Exactly one jax-on-axon process may run at a time (see the memory note on
+tunnel fragility) — run nothing else against the chip concurrently.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+from scipy import ndimage
+
+
+def timeit(fn, sync, repeats=3):
+    r = fn()
+    sync(r)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        r = fn()
+        sync(r)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+    results = {"backend": jax.default_backend()}
+
+    rng = np.random.default_rng(0)
+    shape = (32, 256, 256)
+    raw = ndimage.gaussian_filter(rng.random(shape), (1.0, 4.0, 4.0))
+    raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype(np.float32)
+    x = jnp.asarray(raw)
+
+    # -- flood + CC: assoc vs seq -------------------------------------------
+    from cluster_tools_tpu.ops import _backend
+    from cluster_tools_tpu.ops import cc as C
+    from cluster_tools_tpu.ops.watershed import dt_watershed
+
+    for mode in ("assoc", "seq"):
+        _backend.FORCE_SWEEP_MODE = mode
+        jax.clear_caches()
+        t = timeit(
+            lambda: dt_watershed(x, threshold=0.5),
+            lambda r: r[0].block_until_ready(),
+        )
+        results[f"dtws_{mode}_ms"] = round(t * 1e3, 1)
+        print(f"dt_watershed[{mode}]: {t*1e3:.1f} ms "
+              f"({x.size/t/1e6:.1f} Mvox/s)")
+        mask = jnp.asarray(raw < 0.5)
+        t = timeit(
+            lambda: C.connected_components(mask),
+            lambda r: r[0].block_until_ready(),
+        )
+        results[f"cc_{mode}_ms"] = round(t * 1e3, 1)
+        print(f"connected_components[{mode}]: {t*1e3:.1f} ms")
+    _backend.FORCE_SWEEP_MODE = None
+    jax.clear_caches()
+
+    # -- device RAG kernel vs numpy -----------------------------------------
+    from cluster_tools_tpu import native
+    from cluster_tools_tpu.ops import rag
+
+    labels, _ = native.dt_watershed_cpu(raw, threshold=0.5)
+    lab_d = jnp.asarray(labels.astype(np.int32))
+    t_dev = timeit(
+        lambda: rag.boundary_edge_features_device(lab_d, x, max_edges=65536),
+        lambda r: r[0].block_until_ready(),
+    )
+    t0 = time.perf_counter()
+    rag.boundary_edge_features(labels.astype(np.uint64), raw)
+    t_host = time.perf_counter() - t0
+    results["rag_device_ms"] = round(t_dev * 1e3, 1)
+    results["rag_numpy_ms"] = round(t_host * 1e3, 1)
+    print(f"rag device: {t_dev*1e3:.1f} ms, numpy: {t_host*1e3:.1f} ms")
+
+    # -- verdicts ------------------------------------------------------------
+    results["flood_assoc_wins"] = results["dtws_assoc_ms"] < results["dtws_seq_ms"]
+    results["cc_assoc_wins"] = results["cc_assoc_ms"] < results["cc_seq_ms"]
+    results["rag_device_wins"] = results["rag_device_ms"] < results["rag_numpy_ms"]
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tpu_validate.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results))
+    print(f"-> {out}")
+    if not results["flood_assoc_wins"] or not results["cc_assoc_wins"]:
+        print("NOTE: an assoc path lost on this backend — consider flipping "
+              "the default in _use_assoc() for it.")
+
+
+if __name__ == "__main__":
+    main()
