@@ -73,6 +73,23 @@ def timeit(fn, repeats, *, sync=None):
 # ---------------------------------------------------------------------------
 
 
+def _best_sweep_mode(measure):
+    """Measure a kernel under both sweep modes (the assoc-vs-seq choice of
+    ops/_backend.py is backend-perf-dependent) and return
+    ``(best_seconds, best_mode, {mode: seconds})``.  The winning mode is an
+    achievable production configuration (pin it with CTT_SWEEP_MODE=<mode>)
+    and is reported alongside what the unpinned default would pick — bench is
+    self-tuning but transparent."""
+    from cluster_tools_tpu.ops import _backend
+
+    times = {}
+    for mode in ("assoc", "seq"):
+        with _backend.force_sweep_mode(mode):
+            times[mode] = measure()
+    best = min(times, key=times.get)
+    return times[best], best, times
+
+
 def bench_dtws(x, repeats):
     """Fused device DT-watershed vs single-core C++ (native.dt_watershed_cpu)."""
     import jax
@@ -82,20 +99,31 @@ def bench_dtws(x, repeats):
     from cluster_tools_tpu.ops.watershed import dt_watershed
 
     xd = jax.device_put(jnp.asarray(x))
-    t_dev = timeit(
-        lambda: dt_watershed(xd, threshold=0.5),
-        repeats,
-        sync=lambda r: r[0].block_until_ready(),
+    t_dev, mode, times = _best_sweep_mode(
+        lambda: timeit(
+            lambda: dt_watershed(xd, threshold=0.5),
+            repeats,
+            sync=lambda r: r[0].block_until_ready(),
+        )
     )
     t_host = timeit(
         lambda: native.dt_watershed_cpu(x, threshold=0.5), max(repeats // 2, 1)
     )
     mvox = x.size / t_dev / 1e6
     log(
-        f"[dtws] device {t_dev*1e3:.1f} ms ({mvox:.1f} Mvox/s)  "
+        f"[dtws] device {t_dev*1e3:.1f} ms ({mvox:.1f} Mvox/s, sweep={mode}, "
+        f"assoc {times['assoc']*1e3:.1f} / seq {times['seq']*1e3:.1f} ms)  "
         f"C++ 1-core {t_host*1e3:.1f} ms ({x.size/t_host/1e6:.1f} Mvox/s)"
     )
-    return mvox, t_host / t_dev
+    from cluster_tools_tpu.ops import _backend
+
+    extra = {
+        "dtws_sweep_mode": mode,
+        "dtws_default_mode": "assoc" if _backend.use_assoc() else "seq",
+        "dtws_assoc_ms": round(times["assoc"] * 1e3, 1),
+        "dtws_seq_ms": round(times["seq"] * 1e3, 1),
+    }
+    return mvox, t_host / t_dev, extra
 
 
 def bench_dtws_batched(x, batch, repeats):
@@ -106,10 +134,17 @@ def bench_dtws_batched(x, batch, repeats):
     from cluster_tools_tpu.ops.watershed import dt_watershed
 
     xs = jnp.stack([jnp.asarray(x)] * batch)
-    fn = jax.jit(jax.vmap(lambda v: dt_watershed(v, threshold=0.5)[0]))
-    t = timeit(lambda: fn(xs), repeats, sync=lambda r: r.block_until_ready())
+
+    def measure():
+        fn = jax.jit(jax.vmap(lambda v: dt_watershed(v, threshold=0.5)[0]))
+        return timeit(
+            lambda: fn(xs), repeats, sync=lambda r: r.block_until_ready()
+        )
+
+    t, mode, _ = _best_sweep_mode(measure)
     mvox = batch * x.size / t / 1e6
-    log(f"[dtws_batched x{batch}] {t*1e3:.1f} ms ({mvox:.1f} Mvox/s)")
+    log(f"[dtws_batched x{batch}] {t*1e3:.1f} ms ({mvox:.1f} Mvox/s, "
+        f"sweep={mode})")
     return mvox
 
 
@@ -121,15 +156,17 @@ def bench_cc(x, repeats):
 
     mask_np = x < 0.5
     mask = jnp.asarray(mask_np)
-    t_dev = timeit(
-        lambda: connected_components(mask, connectivity=1),
-        repeats,
-        sync=lambda r: r[0].block_until_ready(),
+    t_dev, mode, times = _best_sweep_mode(
+        lambda: timeit(
+            lambda: connected_components(mask, connectivity=1),
+            repeats,
+            sync=lambda r: r[0].block_until_ready(),
+        )
     )
     t_host = timeit(lambda: ndimage.label(mask_np), max(repeats // 2, 1))
     mvox = x.size / t_dev / 1e6
     log(
-        f"[cc] device {t_dev*1e3:.1f} ms ({mvox:.1f} Mvox/s)  "
+        f"[cc] device {t_dev*1e3:.1f} ms ({mvox:.1f} Mvox/s, sweep={mode})  "
         f"scipy 1-core {t_host*1e3:.1f} ms"
     )
     return mvox, t_host / t_dev
@@ -335,7 +372,8 @@ def main():
     value, vs = None, None
 
     if want("dtws"):
-        value, vs = bench_dtws(make_volume(block), args.repeats)
+        value, vs, dtws_extra = bench_dtws(make_volume(block), args.repeats)
+        extra.update(dtws_extra)
     if want("batched"):
         extra["dtws_batched_mvox_s"] = round(
             bench_dtws_batched(make_volume(block), batch, args.repeats), 3

@@ -3,19 +3,47 @@
 The flood (ops/watershed.py) and connected-components (ops/cc.py) sweeps both
 choose between ``lax.associative_scan`` (log-depth, full-array work — wins on
 dispatch/latency-bound TPUs) and sequential carry chains (O(n) work — wins on
-work-bound XLA-CPU).  One switch keeps the two kernels on the same path;
-tools/tpu_validate.py measures both on real hardware.
+work-bound XLA-CPU).  One switch keeps the two kernels on the same path:
+
+  * default: by backend (assoc off-cpu, seq on cpu);
+  * ``CTT_SWEEP_MODE=assoc|seq`` pins the choice for production runs (the
+    supported way to deploy whichever mode bench/tpu_validate measured best);
+  * ``force_sweep_mode(mode)`` scopes an override for tests and benchmarks,
+    owning both the restore and the jit-cache invalidation.
 """
 
 from __future__ import annotations
 
-# None = pick by backend; tests/benchmarks override to "assoc" / "seq"
+import os
+from contextlib import contextmanager
+
+# None = pick by env/backend; force_sweep_mode() overrides within a scope
 FORCE_SWEEP_MODE = None
 
 
 def use_assoc() -> bool:
     if FORCE_SWEEP_MODE is not None:
         return FORCE_SWEEP_MODE == "assoc"
+    env = os.environ.get("CTT_SWEEP_MODE")
+    if env in ("assoc", "seq"):
+        return env == "assoc"
     import jax
 
     return jax.default_backend() != "cpu"
+
+
+@contextmanager
+def force_sweep_mode(mode):
+    """Scoped sweep-mode override: sets the switch, clears jit caches (traces
+    bake the mode in), and restores + clears on exit even on error."""
+    global FORCE_SWEEP_MODE
+    import jax
+
+    prev = FORCE_SWEEP_MODE
+    FORCE_SWEEP_MODE = mode
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        FORCE_SWEEP_MODE = prev
+        jax.clear_caches()

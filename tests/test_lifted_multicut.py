@@ -185,11 +185,14 @@ def cells_with_classes(tmp_path, rng):
     return path, bnd, gt, classes
 
 
-def test_lifted_segmentation_workflow(tmp_path, cells_with_classes):
+@pytest.mark.parametrize("target", ["local", "tpu"])
+def test_lifted_segmentation_workflow(tmp_path, cells_with_classes, target):
     path, bnd, gt, classes = cells_with_classes
-    config_dir = str(tmp_path / "configs")
-    tmp_folder = str(tmp_path / "tmp")
-    cfg.write_global_config(config_dir, {"block_shape": [12, 24, 24]})
+    config_dir = str(tmp_path / f"configs_{target}")
+    tmp_folder = str(tmp_path / f"tmp_{target}")
+    cfg.write_global_config(
+        config_dir, {"block_shape": [12, 24, 24], "target": target}
+    )
     cfg.write_config(
         config_dir, "watershed",
         {"threshold": 0.4, "sigma_seeds": 1.6, "size_filter": 10,

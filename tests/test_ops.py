@@ -177,9 +177,7 @@ class TestWatershed:
         mask = rng.random((10, 20, 20)) > 0.55
         results = {}
         for mode in ("seq", "assoc"):
-            _backend.FORCE_SWEEP_MODE = mode
-            jax.clear_caches()
-            try:
+            with _backend.force_sweep_mode(mode):
                 for conn in (1, 3):
                     for per_slice in (False, True):
                         labels, n = C.connected_components(
@@ -189,9 +187,6 @@ class TestWatershed:
                         results[(mode, conn, per_slice)] = (
                             np.asarray(labels), int(n)
                         )
-            finally:
-                _backend.FORCE_SWEEP_MODE = None
-                jax.clear_caches()
         for key in [k for k in results if k[0] == "seq"]:
             got, n_got = results[("assoc",) + key[1:]]
             want, n_want = results[key]
@@ -224,9 +219,7 @@ class TestWatershed:
         seeds[~mask] = 0
         results = {}
         for mode in ("seq", "assoc"):
-            _backend.FORCE_SWEEP_MODE = mode
-            jax.clear_caches()
-            try:
+            with _backend.force_sweep_mode(mode):
                 for per_slice in (False, True):
                     results[(mode, per_slice)] = np.asarray(
                         W.seeded_watershed(
@@ -234,9 +227,6 @@ class TestWatershed:
                             mask=jnp.asarray(mask), per_slice=per_slice,
                         )
                     )
-            finally:
-                _backend.FORCE_SWEEP_MODE = None
-                jax.clear_caches()
         for per_slice in (False, True):
             np.testing.assert_array_equal(
                 results[("seq", per_slice)], results[("assoc", per_slice)]

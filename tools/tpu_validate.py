@@ -56,8 +56,7 @@ def main():
     from cluster_tools_tpu.ops.watershed import dt_watershed
 
     for mode in ("assoc", "seq"):
-        _backend.FORCE_SWEEP_MODE = mode
-        jax.clear_caches()
+      with _backend.force_sweep_mode(mode):
         t = timeit(
             lambda: dt_watershed(x, threshold=0.5),
             lambda r: r[0].block_until_ready(),
@@ -72,8 +71,6 @@ def main():
         )
         results[f"cc_{mode}_ms"] = round(t * 1e3, 1)
         print(f"connected_components[{mode}]: {t*1e3:.1f} ms")
-    _backend.FORCE_SWEEP_MODE = None
-    jax.clear_caches()
 
     # -- device RAG kernel vs numpy -----------------------------------------
     from cluster_tools_tpu import native
