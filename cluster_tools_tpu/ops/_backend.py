@@ -1,9 +1,10 @@
 """Shared backend-mode switch for the log-depth sweep kernels.
 
-The flood (ops/watershed.py) and connected-components (ops/cc.py) sweeps both
-choose between ``lax.associative_scan`` (log-depth, full-array work — wins on
-dispatch/latency-bound TPUs) and sequential carry chains (O(n) work — wins on
-work-bound XLA-CPU).  One switch keeps the two kernels on the same path:
+The flood (ops/watershed.py), connected-components (ops/cc.py), and EDT line
+scans (ops/dt.py) all choose between log-depth formulations
+(``lax.associative_scan`` / ``lax.cummax`` — win on dispatch/latency-bound
+TPUs) and sequential carry chains (O(n) work — win on work-bound XLA-CPU).
+One switch keeps every kernel on the same path:
 
   * default: by backend (assoc off-cpu, seq on cpu);
   * ``CTT_SWEEP_MODE=assoc|seq`` pins the choice for production runs (the

@@ -26,22 +26,39 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from . import _backend
+
 # numpy scalar, NOT jnp: a module-level jnp constant would initialize the
 # device backend at import time (see ops/watershed.py)
 _BIG = np.float32(1e10)
 
 
 def _line_scan_distance(bg: jnp.ndarray, pitch: float) -> jnp.ndarray:
-    """Exact 1d distance (in `pitch` units) to the nearest True along the last axis."""
+    """Exact 1d distance (in `pitch` units) to the nearest True along the last
+    axis.  On dispatch-bound backends the directional distance is index
+    arithmetic over one native ``lax.cummax``:
+    d_i = pitch · (i − max_{j ≤ i, bg_j} j) — log depth, one array through the
+    scan.  Work-bound XLA-CPU keeps the sequential ``lax.scan``
+    (ops/_backend.py picks)."""
+    if _backend.use_assoc():
 
-    def directional(b):
-        def step(carry, is_bg):
-            d = jnp.where(is_bg, 0.0, carry + pitch)
-            return d, d
+        def directional(b):
+            n = b.shape[-1]
+            iota = jnp.arange(n, dtype=jnp.float32)
+            # index of the nearest True at or before i (-BIG when none yet)
+            last_bg = lax.cummax(jnp.where(b, iota, -_BIG), axis=b.ndim - 1)
+            return jnp.minimum((iota - last_bg) * pitch, _BIG)
 
-        init = jnp.full(b.shape[:-1], _BIG, dtype=jnp.float32)
-        _, ds = lax.scan(step, init, jnp.moveaxis(b, -1, 0))
-        return jnp.moveaxis(ds, 0, -1)
+    else:
+
+        def directional(b):
+            def step(carry, is_bg):
+                d = jnp.where(is_bg, 0.0, carry + pitch)
+                return d, d
+
+            init = jnp.full(b.shape[:-1], _BIG, dtype=jnp.float32)
+            _, ds = lax.scan(step, init, jnp.moveaxis(b, -1, 0))
+            return jnp.moveaxis(ds, 0, -1)
 
     fwd = directional(bg)
     bwd = jnp.flip(directional(jnp.flip(bg, -1)), -1)

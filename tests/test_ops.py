@@ -381,3 +381,24 @@ class TestUnionFind:
         a, n_new = merge_assignments_device(5, np.zeros((0, 2), dtype=np.int64))
         np.testing.assert_array_equal(a, [0, 1, 2, 3, 4])
         assert n_new == 4
+
+
+class TestDTSweepModes:
+    @pytest.mark.parametrize("pitch", [None, (3.0, 1.0, 2.0)])
+    def test_line_scan_assoc_matches_seq(self, rng, pitch):
+        """The log-depth EDT line scan must equal the sequential one,
+        including anisotropic pitch (pitch enters the assoc index
+        arithmetic)."""
+        from cluster_tools_tpu.ops import _backend
+        from cluster_tools_tpu.ops.dt import distance_transform
+
+        fg = rng.random((8, 20, 20)) > 0.3
+        results = {}
+        for mode in ("seq", "assoc"):
+            with _backend.force_sweep_mode(mode):
+                results[mode] = np.asarray(
+                    distance_transform(jnp.asarray(fg), pixel_pitch=pitch)
+                )
+        np.testing.assert_allclose(results["seq"], results["assoc"], atol=1e-4)
+        want = ndimage.distance_transform_edt(fg, sampling=pitch)
+        np.testing.assert_allclose(results["assoc"], want, atol=1e-3)
