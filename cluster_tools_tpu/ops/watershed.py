@@ -10,17 +10,20 @@ of the seed reachable with the lexicographically smallest path cost
     ( pass height = max h along the path,  hop count,  seed label )
 
 The default 6-connectivity path runs *directional raster sweeps* (the chamfer /
-Gauss–Seidel scheme): ``lax.scan`` relaxes plane-by-plane along ±z, ±y, ±x, so
-each sweep carries flood fronts across the whole axis instead of one voxel —
-the outer ``lax.while_loop`` then converges in O(#bends of the steepest path)
-rounds (typically < 10) instead of O(longest flood path) sweeps.  Monotone
-label-correcting relaxation is exact: every state is witnessed by a real path
-from a seed (induction over updates), states only decrease, and the unique
-fixpoint is the lexicographic minimum over all paths — the same fixpoint the
-neighbor-sweep kernel (``_seeded_watershed_sweep``, kept for connectivity > 1)
-reaches.  Ties resolve to the smaller label id; voxel-exact boundaries can
-differ from vigra's sequential flood order, which is why parity is defined on
-Rand/VoI, not voxel equality (SURVEY.md §7 #1).
+Gauss–Seidel scheme) along ±z, ±y, ±x, so each sweep carries flood fronts
+across the whole axis instead of one voxel — the outer ``lax.while_loop`` then
+converges in O(#bends of the steepest path) rounds (typically < 10) instead of
+O(longest flood path) sweeps.  Each sweep's carry chain evaluates either
+sequentially (``lax.scan``, work-bound backends) or in log depth
+(``lax.associative_scan`` over closed transfer-function compositions,
+dispatch-bound TPUs) — ops/_backend.py picks, both compute the identical
+fixpoint (tested).  Monotone label-correcting relaxation is exact: every state
+is witnessed by a real path from a seed (induction over updates), states only
+decrease, and the unique fixpoint is the lexicographic minimum over all paths —
+the same fixpoint the neighbor-sweep kernel (``_seeded_watershed_sweep``, kept
+for connectivity > 1) reaches.  Ties resolve to the smaller label id;
+voxel-exact boundaries can differ from vigra's sequential flood order, which is
+why parity is defined on Rand/VoI, not voxel equality (SURVEY.md §7 #1).
 """
 
 from __future__ import annotations
