@@ -564,6 +564,109 @@ def dt_watershed(
     return labels, n_seeds
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "threshold",
+        "apply_dt_2d",
+        "apply_ws_2d",
+        "pixel_pitch",
+        "sigma_seeds",
+        "sigma_weights",
+        "alpha",
+        "size_filter",
+        "invert_input",
+        "non_maximum_suppression",
+        "num_segments",
+    ),
+)
+def two_pass_flood(
+    input_: jnp.ndarray,
+    written: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    threshold: float = 0.25,
+    apply_dt_2d: bool = True,
+    apply_ws_2d: bool = True,
+    pixel_pitch: Optional[Tuple[float, ...]] = None,
+    sigma_seeds: float = 2.0,
+    sigma_weights: float = 2.0,
+    alpha: float = 0.8,
+    size_filter: int = 25,
+    invert_input: bool = False,
+    non_maximum_suppression: bool = False,
+    num_segments: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pass 2 of the checkerboard two-pass watershed as one fused XLA program
+    (reference two_pass_watershed.py:96-99 + ``_apply_watershed_with_seeds``,
+    watershed.py:128).
+
+    ``written`` carries the already-written pass-1 neighbor labels compacted to
+    1..k (0 = unwritten); this block's own DT seeds are appended *above* k on
+    device, so the per-block seed count never becomes a static trace value —
+    one compile serves every block, and the whole pass-2 pipeline (threshold →
+    DT → seeds → hmap → flood → size filter) is a single dispatch, vmappable
+    over a stacked block batch.  Returns ``(labels, k)``: flood labels where
+    1..k continue written neighbor ids and values > k are new seeds in the
+    block's own namespace (the host maps both back to global ids).
+
+    ``num_segments`` (static) bounds the size-filter bincount length; the
+    caller can pass a tight bound (own-seed CC ids ≤ N/2 plus written halo-
+    shell voxels), default is the always-safe 2·N + 2.
+    """
+    from .dt import _distance_transform, distance_transform_2d_stack
+
+    if pixel_pitch is not None and apply_dt_2d:
+        # mirror dt_watershed / the reference assertion (watershed.py:149-153)
+        raise ValueError("pixel_pitch requires apply_dt_2d=False")
+
+    x = input_.astype(jnp.float32)
+    if invert_input:
+        x = 1.0 - x
+    fg = x < threshold
+    if mask is not None:
+        # reference pass-2 masking (two_pass_watershed.py:236-241):
+        # masked-out input is set above threshold = background for the DT
+        fg = fg & mask.astype(bool)
+
+    if apply_dt_2d and x.ndim == 3:
+        dt = distance_transform_2d_stack(fg, pixel_pitch=None)
+    else:
+        dt = _distance_transform(fg, pixel_pitch)
+
+    per_slice = apply_ws_2d and x.ndim == 3
+    written = written.astype(jnp.int32)
+    k = written.max()
+    if per_slice:
+        # 2d path parity: no own maxima at written voxels — the reference
+        # zeroes the dt there before seed-making AND hmap construction
+        # (two_pass_watershed.py:144-146)
+        dt = jnp.where(written > 0, 0.0, dt)
+    own_seeds, _ = dt_seeds(
+        dt, sigma_seeds, per_slice=per_slice,
+        nms=non_maximum_suppression, pixel_pitch=pixel_pitch,
+    )
+    seeds = jnp.where(
+        written > 0, written, jnp.where(own_seeds > 0, own_seeds + k, 0)
+    )
+    hmap = make_hmap(x, dt, alpha, sigma_weights, per_slice=per_slice)
+    labels = seeded_watershed(hmap, seeds, mask=fg, per_slice=per_slice)
+    if size_filter > 0:
+        if num_segments is None:
+            # always-safe bound: k ≤ #written voxels and #own seeds ≤ #fg
+            # voxels, which may overlap — labels ≥ the bincount length would
+            # be silently dropped (= wrongly size-filtered)
+            num_segments = 2 * int(np.prod(x.shape)) + 2
+        # written (initial-seed) regions are exempt from the size filter —
+        # continuation labels must survive however small their overlap with
+        # this block is (reference run_watershed ``exclude=initial_seed_ids``,
+        # two_pass_watershed.py:166-167,205-209)
+        labels = apply_size_filter(
+            labels, hmap, size_filter, num_segments, mask=fg,
+            per_slice=per_slice, protect_upto=k,
+        )
+    return labels, k
+
+
 @partial(jax.jit, static_argnames=("alpha", "sigma", "per_slice"))
 def make_hmap(
     input_: jnp.ndarray,
@@ -595,15 +698,20 @@ def apply_size_filter(
     mask: Optional[jnp.ndarray] = None,
     connectivity: int = 1,
     per_slice: bool = False,
+    protect_upto: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Remove segments smaller than ``size_filter`` voxels and re-flood the freed
     voxels from the surviving segments (reference ``_apply_watershed``
     size-filter step, watershed.py:242-250).
 
     ``num_segments`` is the *exclusive* upper bound on label values, i.e.
-    max_label + 1 (pass ``n + 1`` for labels 1..n from dt_seeds)."""
+    max_label + 1 (pass ``n + 1`` for labels 1..n from dt_seeds).
+    ``protect_upto`` (traced scalar) exempts labels ≤ it from the filter
+    (the reference ``exclude=`` seam for two-pass continuation labels)."""
     counts = jnp.bincount(labels.reshape(-1), length=num_segments)
     too_small = counts[labels] < size_filter
+    if protect_upto is not None:
+        too_small = too_small & (labels > protect_upto)
     kept = jnp.where(too_small, 0, labels)
     return seeded_watershed(
         hmap, kept, mask=mask, connectivity=connectivity, per_slice=per_slice

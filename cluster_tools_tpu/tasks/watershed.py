@@ -50,6 +50,22 @@ def _read_input_block(ds, bb, config):
     return _normalize_host(ds[bb])
 
 
+def _pad_block(arr: np.ndarray, full_shape, mode: str = "edge") -> np.ndarray:
+    """Pad a clipped edge-block read up to the static batch shape.
+
+    ``mode='edge'`` (data, masks) replicates border values — constant
+    background padding would inject fake boundaries into the distance
+    transform at volume borders (the reference reads clipped arrays and lets
+    vigra reflect at edges).  Label/seed arrays pad with zeros instead
+    (``mode='zero'``): replicated labels would invent seeds."""
+    pad = [(0, fs - s) for fs, s in zip(full_shape, arr.shape)]
+    if not any(p for _, p in pad):
+        return arr
+    if mode == "zero":
+        return np.pad(arr, pad)
+    return np.pad(arr, pad, mode=mode)
+
+
 def _normalize_host(data: np.ndarray) -> np.ndarray:
     """uint8/uint16 → [0,1] by dtype range; other dtypes cast to float32
     (integer boundary maps would otherwise be thresholded meaninglessly)."""
@@ -117,11 +133,11 @@ class WatershedTask(VolumeTask):
         if not self.mask_path:
             return None
         mask_ds = store.file_reader(self.mask_path, "r")[self.mask_key]
-        out = np.zeros(batch.data.shape, dtype=bool)
-        for i, bh in enumerate(batch.blocks):
-            m = mask_ds[bh.outer.slicing].astype(bool)
-            out[i][tuple(slice(0, s) for s in m.shape)] = m
-        return out
+        full_shape = batch.data.shape[1:]
+        return np.stack([
+            _pad_block(mask_ds[bh.outer.slicing].astype(bool), full_shape)
+            for bh in batch.blocks
+        ])
 
     def _run_batch(self, block_ids: List[int], blocking: Blocking, config):
         in_ds = self.input_ds()
@@ -134,15 +150,10 @@ class WatershedTask(VolumeTask):
         full_shape = tuple(
             bs + 2 * h for bs, h in zip(blocking.block_shape, halo)
         )
-        # padding must land on the background side of the threshold AFTER the
-        # kernel's optional inversion
-        pad_value = 0.0 if params["invert_input"] else 1.0
         for bid in block_ids:
             bh = blocking.block_with_halo(bid, halo)
             arr = _read_input_block(in_ds, bh.outer.slicing, config)
-            pad = [(0, fs - s) for fs, s in zip(full_shape, arr.shape)]
-            arr = np.pad(arr, pad, constant_values=pad_value)
-            datas.append(arr)
+            datas.append(_pad_block(arr, full_shape))
             blocks.append(bh)
         batch_arr = np.stack(datas)
 
@@ -382,7 +393,13 @@ class TwoPassWatershedTask(WatershedTask):
     def _run_batch(self, block_ids, blocking, config):
         if self.pass_id == 0:
             return super()._run_batch(block_ids, blocking, config)
-        # pass 2: flood from written pass-1 labels in the halo + own seeds
+        # pass 2: flood from written pass-1 labels in the halo + own seeds.
+        # Blocks of one checkerboard color are independent, so the whole device
+        # part (threshold → DT → seeds → flood → size filter) is ONE fused
+        # kernel (ops.watershed.two_pass_flood) vmapped over the stacked batch;
+        # only the global↔compact id mapping stays on the host.  Written ids
+        # are compacted to 1..k per block so the device arrays stay int32-safe
+        # and no per-block count leaks into the trace as a static value.
         in_ds = self.input_ds()
         out_ds = self.output_ds()
         halo = config.get("halo") or [0, 0, 0]
@@ -395,61 +412,58 @@ class TwoPassWatershedTask(WatershedTask):
         offset_unit = int(np.prod(blocking.block_shape))
         max_ids = self.tmp_ragged(MAX_IDS_KEY, blocking.n_blocks, np.int64)
 
+        full_shape = tuple(
+            bs + 2 * h for bs, h in zip(blocking.block_shape, halo)
+        )
+        xs, compacts, uniqs, blocks = [], [], [], []
         for bid in block_ids:
             bh = blocking.block_with_halo(bid, halo)
             x = _read_input_block(in_ds, bh.outer.slicing, config)
-            if params["invert_input"]:
-                x = 1.0 - x
             written = out_ds[bh.outer.slicing].astype(np.int64)
-            fg = x < params["threshold"]
-            per_slice = params["apply_ws_2d"] and x.ndim == 3
-            from ..ops.dt import distance_transform, distance_transform_2d_stack
-
-            dt = (
-                distance_transform_2d_stack(jnp.asarray(fg))
-                if params["apply_dt_2d"]
-                else distance_transform(
-                    jnp.asarray(fg), pixel_pitch=params["pixel_pitch"]
-                )
-            )
-            own_seeds, n_own = ws_ops.dt_seeds(
-                dt, params["sigma_seeds"], per_slice=per_slice
-            )
-            own_seeds = np.asarray(own_seeds).astype(np.int64)
-            # flood over COMPACT ids so the device kernels stay int32-safe and
-            # size-filter bincounts stay small: written global ids map to 1..k,
-            # own new seeds to k+1..k+n; mapped back after the flood
             uniq_written = np.unique(written)
             uniq_written = uniq_written[uniq_written > 0]
-            k = uniq_written.size
             compact = np.searchsorted(uniq_written, written) + 1
-            compact = np.where(written > 0, compact, 0)
-            seeds = np.where(
-                compact > 0, compact, np.where(own_seeds > 0, own_seeds + k, 0)
+            compact = np.where(written > 0, compact, 0).astype(np.int32)
+            xs.append(_pad_block(x, full_shape))
+            compacts.append(_pad_block(compact, full_shape, mode="zero"))
+            uniqs.append(uniq_written)
+            blocks.append(bh)
+
+        from ..parallel.dispatch import BlockBatch
+
+        batch_arr = np.stack(xs)
+        batch = BlockBatch(
+            data=batch_arr, valid=None, blocks=blocks, block_ids=list(block_ids)
+        )
+        mask = self._load_mask_batch(batch)
+
+        # tight size-filter bincount bound: own-seed CC ids are consecutive
+        # (≤ N/2) and written ids only occupy the halo shell (pass-1 neighbors
+        # write disjoint inner boxes)
+        n_outer = int(np.prod(full_shape))
+        shell = n_outer - int(np.prod(blocking.block_shape))
+        kernel = partial(
+            ws_ops.two_pass_flood,
+            num_segments=n_outer // 2 + shell + 2,
+            **params,
+        )
+        xb, n_real = put_sharded(batch_arr, config)
+        wb, _ = put_sharded(np.stack(compacts), config)
+        if mask is None:
+            labels, _ = jax.vmap(lambda x, w: kernel(x, w))(xb, wb)
+        else:
+            mb, _ = put_sharded(mask, config)
+            labels, _ = jax.vmap(lambda x, w, m: kernel(x, w, mask=m))(
+                xb, wb, mb
             )
-            hmap = ws_ops.make_hmap(
-                jnp.asarray(x), dt, params["alpha"], params["sigma_weights"],
-                per_slice=per_slice,
-            )
-            labels = ws_ops.seeded_watershed(
-                hmap,
-                jnp.asarray(seeds.astype(np.int32)),
-                mask=jnp.asarray(fg),
-                per_slice=per_slice,
-            )
-            if params["size_filter"] > 0:
-                labels = ws_ops.apply_size_filter(
-                    labels,
-                    hmap,
-                    params["size_filter"],
-                    int(k + np.asarray(own_seeds).max() + 2),
-                    mask=jnp.asarray(fg),
-                    per_slice=per_slice,
-                )
-            labels = np.asarray(labels).astype(np.int64)
-            lab = labels[bh.inner_local.slicing]
-            # map back: 1..k → written global ids, k+1.. → this block's namespace
-            lookup = np.concatenate([[0], uniq_written])
+        labels = np.asarray(labels).astype(np.int64)[:n_real]
+
+        for i, bid in enumerate(block_ids):
+            bh = blocks[i]
+            k = uniqs[i].size
+            lab = labels[i][bh.inner_local.slicing]
+            # map back: 1..k → written global ids, k+1.. → block's namespace
+            lookup = np.concatenate([[0], uniqs[i]])
             is_written = lab <= k
             written_part = lookup[np.where(is_written, lab, 0)]
             new_part = lab - k + bid * offset_unit

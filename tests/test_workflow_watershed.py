@@ -125,6 +125,36 @@ def test_two_pass_boundary_consistency(tmp_path, boundary_volume, target):
     assert cross_boundary_agreement(ws_two) > 0.5
 
 
+def test_two_pass_with_mask(tmp_path, boundary_volume, rng):
+    # pass-2 blocks must respect the mask exactly like pass-1 blocks do —
+    # otherwise masked regions get checkerboard-patterned spurious labels
+    path, raw = boundary_volume
+    f = file_reader(path)
+    mask = np.zeros(raw.shape, dtype="uint8")
+    mask[:, :24, :] = 1
+    f.create_dataset("mask", data=mask, chunks=(12, 24, 24))
+    config_dir = str(tmp_path / "configs_tpmask")
+    tmp_folder = str(tmp_path / "tmp_tpmask")
+    cfg.write_global_config(config_dir, {"block_shape": [12, 24, 24]})
+    cfg.write_config(
+        config_dir, "two_pass_watershed",
+        {**BASE_CONFIG, "halo": [4, 8, 8], "apply_dt_2d": False,
+         "apply_ws_2d": False},
+    )
+    wf = WatershedWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="bnd",
+        output_path=path, output_key="ws_tpmask",
+        mask_path=path, mask_key="mask",
+        two_pass=True,
+    )
+    assert build([wf])
+    ws = file_reader(path, "r")["ws_tpmask"][:]
+    assert (ws[:, 24:, :] == 0).all()
+    fg = (raw < 0.5) & (mask > 0)
+    assert (ws[fg] > 0).mean() > 0.9
+
+
 def test_watershed_with_mask(tmp_path, boundary_volume, rng):
     path, raw = boundary_volume
     f = file_reader(path)
