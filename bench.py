@@ -103,6 +103,23 @@ def _rolled(x, n, axis=1):
     return [np.roll(x, 7 * i, axis=axis) if i else x for i in range(n)]
 
 
+def rolled_pair_variants(x, labels, n, call):
+    """n ``timeit`` variants over (labels, volume) pairs rolled in lockstep
+    (index 0 unshifted — the warmup slot): distinct inputs at zero extra
+    segmentation cost, identical label↔intensity correspondence everywhere
+    except the wrap seam.  ``call(labels_dev, volume_dev)`` runs the kernel."""
+    import jax.numpy as jnp
+
+    out = []
+    for i in range(n):
+        lab = np.roll(labels, 7 * i, axis=1) if i else labels
+        vol = np.roll(x, 7 * i, axis=1) if i else x
+        out.append(
+            (lambda l, v: lambda: call(l, v))(jnp.asarray(lab), jnp.asarray(vol))
+        )
+    return out
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -186,23 +203,25 @@ def bench_dtws_batched(x, batch, repeats):
 
     from cluster_tools_tpu.ops.watershed import dt_watershed
 
-    # distinct stack per timed round (+1 warmup) per sweep mode; rolls differ
-    # across rounds AND across the blocks inside a stack
+    # distinct stack per timed round (+1 warmup), built on device inside
+    # measure() so only one mode's span is HBM-resident at a time (a flat
+    # 2*(repeats+1)-stack pool would hold ~100 block volumes); rolls differ
+    # across modes, rounds, AND the blocks inside a stack
     span = repeats + 1
-    stacks = [
-        jnp.stack([jnp.asarray(np.roll(x, 101 * i + 7 * j, axis=1))
-                   for j in range(batch)])
-        for i in range(2 * span)
-    ]
     fn = jax.jit(jax.vmap(lambda v: dt_watershed(v, threshold=0.5)[0]))
-    variants = [(lambda s: lambda: fn(s))(s) for s in stacks]
 
-    t, mode, _ = _best_sweep_mode(
-        lambda i: timeit(
+    def measure(i):
+        stacks = [
+            jnp.stack([jnp.asarray(np.roll(x, 997 * i + 101 * r + 7 * j, axis=1))
+                       for j in range(batch)])
+            for r in range(span)
+        ]
+        return timeit(
             None, repeats, sync=lambda r: r.block_until_ready(),
-            variants=variants[i * span : (i + 1) * span],
+            variants=[(lambda s: lambda: fn(s))(s) for s in stacks],
         )
-    )
+
+    t, mode, _ = _best_sweep_mode(measure)
     mvox = batch * x.size / t / 1e6
     log(f"[dtws_batched x{batch}] {t*1e3:.1f} ms ({mvox:.1f} Mvox/s, "
         f"sweep={mode})")
@@ -298,22 +317,14 @@ def bench_rag(x, repeats):
         return mvox, None
     import jax.numpy as jnp
 
-    variants = []
-    lab32 = labels.astype(np.int32)
-    for i, v in enumerate(_rolled(x, repeats + 1)):
-        # roll the precomputed labels with the volume: an equally valid
-        # distinct input pair (identical label↔intensity correspondence up to
-        # the wrap seam) at zero extra CPU-watershed cost
-        lab_d = jnp.asarray(np.roll(lab32, 7 * i, axis=1) if i else lab32)
-        x_d = jnp.asarray(v)
-        variants.append(
-            (lambda l, xx: lambda: dev_fn(l, xx, max_edges=65536))(lab_d, x_d)
-        )
     t_dev = timeit(
         None,
         repeats,
         sync=lambda r: r[0].block_until_ready(),
-        variants=variants,
+        variants=rolled_pair_variants(
+            x, labels.astype(np.int32), repeats + 1,
+            lambda l, v: dev_fn(l, v, max_edges=65536),
+        ),
     )
     mvox = x.size / t_dev / 1e6
     log(

@@ -1,0 +1,221 @@
+"""Per-slice seeded flood as a Pallas TPU kernel.
+
+The XLA flood (`ops.watershed._seeded_watershed_scan`) runs each directional
+sweep as its own full-array program under a `lax.while_loop`: every sweep round
+trips through HBM for each state array.  This kernel instead keeps one
+z-slice's whole flood state (height map, altitude, hops, labels) resident in
+VMEM (a 256x256 f32 slice is 256 KB — a dozen such fields fit in ~16 MB) and
+runs BOTH phases to their fixpoint inside a single kernel instance, so the
+only HBM traffic is one read of (hmap, seeds, mask) and one write of the
+labels per slice.  Grid = slices: independent floods per z-slice is exactly
+the reference's 2d watershed mode (reference watershed/watershed.py:120-137),
+which is also its production default (`apply_ws_2d: True`).
+
+Semantics are identical to the XLA path (same lexicographic
+(pass-height, hops, label) relaxation, same tie-breaking — see
+ops/watershed.py module docstring); equivalence is asserted by
+tests/test_pallas_flood.py against `_seeded_watershed_scan` in interpret
+mode.  Sweeps use the same log-depth transfer-function doubling as the
+`assoc` XLA mode: a directional sweep composes per-element clamp transfers
+c -> min(u, max(c, l)) by repeated shift-and-compose (log2(n) steps), so no
+sequential per-lane carry chain exists anywhere in the kernel.  Reverse-
+direction sweeps shift from the opposite side instead of flipping the data —
+no data reorientation anywhere.
+
+Activation: `CTT_FLOOD_MODE=pallas` opts the per-slice flood into this kernel
+on the TPU backend for lane-aligned slice shapes (H multiple of 8, W multiple
+of 128); everything else falls back to the XLA path.  Off by default until
+hardware-validated (tools/tpu_validate.py measures it when a chip is
+reachable — Mosaic lowering cannot be exercised on the CPU interpreter).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+_BIG = np.float32(3.0e38)
+_NEG = np.float32(-3.0e38)
+_BIG_DIST = np.int32(np.iinfo(np.int32).max - 1)
+
+# fixpoint guard: rounds are early-exited on convergence, this is only the
+# hard upper bound — a 2d flood needs O(#bends of the steepest path) rounds,
+# pathological spirals are bounded by the slice diameter
+_MAX_ROUNDS = 256
+
+
+def _shift(x, d, axis, reverse, fill):
+    """The value of the element ``d`` steps *earlier* along the sweep:
+    earlier = lower index for a forward sweep, higher index for reverse.
+    Static-size slice + constant pad (no flips, no rolls)."""
+    if d >= x.shape[axis]:
+        return jnp.full_like(x, fill)
+    if axis == 0:
+        pad = jnp.full_like(x[:d, :], fill)
+        if reverse:
+            return jnp.concatenate([x[d:, :], pad], axis=0)
+        return jnp.concatenate([pad, x[:-d, :]], axis=0)
+    pad = jnp.full_like(x[:, :d], fill)
+    if reverse:
+        return jnp.concatenate([x[:, d:], pad], axis=1)
+    return jnp.concatenate([pad, x[:, :-d]], axis=1)
+
+
+def _sweep_altitude(alt, hmap, is_seed, mask, axis, reverse):
+    """One Gauss-Seidel altitude sweep A'(p) = min(A(p), max(carry, h(p))) by
+    doubling the clamp-transfer composition (u, l): log2(n) shift+compose
+    steps — the in-VMEM mirror of ops.watershed._sweep_altitude_assoc."""
+    conduct = mask & ~is_seed
+    u = jnp.where(mask, alt, _BIG)
+    l = jnp.where(conduct, hmap, u)
+
+    n = alt.shape[axis]
+    for k in range(int(np.ceil(np.log2(max(n, 2))))):
+        # compose the earlier window's transfer (shifted) before our own;
+        # identity transfer (BIG, NEG) pads past the boundary
+        uf = _shift(u, 1 << k, axis, reverse, _BIG)
+        lf = _shift(l, 1 << k, axis, reverse, _NEG)
+        u = jnp.minimum(u, jnp.maximum(uf, l))
+        l = jnp.maximum(lf, l)
+
+    # exclusive prefix applied to the initial carry BIG is just the composed u
+    carry_in = _shift(u, 1, axis, reverse, _BIG)
+    return jnp.where(conduct, jnp.minimum(alt, jnp.maximum(carry_in, hmap)), alt)
+
+
+def _minlex(d1, l1, d2, l2):
+    """Lexicographic min over (hops, label), label 0 = unlabeled = +inf."""
+    take1 = (l1 > 0) & ((l2 == 0) | (d1 < d2) | ((d1 == d2) & (l1 < l2)))
+    return jnp.where(take1, d1, d2), jnp.where(take1, l1, l2)
+
+
+def _sweep_assign(dist, label, alt, hmap, is_seed, mask, axis, reverse):
+    """One (hops, label) BFS sweep over optimal-prefix edges
+    (A(p) == max(A(q), h(p))) by doubling the (const_d, const_l, step, pass)
+    transfer composition — mirror of ops.watershed._sweep_assign_assoc."""
+    alt_masked = jnp.where(mask, alt, _BIG)
+    prev_alt = _shift(alt_masked, 1, axis, reverse, _BIG)
+    edge_ok = alt == jnp.maximum(prev_alt, hmap)
+    can_update = mask & ~is_seed & edge_ok
+
+    cd = jnp.where(mask, dist, _BIG_DIST)
+    cl = jnp.where(mask, label, 0)
+    step = jnp.ones_like(dist)
+    pas = can_update
+
+    n = dist.shape[axis]
+    for k in range(int(np.ceil(np.log2(max(n, 2))))):
+        fd = _shift(cd, 1 << k, axis, reverse, _BIG_DIST)
+        fl = _shift(cl, 1 << k, axis, reverse, jnp.int32(0))
+        fk = _shift(step, 1 << k, axis, reverse, jnp.int32(0))
+        fp = _shift(pas, 1 << k, axis, reverse, False)
+        cand_d = fd + step
+        cand_l = jnp.where(pas, fl, 0)
+        cd, cl = _minlex(cd, cl, cand_d, cand_l)
+        step = fk + step
+        pas = fp & pas
+
+    carry_d = _shift(cd, 1, axis, reverse, _BIG_DIST)
+    carry_l = _shift(cl, 1, axis, reverse, jnp.int32(0))
+
+    cand_dist = carry_d + 1
+    better = can_update & (carry_l > 0) & (
+        (cand_dist < dist)
+        | ((cand_dist == dist) & ((label == 0) | (carry_l < label)))
+    )
+    return (
+        jnp.where(better, cand_dist, dist),
+        jnp.where(better, carry_l, label),
+    )
+
+
+def _flood_slice_kernel(h_ref, s_ref, m_ref, o_ref):
+    """Whole per-slice flood: both phases iterated to their fixpoint in VMEM."""
+    hmap = h_ref[0]
+    seeds = s_ref[0]
+    mask = m_ref[0] != 0
+    seeds = jnp.where(mask, seeds, 0)
+    is_seed = seeds > 0
+
+    # -- phase 1: altitude --------------------------------------------------
+    def alt_round(_, carry):
+        alt, done = carry
+
+        def run():
+            new = alt
+            for axis in (0, 1):
+                for rev in (False, True):
+                    new = _sweep_altitude(new, hmap, is_seed, mask, axis, rev)
+            return new, jnp.all(new == alt)
+
+        # converged rounds are skipped (cond, not where: no wasted sweeps)
+        return lax.cond(done, lambda: (alt, done), run)
+
+    alt0 = jnp.where(is_seed, hmap, _BIG)
+    alt, _ = lax.fori_loop(
+        0, _MAX_ROUNDS, alt_round, (alt0, jnp.bool_(False))
+    )
+
+    # -- phase 2: assignment ------------------------------------------------
+    def asg_round(_, carry):
+        dist, label, done = carry
+
+        def run():
+            d, l = dist, label
+            for axis in (0, 1):
+                for rev in (False, True):
+                    d, l = _sweep_assign(d, l, alt, hmap, is_seed, mask, axis, rev)
+            return d, l, jnp.all((d == dist) & (l == label))
+
+        return lax.cond(done, lambda: (dist, label, done), run)
+
+    dist0 = jnp.where(is_seed, 0, _BIG_DIST)
+    _, label, _ = lax.fori_loop(
+        0, _MAX_ROUNDS, asg_round, (dist0, seeds, jnp.bool_(False))
+    )
+    o_ref[0] = jnp.where(mask, label, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flood_slices(hmap, seeds, mask, interpret: bool = False):
+    """Flood every z-slice of ``hmap`` (N, H, W) independently from ``seeds``
+    (int32, 0 = unlabeled), restricted to ``mask``.  One kernel instance per
+    slice; returns int32 labels shaped like ``hmap``.
+
+    Same fixpoint as ``seeded_watershed(..., per_slice=True)`` on a (N, H, W)
+    volume (asserted in tests).  ``interpret=True`` runs the CPU interpreter
+    (correctness testing without TPU hardware).
+    """
+    n, h, w = hmap.shape
+    spec = lambda: pl.BlockSpec((1, h, w), lambda i: (i, 0, 0))  # noqa: E731
+    return pl.pallas_call(
+        _flood_slice_kernel,
+        grid=(n,),
+        in_specs=[spec(), spec(), spec()],
+        out_specs=spec(),
+        out_shape=jax.ShapeDtypeStruct((n, h, w), jnp.int32),
+        interpret=interpret,
+    )(
+        hmap.astype(jnp.float32),
+        seeds.astype(jnp.int32),
+        mask.astype(jnp.int32),
+    )
+
+
+def pallas_flood_available(shape, per_slice: bool) -> bool:
+    """True when the Pallas flood applies: opted in (CTT_FLOOD_MODE=pallas),
+    per-slice mode, 3d volume, TPU backend, lane-aligned slice shape."""
+    import os
+
+    if os.environ.get("CTT_FLOOD_MODE") != "pallas":
+        return False
+    if not per_slice or len(shape) != 3:
+        return False
+    if shape[1] % 8 or shape[2] % 128:
+        return False
+    return jax.default_backend() == "tpu"

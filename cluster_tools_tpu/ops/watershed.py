@@ -333,6 +333,12 @@ def seeded_watershed(
     else:
         mask_arr = mask.astype(bool)
     if connectivity == 1:
+        if max_iter == 0:
+            from .pallas_flood import flood_slices, pallas_flood_available
+
+            if pallas_flood_available(hmap.shape, per_slice):
+                # whole-slice flood in VMEM (opt-in, CTT_FLOOD_MODE=pallas)
+                return flood_slices(hmap, seeds, mask_arr)
         return _seeded_watershed_scan(
             hmap, seeds, mask_arr, max_iter=max_iter, per_slice=per_slice
         )
@@ -520,6 +526,7 @@ def dt_watershed(
     size_filter: int = 25,
     invert_input: bool = False,
     non_maximum_suppression: bool = False,
+    valid: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The full per-block DT-watershed — one fused XLA program.
 
@@ -528,6 +535,13 @@ def dt_watershed(
     α·input + (1-α)·(1-dt) → seeded flood → size filter.  Mirrors the
     reference hot loop ``_ws_block`` (watershed.py:286-344) minus IO and offsets
     (applied host-side).  Returns ``(labels int32, n_seeds)``.
+
+    ``valid`` marks real voxels of an edge-replicate-padded block (clipped
+    at volume borders, padded to the static batch shape).  The replicated
+    data keeps the DT/seed/hmap fields border-faithful, but the flood and the
+    size filter are restricted to ``valid``: labels never occupy padding, so
+    segment voxel counts match the clipped computation — replicated copies of
+    a small border fragment must not carry it over ``size_filter``.
     """
     from .dt import _distance_transform, distance_transform_2d_stack
 
@@ -554,11 +568,14 @@ def dt_watershed(
         nms=non_maximum_suppression, pixel_pitch=pixel_pitch,
     )
     hmap = make_hmap(x, dt, alpha, sigma_weights, per_slice=per_slice_seeds)
-    labels = seeded_watershed(hmap, seeds, mask=fg, per_slice=per_slice_seeds)
+    flood_mask = fg if valid is None else fg & valid.astype(bool)
+    labels = seeded_watershed(
+        hmap, seeds, mask=flood_mask, per_slice=per_slice_seeds
+    )
     if size_filter > 0:
         num_segments = int(np.prod(x.shape)) // 2 + 2
         labels = apply_size_filter(
-            labels, hmap, size_filter, num_segments, mask=fg,
+            labels, hmap, size_filter, num_segments, mask=flood_mask,
             per_slice=per_slice_seeds,
         )
     return labels, n_seeds
@@ -584,6 +601,7 @@ def two_pass_flood(
     input_: jnp.ndarray,
     written: jnp.ndarray,
     mask: Optional[jnp.ndarray] = None,
+    valid: Optional[jnp.ndarray] = None,
     threshold: float = 0.25,
     apply_dt_2d: bool = True,
     apply_ws_2d: bool = True,
@@ -649,7 +667,10 @@ def two_pass_flood(
         written > 0, written, jnp.where(own_seeds > 0, own_seeds + k, 0)
     )
     hmap = make_hmap(x, dt, alpha, sigma_weights, per_slice=per_slice)
-    labels = seeded_watershed(hmap, seeds, mask=fg, per_slice=per_slice)
+    # flood/size-filter restricted to real voxels of a padded edge block —
+    # see dt_watershed's ``valid`` note
+    flood_mask = fg if valid is None else fg & valid.astype(bool)
+    labels = seeded_watershed(hmap, seeds, mask=flood_mask, per_slice=per_slice)
     if size_filter > 0:
         if num_segments is None:
             # always-safe bound: k ≤ #written voxels and #own seeds ≤ #fg
@@ -661,7 +682,7 @@ def two_pass_flood(
         # this block is (reference run_watershed ``exclude=initial_seed_ids``,
         # two_pass_watershed.py:166-167,205-209)
         labels = apply_size_filter(
-            labels, hmap, size_filter, num_segments, mask=fg,
+            labels, hmap, size_filter, num_segments, mask=flood_mask,
             per_slice=per_slice, protect_upto=k,
         )
     return labels, k

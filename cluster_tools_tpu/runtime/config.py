@@ -29,6 +29,9 @@ DEFAULT_GLOBAL_CONFIG: Dict[str, Any] = {
     "max_num_retries": 0,
     "retry_failure_fraction": 0.5,
     "device_batch_size": 8,
+    # batches in flight on the tpu target: depth d overlaps batch i+1's host
+    # chunk IO with batch i's device execution (1 = serial loop)
+    "pipeline_depth": 2,
     "devices": None,  # None = all jax.devices()
     "seed": 0,
     # multi-host scale-out: run the SAME driver script on every host with

@@ -134,8 +134,11 @@ class TpuExecutor(BaseExecutor):
         self, task, blocking, config, ids, batch_size, batch_fn,
         done, failed, errors,
     ) -> None:
-        for i in range(0, len(ids), batch_size):
-            chunk = ids[i : i + batch_size]
+        chunks = [
+            ids[i : i + batch_size] for i in range(0, len(ids), batch_size)
+        ]
+
+        def _one_batch(chunk):
             try:
                 t0 = time.perf_counter()
                 batch_fn(chunk, blocking, config)
@@ -164,6 +167,28 @@ class TpuExecutor(BaseExecutor):
                         f"[{self.name}] batch dispatch failed, per-block fallback "
                         f"succeeded for blocks {chunk[0]}..{chunk[-1]}:\n{tb}"
                     )
+
+        # Batch pipelining (the reference's dask IO/compute overlap,
+        # inference.py:319-327, moved into the executor): with depth d, up to d
+        # batches are in flight on a small thread pool, so batch i+1's host
+        # chunk reads/decodes run while batch i's device program executes
+        # (XLA releases the GIL during execution).  Depth 1 restores the
+        # serial loop.  A task whose blocks read regions other blocks of the
+        # SAME dispatch write (e.g. two-pass pass 2: the halo'd read overlaps
+        # a same-color *diagonal* neighbor's inner box) declares
+        # ``pipeline_safe = False`` — chunk writes are atomic (os.replace),
+        # so concurrency would not tear data, but it would make which
+        # neighbor labels a batch sees timing-dependent; serial batches keep
+        # the output deterministic.
+        depth = max(int(config.get("pipeline_depth", 2)), 1)
+        if not getattr(task, "pipeline_safe", True):
+            depth = 1
+        if depth == 1 or len(chunks) == 1:
+            for chunk in chunks:
+                _one_batch(chunk)
+        else:
+            with ThreadPoolExecutor(depth) as pool:
+                list(pool.map(_one_batch, chunks))
 
     @staticmethod
     def _n_devices(config) -> int:

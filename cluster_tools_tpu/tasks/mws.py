@@ -133,6 +133,12 @@ class TwoPassMwsTask(MwsBlocksTask):
     def identifier(self) -> str:
         return f"{self.task_name}_pass{self.pass_id}"
 
+    @property
+    def pipeline_safe(self) -> bool:
+        # pass 1 reads halo'd out_ds regions that same-color diagonal
+        # neighbors write (see TwoPassWatershedTask.pipeline_safe)
+        return self.pass_id == 0
+
     def get_block_list(self, blocking: Blocking, gconf):
         from ..utils.blocking import make_checkerboard_block_lists
 

@@ -150,12 +150,16 @@ class WatershedTask(VolumeTask):
         full_shape = tuple(
             bs + 2 * h for bs, h in zip(blocking.block_shape, halo)
         )
+        valids = []
         for bid in block_ids:
             bh = blocking.block_with_halo(bid, halo)
             arr = _read_input_block(in_ds, bh.outer.slicing, config)
             datas.append(_pad_block(arr, full_shape))
+            v = np.ones(arr.shape, dtype=bool)
+            valids.append(_pad_block(v, full_shape, mode="zero"))
             blocks.append(bh)
         batch_arr = np.stack(datas)
+        valid_arr = np.stack(valids)
 
         from ..parallel.dispatch import BlockBatch
 
@@ -166,11 +170,14 @@ class WatershedTask(VolumeTask):
 
         kernel = partial(ws_ops.dt_watershed, **params)
         xb, n_real = put_sharded(batch_arr, config)
+        vb, _ = put_sharded(valid_arr, config)
         if mask is None:
-            labels, _ = jax.vmap(lambda x: kernel(x))(xb)
+            labels, _ = jax.vmap(lambda x, v: kernel(x, valid=v))(xb, vb)
         else:
             mb, _ = put_sharded(mask, config)
-            labels, _ = jax.vmap(lambda x, m: kernel(x, mask=m))(xb, mb)
+            labels, _ = jax.vmap(
+                lambda x, m, v: kernel(x, mask=m, valid=v)
+            )(xb, mb, vb)
         labels = np.asarray(labels)[:n_real]
 
         has_halo = any(h > 0 for h in halo)
@@ -385,6 +392,14 @@ class TwoPassWatershedTask(WatershedTask):
     def identifier(self) -> str:
         return f"{self.task_name}_pass{self.pass_id}"
 
+    @property
+    def pipeline_safe(self) -> bool:
+        # pass 2 reads halo'd out_ds regions that same-color *diagonal*
+        # neighbors write: concurrent batches would make the visible neighbor
+        # labels timing-dependent.  One batch reads everything before writing
+        # anything, so serial batches are fully deterministic.
+        return self.pass_id == 0
+
     def get_block_list(self, blocking, gconf):
         base = super().get_block_list(blocking, gconf)
         white, black = make_checkerboard_block_lists(blocking, base)
@@ -415,7 +430,7 @@ class TwoPassWatershedTask(WatershedTask):
         full_shape = tuple(
             bs + 2 * h for bs, h in zip(blocking.block_shape, halo)
         )
-        xs, compacts, uniqs, blocks = [], [], [], []
+        xs, compacts, valids, uniqs, blocks = [], [], [], [], []
         for bid in block_ids:
             bh = blocking.block_with_halo(bid, halo)
             x = _read_input_block(in_ds, bh.outer.slicing, config)
@@ -426,6 +441,9 @@ class TwoPassWatershedTask(WatershedTask):
             compact = np.where(written > 0, compact, 0).astype(np.int32)
             xs.append(_pad_block(x, full_shape))
             compacts.append(_pad_block(compact, full_shape, mode="zero"))
+            valids.append(
+                _pad_block(np.ones(x.shape, dtype=bool), full_shape, mode="zero")
+            )
             uniqs.append(uniq_written)
             blocks.append(bh)
 
@@ -449,13 +467,16 @@ class TwoPassWatershedTask(WatershedTask):
         )
         xb, n_real = put_sharded(batch_arr, config)
         wb, _ = put_sharded(np.stack(compacts), config)
+        vb, _ = put_sharded(np.stack(valids), config)
         if mask is None:
-            labels, _ = jax.vmap(lambda x, w: kernel(x, w))(xb, wb)
+            labels, _ = jax.vmap(
+                lambda x, w, v: kernel(x, w, valid=v)
+            )(xb, wb, vb)
         else:
             mb, _ = put_sharded(mask, config)
-            labels, _ = jax.vmap(lambda x, w, m: kernel(x, w, mask=m))(
-                xb, wb, mb
-            )
+            labels, _ = jax.vmap(
+                lambda x, w, m, v: kernel(x, w, mask=m, valid=v)
+            )(xb, wb, mb, vb)
         labels = np.asarray(labels).astype(np.int64)[:n_real]
 
         for i, bid in enumerate(block_ids):

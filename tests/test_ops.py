@@ -402,3 +402,40 @@ class TestDTSweepModes:
         np.testing.assert_allclose(results["seq"], results["assoc"], atol=1e-4)
         want = ndimage.distance_transform_edt(fg, sampling=pitch)
         np.testing.assert_allclose(results["assoc"], want, atol=1e-3)
+
+
+class TestDtWatershedValid:
+    def test_padding_does_not_inflate_size_filter(self):
+        """A small border fragment of a clipped edge block must not survive
+        the size filter just because its edge-replicated pad copies inflate
+        the voxel count (dt_watershed ``valid`` semantics)."""
+        import jax.numpy as jnp
+
+        from cluster_tools_tpu.ops.watershed import dt_watershed
+
+        h, w = 16, 40
+        pad_w = 24  # block clipped at the volume border, padded to w + pad_w
+        x = np.ones((2, h, w + pad_w), dtype=np.float32)
+        # a 2x3=6-voxel foreground pocket touching the clipped border (per
+        # slice); edge replication extends it across all 24 pad columns
+        x[:, 6:8, w - 3 : w] = 0.0
+        x[:, :, w:] = x[:, :, w - 1 : w]  # edge-replicate by hand
+        valid = np.zeros(x.shape, dtype=bool)
+        valid[:, :, :w] = True
+
+        # without valid: the pocket spans 6 + 2*24 = 54 voxels >= 25 -> kept
+        labels_no_valid, _ = dt_watershed(
+            jnp.asarray(x), apply_dt_2d=True, apply_ws_2d=True,
+            threshold=0.5, sigma_seeds=0.0, size_filter=25,
+        )
+        assert np.asarray(labels_no_valid)[:, :, : w].max() > 0
+
+        # with valid: true size 6 < 25 -> removed, and no labels in padding
+        labels, _ = dt_watershed(
+            jnp.asarray(x), valid=jnp.asarray(valid),
+            apply_dt_2d=True, apply_ws_2d=True,
+            threshold=0.5, sigma_seeds=0.0, size_filter=25,
+        )
+        labels = np.asarray(labels)
+        assert labels.max() == 0
+        assert (labels[:, :, w:] == 0).all()

@@ -27,7 +27,7 @@ from scipy import ndimage
 # repo root on sys.path; bench.timeit owns the distinct-input timing scheme
 # (variant 0 = sacrificial warmup, one fresh variant per timed round — see its
 # docstring for the axon execution-cache rationale)
-from bench import timeit, _rolled  # noqa: E402
+from bench import timeit, _rolled, rolled_pair_variants  # noqa: E402
 
 REPEATS = 3
 SPAN = REPEATS + 1  # warmup + timed rounds — one disjoint span per sweep mode
@@ -84,21 +84,13 @@ def main():
     from cluster_tools_tpu.ops import rag
 
     labels, _ = native.dt_watershed_cpu(raw, threshold=0.5)
-    lab32 = labels.astype(np.int32)
-    rag_variants = []
-    for i, v in enumerate(raws[:SPAN]):
-        # roll the precomputed labels with the volume — distinct input pairs
-        # at zero extra CPU-watershed cost (identical label↔intensity
-        # correspondence up to the wrap seam)
-        lab_d = jnp.asarray(np.roll(lab32, 7 * i, axis=1) if i else lab32)
-        rag_variants.append(
-            (lambda l, xx: lambda: rag.boundary_edge_features_device(
-                l, xx, max_edges=65536))(lab_d, jnp.asarray(v))
-        )
     t_dev = timeit(
         None, REPEATS,
         sync=lambda r: r[0].block_until_ready(),
-        variants=rag_variants,
+        variants=rolled_pair_variants(
+            raw, labels.astype(np.int32), SPAN,
+            lambda l, v: rag.boundary_edge_features_device(l, v, max_edges=65536),
+        ),
     )
     t0 = time.perf_counter()
     rag.boundary_edge_features(labels.astype(np.uint64), raw)
