@@ -48,3 +48,35 @@ def force_sweep_mode(mode):
     finally:
         FORCE_SWEEP_MODE = prev
         jax.clear_caches()
+
+
+# None = read CTT_FLOOD_MODE; force_flood_mode() overrides within a scope
+FORCE_FLOOD_MODE = None
+
+
+def use_pallas_flood() -> bool:
+    """Whether the per-slice flood should use the Pallas kernel
+    (ops/pallas_flood.py).  Like ``use_assoc`` this is read at TRACE time —
+    already-compiled shapes keep their path; pin the mode before first use
+    (CTT_FLOOD_MODE=pallas) or flip it under ``force_flood_mode``, which owns
+    the jit-cache invalidation."""
+    if FORCE_FLOOD_MODE is not None:
+        return FORCE_FLOOD_MODE == "pallas"
+    return os.environ.get("CTT_FLOOD_MODE") == "pallas"
+
+
+@contextmanager
+def force_flood_mode(mode):
+    """Scoped flood-mode override ('pallas' | 'xla'): sets the switch, clears
+    jit caches (traces bake the path in), restores + clears on exit."""
+    global FORCE_FLOOD_MODE
+    import jax
+
+    prev = FORCE_FLOOD_MODE
+    FORCE_FLOOD_MODE = mode
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        FORCE_FLOOD_MODE = prev
+        jax.clear_caches()

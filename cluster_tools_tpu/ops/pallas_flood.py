@@ -39,14 +39,11 @@ import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
+from .watershed import _minlex  # one source of truth for the tie-break rule
+
 _BIG = np.float32(3.0e38)
 _NEG = np.float32(-3.0e38)
 _BIG_DIST = np.int32(np.iinfo(np.int32).max - 1)
-
-# fixpoint guard: rounds are early-exited on convergence, this is only the
-# hard upper bound — a 2d flood needs O(#bends of the steepest path) rounds,
-# pathological spirals are bounded by the slice diameter
-_MAX_ROUNDS = 256
 
 
 def _shift(x, d, axis, reverse, fill):
@@ -86,12 +83,6 @@ def _sweep_altitude(alt, hmap, is_seed, mask, axis, reverse):
     # exclusive prefix applied to the initial carry BIG is just the composed u
     carry_in = _shift(u, 1, axis, reverse, _BIG)
     return jnp.where(conduct, jnp.minimum(alt, jnp.maximum(carry_in, hmap)), alt)
-
-
-def _minlex(d1, l1, d2, l2):
-    """Lexicographic min over (hops, label), label 0 = unlabeled = +inf."""
-    take1 = (l1 > 0) & ((l2 == 0) | (d1 < d2) | ((d1 == d2) & (l1 < l2)))
-    return jnp.where(take1, d1, d2), jnp.where(take1, l1, l2)
 
 
 def _sweep_assign(dist, label, alt, hmap, is_seed, mask, axis, reverse):
@@ -142,6 +133,13 @@ def _flood_slice_kernel(h_ref, s_ref, m_ref, o_ref):
     seeds = jnp.where(mask, seeds, 0)
     is_seed = seeds > 0
 
+    # fixpoint guard: rounds early-exit on convergence; the bound is the
+    # slice semi-perimeter + slack — a flood round resolves one directional
+    # segment of the steepest path, and no path in an H x W slice has more
+    # than H + W direction changes (worst-case serpentine/spiral corridors)
+    h_dim, w_dim = hmap.shape
+    max_rounds = h_dim + w_dim + 4
+
     # -- phase 1: altitude --------------------------------------------------
     def alt_round(_, carry):
         alt, done = carry
@@ -158,7 +156,7 @@ def _flood_slice_kernel(h_ref, s_ref, m_ref, o_ref):
 
     alt0 = jnp.where(is_seed, hmap, _BIG)
     alt, _ = lax.fori_loop(
-        0, _MAX_ROUNDS, alt_round, (alt0, jnp.bool_(False))
+        0, max_rounds, alt_round, (alt0, jnp.bool_(False))
     )
 
     # -- phase 2: assignment ------------------------------------------------
@@ -176,7 +174,7 @@ def _flood_slice_kernel(h_ref, s_ref, m_ref, o_ref):
 
     dist0 = jnp.where(is_seed, 0, _BIG_DIST)
     _, label, _ = lax.fori_loop(
-        0, _MAX_ROUNDS, asg_round, (dist0, seeds, jnp.bool_(False))
+        0, max_rounds, asg_round, (dist0, seeds, jnp.bool_(False))
     )
     o_ref[0] = jnp.where(mask, label, 0)
 
@@ -208,11 +206,17 @@ def flood_slices(hmap, seeds, mask, interpret: bool = False):
 
 
 def pallas_flood_available(shape, per_slice: bool) -> bool:
-    """True when the Pallas flood applies: opted in (CTT_FLOOD_MODE=pallas),
-    per-slice mode, 3d volume, TPU backend, lane-aligned slice shape."""
-    import os
+    """True when the Pallas flood applies: opted in (CTT_FLOOD_MODE=pallas or
+    a ``force_flood_mode('pallas')`` scope), per-slice mode, 3d volume, TPU
+    backend, lane-aligned slice shape.
 
-    if os.environ.get("CTT_FLOOD_MODE") != "pallas":
+    Evaluated at TRACE time (this runs inside jitted callers): a shape that
+    was already compiled keeps its path until the jit caches are cleared —
+    pin the mode before first use, or use ``_backend.force_flood_mode``,
+    which owns the cache invalidation."""
+    from . import _backend
+
+    if not _backend.use_pallas_flood():
         return False
     if not per_slice or len(shape) != 3:
         return False

@@ -67,6 +67,11 @@ class LocalExecutor(BaseExecutor):
 
     def run_blocks(self, task, blocking, block_ids, config) -> RunResult:
         n_workers = max(int(config.get("max_jobs", 1)), 1)
+        if not getattr(task, "pipeline_safe", True):
+            # same contract as the TpuExecutor pipeline: blocks that read
+            # regions concurrent blocks write (two-pass pass 2) run serially
+            # so the visible neighbor labels are not timing-dependent
+            n_workers = 1
         done: List[int] = []
         failed: List[int] = []
         errors: Dict[int, str] = {}

@@ -245,3 +245,28 @@ def test_pipeline_batch_failure_falls_back_per_block(tmp_env):
     build([t])
     status = t.output().read()
     assert status["complete"] and sorted(status["done"]) == list(range(8))
+
+
+def test_local_executor_honors_pipeline_safe(tmp_env):
+    """pipeline_safe=False serializes the LocalExecutor thread pool too (the
+    MWS pass-2 path has no batch dispatch and runs through LocalExecutor)."""
+    import threading
+
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(
+        config_dir, {"block_shape": [4, 32, 32], "max_jobs": 4}
+    )
+
+    class UnsafeTask(RecordingTask):
+        task_name = "unsafe"
+        pipeline_safe = False
+
+        def process_block(self, block_id, blocking, config):
+            self.out.setdefault("threads", set()).add(threading.get_ident())
+            self.out.setdefault("calls", []).append(block_id)
+
+    out = {}
+    t = UnsafeTask(tmp_folder, config_dir, out=out)
+    build([t])
+    assert sorted(out["calls"]) == list(range(8))
+    assert len(out["threads"]) == 1

@@ -8,8 +8,9 @@ the assoc path needs real hardware.  Run this when the chip is reachable:
 
     python tools/tpu_validate.py
 
-It times both modes for the flood and CC, the fused DT-watershed, and the
-device RAG kernel, prints a table, and writes tools/tpu_validate.json.
+It times both sweep modes for the flood and CC, the fused DT-watershed, the
+Pallas per-slice flood (Mosaic lowering + exactness + perf vs the XLA flood),
+and the device RAG kernel, prints a table, and writes tools/tpu_validate.json.
 Exactly one jax-on-axon process may run at a time (see the memory note on
 tunnel fragility) — run nothing else against the chip concurrently.
 """
@@ -78,6 +79,51 @@ def main():
         )
         results[f"cc_{mode}_ms"] = round(t * 1e3, 1)
         print(f"connected_components[{mode}]: {t*1e3:.1f} ms")
+
+    # -- Pallas per-slice flood: Mosaic lowering + perf vs the XLA flood ----
+    # (the only place the real-hardware lowering of ops/pallas_flood.py is
+    # exercised — the CPU interpreter covers correctness, not Mosaic)
+    from cluster_tools_tpu.ops.pallas_flood import flood_slices
+    from cluster_tools_tpu.ops.watershed import (
+        _seeded_watershed_scan,
+        dt_seeds,
+    )
+    from cluster_tools_tpu.ops.dt import distance_transform_2d_stack
+
+    fg = jnp.asarray(raw < 0.5)
+    dt_f = distance_transform_2d_stack(fg)
+    seeds_f, _ = dt_seeds(dt_f, sigma=2.0, per_slice=True)
+    hmaps = [jnp.asarray(0.8 * v + 0.2) for v in raws]
+    try:
+        ref_out = _seeded_watershed_scan(hmaps[0], seeds_f, fg, per_slice=True)
+        got = flood_slices(hmaps[0], seeds_f, fg)
+        agree = bool(jnp.array_equal(got, ref_out))
+        results["pallas_flood_exact"] = agree
+        t_p = timeit(
+            None, REPEATS,
+            sync=lambda r: r.block_until_ready(),
+            variants=[
+                (lambda h: lambda: flood_slices(h, seeds_f, fg))(h)
+                for h in hmaps[:SPAN]
+            ],
+        )
+        t_x = timeit(
+            None, REPEATS,
+            sync=lambda r: r.block_until_ready(),
+            variants=[
+                (lambda h: lambda: _seeded_watershed_scan(
+                    h, seeds_f, fg, per_slice=True))(h)
+                for h in hmaps[SPAN : 2 * SPAN]
+            ],
+        )
+        results["pallas_flood_ms"] = round(t_p * 1e3, 1)
+        results["xla_flood_ms"] = round(t_x * 1e3, 1)
+        results["pallas_flood_wins"] = t_p < t_x
+        print(f"pallas flood: {t_p*1e3:.1f} ms (exact={agree}), "
+              f"xla flood: {t_x*1e3:.1f} ms")
+    except Exception as e:  # Mosaic lowering / runtime failure: record, go on
+        results["pallas_flood_error"] = f"{type(e).__name__}: {e}"[:500]
+        print(f"pallas flood FAILED to lower/run: {e}")
 
     # -- device RAG kernel vs numpy -----------------------------------------
     from cluster_tools_tpu import native
