@@ -7,6 +7,7 @@ from .mesh import (
     shard_batch,
 )
 from .dispatch import BlockBatch, read_block_batch, write_block_batch
+from .sharded import halo_exchange, sharded_connected_components
 
 __all__ = [
     "get_mesh",
@@ -18,4 +19,6 @@ __all__ = [
     "BlockBatch",
     "read_block_batch",
     "write_block_batch",
+    "halo_exchange",
+    "sharded_connected_components",
 ]
