@@ -7,7 +7,11 @@ from .mesh import (
     shard_batch,
 )
 from .dispatch import BlockBatch, read_block_batch, write_block_batch
-from .sharded import halo_exchange, sharded_connected_components
+from .sharded import (
+    halo_exchange,
+    sharded_connected_components,
+    sharded_seeded_watershed,
+)
 
 __all__ = [
     "get_mesh",
@@ -21,4 +25,5 @@ __all__ = [
     "write_block_batch",
     "halo_exchange",
     "sharded_connected_components",
+    "sharded_seeded_watershed",
 ]

@@ -83,6 +83,36 @@ def halo_exchange(x, halo: int, axis_name: str, fill=0):
     return jnp.concatenate([lo, x, hi], axis=0)
 
 
+def _exchange_planes(arrs, axis_name):
+    """The shard-boundary exchange both sharded kernels share: every array's
+    last plane goes to the +z neighbor, first plane to the -z neighbor.
+    Returns ``(from_below, from_above)`` plane tuples (zero-filled at the
+    mesh edges — combiners guard via exchanged mask/label planes)."""
+    lo = tuple(_neighbor_planes(a[-1], axis_name, +1) for a in arrs)
+    hi = tuple(_neighbor_planes(a[0], axis_name, -1) for a in arrs)
+    return lo, hi
+
+
+def _update_boundary(state, combine, lo, hi, z_local):
+    """Apply a cross-boundary ``combine`` to every volume in ``state``:
+    first planes against the -z neighbor's contribution ``lo``, last planes
+    against the +z neighbor's ``hi``.  A one-plane shard is both boundary
+    planes, so both contributions combine into the same plane.
+
+    ``combine(own_planes, got_planes, plane_idx) -> new_planes`` where
+    ``plane_idx`` is 0 or -1 (for indexing side data in the closure).
+    """
+    first = combine(tuple(v[0] for v in state), lo, 0)
+    if z_local == 1:
+        first = combine(first, hi, 0)
+        return tuple(f[None] for f in first)
+    last = combine(tuple(v[-1] for v in state), hi, -1)
+    return tuple(
+        jnp.concatenate([f[None], v[1:-1], l[None]], 0)
+        for f, v, l in zip(first, state, last)
+    )
+
+
 def _local_relax(label, mask, offsets, axes, size, shard_offset, local_size):
     """One round of per-shard relaxation: min-label propagation (log-depth
     axis sweeps on the assoc path — the same CTT_SWEEP_MODE switch every
@@ -147,12 +177,12 @@ def _sharded_cc(mask, connectivity, axis_name, mesh):
         def boundary_merge(label):
             # exchange boundary label+mask planes with both z-neighbors and
             # min-combine over every cross-boundary connection
-            lab_lo = _neighbor_planes(label[-1], axis_name, +1)
-            msk_lo = _neighbor_planes(m[-1], axis_name, +1)
-            lab_hi = _neighbor_planes(label[0], axis_name, -1)
-            msk_hi = _neighbor_planes(m[0], axis_name, -1)
+            lo, hi = _exchange_planes((label, m), axis_name)
 
-            def combine(own_lab, own_msk, got_lab, got_msk):
+            def combine(own, got, plane_idx):
+                (own_lab,) = own
+                got_lab, got_msk = got
+                own_msk = m[plane_idx]
                 best = own_lab
                 for off in cross:
                     g_lab = _shift(got_lab, off, sentinel)
@@ -160,19 +190,10 @@ def _sharded_cc(mask, connectivity, axis_name, mesh):
                     best = jnp.minimum(
                         best, jnp.where(own_msk & g_msk, g_lab, sentinel)
                     )
-                return best
+                return (best,)
 
-            if z_local == 1:
-                # one plane per shard: it is both boundary planes — merge
-                # the two neighbor contributions into the same plane
-                plane = combine(label[0], m[0], lab_lo, msk_lo)
-                plane = combine(plane, m[0], lab_hi, msk_hi)
-                return plane[None]
-            first = combine(label[0], m[0], lab_lo, msk_lo)
-            last = combine(label[-1], m[-1], lab_hi, msk_hi)
-            return jnp.concatenate(
-                [first[None], label[1:-1], last[None]], axis=0
-            )
+            (out,) = _update_boundary((label,), combine, lo, hi, z_local)
+            return out
 
         def cond(state):
             _, changed = state
@@ -199,6 +220,160 @@ def _sharded_cc(mask, connectivity, axis_name, mesh):
         out_specs=P(axis_name),
     )
     return fn(mask)
+
+
+@partial(jax.jit, static_argnames=("axis_name", "mesh"))
+def _sharded_flood(hmap, seeds, mask, axis_name, mesh):
+    from ..ops import _backend
+    from ..ops.watershed import (
+        _BIG,
+        _sweep_altitude_assoc,
+        _sweep_altitude_seq,
+        _sweep_assign_assoc,
+        _sweep_assign_seq,
+    )
+
+    if _backend.use_assoc():
+        sweep_alt, sweep_asg = _sweep_altitude_assoc, _sweep_assign_assoc
+    else:
+        sweep_alt, sweep_asg = _sweep_altitude_seq, _sweep_assign_seq
+    big_dist = jnp.int32(np.iinfo(np.int32).max - 1)
+    n_shards = mesh.shape[axis_name]
+    z_local = hmap.shape[0] // n_shards
+
+    def local_fn(h, s, m):
+        s = jnp.where(m, s, 0)
+        is_seed = s > 0
+
+        # -- phase 1: altitude ---------------------------------------------
+        def alt_boundary(alt):
+            lo, hi = _exchange_planes((alt, m), axis_name)
+
+            def comb(own, got, plane_idx):
+                (own_alt,) = own
+                got_a, got_m = got
+                cand = jnp.maximum(got_a, h[plane_idx])
+                ok = m[plane_idx] & ~is_seed[plane_idx] & got_m
+                return (jnp.where(ok, jnp.minimum(own_alt, cand), own_alt),)
+
+            (out,) = _update_boundary((alt,), comb, lo, hi, z_local)
+            return out
+
+        def alt_body(state):
+            alt, _ = state
+            new = alt
+            for axis in (0, 1, 2):
+                for rev in (False, True):
+                    new = sweep_alt(new, h, is_seed, m, axis, rev)
+            new = alt_boundary(new)
+            changed = lax.psum(
+                jnp.any(new != alt).astype(jnp.int32), axis_name
+            ) > 0
+            return new, changed
+
+        alt0 = jnp.where(is_seed, h, _BIG)
+        alt, _ = lax.while_loop(
+            lambda st: st[1], alt_body, (alt0, jnp.bool_(True))
+        )
+
+        # -- phase 2: assignment -------------------------------------------
+        alt_masked = jnp.where(m, alt, _BIG)
+        (alt_lo,), (alt_hi,) = _exchange_planes((alt_masked,), axis_name)
+        # mesh-edge shards received zeros: overwrite with BIG (no edge)
+        idx = lax.axis_index(axis_name)
+        alt_lo = jnp.where(idx == 0, jnp.full_like(alt_lo, _BIG), alt_lo)
+        alt_hi = jnp.where(
+            idx == n_shards - 1, jnp.full_like(alt_hi, _BIG), alt_hi
+        )
+        def asg_boundary(dist, label):
+            lo, hi = _exchange_planes((dist, label), axis_name)
+
+            def comb(own, got, plane_idx):
+                d, l = own
+                got_d, got_l = got
+                # the neighbor altitude belongs to the SIDE the contribution
+                # came from (a one-plane shard combines both sides into the
+                # same plane, so the side can't be derived from plane_idx)
+                got_a = alt_lo if got is lo else alt_hi
+                edge_ok = alt[plane_idx] == jnp.maximum(got_a, h[plane_idx])
+                cand = got_d + 1
+                valid = (
+                    m[plane_idx] & ~is_seed[plane_idx] & edge_ok & (got_l > 0)
+                )
+                better = valid & (
+                    (cand < d) | ((cand == d) & ((l == 0) | (got_l < l)))
+                )
+                return (
+                    jnp.where(better, cand, d),
+                    jnp.where(better, got_l, l),
+                )
+
+            return _update_boundary((dist, label), comb, lo, hi, z_local)
+
+        def asg_body(state):
+            dist, label, _ = state
+            d, l = dist, label
+            for axis in (0, 1, 2):
+                for rev in (False, True):
+                    d, l = sweep_asg(d, l, alt, h, is_seed, m, axis, rev)
+            d, l = asg_boundary(d, l)
+            changed = lax.psum(
+                jnp.any((d != dist) | (l != label)).astype(jnp.int32),
+                axis_name,
+            ) > 0
+            return d, l, changed
+
+        dist0 = jnp.where(is_seed, 0, big_dist)
+        _, label, _ = lax.while_loop(
+            lambda st: st[2], asg_body, (dist0, s, jnp.bool_(True))
+        )
+        return jnp.where(m, label, 0)
+
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=P(axis_name),
+        # the reused sweep kernels build scan carries from shape constants,
+        # which the varying-manual-axes tracker sees as replicated values
+        # meeting varying ones — semantically fine here (every value is
+        # per-shard), so disable the strict check
+        check_vma=False,
+    )
+    return fn(hmap, seeds, mask)
+
+
+def sharded_seeded_watershed(
+    hmap,
+    seeds,
+    mask=None,
+    mesh=None,
+    axis_name: str = "data",
+) -> jnp.ndarray:
+    """Seeded 3d flood of a z-sharded volume over the device mesh — the
+    flagship kernel's collective form: per-shard directional sweeps
+    (ops.watershed, honoring CTT_SWEEP_MODE) + ppermute'd boundary-plane
+    relaxation + psum convergence votes, both flood phases inside one jit.
+
+    Computes the SAME lexicographic (pass-height, hops, label) fixpoint as
+    ``ops.watershed.seeded_watershed(..., per_slice=False)`` — exact label
+    equality (tested) — for volumes whose z-extent is divisible by the mesh
+    size.  Seeds are global int32 ids (0 = unlabeled); voxels outside
+    ``mask`` stay 0.
+    """
+    mesh = mesh if mesh is not None else get_mesh(axis_name=axis_name)
+    n = mesh.shape[axis_name]
+    if hmap.shape[0] % n:
+        raise ValueError(
+            f"z extent {hmap.shape[0]} not divisible by mesh size {n}"
+        )
+    if mask is None:
+        mask = jnp.ones(hmap.shape, dtype=bool)
+    sharding = NamedSharding(mesh, P(axis_name))
+    hmap = jax.device_put(jnp.asarray(hmap, jnp.float32), sharding)
+    seeds = jax.device_put(jnp.asarray(seeds, jnp.int32), sharding)
+    mask = jax.device_put(jnp.asarray(mask, bool), sharding)
+    return _sharded_flood(hmap, seeds, mask, axis_name, mesh)
 
 
 def sharded_connected_components(
