@@ -10,6 +10,7 @@ from .base import VolumeTask
 from .threshold import ThresholdTask
 from .thresholded_components import (
     BlockComponentsTask,
+    ShardedComponentsTask,
     MergeOffsetsTask,
     BlockFacesTask,
     MergeAssignmentsTask,
@@ -88,6 +89,7 @@ __all__ = [
     "VolumeTask",
     "ThresholdTask",
     "BlockComponentsTask",
+    "ShardedComponentsTask",
     "MergeOffsetsTask",
     "BlockFacesTask",
     "MergeAssignmentsTask",

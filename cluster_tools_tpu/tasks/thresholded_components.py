@@ -224,3 +224,104 @@ class MergeAssignmentsTask(VolumeSimpleTask):
         assignment, n_new = merge(n_labels + 1, pairs)
         np.save(os.path.join(self.tmp_folder, ASSIGNMENTS_NAME), assignment)
         self.log(f"merged {n_labels} block-local labels into {n_new} components")
+
+
+class ShardedComponentsTask(VolumeSimpleTask):
+    """Whole-volume connected components over the device mesh in ONE jit
+    program — the collective alternative to the 5-step block pipeline above.
+
+    The volume is z-sharded over the mesh (``devices`` config), thresholded
+    on device, and labeled by ``parallel.sharded.sharded_connected_components``
+    (per-shard sweeps + ppermute'd boundary planes + psum convergence): the
+    cross-block merge that steps 2-4 route through the filesystem happens
+    entirely over ICI.  Use when the volume fits in the mesh's aggregate HBM;
+    the block pipeline remains the out-of-core path.  Output is consecutive
+    uint64 labels (background 0) matching the block pipeline's partition at
+    ``sigma == 0``; with smoothing the two differ at block borders by design
+    — the block path smooths each halo-less block (truncating the filter at
+    every block boundary, as the reference's block_components does), while
+    this path smooths the whole volume seamlessly.
+    """
+
+    task_name = "sharded_components"
+
+    def __init__(self, *args, input_path: str = None, input_key: str = None,
+                 output_path: str = None, output_key: str = None,
+                 mask_path: str = None, mask_key: str = None, **kwargs):
+        super().__init__(
+            *args, input_path=input_path, input_key=input_key,
+            output_path=output_path, output_key=output_key,
+            mask_path=mask_path, mask_key=mask_key, **kwargs,
+        )
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update(
+            {"threshold": 0.5, "threshold_mode": "greater", "sigma": 0.0,
+             "connectivity": 1}
+        )
+        return conf
+
+    def run_impl(self) -> None:
+        from ..parallel.mesh import get_mesh, resolve_devices
+        from ..parallel.sharded import sharded_connected_components
+        from ..utils import store as store_mod
+
+        conf = {**self.global_config(), **self.get_task_config()}
+        mode = conf.get("threshold_mode", "greater")
+        if mode not in ("greater", "less", "equal"):
+            raise ValueError(f"unsupported threshold_mode {mode!r}")
+        in_ds = store_mod.file_reader(self.input_path, "r")[self.input_key]
+        raw = in_ds[:]
+        sigma = conf.get("sigma", 0.0) or 0.0  # scalar or per-axis sequence
+        if np.any(np.asarray(sigma) > 0):
+            from scipy import ndimage as _ndi
+
+            raw = _ndi.gaussian_filter(raw.astype("float32"), sigma)
+        threshold = float(conf.get("threshold", 0.5))
+        if mode == "greater":
+            mask = raw > threshold
+        elif mode == "less":
+            mask = raw < threshold
+        else:
+            mask = raw == threshold
+        if self.mask_path:
+            m = store_mod.file_reader(self.mask_path, "r")[self.mask_key][:]
+            mask &= m.astype(bool)
+
+        devices = resolve_devices(conf)
+        mesh = get_mesh(devices)
+        n_dev = len(devices)
+        pad = (-mask.shape[0]) % n_dev
+        padded = (
+            np.pad(mask, ((0, pad),) + ((0, 0),) * (mask.ndim - 1))
+            if pad else mask
+        )
+        raw_labels = np.asarray(
+            sharded_connected_components(
+                padded, mesh=mesh,
+                connectivity=int(conf.get("connectivity", 1)),
+            )
+        )[: mask.shape[0]]
+
+        # consecutive uint64 ids in root order (matches the block pipeline's
+        # relabeling up to partition equality); background -1 → 0 first so the
+        # shared helper keeps zero
+        from ..ops.relabel import relabel_consecutive_np
+
+        shifted = np.where(raw_labels < 0, 0, raw_labels.astype(np.int64) + 1)
+        out, n_labels = relabel_consecutive_np(shifted.astype(np.uint64))
+
+        f = store_mod.file_reader(self.output_path, "a")
+        block_shape = conf.get("block_shape")
+        ds = f.require_dataset(
+            self.output_key, shape=out.shape, dtype="uint64",
+            chunks=tuple(block_shape) if block_shape else None,
+            compression="gzip",
+        )
+        ds[:] = out
+        ds.attrs["n_labels"] = int(n_labels)
+        self.log(
+            f"sharded CC over {n_dev} devices: {n_labels} components"
+        )

@@ -15,6 +15,7 @@ from ..tasks.thresholded_components import (
     BlockFacesTask,
     MergeAssignmentsTask,
     MergeOffsetsTask,
+    ShardedComponentsTask,
 )
 from ..tasks.write import WriteTask
 
@@ -22,7 +23,13 @@ from ..tasks.write import WriteTask
 
 
 class ThresholdedComponentsWorkflow(WorkflowBase):
-    """threshold → block CC → offsets → faces → union-find → write."""
+    """threshold → block CC → offsets → faces → union-find → write.
+
+    ``sharded=True`` replaces the 5-task block pipeline with ONE collective
+    task (``ShardedComponentsTask``): the volume z-shards over the device
+    mesh and the cross-block merge rides ICI (ppermute + psum) instead of
+    the scratch store — for volumes that fit the mesh's aggregate HBM.
+    """
 
     task_name = "thresholded_components_workflow"
 
@@ -39,6 +46,7 @@ class ThresholdedComponentsWorkflow(WorkflowBase):
         assignment_path: Optional[str] = None,
         mask_path: str = None,
         mask_key: str = None,
+        sharded: bool = False,
     ):
         super().__init__(tmp_folder, config_dir, max_jobs, target)
         self.input_path = input_path
@@ -47,8 +55,23 @@ class ThresholdedComponentsWorkflow(WorkflowBase):
         self.output_key = output_key
         self.mask_path = mask_path
         self.mask_key = mask_key
+        self.sharded = sharded
 
     def requires(self):
+        if self.sharded:
+            return [
+                ShardedComponentsTask(
+                    self.tmp_folder,
+                    self.config_dir,
+                    self.max_jobs,
+                    input_path=self.input_path,
+                    input_key=self.input_key,
+                    output_path=self.output_path,
+                    output_key=self.output_key,
+                    mask_path=self.mask_path,
+                    mask_key=self.mask_key,
+                )
+            ]
         blocks_key = self.output_key + "_blocks"
         components = BlockComponentsTask(
             self.tmp_folder,
@@ -102,6 +125,7 @@ class ThresholdedComponentsWorkflow(WorkflowBase):
     def get_config(cls):
         conf = super().get_config()
         conf["block_components"] = BlockComponentsTask.default_task_config()
+        conf["sharded_components"] = ShardedComponentsTask.default_task_config()
         conf["write"] = WriteTask.default_task_config()
         return conf
 

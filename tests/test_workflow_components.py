@@ -56,6 +56,37 @@ def test_thresholded_components_matches_scipy(tmp_path, rng, target):
     _assert_same_partition(got, want)
 
 
+def test_sharded_components_workflow_matches_block_pipeline(tmp_path, rng):
+    """sharded=True routes through ONE collective task (z-sharded volume +
+    ICI boundary exchange) and must produce the block pipeline's partition."""
+    path, raw = _make_volume(tmp_path, rng)
+    threshold = 0.55
+    outs = {}
+    for name, sharded in [("blocks", False), ("sharded", True)]:
+        tmp_folder = str(tmp_path / f"tmp_{name}")
+        config_dir = str(tmp_path / f"configs_{name}")
+        cfg.write_global_config(
+            config_dir, {"block_shape": [16, 16, 16], "target": "tpu"}
+        )
+        cfg.write_config(config_dir, "block_components", {"threshold": threshold})
+        cfg.write_config(config_dir, "sharded_components", {"threshold": threshold})
+        wf = ThresholdedComponentsWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            output_path=path, output_key=f"components_{name}",
+            sharded=sharded,
+        )
+        assert build([wf])
+        outs[name] = file_reader(path, "r")[f"components_{name}"][:]
+
+    want, _ = ndimage.label(raw > threshold)
+    _assert_same_partition(outs["sharded"], want)
+    _assert_same_partition(outs["sharded"], outs["blocks"])
+    # consecutive uint64 ids, background preserved
+    ids = np.unique(outs["sharded"])
+    assert ids[0] == 0 and (np.diff(ids) == 1).all()
+
+
 def test_relabel_workflow_makes_consecutive(tmp_path, rng):
     path = str(tmp_path / "data.zarr")
     labels = rng.choice([0, 7, 1000, 123456789], size=(24, 24, 24)).astype("uint64")
