@@ -12,6 +12,7 @@ from .sharded import (
     sharded_connected_components,
     sharded_seeded_watershed,
 )
+from .sharded_watershed import sharded_dt_watershed
 
 __all__ = [
     "get_mesh",
@@ -26,4 +27,5 @@ __all__ = [
     "halo_exchange",
     "sharded_connected_components",
     "sharded_seeded_watershed",
+    "sharded_dt_watershed",
 ]

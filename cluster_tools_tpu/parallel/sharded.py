@@ -60,26 +60,49 @@ def _neighbor_planes(plane, axis_name, direction):
 
 
 def halo_exchange(x, halo: int, axis_name: str, fill=0):
-    """Extend a z-sharded array with ``halo`` boundary planes from each mesh
-    neighbor (call inside ``shard_map``).  Outer shards pad with ``fill``.
+    """Extend a z-sharded array with ``halo`` boundary planes from its mesh
+    neighbors (call inside ``shard_map``).  Beyond-the-volume planes (outer
+    shards) pad with ``fill``.
 
-    Returns the locally-extended array of shape (Zl + 2*halo, ...) — the ICI
-    equivalent of the reference's overlapping chunk reads (SURVEY.md §2.8.2).
+    A halo deeper than one shard chains ppermutes — hop k forwards the block
+    received at hop k-1, so shard i accumulates shards i∓1..i∓hops — and
+    slices the nearest ``halo`` planes.  Returns shape (Zl + 2*halo, ...):
+    the ICI equivalent of the reference's overlapping chunk reads
+    (SURVEY.md §2.8.2).
     """
-    if halo > x.shape[0]:
-        # a deeper halo would need multi-hop ppermute; silently returning
-        # fewer planes than promised corrupts the caller's stencil
-        raise ValueError(
-            f"halo {halo} exceeds the local shard depth {x.shape[0]}"
-        )
-    lo = _neighbor_planes(x[-halo:], axis_name, +1)  # from the -z neighbor
-    hi = _neighbor_planes(x[:halo], axis_name, -1)   # from the +z neighbor
+    z_local = x.shape[0]
+    hops = -(-halo // z_local)  # ceil
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
-    fill_lo = jnp.full_like(lo, fill)
-    fill_hi = jnp.full_like(hi, fill)
-    lo = jnp.where(idx == 0, fill_lo, lo)
-    hi = jnp.where(idx == n - 1, fill_hi, hi)
+
+    def gather(direction):
+        if hops == 1:
+            # common case: one hop moves only the needed boundary planes
+            plane = x[-halo:] if direction > 0 else x[:halo]
+            got = _neighbor_planes(plane, axis_name, direction)
+            missing = (idx < 1) if direction > 0 else (idx >= n - 1)
+            return jnp.where(missing, jnp.full_like(got, fill), got)
+        # shallow shards: chain full blocks (hop h forwards hop h-1's block,
+        # so shard i accumulates shards i∓1..i∓hops), then slice
+        parts = []
+        block = x
+        for h in range(1, hops + 1):
+            block = _neighbor_planes(block, axis_name, direction)
+            missing = (idx < h) if direction > 0 else (idx >= n - h)
+            block = jnp.where(missing, jnp.full_like(block, fill), block)
+            # keep global z order: lo side grows downward (farthest first),
+            # hi side grows upward (nearest first)
+            if direction > 0:
+                parts.insert(0, block)
+            else:
+                parts.append(block)
+        stacked = jnp.concatenate(parts, axis=0)
+        # nearest `halo` planes: the trailing ones on the lo side, the
+        # leading ones on the hi side
+        return stacked[-halo:] if direction > 0 else stacked[:halo]
+
+    lo = gather(+1)  # from the -z side
+    hi = gather(-1)  # from the +z side
     return jnp.concatenate([lo, x, hi], axis=0)
 
 

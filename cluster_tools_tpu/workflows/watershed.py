@@ -9,7 +9,12 @@ from __future__ import annotations
 from typing import Optional
 
 from ..runtime.workflow import WorkflowBase
-from ..tasks.watershed import AgglomerateTask, TwoPassWatershedTask, WatershedTask
+from ..tasks.watershed import (
+    AgglomerateTask,
+    ShardedWatershedTask,
+    TwoPassWatershedTask,
+    WatershedTask,
+)
 
 
 class WatershedWorkflow(WorkflowBase):
@@ -29,6 +34,7 @@ class WatershedWorkflow(WorkflowBase):
         mask_key: str = None,
         two_pass: bool = False,
         agglomeration: bool = False,
+        sharded: bool = False,
         dependencies=(),
     ):
         super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
@@ -40,6 +46,7 @@ class WatershedWorkflow(WorkflowBase):
         self.mask_key = mask_key
         self.two_pass = two_pass
         self.agglomeration = agglomeration
+        self.sharded = sharded
 
     def requires(self):
         kwargs = dict(
@@ -50,6 +57,32 @@ class WatershedWorkflow(WorkflowBase):
             mask_path=self.mask_path,
             mask_key=self.mask_key,
         )
+        if self.sharded:
+            # whole-volume collective DT-watershed over the device mesh: no
+            # block offsets, no halos, one globally-consistent fragmentation
+            # (volume must fit the mesh's aggregate HBM; 3d mode, no mask)
+            if self.mask_path:
+                raise ValueError(
+                    "sharded watershed does not support masks yet — use the "
+                    "block pipeline"
+                )
+            if self.two_pass or self.agglomeration:
+                raise ValueError(
+                    "sharded watershed is already globally consistent — "
+                    "two_pass/agglomeration do not apply"
+                )
+            sharded_kwargs = dict(kwargs)
+            sharded_kwargs.pop("mask_path")
+            sharded_kwargs.pop("mask_key")
+            return [
+                ShardedWatershedTask(
+                    self.tmp_folder,
+                    self.config_dir,
+                    self.max_jobs,
+                    dependencies=list(self.dependencies),
+                    **sharded_kwargs,
+                )
+            ]
         if self.two_pass:
             pass1 = TwoPassWatershedTask(
                 self.tmp_folder,
@@ -109,4 +142,5 @@ class WatershedWorkflow(WorkflowBase):
         conf = super().get_config()
         conf["watershed"] = WatershedTask.default_task_config()
         conf["agglomerate"] = AgglomerateTask.default_task_config()
+        conf["sharded_watershed"] = ShardedWatershedTask.default_task_config()
         return conf

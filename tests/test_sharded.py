@@ -113,7 +113,8 @@ def test_sharded_cc_single_plane_shards(rng):
     np.testing.assert_array_equal(got, ref)
 
 
-def test_halo_exchange_rejects_deep_halo():
+def test_halo_exchange_multi_hop():
+    # halo deeper than one shard: planes chain through multiple neighbors
     from functools import partial
 
     import jax.numpy as jnp
@@ -122,14 +123,24 @@ def test_halo_exchange_rejects_deep_halo():
     from cluster_tools_tpu.parallel.sharded import shard_map
 
     mesh = get_mesh()
-    x = np.zeros((16, 4, 4), dtype=np.float32)  # z_local = 2
+    x = np.arange(16 * 2 * 2, dtype=np.float32).reshape(16, 2, 2)  # Zl = 2
     xd = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data")))
+    halo = 5  # needs 3 hops at z_local = 2
     fn = shard_map(
-        partial(halo_exchange, halo=3, axis_name="data"),
+        partial(halo_exchange, halo=halo, axis_name="data", fill=-1.0),
         mesh=mesh, in_specs=P("data"), out_specs=P("data"),
     )
-    with pytest.raises(ValueError, match="halo 3 exceeds"):
-        jax.jit(fn)(xd)
+    out = np.asarray(jax.jit(fn)(xd)).reshape(8, 2 * halo + 2, 2, 2)
+    for s in range(8):
+        z0 = 2 * s
+        np.testing.assert_array_equal(out[s, halo : halo + 2], x[z0 : z0 + 2])
+        for k in range(halo):
+            src = z0 - halo + k
+            want = x[src] if src >= 0 else np.full((2, 2), -1.0)
+            np.testing.assert_array_equal(out[s, k], want)
+            src = z0 + 2 + k
+            want = x[src] if src < 16 else np.full((2, 2), -1.0)
+            np.testing.assert_array_equal(out[s, halo + 2 + k], want)
 
 
 class TestShardedFlood:
