@@ -33,7 +33,6 @@ agrees — ``sharded_dt_watershed`` yields the SAME PARTITION as
 from __future__ import annotations
 
 from functools import partial
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -99,32 +98,36 @@ def _sharded_edt(fg, pitch, axis_name):
     return jnp.sqrt(jnp.minimum(g, _DT_BIG)).astype(jnp.float32)
 
 
-def _sharded_gaussian_z(x, sigma, axis_name):
+def _reflect_z(ext, radius, z_local, axis_name, total):
+    """Replace out-of-volume halo planes with the volume's symmetric
+    reflection (jnp.pad mode="symmetric": global position g < 0 mirrors
+    plane -g-1, g >= total mirrors 2*total-g-1).  ``total`` is the REAL
+    volume depth — when the z-extent was padded up to mesh divisibility this
+    is smaller than n*z_local, and the pad slab itself mirrors real planes.
+    With multi-hop halos a SHALLOW shard near an edge also has out-of-volume
+    planes (not just shard 0 / n-1), and every mirror source provably lies
+    inside this shard's extended range — one gather fixes all cases."""
+    idx = lax.axis_index(axis_name)
+    z0 = idx * z_local
+    g = z0 - radius + jnp.arange(ext.shape[0])
+    src = jnp.where(g < 0, -g - 1, jnp.where(g >= total, 2 * total - g - 1, g))
+    loc = jnp.clip(src - (z0 - radius), 0, ext.shape[0] - 1)
+    return jnp.take(ext, loc, axis=0)
+
+
+def _sharded_gaussian_z(x, sigma, axis_name, total):
     """Gaussian smoothing matching ``filters.gaussian`` on the unsharded
-    volume: y/x passes are plane-local; the z pass convolves a halo-extended
-    shard (neighbor planes via ppermute, symmetric padding at the volume's
-    outer faces — the same boundary rule ``_conv_along_axis`` applies)."""
+    volume of depth ``total``: y/x passes are plane-local; the z pass
+    convolves a halo-extended shard (neighbor planes via ppermute, symmetric
+    padding at the volume's outer faces — the same boundary rule
+    ``_conv_along_axis`` applies)."""
     from ..ops.filters import _conv_along_axis
 
     x = x.astype(jnp.float32)
     kernel = jnp.asarray(_gauss_kernel(float(sigma), 0))
     radius = kernel.shape[0] // 2
     ext = halo_exchange(x, radius, axis_name)
-    n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    z_local = x.shape[0]
-    # replace out-of-volume halo planes with the volume's symmetric
-    # reflection (jnp.pad mode="symmetric": global position g < 0 mirrors
-    # plane -g-1, g >= Z mirrors 2Z-g-1).  With multi-hop halos a SHALLOW
-    # shard near the edge also has out-of-volume planes (not just shard
-    # 0 / n-1), and every mirror source provably lies inside this shard's
-    # extended range — one gather fixes all cases
-    z0 = idx * z_local
-    total = n * z_local
-    g = z0 - radius + jnp.arange(ext.shape[0])
-    src = jnp.where(g < 0, -g - 1, jnp.where(g >= total, 2 * total - g - 1, g))
-    loc = jnp.clip(src - (z0 - radius), 0, ext.shape[0] - 1)
-    ext = jnp.take(ext, loc, axis=0)
+    ext = _reflect_z(ext, radius, x.shape[0], axis_name, total)
     # z pass on the extended shard (halo consumed by the VALID conv)
     moved = jnp.moveaxis(ext, 0, -1)
     smoothed = _conv_along_axis_valid(moved, kernel)
@@ -148,20 +151,13 @@ def _conv_along_axis_valid(x, kernel):
     return out.reshape(batch_shape + (out.shape[-1],))
 
 
-def _local_maxima(smoothed, axis_name):
+def _local_maxima(smoothed, axis_name, total):
     """3x3x3 window maxima across shard boundaries: 1-plane halo exchange,
     then the same symmetric-edge reduce_window the single-device
-    ``maximum_filter`` applies (1-deep symmetric pad == edge value)."""
+    ``maximum_filter`` applies (1-deep symmetric pad == edge value at the
+    real volume boundary ``total``)."""
     ext = halo_exchange(smoothed, 1, axis_name, fill=-np.inf)
-    n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    # outer faces: symmetric 1-pad equals the edge plane itself
-    ext = jnp.where(
-        idx == 0, jnp.concatenate([smoothed[:1], ext[1:]], 0), ext
-    )
-    ext = jnp.where(
-        idx == n - 1, jnp.concatenate([ext[:-1], smoothed[-1:]], 0), ext
-    )
+    ext = _reflect_z(ext, 1, smoothed.shape[0], axis_name, total)
     pad_yx = [(0, 0), (1, 1), (1, 1)]
     padded = jnp.pad(ext, pad_yx, mode="symmetric")
     win = lax.reduce_window(
@@ -174,34 +170,43 @@ def _local_maxima(smoothed, axis_name):
     jax.jit,
     static_argnames=(
         "threshold", "pitch", "sigma_seeds", "sigma_weights", "alpha",
-        "invert_input", "axis_name", "mesh",
+        "invert_input", "axis_name", "mesh", "z_valid",
     ),
 )
 def _stage_a(
     x, threshold, pitch, sigma_seeds, sigma_weights, alpha, invert_input,
-    axis_name, mesh,
+    axis_name, mesh, z_valid,
 ):
     """threshold → EDT → smoothed maxima → height map, one collective jit
-    (module-level so one compilation serves every same-shape volume)."""
+    (module-level so one compilation serves every same-shape volume).
+
+    ``z_valid`` (static) is the REAL volume depth: when z was padded up to
+    mesh divisibility (with a foreground-side value, so the pad contributes
+    no DT background), smoothing mirrors at the true boundary, maxima and
+    the flood mask exclude the pad slab, and the normalization ignores it —
+    the result matches the unpadded single-device kernel exactly."""
 
     def local_fn(x):
+        z_local = x.shape[0]
+        idx = lax.axis_index(axis_name)
+        valid = (idx * z_local + jnp.arange(z_local) < z_valid)[:, None, None]
         if invert_input:
             x = 1.0 - x
         fg = x < threshold
         dt = _sharded_edt(fg, pitch, axis_name)
         smoothed = (
-            _sharded_gaussian_z(dt, sigma_seeds, axis_name)
+            _sharded_gaussian_z(dt, sigma_seeds, axis_name, z_valid)
             if sigma_seeds and sigma_seeds > 0 else dt
         )
-        maxima = _local_maxima(smoothed, axis_name) & (dt > 0)
-        # global normalize for the height map
-        gmin = lax.pmin(jnp.min(dt), axis_name)
-        gmax = lax.pmax(jnp.max(dt), axis_name)
+        maxima = _local_maxima(smoothed, axis_name, z_valid) & (dt > 0) & valid
+        # global normalize for the height map, over real voxels only
+        gmin = lax.pmin(jnp.min(jnp.where(valid, dt, _DT_BIG)), axis_name)
+        gmax = lax.pmax(jnp.max(jnp.where(valid, dt, -_DT_BIG)), axis_name)
         dtn = (dt - gmin) / jnp.maximum(gmax - gmin, 1e-6)
         hmap = alpha * x + (1.0 - alpha) * (1.0 - dtn)
         if sigma_weights and sigma_weights > 0:
-            hmap = _sharded_gaussian_z(hmap, sigma_weights, axis_name)
-        return fg, maxima, hmap
+            hmap = _sharded_gaussian_z(hmap, sigma_weights, axis_name, z_valid)
+        return fg & valid, maxima, hmap
 
     return shard_map(
         local_fn, mesh=mesh, in_specs=P(axis_name),
@@ -226,18 +231,28 @@ def sharded_dt_watershed(
 
     Returns ``(labels int32 [host], n_seeds)``: labels carry seed-plateau
     root ids (+1); the partition equals the single-device kernel's (ids are
-    order-isomorphic, so the min-label tie-break agrees — tested).  The size
-    filter counts on host between two collective programs (see module
-    docstring).  The volume's z-extent must be divisible by the mesh size;
-    shards shallower than a gaussian radius are fine (multi-hop halos).
+    order-isomorphic, so the min-label tie-break agrees — tested, including
+    non-divisible z).  The size filter counts on host between two collective
+    programs (see module docstring).  A z-extent not divisible by the mesh
+    size is padded internally on the foreground side of the threshold — the
+    pad contributes no DT background, mirrors at the TRUE boundary for
+    smoothing, and is excluded from seeds/flood/counts, so the result still
+    matches the unpadded single-device kernel.  Shards shallower than a
+    gaussian radius are fine (multi-hop halos).
     """
     from .sharded import sharded_seeded_watershed
 
     mesh = mesh if mesh is not None else get_mesh(axis_name=axis_name)
     n = mesh.shape[axis_name]
-    if input_.shape[0] % n:
-        raise ValueError(
-            f"z extent {input_.shape[0]} not divisible by mesh size {n}"
+    z_valid = int(input_.shape[0])
+    pad = (-z_valid) % n
+    input_ = np.asarray(input_, dtype=np.float32)
+    if pad:
+        # foreground side of the threshold AFTER the kernel's inversion
+        # (assumes 0 < threshold < 1, the reference's probability range)
+        pad_val = 1.0 if invert_input else 0.0
+        input_ = np.pad(
+            input_, ((0, pad), (0, 0), (0, 0)), constant_values=pad_val
         )
     pitch = (1.0,) * 3 if pixel_pitch is None else tuple(
         float(p) for p in pixel_pitch
@@ -249,7 +264,7 @@ def sharded_dt_watershed(
 
     fg_d, maxima_d, hmap_d = _stage_a(
         x_d, threshold, pitch, sigma_seeds, sigma_weights, alpha,
-        invert_input, axis_name, mesh,
+        invert_input, axis_name, mesh, z_valid,
     )
 
     # seed-plateau CC over the mesh (full connectivity, like dt_seeds)
@@ -265,6 +280,8 @@ def sharded_dt_watershed(
     uniq, counts = np.unique(labels, return_counts=True)
     n_seeds = int((uniq > 0).sum())
     if size_filter > 0:
+        # the pad slab holds no labels (flood mask excludes it), so these
+        # counts are real-voxel counts
         too_small = uniq[(counts < size_filter) & (uniq > 0)]
         if too_small.size:
             kept = np.where(np.isin(labels, too_small), 0, labels)
@@ -274,4 +291,4 @@ def sharded_dt_watershed(
                     axis_name=axis_name,
                 )
             )
-    return labels, n_seeds
+    return labels[:z_valid], n_seeds

@@ -544,9 +544,6 @@ class ShardedWatershedTask(VolumeTask):
         devices = resolve_devices(config)
         mesh = get_mesh(devices)
         n_dev = len(devices)
-        pad = (-raw.shape[0]) % n_dev
-        if pad:
-            raw = np.pad(raw, ((0, pad), (0, 0), (0, 0)), mode="edge")
 
         pitch = config.get("pixel_pitch")
         labels, n_seeds = sharded_dt_watershed(
@@ -560,7 +557,6 @@ class ShardedWatershedTask(VolumeTask):
             size_filter=int(config.get("size_filter", 25)),
             invert_input=bool(config.get("invert_inputs", False)),
         )
-        labels = labels[: blocking.shape[0]]
         out, n_labels = relabel_consecutive_np(labels.astype(np.uint64))
         self.output_ds()[:] = out
         self.log(

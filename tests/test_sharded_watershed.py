@@ -52,9 +52,21 @@ def test_sharded_dtws_no_smoothing(rng):
     assert _bijection(got, np.asarray(ref))
 
 
-def test_sharded_dtws_rejects_bad_extent(rng):
-    with pytest.raises(ValueError, match="not divisible"):
-        sharded_dt_watershed(_volume(rng, shape=(9, 16, 16)))
+def test_sharded_dtws_non_divisible_z(rng):
+    """z=25 on the 8-device mesh: internal foreground-side padding, mirrors
+    at the TRUE boundary, pad excluded from seeds/flood/counts — the result
+    still matches the unpadded single-device kernel exactly (a border
+    fragment must not survive the size filter via padded copies)."""
+    raw = _volume(rng, shape=(25, 16, 16))
+    kwargs = dict(threshold=0.6, sigma_seeds=1.0, sigma_weights=1.0,
+                  alpha=0.8, size_filter=12)
+    ref, n_ref = dt_watershed(
+        jnp.asarray(raw), apply_dt_2d=False, apply_ws_2d=False, **kwargs
+    )
+    got, n_got = sharded_dt_watershed(raw, **kwargs)
+    assert got.shape == raw.shape
+    assert n_got == int(n_ref)
+    assert _bijection(got, np.asarray(ref))
 
 
 def test_sharded_dtws_deep_halo_smoothing(rng):
