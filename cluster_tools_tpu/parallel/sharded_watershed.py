@@ -105,8 +105,13 @@ def _reflect_z(ext, radius, z_local, axis_name, total):
     volume depth — when the z-extent was padded up to mesh divisibility this
     is smaller than n*z_local, and the pad slab itself mirrors real planes.
     With multi-hop halos a SHALLOW shard near an edge also has out-of-volume
-    planes (not just shard 0 / n-1), and every mirror source provably lies
-    inside this shard's extended range — one gather fixes all cases."""
+    planes (not just shard 0 / n-1); one gather fixes all cases.
+
+    Scope: mirror sources are provably in range for every tap feeding a REAL
+    (g < total) output plane; taps feeding pad-slab outputs (internal
+    z-padding, ``total < n*z_local``) may clip to a wrong plane — callers
+    MUST mask pad-slab outputs out (the watershed stages do, via ``valid``).
+    """
     idx = lax.axis_index(axis_name)
     z0 = idx * z_local
     g = z0 - radius + jnp.arange(ext.shape[0])

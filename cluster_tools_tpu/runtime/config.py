@@ -57,6 +57,9 @@ def process_topology(gconf: Dict[str, Any]):
 
 DEFAULT_TASK_CONFIG: Dict[str, Any] = {
     "threads_per_job": 1,
+    # host threads for a block batch's chunk reads (gzip-decode bound;
+    # set 1 for backends where concurrency buys nothing, e.g. hdf5)
+    "read_threads": 4,
     "time_limit": 60,
     "mem_limit": 2,
 }

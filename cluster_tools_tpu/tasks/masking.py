@@ -117,6 +117,7 @@ class MinfilterTask(VolumeTask):
         in_ds = self.input_ds()
         out_ds = self.output_ds()
         batch = read_block_batch(in_ds, blocking, block_ids, halo=halo,
+                                 n_threads=int(config.get("read_threads", 4)),
                                  dtype="float32")
         # replicate-pad the static-shape padding: zero fill would leak
         # "masked out" into border blocks through the min window

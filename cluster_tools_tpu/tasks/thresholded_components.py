@@ -83,7 +83,10 @@ class BlockComponentsTask(VolumeTask):
             sigma = tuple(sigma)
         in_ds = self.input_ds()
         out_ds = self.output_ds()
-        batch = read_block_batch(in_ds, blocking, block_ids, dtype="float32")
+        batch = read_block_batch(
+            in_ds, blocking, block_ids, dtype="float32",
+            n_threads=int(config.get("read_threads", 4)),
+        )
         xb, n = put_sharded(batch.data, config)
         labels, _ = _components_batch(
             xb,

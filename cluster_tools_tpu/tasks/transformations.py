@@ -100,7 +100,10 @@ class LinearTransformationTask(VolumeTask):
     def _run_batch(self, block_ids, blocking: Blocking, config):
         in_ds = self.input_ds()
         out_ds = self.output_ds()
-        batch = read_block_batch(in_ds, blocking, block_ids, dtype="float32")
+        batch = read_block_batch(
+            in_ds, blocking, block_ids, dtype="float32",
+            n_threads=int(config.get("read_threads", 4)),
+        )
         a, b = self._coefficients(blocking, block_ids)
 
         if self.mask_path:

@@ -1,0 +1,19 @@
+"""Synthetic CREMI-like demo volume shared by the example scripts."""
+
+import numpy as np
+from scipy import ndimage
+
+from cluster_tools_tpu.utils import file_reader
+
+
+def make_demo_volume(path, shape=(32, 64, 64), seed=0):
+    """Write a smooth boundary-probability volume (plus a ground-truth-ish
+    label volume from its basins) into an n5 container."""
+    rng = np.random.default_rng(seed)
+    raw = ndimage.gaussian_filter(rng.random(shape), (1.5, 2.5, 2.5))
+    raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+    f = file_reader(path)
+    chunks = tuple(min(16, s) for s in shape)
+    if "boundaries" not in f:
+        f.create_dataset("boundaries", data=raw, chunks=chunks)
+    return path, "boundaries"

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Build a multiscale pyramid with paintera/bdv metadata
+(the role of the reference's example/downscale.py)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import DownscalingWorkflow
+
+
+def run_downscale(input_path, input_key, output_key_prefix,
+                  scale_factors=((1, 2, 2), (1, 2, 2), (2, 2, 2)),
+                  tmp_folder="tmp_ds", config_dir="configs_ds",
+                  target="tpu", metadata_format="paintera"):
+    cfg.write_global_config(config_dir, {
+        "block_shape": [16, 32, 32], "target": target,
+    })
+    wf = DownscalingWorkflow(
+        tmp_folder, config_dir,
+        input_path=input_path, input_key=input_key,
+        scale_factors=scale_factors,
+        output_path=input_path,
+        output_key_prefix=output_key_prefix,
+        metadata_format=metadata_format,
+        metadata_dict={"resolution": [40, 4, 4], "unit": "nm"},
+    )
+    if not build([wf]):
+        raise RuntimeError("downscaling failed")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--demo", action="store_true")
+    p.add_argument("--input", default="demo_data.n5")
+    p.add_argument("--input-key", default="boundaries")
+    p.add_argument("--output-key-prefix", default="pyramid")
+    p.add_argument("--target", default="tpu",
+                   choices=("tpu", "local", "slurm", "lsf"))
+    args = p.parse_args()
+
+    if args.demo:
+        from _demo_data import make_demo_volume
+
+        make_demo_volume(args.input)
+    run_downscale(
+        args.input, args.input_key, args.output_key_prefix, target=args.target
+    )
+    f = file_reader(args.input, "r")
+    scales = sorted(k for k in f[args.output_key_prefix].keys())
+    shapes = [f[f"{args.output_key_prefix}/{s}"].shape for s in scales]
+    print(f"pyramid written: {dict(zip(scales, shapes))}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Postprocess a segmentation: remove small fragments and re-flood the freed
+voxels from the surviving segments (the role of the reference's
+example/postprocessing.py size-filter path).
+
+Chain: morphology (per-segment sizes) → size filter (assignment table of
+kept ids) → filling size filter (discarded voxels re-flooded over the
+boundary map, reference filling_size_filter.py).
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.tasks.postprocess import (
+    SIZE_FILTER_NAME,
+    FillingSizeFilterTask,
+    SizeFilterTask,
+)
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import MorphologyWorkflow
+from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+
+def run_size_filter(path, seg_key, hmap_key, out_key, min_size,
+                    tmp_folder="tmp_pp", config_dir="configs_pp",
+                    target="tpu"):
+    cfg.write_global_config(config_dir, {
+        "block_shape": [16, 32, 32], "target": target,
+    })
+
+    morpho = MorphologyWorkflow(
+        tmp_folder, config_dir, input_path=path, input_key=seg_key,
+    )
+    size_filter = SizeFilterTask(
+        tmp_folder, config_dir, dependencies=[morpho], min_size=min_size,
+        relabel=False,
+    )
+    if not build([size_filter]):
+        raise RuntimeError("size filter failed")
+
+    # kept-id table → discard list for the filling re-flood
+    kept = np.load(os.path.join(tmp_folder, SIZE_FILTER_NAME))[:, 0]
+    seg_ids = file_reader(path, "r")[seg_key][:]
+    all_ids = np.unique(seg_ids)
+    discard = np.setdiff1d(all_ids[all_ids > 0], kept)
+    discard_path = os.path.join(tmp_folder, "discard_ids.npy")
+    np.save(discard_path, discard.astype("uint64"))
+
+    fill = FillingSizeFilterTask(
+        tmp_folder, config_dir,
+        input_path=path, input_key=seg_key,
+        output_path=path, output_key=out_key,
+        hmap_path=path, hmap_key=hmap_key,
+        res_path=discard_path,
+    )
+    if not build([fill]):
+        raise RuntimeError("filling size filter failed")
+    return discard.size
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--demo", action="store_true")
+    p.add_argument("--input", default="demo_data.n5")
+    p.add_argument("--input-key", default="boundaries")
+    p.add_argument("--seg-key", default="segmentation/watershed")
+    p.add_argument("--output-key", default="segmentation/size_filtered")
+    p.add_argument("--min-size", type=int, default=50)
+    p.add_argument("--target", default="tpu",
+                   choices=("tpu", "local", "slurm", "lsf"))
+    args = p.parse_args()
+
+    if args.demo:
+        from _demo_data import make_demo_volume
+
+        make_demo_volume(args.input)
+        cfg.write_global_config("configs_ws_pp", {
+            "block_shape": [16, 32, 32], "target": args.target,
+        })
+        cfg.write_config("configs_ws_pp", "watershed", {
+            "threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 0,
+            "apply_dt_2d": False, "apply_ws_2d": False, "halo": [2, 4, 4],
+        })
+        ws = WatershedWorkflow(
+            "tmp_ws_pp", "configs_ws_pp",
+            input_path=args.input, input_key=args.input_key,
+            output_path=args.input, output_key=args.seg_key,
+        )
+        assert build([ws])
+
+    n_removed = run_size_filter(
+        args.input, args.seg_key, args.input_key, args.output_key,
+        args.min_size, target=args.target,
+    )
+    out = file_reader(args.input, "r")[args.output_key][:]
+    print(f"size filter removed {n_removed} fragments < {args.min_size} vox; "
+          f"{len(np.unique(out)) - 1} segments remain "
+          f"-> {args.input}:{args.output_key}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""This framework's collective path: whole-volume kernels over the device
+mesh (no reference analog — the reference merges cross-block results through
+the filesystem; here the volume z-shards over the mesh and every cross-shard
+dependency rides an ICI collective inside one jit program).
+
+Two entry points:
+  * `ThresholdedComponentsWorkflow(sharded=True)` — global connected
+    components, cross-shard merge via ppermute'd boundary planes;
+  * `WatershedWorkflow(sharded=True)` — the ENTIRE DT-watershed collective:
+    cross-shard EDT, halo'd smoothing, sharded seed-CC, collective flood —
+    one globally-consistent fragmentation, no block offsets, no stitching.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import ThresholdedComponentsWorkflow
+from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--demo", action="store_true")
+    p.add_argument("--input", default="demo_data.n5")
+    p.add_argument("--input-key", default="boundaries")
+    args = p.parse_args()
+
+    if args.demo:
+        from _demo_data import make_demo_volume
+
+        make_demo_volume(args.input)
+
+    config_dir, tmp_folder = "configs_sharded", "tmp_sharded"
+    cfg.write_global_config(config_dir, {
+        "block_shape": [16, 32, 32], "target": "tpu",
+    })
+    cfg.write_config(config_dir, "sharded_components", {"threshold": 0.5})
+    cfg.write_config(config_dir, "sharded_watershed", {
+        "threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 10,
+    })
+
+    cc = ThresholdedComponentsWorkflow(
+        tmp_folder + "_cc", config_dir,
+        input_path=args.input, input_key=args.input_key,
+        output_path=args.input, output_key="sharded/components",
+        sharded=True,
+    )
+    ws = WatershedWorkflow(
+        tmp_folder + "_ws", config_dir,
+        input_path=args.input, input_key=args.input_key,
+        output_path=args.input, output_key="sharded/watershed",
+        sharded=True,
+    )
+    if not build([cc, ws]):
+        raise RuntimeError("sharded workflows failed")
+
+    f = file_reader(args.input, "r")
+    n_cc = len(np.unique(f["sharded/components"][:])) - 1
+    n_ws = len(np.unique(f["sharded/watershed"][:])) - 1
+    import jax
+
+    print(f"collective CC: {n_cc} components, collective DT-watershed: "
+          f"{n_ws} fragments over {jax.device_count()} devices")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Skeletonize a segmentation (the role of the reference's
+example/skeletons.py): per-segment morphology → bbox crop → thinning →
+varlength skeleton serialization."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.workflows import SkeletonWorkflow
+from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--demo", action="store_true")
+    p.add_argument("--input", default="demo_data.n5")
+    p.add_argument("--seg-key", default="segmentation/watershed")
+    p.add_argument("--target", default="tpu",
+                   choices=("tpu", "local", "slurm", "lsf"))
+    args = p.parse_args()
+
+    config_dir, tmp_folder = "configs_skel", "tmp_skel"
+    cfg.write_global_config(config_dir, {
+        "block_shape": [16, 32, 32], "target": args.target,
+    })
+    if args.demo:
+        from _demo_data import make_demo_volume
+
+        make_demo_volume(args.input)
+        cfg.write_config(config_dir, "watershed", {
+            "threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 25,
+            "apply_dt_2d": False, "apply_ws_2d": False, "halo": [2, 4, 4],
+        })
+        ws = WatershedWorkflow(
+            tmp_folder, config_dir,
+            input_path=args.input, input_key="boundaries",
+            output_path=args.input, output_key=args.seg_key,
+        )
+        assert build([ws])
+
+    wf = SkeletonWorkflow(
+        tmp_folder, config_dir,
+        input_path=args.input, input_key=args.seg_key,
+    )
+    if not build([wf]):
+        raise RuntimeError("skeleton workflow failed")
+    from cluster_tools_tpu.tasks.skeletons import SKELETONS_KEY
+    from cluster_tools_tpu.tasks.base import scratch_store_path
+    from cluster_tools_tpu.utils import file_reader
+
+    skels = file_reader(scratch_store_path(tmp_folder), "r")[SKELETONS_KEY]
+    n = sum(
+        1 for i in range(skels.grid_shape[0])
+        if skels.read_chunk((i,)) is not None
+    )
+    print(f"skeletonized {n} segments -> {scratch_store_path(tmp_folder)}")
+
+
+if __name__ == "__main__":
+    main()

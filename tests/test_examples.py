@@ -1,0 +1,30 @@
+"""The example/ scripts must stay runnable (reference example/ parity)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize(
+    "script",
+    ["multicut.py", "sharded_volume.py", "downscale.py",
+     "postprocessing.py", "skeletons.py"],
+)
+def test_example_demo_runs(tmp_path, script):
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", script), "--demo"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip()
