@@ -110,6 +110,16 @@ def merge_threads(task) -> int:
     return max(int(task.get_task_config().get("threads_per_job", 1)), 1)
 
 
+def read_threads(config) -> int:
+    """The ``read_threads`` knob (chunk-read fan-out of a block batch) —
+    DEFAULT_TASK_CONFIG owns the default, this helper just clamps."""
+    from ..runtime.config import DEFAULT_TASK_CONFIG
+
+    return max(
+        int(config.get("read_threads", DEFAULT_TASK_CONFIG["read_threads"])), 1
+    )
+
+
 def resolve_n_blocks(
     config_dir, path: str, key: str, scale: int = 0, space_ndim: int = 3
 ) -> int:

@@ -27,7 +27,7 @@ from ..ops.filters import minimum_filter
 from ..parallel.dispatch import read_block_batch, write_block_batch
 from ..utils import store
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask
+from .base import VolumeSimpleTask, VolumeTask, read_threads
 
 
 def resize_nearest(data: np.ndarray, shape: Sequence[int]) -> np.ndarray:
@@ -117,7 +117,7 @@ class MinfilterTask(VolumeTask):
         in_ds = self.input_ds()
         out_ds = self.output_ds()
         batch = read_block_batch(in_ds, blocking, block_ids, halo=halo,
-                                 n_threads=int(config.get("read_threads", 4)),
+                                 n_threads=read_threads(config),
                                  dtype="float32")
         # replicate-pad the static-shape padding: zero fill would leak
         # "masked out" into border blocks through the min window

@@ -28,6 +28,7 @@ from .base import VolumeSimpleTask, VolumeTask
 from .morphology import MORPHOLOGY_NAME
 
 SIZE_FILTER_NAME = "size_filter_assignments.npy"
+SIZE_FILTER_DISCARD_NAME = "size_filter_discard.npy"
 ID_FILTER_NAME = "id_filter_assignments.npy"
 GRAPH_CC_NAME = "graph_cc_assignments.npy"
 GRAPH_WS_NAME = "graph_watershed_assignments.npy"
@@ -60,6 +61,12 @@ class SizeFilterTask(VolumeSimpleTask):
         )
         assignment = np.stack([kept_ids, new_ids], axis=1)
         np.save(os.path.join(self.tmp_folder, SIZE_FILTER_NAME), assignment)
+        # the complementary discard list drives the apply steps
+        # (background_size_filter / filling_size_filter / graph watershed)
+        discard = ids[~keep & (ids != 0)]
+        np.save(
+            os.path.join(self.tmp_folder, SIZE_FILTER_DISCARD_NAME), discard
+        )
         self.log(
             f"size filter: kept {kept_ids.size}/{ids.size} segments "
             f"(min_size={self.min_size})"
@@ -202,10 +209,15 @@ class OrphanAssignmentsTask(VolumeSimpleTask):
         from .graph import load_graph
 
         nodes, edges = load_graph(self.tmp_store())
-        # assignments: dense per-node cluster vector or (node, cluster) table;
-        # nodes absent from a sparse table keep their own label (mapping them
-        # to 0 would wipe every unlisted segment to background)
-        table = np.load(self.assignment_path)
+        # assignments: dense per-node-index cluster vector or (node, cluster)
+        # table; nodes absent from a sparse table keep their own label
+        # (mapping them to 0 would wipe every unlisted segment to background).
+        # No path = identity: orphans judged on the raw fragment graph.
+        table = (
+            nodes.astype(np.uint64)
+            if self.assignment_path is None
+            else np.load(self.assignment_path)
+        )
         if table.ndim == 2:
             assignments = nodes.astype(np.uint64).copy()
             idx = np.searchsorted(nodes, table[:, 0].astype(nodes.dtype))

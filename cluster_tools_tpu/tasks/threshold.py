@@ -17,7 +17,7 @@ from ..ops import filters
 from ..parallel.dispatch import read_block_batch, write_block_batch
 from ..parallel.mesh import put_sharded
 from ..utils.blocking import Blocking
-from .base import VolumeTask
+from .base import VolumeTask, read_threads
 
 _MODES = {
     "greater": jnp.greater,
@@ -55,7 +55,7 @@ class ThresholdTask(VolumeTask):
         out_ds = self.output_ds()
         batch = read_block_batch(
             in_ds, blocking, block_ids, dtype="float32",
-            n_threads=int(config.get("read_threads", 4)),
+            n_threads=read_threads(config),
         )
         xb, n = put_sharded(batch.data, config)
         result = _threshold_batch(

@@ -29,7 +29,7 @@ from ..ops.unionfind import merge_assignments_device, merge_assignments_np
 from ..parallel.dispatch import read_block_batch, write_block_batch
 from ..parallel.mesh import put_sharded
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, read_threads
 
 MAX_IDS_KEY = "thresholded_components/max_ids"
 FACES_KEY = "thresholded_components/faces"
@@ -85,7 +85,7 @@ class BlockComponentsTask(VolumeTask):
         out_ds = self.output_ds()
         batch = read_block_batch(
             in_ds, blocking, block_ids, dtype="float32",
-            n_threads=int(config.get("read_threads", 4)),
+            n_threads=read_threads(config),
         )
         xb, n = put_sharded(batch.data, config)
         labels, _ = _components_batch(

@@ -24,7 +24,7 @@ import numpy as np
 from ..parallel.dispatch import read_block_batch, write_block_batch
 from ..utils import store
 from ..utils.blocking import Blocking
-from .base import VolumeTask
+from .base import VolumeTask, read_threads
 
 
 def load_transformation(trafo_file: str, n_slices: int) -> Dict[str, Any]:
@@ -102,7 +102,7 @@ class LinearTransformationTask(VolumeTask):
         out_ds = self.output_ds()
         batch = read_block_batch(
             in_ds, blocking, block_ids, dtype="float32",
-            n_threads=int(config.get("read_threads", 4)),
+            n_threads=read_threads(config),
         )
         a, b = self._coefficients(blocking, block_ids)
 

@@ -27,6 +27,14 @@ from .multicut import (
     MulticutWorkflow,
 )
 from .mws import MwsWorkflow, TwoPassMwsWorkflow
+from .postprocessing import (
+    ConnectedComponentsWorkflow,
+    FilterByThresholdWorkflow,
+    FilterLabelsWorkflow,
+    FilterOrphansWorkflow,
+    SizeFilterAndGraphWatershedWorkflow,
+    SizeFilterWorkflow,
+)
 from .stitching import MulticutStitchingWorkflow, SimpleStitchingWorkflow
 from .ilastik import IlastikCarvingWorkflow, IlastikPredictionWorkflow
 from .relabel import RelabelWorkflow, UniqueWorkflow
@@ -62,6 +70,12 @@ __all__ = [
     "MulticutSegmentationWorkflow",
     "MulticutWorkflow",
     "MwsWorkflow",
+    "ConnectedComponentsWorkflow",
+    "FilterByThresholdWorkflow",
+    "FilterLabelsWorkflow",
+    "FilterOrphansWorkflow",
+    "SizeFilterAndGraphWatershedWorkflow",
+    "SizeFilterWorkflow",
     "TwoPassMwsWorkflow",
     "MulticutStitchingWorkflow",
     "SimpleStitchingWorkflow",

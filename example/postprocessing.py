@@ -3,9 +3,9 @@
 voxels from the surviving segments (the role of the reference's
 example/postprocessing.py size-filter path).
 
-Chain: morphology (per-segment sizes) → size filter (assignment table of
-kept ids) → filling size filter (discarded voxels re-flooded over the
-boundary map, reference filling_size_filter.py).
+One composite does the whole chain — morphology (per-segment sizes) → size
+filter → filling re-flood over the boundary map → consecutive relabel:
+``SizeFilterWorkflow(min_size=..., hmap_path=..., relabel=True)``.
 """
 
 import argparse
@@ -17,51 +17,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from cluster_tools_tpu.runtime import build, config as cfg
-from cluster_tools_tpu.tasks.postprocess import (
-    SIZE_FILTER_NAME,
-    FillingSizeFilterTask,
-    SizeFilterTask,
-)
 from cluster_tools_tpu.utils import file_reader
-from cluster_tools_tpu.workflows import MorphologyWorkflow
+from cluster_tools_tpu.workflows import SizeFilterWorkflow
 from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
-
-
-def run_size_filter(path, seg_key, hmap_key, out_key, min_size,
-                    tmp_folder="tmp_pp", config_dir="configs_pp",
-                    target="tpu"):
-    cfg.write_global_config(config_dir, {
-        "block_shape": [16, 32, 32], "target": target,
-    })
-
-    morpho = MorphologyWorkflow(
-        tmp_folder, config_dir, input_path=path, input_key=seg_key,
-    )
-    size_filter = SizeFilterTask(
-        tmp_folder, config_dir, dependencies=[morpho], min_size=min_size,
-        relabel=False,
-    )
-    if not build([size_filter]):
-        raise RuntimeError("size filter failed")
-
-    # kept-id table → discard list for the filling re-flood
-    kept = np.load(os.path.join(tmp_folder, SIZE_FILTER_NAME))[:, 0]
-    seg_ids = file_reader(path, "r")[seg_key][:]
-    all_ids = np.unique(seg_ids)
-    discard = np.setdiff1d(all_ids[all_ids > 0], kept)
-    discard_path = os.path.join(tmp_folder, "discard_ids.npy")
-    np.save(discard_path, discard.astype("uint64"))
-
-    fill = FillingSizeFilterTask(
-        tmp_folder, config_dir,
-        input_path=path, input_key=seg_key,
-        output_path=path, output_key=out_key,
-        hmap_path=path, hmap_key=hmap_key,
-        res_path=discard_path,
-    )
-    if not build([fill]):
-        raise RuntimeError("filling size filter failed")
-    return discard.size
 
 
 def main():
@@ -76,31 +34,42 @@ def main():
                    choices=("tpu", "local", "slurm", "lsf"))
     args = p.parse_args()
 
+    config_dir = "configs_pp"
+    cfg.write_global_config(config_dir, {
+        "block_shape": [16, 32, 32], "target": args.target,
+    })
     if args.demo:
         from _demo_data import make_demo_volume
 
         make_demo_volume(args.input)
-        cfg.write_global_config("configs_ws_pp", {
-            "block_shape": [16, 32, 32], "target": args.target,
-        })
-        cfg.write_config("configs_ws_pp", "watershed", {
+        cfg.write_config(config_dir, "watershed", {
             "threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 0,
             "apply_dt_2d": False, "apply_ws_2d": False, "halo": [2, 4, 4],
         })
         ws = WatershedWorkflow(
-            "tmp_ws_pp", "configs_ws_pp",
+            "tmp_ws_pp", config_dir,
             input_path=args.input, input_key=args.input_key,
             output_path=args.input, output_key=args.seg_key,
         )
         assert build([ws])
 
-    n_removed = run_size_filter(
-        args.input, args.seg_key, args.input_key, args.output_key,
-        args.min_size, target=args.target,
+    wf = SizeFilterWorkflow(
+        "tmp_pp", config_dir,
+        input_path=args.input, input_key=args.seg_key,
+        output_path=args.input, output_key=args.output_key,
+        min_size=args.min_size,
+        hmap_path=args.input, hmap_key=args.input_key,  # filling re-flood
+        relabel=True,
     )
-    out = file_reader(args.input, "r")[args.output_key][:]
-    print(f"size filter removed {n_removed} fragments < {args.min_size} vox; "
-          f"{len(np.unique(out)) - 1} segments remain "
+    if not build([wf]):
+        raise RuntimeError("size filter workflow failed")
+
+    f = file_reader(args.input, "r")
+    n_before = len(np.unique(f[args.seg_key][:])) - 1
+    out = f[args.output_key][:]
+    n_after = len(np.unique(out)) - 1
+    print(f"size filter: {n_before} -> {n_after} segments "
+          f"(< {args.min_size} vox re-flooded into survivors) "
           f"-> {args.input}:{args.output_key}")
 
 

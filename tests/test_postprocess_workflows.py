@@ -1,0 +1,215 @@
+"""Postprocess workflow composites
+(reference postprocess_workflow.py:24-412 equivalents)."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+@pytest.fixture
+def seg_volume(tmp_path, rng):
+    """Segmentation with two large segments and several tiny fragments."""
+    shape = (16, 32, 32)
+    seg = np.ones(shape, dtype="uint64")
+    seg[:, :, 16:] = 2
+    # tiny fragments (8 voxels each) embedded in segment 1
+    seg[2:4, 2:4, 2:4] = 3
+    seg[8:10, 8:10, 8:10] = 4
+    hmap = np.zeros(shape, dtype="float32")
+    hmap[:, :, 15:17] = 1.0
+    path = str(tmp_path / "pp.n5")
+    f = file_reader(path)
+    f.create_dataset("seg", data=seg, chunks=(8, 16, 16))
+    f.create_dataset("hmap", data=hmap, chunks=(8, 16, 16))
+    return path, seg
+
+
+def _env(tmp_path, name):
+    config_dir = str(tmp_path / f"configs_{name}")
+    tmp_folder = str(tmp_path / f"tmp_{name}")
+    cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+    return tmp_folder, config_dir
+
+
+def test_size_filter_workflow_background(tmp_path, seg_volume):
+    from cluster_tools_tpu.workflows import SizeFilterWorkflow
+
+    path, seg = seg_volume
+    tmp_folder, config_dir = _env(tmp_path, "sfb")
+    wf = SizeFilterWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="seg",
+        output_path=path, output_key="filtered_bg",
+        min_size=100,
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["filtered_bg"][:]
+    assert set(np.unique(got)) == {0, 1, 2}  # tiny ids 3,4 -> background
+    assert (got[seg == 3] == 0).all()
+
+
+def test_size_filter_workflow_filling_and_relabel(tmp_path, seg_volume):
+    from cluster_tools_tpu.workflows import SizeFilterWorkflow
+
+    path, seg = seg_volume
+    tmp_folder, config_dir = _env(tmp_path, "sff")
+    wf = SizeFilterWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="seg",
+        output_path=path, output_key="filtered_fill",
+        min_size=100, hmap_path=path, hmap_key="hmap", relabel=True,
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["filtered_fill"][:]
+    # tiny fragments re-flooded from survivors: no background introduced
+    assert (got > 0).all()
+    ids = np.unique(got)
+    assert (np.diff(ids) == 1).all() and ids[0] == 1  # relabeled consecutive
+    assert len(ids) == 2
+
+
+def test_filter_labels_workflow(tmp_path, seg_volume):
+    from cluster_tools_tpu.workflows import FilterLabelsWorkflow
+
+    path, seg = seg_volume
+    tmp_folder, config_dir = _env(tmp_path, "fl")
+    wf = FilterLabelsWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="seg",
+        output_path=path, output_key="filtered_ids",
+        filter_labels=[2, 4],
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["filtered_ids"][:]
+    np.testing.assert_array_equal(
+        got, np.where(np.isin(seg, [2, 4]), 0, seg)
+    )
+
+
+def test_filter_by_threshold_workflow(tmp_path, seg_volume):
+    from cluster_tools_tpu.workflows import FilterByThresholdWorkflow
+
+    path, seg = seg_volume
+    # intensity map: segment 2 bright, everything else dark
+    intensity = np.where(seg == 2, 0.9, 0.1).astype("float32")
+    file_reader(path).create_dataset(
+        "intensity", data=intensity, chunks=(8, 16, 16)
+    )
+    tmp_folder, config_dir = _env(tmp_path, "ft")
+    wf = FilterByThresholdWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="intensity",
+        seg_path=path, seg_key="seg",
+        output_path=path, output_key="filtered_dark",
+        threshold=0.5, threshold_mode="less",  # drop DARK segments
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["filtered_dark"][:]
+    assert set(np.unique(got)) == {0, 2}  # only the bright segment survives
+
+
+def test_filter_orphans_workflow(tmp_path):
+    from cluster_tools_tpu.workflows import FilterOrphansWorkflow
+
+    # chain 1-2-3: 1 and 3 are orphans (single neighbor) and adopt 2
+    labels = np.zeros((8, 8, 24), dtype="uint64")
+    labels[:, :, :8] = 1
+    labels[:, :, 8:16] = 2
+    labels[:, :, 16:] = 3
+    path = str(tmp_path / "orph.n5")
+    file_reader(path).create_dataset("seg", data=labels, chunks=(8, 8, 8))
+    tmp_folder, config_dir = _env(tmp_path, "orph")
+    cfg.write_global_config(config_dir, {"block_shape": [8, 8, 24]})
+    wf = FilterOrphansWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="seg",
+        output_path=path, output_key="no_orphans",
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["no_orphans"][:]
+    assert set(np.unique(got)) == {2}
+
+
+def test_connected_components_workflow(tmp_path):
+    from cluster_tools_tpu.workflows import ConnectedComponentsWorkflow
+
+    # touching segments 1|2 and a detached segment 5
+    labels = np.zeros((8, 8, 24), dtype="uint64")
+    labels[:, :, :8] = 1
+    labels[:, :, 8:12] = 2
+    labels[:, :, 16:] = 5
+    path = str(tmp_path / "gcc.n5")
+    file_reader(path).create_dataset("seg", data=labels, chunks=(8, 8, 8))
+    tmp_folder, config_dir = _env(tmp_path, "gcc")
+    cfg.write_global_config(config_dir, {"block_shape": [8, 8, 24]})
+    wf = ConnectedComponentsWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="seg",
+        output_path=path, output_key="graph_cc",
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["graph_cc"][:]
+    # 1 and 2 share a face -> one component; 5 stays its own; bg preserved
+    c1 = np.unique(got[labels == 1])
+    c2 = np.unique(got[labels == 2])
+    c5 = np.unique(got[labels == 5])
+    assert len(c1) == len(c2) == len(c5) == 1
+    assert c1[0] == c2[0] != c5[0]
+    assert (got[labels == 0] == 0).all()
+
+
+def test_size_filter_graph_watershed_workflow(tmp_path, rng):
+    from cluster_tools_tpu.tasks.costs import ProbsToCostsTask
+    from cluster_tools_tpu.workflows import (
+        EdgeFeaturesWorkflow,
+        GraphWorkflow,
+        SizeFilterAndGraphWatershedWorkflow,
+    )
+
+    # 1|tiny|2 along x: the tiny middle fragment is below min_size and must
+    # re-attach to its strongest-connected neighbor (weak boundary to 1)
+    shape = (8, 16, 24)
+    labels = np.zeros(shape, dtype="uint64")
+    labels[:, :, :10] = 1
+    labels[:, :, 10:12] = 7  # tiny fragment: 8*16*2 = 256 vox
+    labels[:, :, 12:] = 2
+    bnd = np.zeros(shape, dtype="float32")
+    bnd[:, :, 9:11] = 0.1   # WEAK boundary 1|7
+    bnd[:, :, 11:13] = 0.9  # STRONG boundary 7|2
+    path = str(tmp_path / "gw.n5")
+    f = file_reader(path)
+    f.create_dataset("seg", data=labels, chunks=(8, 8, 8))
+    f.create_dataset("bnd", data=bnd, chunks=(8, 8, 8))
+    tmp_folder, config_dir = _env(tmp_path, "gw")
+    cfg.write_global_config(config_dir, {"block_shape": [8, 8, 24]})
+
+    # problem pipeline (graph + features + costs) in the same tmp_folder —
+    # the reference's problem_path
+    graph = GraphWorkflow(
+        tmp_folder, config_dir, input_path=path, input_key="seg"
+    )
+    feats = EdgeFeaturesWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="bnd",
+        labels_path=path, labels_key="seg",
+        dependencies=[graph],
+    )
+    costs = ProbsToCostsTask(tmp_folder, config_dir, dependencies=[feats])
+    assert build([costs])
+
+    wf = SizeFilterAndGraphWatershedWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="seg",
+        output_path=path, output_key="gw_filtered",
+        min_size=1000, relabel=True,
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["gw_filtered"][:]
+    # the tiny fragment adopted segment 1's label (weak shared boundary)
+    assert (np.unique(got[labels == 7]) == np.unique(got[labels == 1])).all()
+    assert (np.unique(got[labels == 2]) != np.unique(got[labels == 1])).all()
+    ids = np.unique(got)
+    assert ids[0] >= 1 and len(ids) == 2  # relabeled, tiny id gone
