@@ -13,6 +13,7 @@ from .sharded import (
     sharded_seeded_watershed,
 )
 from .sharded_watershed import sharded_dt_watershed
+from .sharded_rag import sharded_boundary_edge_features
 
 __all__ = [
     "get_mesh",
@@ -28,4 +29,5 @@ __all__ = [
     "sharded_connected_components",
     "sharded_seeded_watershed",
     "sharded_dt_watershed",
+    "sharded_boundary_edge_features",
 ]

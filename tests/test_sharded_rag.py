@@ -1,0 +1,70 @@
+"""Collective RAG feature accumulation vs the host oracle."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu.ops.rag import HIST_BINS, boundary_edge_features
+from cluster_tools_tpu.parallel.sharded_rag import (
+    sharded_boundary_edge_features,
+)
+
+
+def _fixture(rng, shape=(16, 24, 24), n_seg=40):
+    labels = rng.integers(1, n_seg, tuple(s // 4 for s in shape))
+    labels = np.kron(labels, np.ones((4, 4, 4), dtype=np.int64)).astype(
+        np.int32
+    )
+    values = ndimage.gaussian_filter(rng.random(shape), 1.0).astype(np.float32)
+    values = (values - values.min()) / (values.max() - values.min())
+    return labels, values
+
+
+def test_sharded_rag_matches_host_oracle(rng):
+    labels, values = _fixture(rng)
+    edges, feats = sharded_boundary_edge_features(labels, values)
+
+    want_edges, want = boundary_edge_features(
+        labels.astype(np.uint64), values.astype(np.float64)
+    )
+    np.testing.assert_array_equal(edges, want_edges)
+    # exact columns: mean, var, min, max, count
+    np.testing.assert_allclose(
+        feats[:, [0, 2, 8, 9]], want[:, [0, 2, 8, 9]], rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(feats[:, 1], want[:, 1], rtol=1e-4, atol=1e-5)
+    # quantiles: one histogram bin (the block merge's own tolerance)
+    tol = 1.0 / HIST_BINS + 1e-6
+    assert (np.abs(feats[:, 3:8] - want[:, 3:8]) <= tol).all()
+
+
+def test_sharded_rag_cross_shard_edges(rng):
+    # two segments meeting exactly AT a shard boundary: the edge's samples
+    # live in one shard (pair ownership) but merging must still be correct
+    # when a segment pair also touches inside other shards
+    labels = np.ones((16, 8, 8), dtype=np.int32)
+    labels[8:] = 2  # boundary at z=8 == shard boundary on the 8-device mesh
+    values = rng.random((16, 8, 8)).astype(np.float32)
+    edges, feats = sharded_boundary_edge_features(labels, values)
+    want_edges, want = boundary_edge_features(
+        labels.astype(np.uint64), values.astype(np.float64)
+    )
+    np.testing.assert_array_equal(edges, want_edges)
+    np.testing.assert_allclose(
+        feats[:, [0, 2, 8, 9]], want[:, [0, 2, 8, 9]], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_sharded_rag_rejects_bad_extent(rng):
+    labels, values = _fixture(rng, shape=(12, 8, 8))
+    with pytest.raises(ValueError, match="not divisible"):
+        sharded_boundary_edge_features(labels, values)
+
+
+def test_sharded_rag_overflow_fails_loudly(rng):
+    # more distinct edges than max_edges in every shard: the lexicographic
+    # tail would be dropped identically everywhere, so the merged count
+    # alone cannot see it — the local-table guard must raise
+    labels, values = _fixture(rng, shape=(16, 40, 8), n_seg=60)
+    with pytest.raises(RuntimeError, match="overflow"):
+        sharded_boundary_edge_features(labels, values, max_edges=32)
