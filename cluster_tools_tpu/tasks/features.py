@@ -172,3 +172,98 @@ class MergeEdgeFeaturesTask(VolumeSimpleTask):
             exist_ok=True,
         )
         self.log(f"merged features for {n_edges} edges")
+
+
+class ShardedProblemTask(VolumeSimpleTask):
+    """Whole-problem RAG extraction + 10-feature accumulation in ONE
+    collective program over the device mesh
+    (``parallel.sharded_rag.sharded_boundary_edge_features``) — the
+    collective replacement for the InitialSubGraphs→MergeSubGraphs→MapEdgeIds
+    + BlockEdgeFeatures→MergeEdgeFeatures chain when the volume fits the
+    mesh's aggregate HBM.  Writes the standard problem scratch layout
+    (graph/nodes, graph/edges + attrs, features/edges) so every downstream
+    consumer (costs, global multicut solve, postprocess graph tasks) runs
+    unchanged.
+
+    ``input_path/key`` = boundary map, ``labels_path/key`` = segmentation.
+    """
+
+    task_name = "sharded_problem"
+
+    def __init__(self, *args, input_path: str = None, input_key: str = None,
+                 labels_path: str = None, labels_key: str = None, **kwargs):
+        super().__init__(
+            *args, input_path=input_path, input_key=input_key,
+            labels_path=labels_path, labels_key=labels_key, **kwargs,
+        )
+
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update({"max_edges": 16384})
+        return conf
+
+    def run_impl(self) -> None:
+        from .graph import EDGES_KEY, NODES_KEY
+        from ..parallel.mesh import get_mesh, resolve_devices
+        from ..parallel.sharded_rag import sharded_boundary_edge_features
+        from ..utils import store
+
+        conf = {**self.global_config(), **self.get_task_config()}
+        seg = store.file_reader(self.labels_path, "r")[self.labels_key][:]
+        seg = seg.astype(np.uint64)
+        data_ds = store.file_reader(self.input_path, "r")[self.input_key]
+        if len(data_ds.shape) != seg.ndim:
+            raise ValueError(
+                "sharded_problem supports 3d boundary maps only — affinity "
+                "(4d) inputs go through the block pipeline "
+                "(sharded_problem=False with block_edge_features offsets)"
+            )
+        data = data_ds[:]
+        # the block path's normalization convention (BlockEdgeFeaturesTask.
+        # _normalize): uint8 → /255, every other dtype raw — applied BEFORE
+        # the float cast so the two paths agree
+        if data.dtype == np.uint8:
+            data = data.astype(np.float32) / 255.0
+        else:
+            data = np.asarray(data, dtype=np.float32)
+
+        # compact nonzero labels to 1..n (kernel ids = node index + 1)
+        nodes = np.unique(seg)
+        nodes = nodes[nodes > 0]
+        compact = np.searchsorted(nodes, seg) + 1
+        compact = np.where(seg > 0, compact, 0).astype(np.int32)
+
+        devices = resolve_devices(conf)
+        mesh = get_mesh(devices)
+        pad = (-compact.shape[0]) % len(devices)
+        if pad:
+            zpad = ((0, pad),) + ((0, 0),) * (compact.ndim - 1)
+            compact = np.pad(compact, zpad)  # label 0: no pairs in the pad
+            data = np.pad(data, zpad)
+
+        edges_c, feats = sharded_boundary_edge_features(
+            compact, data, mesh=mesh,
+            max_edges=int(conf.get("max_edges", 16384)),
+        )
+        dense = (edges_c - 1).astype(np.int64)  # compact id → node index
+
+        out = self.tmp_store()
+        out.create_dataset(
+            NODES_KEY, data=nodes, chunks=(max(nodes.size, 1),), exist_ok=True
+        )
+        out.create_dataset(
+            EDGES_KEY, data=dense,
+            chunks=(max(dense.shape[0], 1), 2), exist_ok=True,
+        )
+        g = out[EDGES_KEY]
+        g.attrs["n_nodes"] = int(nodes.size)
+        g.attrs["n_edges"] = int(dense.shape[0])
+        out.create_dataset(
+            FEATURES_KEY, data=feats.astype(np.float64),
+            chunks=(max(feats.shape[0], 1), N_FEATURES), exist_ok=True,
+        )
+        self.log(
+            f"sharded problem over {len(devices)} devices: "
+            f"{nodes.size} nodes, {dense.shape[0]} edges"
+        )

@@ -164,6 +164,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         mask_key: str = None,
         n_scales: int = 1,
         skip_ws: bool = False,
+        sharded_problem: bool = False,
         dependencies=(),
     ):
         super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
@@ -177,6 +178,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         self.mask_key = mask_key
         self.n_scales = n_scales
         self.skip_ws = skip_ws
+        self.sharded_problem = sharded_problem
 
     def requires(self):
         dep = list(self.dependencies)
@@ -189,24 +191,39 @@ class MulticutSegmentationWorkflow(WorkflowBase):
                 mask_path=self.mask_path, mask_key=self.mask_key,
             )
             dep = [ws]
-        graph = GraphWorkflow(
-            self.tmp_folder, self.config_dir, self.max_jobs,
-            input_path=self.ws_path, input_key=self.ws_key,
-            dependencies=dep,
-        )
-        feats = EdgeFeaturesWorkflow(
-            self.tmp_folder, self.config_dir, self.max_jobs,
-            input_path=self.input_path, input_key=self.input_key,
-            labels_path=self.ws_path, labels_key=self.ws_key,
-            dependencies=[graph],
-        )
+        if self.sharded_problem:
+            # whole-problem RAG + features in one collective program over the
+            # mesh; no block edge-id maps exist, so the solve is the global
+            # one (n_scales=0) — consistent with the fits-in-HBM regime
+            from ..tasks.features import ShardedProblemTask
+
+            problem = ShardedProblemTask(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                dependencies=dep,
+                input_path=self.input_path, input_key=self.input_key,
+                labels_path=self.ws_path, labels_key=self.ws_key,
+            )
+            n_scales = 0
+        else:
+            graph = GraphWorkflow(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                input_path=self.ws_path, input_key=self.ws_key,
+                dependencies=dep,
+            )
+            problem = EdgeFeaturesWorkflow(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                input_path=self.input_path, input_key=self.input_key,
+                labels_path=self.ws_path, labels_key=self.ws_key,
+                dependencies=[graph],
+            )
+            n_scales = self.n_scales
         costs = ProbsToCostsTask(
-            self.tmp_folder, self.config_dir, dependencies=[feats]
+            self.tmp_folder, self.config_dir, dependencies=[problem]
         )
         mc = MulticutWorkflow(
             self.tmp_folder, self.config_dir, self.max_jobs,
             input_path=self.ws_path, input_key=self.ws_key,
-            n_scales=self.n_scales, dependencies=[costs],
+            n_scales=n_scales, dependencies=[costs],
         )
         write = WriteTask(
             self.tmp_folder, self.config_dir, self.max_jobs,

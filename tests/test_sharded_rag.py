@@ -68,3 +68,86 @@ def test_sharded_rag_overflow_fails_loudly(rng):
     labels, values = _fixture(rng, shape=(16, 40, 8), n_seg=60)
     with pytest.raises(RuntimeError, match="overflow"):
         sharded_boundary_edge_features(labels, values, max_edges=32)
+
+
+def test_sharded_problem_multicut_segmentation(tmp_path, rng):
+    """MulticutSegmentationWorkflow(sharded_problem=True): the collective
+    problem extraction feeds costs + global solve unchanged, and the
+    segmentation partition matches the block-pipeline run."""
+    from cluster_tools_tpu.ops.evaluation import same_partition
+    from cluster_tools_tpu.runtime import build, config as cfg
+    from cluster_tools_tpu.utils import file_reader
+    from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+
+    raw = ndimage.gaussian_filter(rng.random((16, 32, 32)), (1.0, 2.0, 2.0))
+    raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+    path = str(tmp_path / "mc.n5")
+    file_reader(path).create_dataset("bnd", data=raw, chunks=(8, 16, 16))
+
+    segs = {}
+    for name, sharded in [("blocks", False), ("collective", True)]:
+        config_dir = str(tmp_path / f"configs_{name}")
+        tmp_folder = str(tmp_path / f"tmp_{name}")
+        cfg.write_global_config(
+            config_dir, {"block_shape": [8, 16, 16], "target": "tpu"}
+        )
+        cfg.write_config(config_dir, "watershed", {
+            "threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 5,
+            "apply_dt_2d": False, "apply_ws_2d": False, "halo": [2, 4, 4],
+        })
+        wf = MulticutSegmentationWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="bnd",
+            ws_path=path, ws_key=f"ws_{name}",
+            output_path=path, output_key=f"seg_{name}",
+            sharded_problem=sharded,
+        )
+        assert build([wf])
+        segs[name] = file_reader(path, "r")[f"seg_{name}"][:]
+
+    # both runs share the watershed config -> identical fragments; features
+    # differ only in sketch-quantile columns, and the default costs use the
+    # mean column -> identical multicut partitions
+    assert same_partition(segs["collective"], segs["blocks"])
+
+
+def test_sharded_problem_uint8_and_affinity_guard(tmp_path, rng):
+    from cluster_tools_tpu.runtime import build, config as cfg
+    from cluster_tools_tpu.tasks.features import ShardedProblemTask
+    from cluster_tools_tpu.utils import file_reader
+
+    labels, values = _fixture(rng)
+    path = str(tmp_path / "u8.n5")
+    f = file_reader(path)
+    f.create_dataset("seg", data=labels.astype("uint64"), chunks=(8, 12, 12))
+    f.create_dataset(
+        "bnd_u8", data=(values * 255).astype("uint8"), chunks=(8, 12, 12)
+    )
+    f.create_dataset(
+        "affs", data=np.stack([values] * 3), chunks=(3, 8, 12, 12)
+    )
+    config_dir = str(tmp_path / "configs")
+    tmp_folder = str(tmp_path / "tmp")
+    cfg.write_global_config(config_dir, {"block_shape": [8, 12, 12]})
+
+    # uint8 boundary maps normalize by /255 (the block path's convention):
+    # mean features must land in [0, 1]
+    task = ShardedProblemTask(
+        tmp_folder, config_dir,
+        input_path=path, input_key="bnd_u8",
+        labels_path=path, labels_key="seg",
+    )
+    assert build([task])
+    feats = file_reader(
+        tmp_folder + "/data.zarr", "r"
+    )["features/edges"][:]
+    assert feats.shape[1] == 10 and feats[:, 0].max() <= 1.0
+
+    # 4d affinity inputs fail loudly instead of sharding the channel axis
+    bad = ShardedProblemTask(
+        str(tmp_path / "tmp2"), config_dir,
+        input_path=path, input_key="affs",
+        labels_path=path, labels_key="seg",
+    )
+    with pytest.raises(Exception, match="3d boundary maps"):
+        bad.run()
