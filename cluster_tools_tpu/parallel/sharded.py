@@ -29,7 +29,6 @@ Tested on the 8-virtual-device CPU mesh against the scipy oracle
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

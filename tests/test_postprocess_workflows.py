@@ -3,7 +3,6 @@
 
 import numpy as np
 import pytest
-from scipy import ndimage
 
 from cluster_tools_tpu.runtime import build, config as cfg
 from cluster_tools_tpu.utils import file_reader

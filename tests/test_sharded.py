@@ -19,21 +19,13 @@ from cluster_tools_tpu.parallel.sharded import (
 )
 
 
-def _partition_equal(a, b):
-    """Same partition of the voxels (label values may differ)."""
-    a = np.asarray(a).reshape(-1)
-    b = np.asarray(b).reshape(-1)
-    pairs = {}
-    for x, y in zip(a, b):
-        if x in pairs and pairs[x] != y:
-            return False
-        pairs[x] = y
-    rev = {}
-    for x, y in pairs.items():
-        if y in rev and rev[y] != x:
-            return False
-        rev[y] = x
-    return True
+def _cc_partition_equal(raw_labels, ref):
+    """Sharded-CC output (root ids, -1 = background) vs an oracle labeling:
+    shift to the same_partition convention (background 0, ids >= 1)."""
+    from cluster_tools_tpu.ops.evaluation import same_partition
+
+    shifted = np.where(raw_labels < 0, 0, raw_labels.astype(np.int64) + 1)
+    return same_partition(shifted, ref)
 
 
 @pytest.mark.parametrize("connectivity", [1, 3])
@@ -50,7 +42,7 @@ def test_sharded_cc_matches_oracle(rng, connectivity):
     ref, _ = ndimage.label(mask, structure=structure)
 
     assert (got[~mask] == -1).all()
-    assert _partition_equal(got[mask], ref[mask])
+    assert _cc_partition_equal(got, ref)
 
 
 def test_sharded_cc_root_ids_match_single_device(rng):
