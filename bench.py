@@ -27,7 +27,9 @@ The ``extra`` field carries the remaining BASELINE.md configs:
     ``target='tpu'`` on the default device vs the identical workflow with
     ``target='local'`` forced onto the host XLA-CPU backend in a subprocess
     (the reference's deployment model: all-cores local execution,
-    cluster_tasks.py:514-555)
+    cluster_tasks.py:514-555); plus the same pipeline with
+    ``sharded_problem=True`` (the one-program collective RAG+features path)
+    as ``e2e_sharded_problem_wall_s``
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
@@ -334,7 +336,7 @@ def bench_rag(x, repeats):
     return mvox, t_host / t_dev
 
 
-def bench_e2e(x, block_shape):
+def bench_e2e(x, block_shape, platform=None):
     """Full watershed→graph→features→costs→multicut pipeline wall-clock."""
     from bench_e2e_lib import run_pipeline
 
@@ -346,6 +348,42 @@ def bench_e2e(x, block_shape):
         # candidate: this process, default device (the TPU chip under the driver)
         t_dev = run_pipeline(vol_path, x.shape, block_shape, "tpu")
         log(f"[e2e] tpu target {t_dev:.2f} s")
+
+        # the collective problem path (one-program RAG+features + global
+        # solve) on the same volume — in a fresh subprocess on the SAME
+        # default device, so its jit caches are as cold as the block path's
+        # were (in-process it would inherit the shared stages' compiles and
+        # report an incomparably warm wall-clock)
+        sh_script = os.path.join(td, "e2e_sharded.py")
+        # inherit an explicit --platform (debug runs); default = the chip
+        force = (
+            f"import jax; jax.config.update('jax_platforms', {platform!r})\n"
+            if platform else ""
+        )
+        with open(sh_script, "w") as f:
+            f.write(
+                "import json, sys\n"
+                f"sys.path.insert(0, {here!r})\n"
+                + force +
+                "from bench_e2e_lib import run_pipeline\n"
+                f"t = run_pipeline({vol_path!r}, {tuple(x.shape)!r}, "
+                f"{tuple(block_shape)!r}, 'tpu', sharded_problem=True)\n"
+                "print(json.dumps({'wall_s': t}))\n"
+            )
+        try:
+            sh_out = subprocess.run(
+                [sys.executable, sh_script], capture_output=True, text=True,
+                timeout=1200,
+            )
+            if sh_out.returncode != 0:
+                raise RuntimeError(sh_out.stderr[-500:])
+            t_sharded = json.loads(
+                sh_out.stdout.strip().splitlines()[-1]
+            )["wall_s"]
+            log(f"[e2e] tpu sharded-problem {t_sharded:.2f} s (cold subprocess)")
+        except Exception as e:  # report the block path regardless
+            log(f"[e2e] sharded-problem variant failed: {e}")
+            t_sharded = None
 
         # baseline: same framework, host XLA-CPU backend, local target
         script = os.path.join(td, "e2e_cpu.py")
@@ -367,13 +405,13 @@ def bench_e2e(x, block_shape):
         )
         if out.returncode != 0:
             log(f"[e2e] cpu baseline failed:\n{out.stderr[-2000:]}")
-            return x.size / t_dev / 1e6, None
+            return x.size / t_dev / 1e6, None, t_sharded
         t_host = json.loads(out.stdout.strip().splitlines()[-1])["wall_s"]
         log(
             f"[e2e] cpu-local baseline {t_host:.2f} s (subprocess total "
             f"{time.perf_counter()-t0:.1f} s)"
         )
-    return x.size / t_dev / 1e6, t_host / t_dev
+    return x.size / t_dev / 1e6, t_host / t_dev, t_sharded
 
 
 # ---------------------------------------------------------------------------
@@ -506,11 +544,15 @@ def main():
         extra["rag_vs_baseline"] = round(rag_r, 3) if rag_r is not None else None
         _suspect_throughput(rag_v, extra, "rag_timing_suspect")
     if want("e2e"):
-        e2e_v, e2e_r = bench_e2e(make_volume(e2e_shape, seed=3), e2e_block)
+        e2e_v, e2e_r, e2e_sharded = bench_e2e(
+            make_volume(e2e_shape, seed=3), e2e_block, platform=args.platform
+        )
         extra["e2e_multicut_mvox_s"] = round(e2e_v, 3)
         extra["e2e_multicut_vs_baseline"] = (
             round(e2e_r, 3) if e2e_r is not None else None
         )
+        if e2e_sharded is not None:
+            extra["e2e_sharded_problem_wall_s"] = round(e2e_sharded, 2)
 
     print(
         json.dumps(

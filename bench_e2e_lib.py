@@ -14,7 +14,10 @@ import time
 import numpy as np
 
 
-def run_pipeline(vol_path, shape, block_shape, target):
+def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False):
+    """Wall-clock of the full pipeline; ``sharded_problem=True`` swaps the
+    block-wise graph+features extraction for the one-program collective
+    path (ShardedProblemTask + global solve)."""
     from cluster_tools_tpu.runtime import build, config as cfg
     from cluster_tools_tpu.utils import file_reader
     from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
@@ -37,12 +40,16 @@ def run_pipeline(vol_path, shape, block_shape, target):
             {"threshold": 0.5, "sigma_seeds": 2.0, "size_filter": 25,
              "halo": [2, 4, 4]},
         )
+        cfg.write_config(
+            config_dir, "sharded_problem", {"max_edges": 1 << 17}
+        )
         wf = MulticutSegmentationWorkflow(
             tmp_folder, config_dir,
             input_path=data_path, input_key="bnd",
             ws_path=data_path, ws_key="ws",
             output_path=data_path, output_key="seg",
             n_scales=1,
+            sharded_problem=sharded_problem,
         )
         t0 = time.perf_counter()
         ok = build([wf])
