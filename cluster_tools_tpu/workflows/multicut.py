@@ -241,4 +241,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         conf["watershed"] = WatershedTask.default_task_config()
         conf["block_edge_features"] = BlockEdgeFeaturesTask.default_task_config()
         conf["probs_to_costs"] = ProbsToCostsTask.default_task_config()
+        from ..tasks.features import ShardedProblemTask
+
+        conf["sharded_problem"] = ShardedProblemTask.default_task_config()
         return conf
