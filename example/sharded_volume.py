@@ -62,13 +62,33 @@ def main():
     if not build([cc, ws]):
         raise RuntimeError("sharded workflows failed")
 
+    # full multicut with the collective problem extraction (one-program RAG
+    # + features feeding the global solve)
+    from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+
+    cfg.write_config(config_dir, "watershed", {
+        "threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 5,
+        "apply_dt_2d": False, "apply_ws_2d": False, "halo": [2, 4, 4],
+    })
+    mc = MulticutSegmentationWorkflow(
+        tmp_folder + "_mc", config_dir,
+        input_path=args.input, input_key=args.input_key,
+        ws_path=args.input, ws_key="sharded/mc_ws",
+        output_path=args.input, output_key="sharded/multicut",
+        sharded_problem=True,
+    )
+    if not build([mc]):
+        raise RuntimeError("sharded-problem multicut failed")
+
     f = file_reader(args.input, "r")
     n_cc = len(np.unique(f["sharded/components"][:])) - 1
     n_ws = len(np.unique(f["sharded/watershed"][:])) - 1
+    n_mc = len(np.unique(f["sharded/multicut"][:])) - 1
     import jax
 
     print(f"collective CC: {n_cc} components, collective DT-watershed: "
-          f"{n_ws} fragments over {jax.device_count()} devices")
+          f"{n_ws} fragments, collective-problem multicut: {n_mc} segments "
+          f"over {jax.device_count()} devices")
 
 
 if __name__ == "__main__":
