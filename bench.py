@@ -205,6 +205,15 @@ def bench_dtws_batched(x, batch, repeats):
 
     from cluster_tools_tpu.ops.watershed import dt_watershed
 
+    if jax.default_backend() == "cpu":
+        # the work-bound CPU fallback (dead tunnel) blows the config's time
+        # budget at full batch x repeats — shrink instead of skipping, so a
+        # fallback run still reports a (flagged) number
+        batch = min(batch, 2)
+        repeats = min(repeats, 1)
+        log(f"[dtws_batched] cpu backend: shrunk to batch={batch}, "
+            f"repeats={repeats}")
+
     # distinct stack per timed round (+1 warmup), built on device inside
     # measure() so only one mode's span is HBM-resident at a time (a flat
     # 2*(repeats+1)-stack pool would hold ~100 block volumes); rolls differ
