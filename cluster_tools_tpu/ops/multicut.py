@@ -34,6 +34,49 @@ def transform_probabilities_to_costs(
     return costs
 
 
+NODE_LABEL_MODES = ("ignore", "isolate", "ignore_transition")
+
+
+def apply_node_label_costs(
+    costs: np.ndarray,
+    endpoint_labels: np.ndarray,
+    mode: str,
+    max_repulsive: float,
+    max_attractive: float,
+) -> np.ndarray:
+    """Force edge costs from per-endpoint node labels (reference
+    costs/probs_to_costs.py:116-152).
+
+    ``endpoint_labels`` is ``[m, 2]``: the node label of each edge endpoint.
+    A node "has the label" when its value is > 0.
+
+    - ``ignore``: any edge touching a labeled node → ``max_repulsive``
+      (excise labeled nodes from the partition).
+    - ``isolate``: both endpoints labeled → ``max_attractive``; exactly one
+      labeled → ``max_repulsive`` (labeled nodes form their own segment).
+    - ``ignore_transition``: endpoints with *different* label values →
+      ``max_repulsive`` (semantic boundaries must stay cut).
+    """
+    if mode not in NODE_LABEL_MODES:
+        raise ValueError(f"invalid node-label mode {mode!r}, pick from {NODE_LABEL_MODES}")
+    out = np.asarray(costs, dtype=np.float64).copy()
+    lab = np.asarray(endpoint_labels)
+    if lab.ndim != 2 or lab.shape[1] != 2 or lab.shape[0] != out.shape[0]:
+        raise ValueError(
+            f"endpoint_labels must be [{out.shape[0]}, 2], got {lab.shape}"
+        )
+    has = lab > 0
+    if mode == "ignore":
+        out[has.any(axis=1)] = max_repulsive
+    elif mode == "isolate":
+        n_labeled = has.sum(axis=1)
+        out[n_labeled == 2] = max_attractive
+        out[n_labeled == 1] = max_repulsive
+    else:  # ignore_transition
+        out[lab[:, 0] != lab[:, 1]] = max_repulsive
+    return out
+
+
 def _gaec_python(n_nodes: int, uv: np.ndarray, costs: np.ndarray,
                  stop_priority: float = 0.0, mean_mode: bool = False,
                  counts: Optional[np.ndarray] = None) -> np.ndarray:

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..utils.blocking import Blocking
 from .base import VolumeTask
-from .graph import SUB_NODES_KEY, _read_block_with_upper_halo
+from .graph import SUB_NODES_KEY, read_block_with_upper_halo
 
 VIOLATING_IDS_NAME = "check_components_violating_ids.npy"
 FAILED_SUBGRAPH_BLOCKS_NAME = "check_sub_graphs_failed_blocks.npy"
@@ -43,7 +43,7 @@ class CheckSubGraphsTask(VolumeTask):
         super().run()
 
     def process_block(self, block_id: int, blocking: Blocking, config):
-        seg = _read_block_with_upper_halo(
+        seg = read_block_with_upper_halo(
             self.input_ds(), blocking, block_id
         ).astype(np.uint64)
         want = np.unique(seg)

@@ -30,7 +30,7 @@ from ..ops.rag import (
 )
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
-from .graph import _read_block_with_upper_halo, load_graph
+from .graph import read_block_with_upper_halo, load_graph
 
 FEATURE_IDS_KEY = "features/ids"
 FEATURE_VALS_KEY = "features/vals"
@@ -75,7 +75,7 @@ class BlockEdgeFeaturesTask(VolumeTask):
         return store.file_reader(self.labels_path, "r")[self.labels_key]
 
     def process_block(self, block_id: int, blocking: Blocking, config):
-        seg = _read_block_with_upper_halo(
+        seg = read_block_with_upper_halo(
             self.labels_ds(), blocking, block_id
         ).astype(np.uint64)
         data_ds = self.input_ds()

@@ -33,7 +33,7 @@ EDGES_KEY = "graph/edges"
 BLOCK_EDGE_IDS_KEY = "graph/block_edge_ids"
 
 
-def _read_block_with_upper_halo(ds, blocking: Blocking, block_id: int):
+def read_block_with_upper_halo(ds, blocking: Blocking, block_id: int):
     """Block plus one voxel towards the upper neighbors, so cross-block label
     faces are captured (clipped at the volume border)."""
     block = blocking.block(block_id)
@@ -55,7 +55,7 @@ class InitialSubGraphsTask(VolumeTask):
     output_dtype = None
 
     def process_block(self, block_id: int, blocking: Blocking, config):
-        seg = _read_block_with_upper_halo(self.input_ds(), blocking, block_id)
+        seg = read_block_with_upper_halo(self.input_ds(), blocking, block_id)
         seg = seg.astype(np.uint64)
         edges = block_edges(seg)
         sub = self.tmp_ragged(SUB_EDGES_KEY, blocking.n_blocks, np.uint64)

@@ -25,7 +25,7 @@ from ..ops.unionfind import UnionFindNp
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
 from .costs import COSTS_NAME
-from .graph import load_graph
+from .graph import read_block_with_upper_halo, load_graph
 
 ASSIGNMENTS_NAME = "multicut_assignments.npy"
 
@@ -130,7 +130,13 @@ class SolveSubproblemsTask(VolumeTask):
         nodes, _ = load_graph(store)
         edges, costs, node_labeling = load_scale_problem(self, self.scale)
 
-        seg = self.input_ds()[blocking.block(block_id).slicing]
+        # +1 upper halo: the node set must cover both endpoints of every
+        # cross-face edge the graph extraction saw (graph.py reads the same
+        # halo), or those edges land in no subproblem, are never cut, and
+        # ReduceProblem would union-merge them regardless of cost
+        seg = read_block_with_upper_halo(
+            self.input_ds(), blocking, block_id
+        )
         out = self.tmp_ragged(
             f"multicut/s{self.scale}/cut_edges", blocking.n_blocks, np.int64
         )

@@ -165,6 +165,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         n_scales: int = 1,
         skip_ws: bool = False,
         sharded_problem: bool = False,
+        node_label_dict: Optional[dict] = None,
         dependencies=(),
     ):
         super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
@@ -179,6 +180,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         self.n_scales = n_scales
         self.skip_ws = skip_ws
         self.sharded_problem = sharded_problem
+        self.node_label_dict = dict(node_label_dict or {})
 
     def requires(self):
         dep = list(self.dependencies)
@@ -218,7 +220,8 @@ class MulticutSegmentationWorkflow(WorkflowBase):
             )
             n_scales = self.n_scales
         costs = ProbsToCostsTask(
-            self.tmp_folder, self.config_dir, dependencies=[problem]
+            self.tmp_folder, self.config_dir, dependencies=[problem],
+            node_label_dict=self.node_label_dict,
         )
         mc = MulticutWorkflow(
             self.tmp_folder, self.config_dir, self.max_jobs,

@@ -1,0 +1,247 @@
+"""Node-label cost overrides (reference costs/probs_to_costs.py:116-152).
+
+Unit oracle for the three override modes plus an end-to-end check that
+ProbsToCostsTask applies them on top of the transformed costs with the
+5×min / 5×max bounds of the reference (probs_to_costs.py:219-220).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.ops.multicut import (
+    apply_node_label_costs,
+    transform_probabilities_to_costs,
+)
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+
+class TestApplyNodeLabelCosts:
+    # endpoint label combos: both labeled / one labeled / none / equal>0 /
+    # different>0
+    EP = np.array(
+        [[1, 1], [1, 0], [0, 0], [2, 2], [1, 2]], dtype=np.int64
+    )
+
+    def test_ignore(self):
+        costs = np.zeros(5)
+        out = apply_node_label_costs(costs, self.EP, "ignore", -10.0, 10.0)
+        # every edge touching a labeled node is max repulsive
+        np.testing.assert_array_equal(out, [-10, -10, 0, -10, -10])
+
+    def test_isolate(self):
+        costs = np.zeros(5)
+        out = apply_node_label_costs(costs, self.EP, "isolate", -10.0, 10.0)
+        # both labeled → attractive, exactly one → repulsive
+        np.testing.assert_array_equal(out, [10, -10, 0, 10, 10])
+
+    def test_ignore_transition(self):
+        costs = np.zeros(5)
+        out = apply_node_label_costs(
+            costs, self.EP, "ignore_transition", -10.0, 10.0
+        )
+        # differing label values (incl. label↔0) → repulsive
+        np.testing.assert_array_equal(out, [0, -10, 0, 0, -10])
+
+    def test_invalid_mode_raises(self):
+        with pytest.raises(ValueError, match="invalid node-label mode"):
+            apply_node_label_costs(np.zeros(5), self.EP, "bogus", -1.0, 1.0)
+
+    def test_does_not_mutate_input(self):
+        costs = np.zeros(5)
+        apply_node_label_costs(costs, self.EP, "ignore", -10.0, 10.0)
+        assert (costs == 0).all()
+
+
+class TestProbsToCostsNodeLabels:
+    def _problem(self, tmp_path, rng, name, seed=0):
+        from cluster_tools_tpu.workflows import (
+            EdgeFeaturesWorkflow,
+            GraphWorkflow,
+        )
+
+        rng = np.random.default_rng(seed)  # same volume for every `name`
+        labels = rng.integers(1, 25, (8, 16, 16)).astype("uint64")
+        bnd = rng.random((8, 16, 16)).astype("float32")
+        path = str(tmp_path / f"{name}.n5")
+        f = file_reader(path)
+        f.create_dataset("ws", data=labels, chunks=(4, 8, 8))
+        f.create_dataset("bnd", data=bnd, chunks=(4, 8, 8))
+        config_dir = str(tmp_path / f"configs_{name}")
+        tmp_folder = str(tmp_path / f"tmp_{name}")
+        cfg.write_global_config(config_dir, {"block_shape": [4, 8, 8]})
+        graph = GraphWorkflow(
+            tmp_folder, config_dir, input_path=path, input_key="ws"
+        )
+        feats = EdgeFeaturesWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="bnd",
+            labels_path=path, labels_key="ws",
+            dependencies=[graph],
+        )
+        return tmp_folder, config_dir, feats
+
+    def test_override_matches_manual_application(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.costs import COSTS_NAME, ProbsToCostsTask
+
+        # base run without overrides
+        tmp_a, cfg_a, feats_a = self._problem(tmp_path, rng, "base")
+        base = ProbsToCostsTask(tmp_a, cfg_a, dependencies=[feats_a])
+        assert build([base])
+        base_costs = np.load(os.path.join(tmp_a, COSTS_NAME))
+
+        store = file_reader(os.path.join(tmp_a, "data.zarr"), "r")
+        nodes = store["graph/nodes"][:]
+        edges = store["graph/edges"][:]
+
+        # binary node-label table indexed by fragment id
+        table = np.zeros(int(nodes.max()) + 1, dtype=np.uint32)
+        table[nodes[rng.random(nodes.size) < 0.4]] = 1
+        label_path = str(tmp_path / "node_labels.npy")
+        np.save(label_path, table)
+
+        # identical problem, this time with the isolate override
+        tmp_b, cfg_b, feats_b = self._problem(tmp_path, rng, "override")
+        task = ProbsToCostsTask(
+            tmp_b, cfg_b, dependencies=[feats_b],
+            node_label_dict={"isolate": label_path},
+        )
+        assert build([task])
+        got = np.load(os.path.join(tmp_b, COSTS_NAME))
+
+        want = apply_node_label_costs(
+            base_costs,
+            table[nodes[edges]],
+            "isolate",
+            5.0 * base_costs.min(),
+            5.0 * base_costs.max(),
+        )
+        np.testing.assert_allclose(got, want)
+        assert not np.allclose(got, base_costs)  # the override did something
+
+    def test_store_dataset_source_and_bad_mode(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.costs import COSTS_NAME, ProbsToCostsTask
+
+        tmp_folder, config_dir, feats = self._problem(tmp_path, rng, "ds")
+        # labels provided as a chunked-store dataset instead of .npy
+        label_store = str(tmp_path / "labels.n5")
+        # size: fragment ids are < 25 by construction
+        table = np.zeros(25, dtype=np.uint64)
+        table[rng.integers(1, 25, 8)] = 3
+        file_reader(label_store).create_dataset(
+            "node_labels", data=table, chunks=(25,)
+        )
+        task = ProbsToCostsTask(
+            tmp_folder, config_dir, dependencies=[feats],
+            node_label_dict={"ignore_transition": (label_store, "node_labels")},
+        )
+        assert build([task])
+        costs = np.load(os.path.join(tmp_folder, COSTS_NAME))
+        store = file_reader(os.path.join(tmp_folder, "data.zarr"), "r")
+        nodes = store["graph/nodes"][:]
+        edges = store["graph/edges"][:]
+        ep = table[nodes[edges]]
+        transition = ep[:, 0] != ep[:, 1]
+        if transition.any():
+            rep = costs[transition]
+            assert (rep == rep[0]).all() and rep[0] < costs.min() / 4.9
+
+    def test_invalid_mode_rejected_at_construction(self, tmp_path):
+        from cluster_tools_tpu.tasks.costs import ProbsToCostsTask
+
+        with pytest.raises(ValueError, match="invalid node-label modes"):
+            ProbsToCostsTask(
+                str(tmp_path / "tmp_bad"), str(tmp_path / "cfg"),
+                dependencies=[],
+                node_label_dict={"bogus": "labels.npy"},
+            )
+
+    def test_short_label_table_rejected_with_diagnostic(self, tmp_path, rng):
+        from cluster_tools_tpu.tasks.costs import ProbsToCostsTask
+
+        tmp_folder, config_dir, feats = self._problem(tmp_path, rng, "short")
+        label_path = str(tmp_path / "short_labels.npy")
+        np.save(label_path, np.zeros(2, dtype=np.uint32))  # far too short
+        task = ProbsToCostsTask(
+            tmp_folder, config_dir, dependencies=[feats],
+            node_label_dict={"ignore": label_path},
+        )
+        # the failure must name the offending table, not be a bare IndexError
+        with pytest.raises(ValueError, match="node-label table"):
+            build([task])
+
+    def test_identifier_distinguishes_override_dicts(self, tmp_path):
+        from cluster_tools_tpu.tasks.costs import ProbsToCostsTask
+
+        mk = lambda nld: ProbsToCostsTask(
+            str(tmp_path / "t"), str(tmp_path / "c"),
+            dependencies=[], node_label_dict=nld,
+        ).identifier
+        a = mk({"ignore": "a.npy"})
+        b = mk({"ignore": "b.npy"})
+        c = mk({"isolate": "a.npy"})
+        d = mk({"ignore": ("store.n5", "key")})
+        assert len({a, b, c, d}) == 4
+        assert mk(None) == "probs_to_costs"
+
+    def test_workflow_plumbs_node_label_dict(self, tmp_path, rng):
+        """MulticutSegmentationWorkflow(node_label_dict=...) must isolate the
+        labeled fragments in the final segmentation."""
+        from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+        from scipy import ndimage
+
+        # fixed seed: the reference's max_repulsive = 5*min(cost)
+        # (probs_to_costs.py:219) only isolates when min(cost) < 0, which
+        # holds for this volume but not for arbitrary noise draws
+        rng = np.random.default_rng(0)
+        labels_gt = rng.integers(1, 8, (4, 8, 8)).astype("uint64")
+        labels_gt = np.kron(labels_gt, np.ones((2, 2, 2), dtype=np.uint64))
+        bnd = ndimage.gaussian_filter(
+            rng.random(labels_gt.shape), 1.0
+        ).astype("float32")
+        path = str(tmp_path / "wf.n5")
+        f = file_reader(path)
+        f.create_dataset("bnd", data=bnd, chunks=(4, 8, 8))
+        config_dir = str(tmp_path / "configs_wf")
+        tmp_folder = str(tmp_path / "tmp_wf")
+        cfg.write_global_config(config_dir, {"block_shape": [4, 8, 8]})
+        cfg.write_config(
+            config_dir, "watershed",
+            {"threshold": 0.6, "sigma_seeds": 1.0, "size_filter": 0},
+        )
+        # first run watershed-only to learn fragment ids, via a plain workflow
+        wf = MulticutSegmentationWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="bnd",
+            ws_path=path, ws_key="ws",
+            output_path=path, output_key="seg_plain",
+        )
+        assert build([wf])
+        store = file_reader(os.path.join(tmp_folder, "data.zarr"), "r")
+        nodes = store["graph/nodes"][:]
+        # mark one fragment for isolation
+        marked = int(nodes[0])
+        table = np.zeros(int(nodes.max()) + 1, dtype=np.uint32)
+        table[marked] = 1
+        label_path = str(tmp_path / "wf_labels.npy")
+        np.save(label_path, table)
+
+        tmp2 = str(tmp_path / "tmp_wf2")
+        wf2 = MulticutSegmentationWorkflow(
+            tmp2, config_dir,
+            input_path=path, input_key="bnd",
+            ws_path=path, ws_key="ws2",
+            output_path=path, output_key="seg_iso",
+            node_label_dict={"ignore": label_path},
+        )
+        assert build([wf2])
+        ws = file_reader(path, "r")["ws2"][:]
+        seg = file_reader(path, "r")["seg_iso"][:]
+        # the marked fragment's segment id must not be shared by any other
+        # fragment: all its edges were maximally repulsive
+        seg_ids = np.unique(seg[ws == marked])
+        assert seg_ids.size == 1
+        others = seg[(ws != marked) & (ws > 0)]
+        assert seg_ids[0] not in others
