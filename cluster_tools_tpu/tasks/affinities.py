@@ -44,7 +44,7 @@ class InsertAffinitiesTask(VolumeTask):
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
         conf = super().default_task_config()
-        conf.update({"erode_by": 0, "erode_3d": False, "zero_objects_list": None,
+        conf.update({"erode_by": 6, "erode_3d": True, "zero_objects_list": None,
                      "dilate_by": 2, "chunks": None})
         return conf
 
@@ -66,9 +66,9 @@ class InsertAffinitiesTask(VolumeTask):
         # offsets + erosion + in-plane dilation all widen the region whose
         # boundary responses can reach the inner block
         halo = _offsets_halo(self.offsets)
-        erode_by = int(config.get("erode_by", 0))
+        erode_by = int(config["erode_by"])
         dilate_by = int(config.get("dilate_by", 2))
-        if config.get("erode_3d", False):
+        if config["erode_3d"]:
             halo = [max(h, erode_by) for h in halo]
         else:
             halo = [halo[0]] + [max(h, erode_by) for h in halo[1:]]
@@ -93,12 +93,12 @@ class InsertAffinitiesTask(VolumeTask):
         if np.dtype(in_ds.dtype) == np.dtype("uint8"):
             affs /= 255.0
 
-        erode_by = int(config.get("erode_by", 0))
+        erode_by = int(config["erode_by"])
         if erode_by > 0:
             from ..ops.watershed import fit_to_hmap
 
             objs = fit_to_hmap(
-                objs, affs[0].copy(), erode_by, config.get("erode_3d", False)
+                objs, affs[0].copy(), erode_by, config["erode_3d"]
             )
         obj_ids = np.unique(objs)
         obj_ids = obj_ids[obj_ids > 0]

@@ -84,7 +84,7 @@ class ProbsToCostsTask(VolumeSimpleTask):
         conf.update(
             {
                 "beta": 0.5,
-                "weight_edges": True,
+                "weight_edges": False,
                 "weighting_exponent": 1.0,
                 "invert_inputs": False,
             }
@@ -107,7 +107,7 @@ class ProbsToCostsTask(VolumeSimpleTask):
             probs = feats[:, 0]
         if conf.get("invert_inputs", False):
             probs = 1.0 - probs
-        sizes = feats[:, 9] if conf.get("weight_edges", True) else None
+        sizes = feats[:, 9] if conf["weight_edges"] else None
         costs = transform_probabilities_to_costs(
             probs,
             beta=float(conf.get("beta", 0.5)),

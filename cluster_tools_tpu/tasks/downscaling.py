@@ -256,7 +256,7 @@ class ScaleToBoundariesTask(VolumeTask):
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
         conf = super().default_task_config()
-        conf.update({"erode_by": 6, "erode_3d": True, "channel": 0})
+        conf.update({"erode_by": 12, "erode_3d": True, "channel": 0})
         return conf
 
     def get_shape(self) -> Sequence[int]:
@@ -266,7 +266,7 @@ class ScaleToBoundariesTask(VolumeTask):
         return shape[-3:] if len(shape) > 3 else shape
 
     def _halo(self, config):
-        erode_by = config.get("erode_by", 6)
+        erode_by = config["erode_by"]
         h = int(erode_by) if not isinstance(erode_by, dict) else max(
             erode_by.values()
         )
@@ -275,7 +275,7 @@ class ScaleToBoundariesTask(VolumeTask):
     def process_block(self, block_id: int, blocking: Blocking, config):
         from ..ops.watershed import fit_to_hmap
 
-        erode_by = config.get("erode_by", 6)
+        erode_by = config["erode_by"]
         if isinstance(erode_by, dict):
             erode_by = max(erode_by.values())  # per-object radii: use the max
         erode_by = int(erode_by)

@@ -39,7 +39,7 @@ class MwsBlocksTask(VolumeTask):
                     [-2, 0, 0], [0, -3, 0], [0, 0, -3],
                     [-3, -3, -3], [-3, 3, 3],
                 ],
-                "strides": [1, 2, 2],
+                "strides": [1, 1, 1],
                 "randomize_strides": False,
                 "noise_level": 0.0,
                 "halo": [2, 4, 4],

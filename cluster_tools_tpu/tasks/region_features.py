@@ -49,7 +49,7 @@ class RegionFeaturesTask(VolumeTask):
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
         conf = super().default_task_config()
-        conf.update({"channel": None, "ignore_label": None})
+        conf.update({"channel": None, "ignore_label": 0})
         return conf
 
     def process_block(self, block_id: int, blocking: Blocking, config):

@@ -91,7 +91,7 @@ class WatershedTask(VolumeTask):
         # mirrors the reference's knobs (watershed.py:50-61)
         conf.update(
             {
-                "threshold": 0.25,
+                "threshold": 0.5,
                 "apply_dt_2d": True,
                 "apply_ws_2d": True,
                 "pixel_pitch": None,
@@ -115,7 +115,7 @@ class WatershedTask(VolumeTask):
     def _kernel_params(config) -> Dict[str, Any]:
         pitch = config.get("pixel_pitch")
         return dict(
-            threshold=float(config.get("threshold", 0.25)),
+            threshold=float(config["threshold"]),
             apply_dt_2d=bool(config.get("apply_dt_2d", True)),
             apply_ws_2d=bool(config.get("apply_ws_2d", True)),
             pixel_pitch=tuple(pitch) if pitch else None,
@@ -124,9 +124,7 @@ class WatershedTask(VolumeTask):
             alpha=float(config.get("alpha", 0.8)),
             size_filter=int(config.get("size_filter", 25)),
             invert_input=bool(config.get("invert_inputs", False)),
-            non_maximum_suppression=bool(
-                config.get("non_maximum_suppression", False)
-            ),
+            non_maximum_suppression=bool(config["non_maximum_suppression"]),
         )
 
     def _load_mask_batch(self, batch) -> Optional[np.ndarray]:
@@ -388,6 +386,14 @@ class TwoPassWatershedTask(WatershedTask):
         super().__init__(*args, **kwargs)
         self.pass_id = pass_id
 
+    @classmethod
+    def default_task_config(cls):
+        conf = super().default_task_config()
+        # the two-pass variant defaults NMS on (reference
+        # two_pass_watershed.py:54) where plain watershed defaults it off
+        conf["non_maximum_suppression"] = True
+        return conf
+
     @property
     def identifier(self) -> str:
         return f"{self.task_name}_pass{self.pass_id}"
@@ -514,7 +520,7 @@ class ShardedWatershedTask(VolumeTask):
         conf = super().default_task_config()
         conf.update(
             {
-                "threshold": 0.25,
+                "threshold": 0.5,
                 "pixel_pitch": None,
                 "sigma_seeds": 2.0,
                 "sigma_weights": 2.0,
@@ -549,7 +555,7 @@ class ShardedWatershedTask(VolumeTask):
         labels, n_seeds = sharded_dt_watershed(
             raw,
             mesh=mesh,
-            threshold=float(config.get("threshold", 0.25)),
+            threshold=float(config["threshold"]),
             pixel_pitch=tuple(pitch) if pitch else None,
             sigma_seeds=float(config.get("sigma_seeds", 2.0)),
             sigma_weights=float(config.get("sigma_weights", 2.0)),

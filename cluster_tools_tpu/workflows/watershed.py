@@ -141,6 +141,7 @@ class WatershedWorkflow(WorkflowBase):
     def get_config(cls):
         conf = super().get_config()
         conf["watershed"] = WatershedTask.default_task_config()
+        conf["two_pass_watershed"] = TwoPassWatershedTask.default_task_config()
         conf["agglomerate"] = AgglomerateTask.default_task_config()
         conf["sharded_watershed"] = ShardedWatershedTask.default_task_config()
         return conf

@@ -96,6 +96,11 @@ class TestAffinityTasks:
         f.create_dataset("objs", data=objs, chunks=(8, 16, 16))
 
         tmp_folder, config_dir = self._setup(tmp_path, "ins")
+        # no erosion: this checks raw boundary insertion (the default
+        # erode_by=6 would shrink these small objects away)
+        cfg.write_config(
+            config_dir, "insert_affinities", {"erode_by": 0, "erode_3d": False}
+        )
         task = InsertAffinitiesTask(
             tmp_folder, config_dir,
             input_path=path, input_key="affs",

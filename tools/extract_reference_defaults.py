@@ -1,0 +1,98 @@
+"""Extract the reference's per-task default_task_config literals via AST.
+
+Walks every module under the reference checkout (default /root/reference/
+cluster_tools), finds ``default_task_config`` staticmethods, and records the
+dict literal passed to ``config.update({...})`` together with its
+``task_name`` and file:line provenance.  Output: a frozen JSON consumed by
+tests/test_config_parity.py — regenerate with
+
+    python tools/extract_reference_defaults.py > tests/data/reference_task_defaults.json
+
+Only literal keys/values are kept (the reference uses pure literals in these
+dicts), so no reference code is executed.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+
+REFERENCE_ROOT = os.environ.get("CTT_REFERENCE", "/root/reference/cluster_tools")
+
+
+def _literal(node):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return f"<non-literal:{ast.dump(node)[:40]}>"
+
+
+def extract_file(path: str, rel: str):
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = []
+    for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+        task_name = None
+        for stmt in cls.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "task_name"
+                and isinstance(stmt.value, ast.Constant)
+            ):
+                task_name = stmt.value.value
+        fn = next(
+            (
+                s
+                for s in cls.body
+                if isinstance(s, ast.FunctionDef)
+                and s.name == "default_task_config"
+            ),
+            None,
+        )
+        if fn is None or task_name is None:
+            continue
+        defaults = {}
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"
+                and node.args
+                and isinstance(node.args[0], ast.Dict)
+            ):
+                for k, v in zip(node.args[0].keys, node.args[0].values):
+                    if isinstance(k, ast.Constant):
+                        defaults[k.value] = _literal(v)
+        out.append(
+            {
+                "task_name": task_name,
+                "class": cls.name,
+                "source": f"{rel}:{fn.lineno}",
+                "defaults": defaults,
+            }
+        )
+    return out
+
+
+def main():
+    records = []
+    for dirpath, _, filenames in sorted(os.walk(REFERENCE_ROOT)):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, REFERENCE_ROOT)
+            try:
+                records.extend(extract_file(path, rel))
+            except SyntaxError as e:
+                print(f"skip {rel}: {e}", file=sys.stderr)
+    json.dump(records, sys.stdout, indent=1, sort_keys=True)
+    print()
+
+
+if __name__ == "__main__":
+    main()
