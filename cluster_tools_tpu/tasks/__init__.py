@@ -81,6 +81,7 @@ from .evaluation import MeasuresTask, ObjectViTask
 from .multicut import (
     SolveSubproblemsTask,
     ReduceProblemTask,
+    ReducedAssignmentsTask,
     SolveGlobalTask,
     SubSolutionsTask,
 )
@@ -153,6 +154,7 @@ __all__ = [
     "ObjectViTask",
     "SolveSubproblemsTask",
     "ReduceProblemTask",
+    "ReducedAssignmentsTask",
     "SolveGlobalTask",
     "SubSolutionsTask",
 ]

@@ -228,6 +228,43 @@ class SolveGlobalTask(VolumeSimpleTask):
             f"global solve: {n_current} nodes → {int(result.max()) + 1} segments"
         )
 
+def reduced_assignments_name(scale: int) -> str:
+    return f"reduced_assignments_s{scale}.npy"
+
+
+class ReducedAssignmentsTask(VolumeSimpleTask):
+    """Emit the scale-``n`` *reduced* labeling (merged through the
+    hierarchical reduces, but not globally solved) as a (label → segment)
+    table, the role of ``s{n}/node_labeling`` in the reference's
+    ReducedSolutionWorkflow (multicut_workflow.py:103-125)."""
+
+    task_name = "reduced_assignments"
+
+    def __init__(self, *args, scale: int = 0, **kwargs):
+        super().__init__(*args, scale=scale, **kwargs)
+
+    @property
+    def identifier(self) -> str:
+        return f"{self.task_name}_s{self.scale}"
+
+    def run_impl(self) -> None:
+        if self.scale == 0:
+            # identity labeling straight from the graph: scale 0 needs
+            # neither edges nor costs (which may not have been computed)
+            n_nodes = int(self.tmp_store()["graph/edges"].attrs["n_nodes"])
+            node_labeling = np.arange(n_nodes, dtype=np.int64)
+        else:
+            _, _, node_labeling = load_scale_problem(self, self.scale)
+        write_assignment_table(
+            self, node_labeling.astype(np.int64),
+            reduced_assignments_name(self.scale),
+        )
+        self.log(
+            f"scale-{self.scale} reduced labeling: "
+            f"{int(node_labeling.max()) + 1} clusters"
+        )
+
+
 class SubSolutionsTask(VolumeTask):
     """Write each block's standalone sub-solution as a label volume for
     inspection (reference sub_solutions.py:28): the block's subproblem is

@@ -25,6 +25,9 @@ from .multicut import (
     GraphWorkflow,
     MulticutSegmentationWorkflow,
     MulticutWorkflow,
+    ProblemWorkflow,
+    ReducedSolutionWorkflow,
+    SubSolutionsWorkflow,
 )
 from .mws import MwsWorkflow, TwoPassMwsWorkflow
 from .postprocessing import (
@@ -69,6 +72,9 @@ __all__ = [
     "IlastikPredictionWorkflow",
     "MulticutSegmentationWorkflow",
     "MulticutWorkflow",
+    "ProblemWorkflow",
+    "ReducedSolutionWorkflow",
+    "SubSolutionsWorkflow",
     "MwsWorkflow",
     "ConnectedComponentsWorkflow",
     "FilterByThresholdWorkflow",

@@ -26,9 +26,12 @@ from ..tasks.graph import (
 )
 from ..tasks.multicut import (
     ASSIGNMENTS_NAME,
+    ReducedAssignmentsTask,
     ReduceProblemTask,
     SolveGlobalTask,
     SolveSubproblemsTask,
+    SubSolutionsTask,
+    reduced_assignments_name,
 )
 from ..tasks.watershed import WatershedTask
 from ..tasks.write import WriteTask
@@ -108,6 +111,125 @@ class EdgeFeaturesWorkflow(WorkflowBase):
         return [merge]
 
 
+class ProblemWorkflow(WorkflowBase):
+    """Graph extraction → (optional sanity checks) → edge features →
+    (optional) costs: the standalone "problem" pipeline
+    (reference workflows.py:28-107).
+
+    ``sanity_checks`` inserts the per-block subgraph validation between graph
+    extraction and feature accumulation (reference workflows.py:61-72);
+    ``compute_costs=False`` stops after the features (for learning
+    pipelines that predict their own probabilities).
+    """
+
+    task_name = "problem_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
+                 input_path=None, input_key=None,       # boundary/affinity map
+                 ws_path=None, ws_key=None,             # fragment labels
+                 n_scales: int = 1,
+                 sanity_checks: bool = False,
+                 compute_costs: bool = True,
+                 probs_path=None,                       # RF edge probabilities
+                 node_label_dict=None,
+                 sharded_problem: bool = False,
+                 dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.n_scales = n_scales
+        self.sanity_checks = sanity_checks
+        self.compute_costs = compute_costs
+        self.probs_path = probs_path
+        self.node_label_dict = dict(node_label_dict or {})
+        self.sharded_problem = sharded_problem
+
+    def requires(self):
+        dep = list(self.dependencies)
+        if self.sharded_problem:
+            if self.sanity_checks:
+                # the collective path has no per-block subgraph
+                # serialization to verify — refusing beats silently
+                # skipping validation the user asked for
+                raise ValueError(
+                    "sanity_checks=True is not available with "
+                    "sharded_problem=True: the collective problem "
+                    "extraction has no per-block subgraphs to check"
+                )
+            from ..tasks.features import ShardedProblemTask
+
+            problem = ShardedProblemTask(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                dependencies=dep,
+                input_path=self.input_path, input_key=self.input_key,
+                labels_path=self.ws_path, labels_key=self.ws_key,
+            )
+            dep = [problem]
+        else:
+            graph = GraphWorkflow(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                input_path=self.ws_path, input_key=self.ws_key,
+                n_scales=self.n_scales, dependencies=dep,
+            )
+            dep = [graph]
+            if self.sanity_checks:
+                from ..tasks.debugging import CheckSubGraphsTask
+
+                check = CheckSubGraphsTask(
+                    self.tmp_folder, self.config_dir, self.max_jobs,
+                    dependencies=dep,
+                    input_path=self.ws_path, input_key=self.ws_key,
+                )
+                dep = [check]
+            feats = EdgeFeaturesWorkflow(
+                self.tmp_folder, self.config_dir, self.max_jobs,
+                input_path=self.input_path, input_key=self.input_key,
+                labels_path=self.ws_path, labels_key=self.ws_key,
+                dependencies=dep,
+            )
+            dep = [feats]
+        if self.compute_costs:
+            costs = ProbsToCostsTask(
+                self.tmp_folder, self.config_dir, dependencies=dep,
+                probs_path=self.probs_path,
+                node_label_dict=self.node_label_dict,
+            )
+            dep = [costs]
+        return dep
+
+    @classmethod
+    def get_config(cls):
+        conf = super().get_config()
+        conf["block_edge_features"] = BlockEdgeFeaturesTask.default_task_config()
+        conf["probs_to_costs"] = ProbsToCostsTask.default_task_config()
+        from ..tasks.features import ShardedProblemTask
+
+        conf["sharded_problem"] = ShardedProblemTask.default_task_config()
+        return conf
+
+
+def _hierarchical_solve_tasks(
+    wf, n_scales: int, dep: list, ws_path: str, ws_key: str
+) -> list:
+    """solve_subproblems(s) → reduce_problem(s) chains for scales
+    0..n_scales-1, so the scale-``n_scales`` problem exists afterwards."""
+    for scale in range(n_scales):
+        solve = SolveSubproblemsTask(
+            wf.tmp_folder, wf.config_dir, wf.max_jobs,
+            dependencies=dep, scale=scale,
+            input_path=ws_path, input_key=ws_key,
+        )
+        reduce_ = ReduceProblemTask(
+            wf.tmp_folder, wf.config_dir,
+            dependencies=[solve], scale=scale,
+            input_path=ws_path, input_key=ws_key,
+        )
+        dep = [reduce_]
+    return dep
+
+
 class MulticutWorkflow(WorkflowBase):
     """Hierarchical multicut solve (reference multicut_workflow.py:45)."""
 
@@ -122,19 +244,10 @@ class MulticutWorkflow(WorkflowBase):
         self.n_scales = n_scales
 
     def requires(self):
-        dep = list(self.dependencies)
-        for scale in range(self.n_scales):
-            solve = SolveSubproblemsTask(
-                self.tmp_folder, self.config_dir, self.max_jobs,
-                dependencies=dep, scale=scale,
-                input_path=self.input_path, input_key=self.input_key,
-            )
-            reduce_ = ReduceProblemTask(
-                self.tmp_folder, self.config_dir,
-                dependencies=[solve], scale=scale,
-                input_path=self.input_path, input_key=self.input_key,
-            )
-            dep = [reduce_]
+        dep = _hierarchical_solve_tasks(
+            self, self.n_scales, list(self.dependencies),
+            self.input_path, self.input_key,
+        )
         solve_global = SolveGlobalTask(
             self.tmp_folder, self.config_dir, dependencies=dep,
             scale=self.n_scales,
@@ -165,6 +278,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         n_scales: int = 1,
         skip_ws: bool = False,
         sharded_problem: bool = False,
+        sanity_checks: bool = False,
         node_label_dict: Optional[dict] = None,
         dependencies=(),
     ):
@@ -180,6 +294,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         self.n_scales = n_scales
         self.skip_ws = skip_ws
         self.sharded_problem = sharded_problem
+        self.sanity_checks = sanity_checks
         self.node_label_dict = dict(node_label_dict or {})
 
     def requires(self):
@@ -193,40 +308,22 @@ class MulticutSegmentationWorkflow(WorkflowBase):
                 mask_path=self.mask_path, mask_key=self.mask_key,
             )
             dep = [ws]
-        if self.sharded_problem:
-            # whole-problem RAG + features in one collective program over the
-            # mesh; no block edge-id maps exist, so the solve is the global
-            # one (n_scales=0) — consistent with the fits-in-HBM regime
-            from ..tasks.features import ShardedProblemTask
-
-            problem = ShardedProblemTask(
-                self.tmp_folder, self.config_dir, self.max_jobs,
-                dependencies=dep,
-                input_path=self.input_path, input_key=self.input_key,
-                labels_path=self.ws_path, labels_key=self.ws_key,
-            )
-            n_scales = 0
-        else:
-            graph = GraphWorkflow(
-                self.tmp_folder, self.config_dir, self.max_jobs,
-                input_path=self.ws_path, input_key=self.ws_key,
-                dependencies=dep,
-            )
-            problem = EdgeFeaturesWorkflow(
-                self.tmp_folder, self.config_dir, self.max_jobs,
-                input_path=self.input_path, input_key=self.input_key,
-                labels_path=self.ws_path, labels_key=self.ws_key,
-                dependencies=[graph],
-            )
-            n_scales = self.n_scales
-        costs = ProbsToCostsTask(
-            self.tmp_folder, self.config_dir, dependencies=[problem],
+        problem = ProblemWorkflow(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            input_path=self.input_path, input_key=self.input_key,
+            ws_path=self.ws_path, ws_key=self.ws_key,
+            sanity_checks=self.sanity_checks,
             node_label_dict=self.node_label_dict,
+            sharded_problem=self.sharded_problem,
+            dependencies=dep,
         )
+        # the collective problem path has no block edge-id maps, so the solve
+        # is the global one (n_scales=0) — consistent with fits-in-HBM
+        n_scales = 0 if self.sharded_problem else self.n_scales
         mc = MulticutWorkflow(
             self.tmp_folder, self.config_dir, self.max_jobs,
             input_path=self.ws_path, input_key=self.ws_key,
-            n_scales=n_scales, dependencies=[costs],
+            n_scales=n_scales, dependencies=[problem],
         )
         write = WriteTask(
             self.tmp_folder, self.config_dir, self.max_jobs,
@@ -248,3 +345,76 @@ class MulticutSegmentationWorkflow(WorkflowBase):
 
         conf["sharded_problem"] = ShardedProblemTask.default_task_config()
         return conf
+
+
+class SubSolutionsWorkflow(WorkflowBase):
+    """Hierarchical solve to scale ``n_scales``, then write each block's
+    standalone sub-solution for inspection (reference
+    multicut_workflow.py:70-100)."""
+
+    task_name = "sub_solutions_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
+                 ws_path=None, ws_key=None,
+                 output_path=None, output_key=None,
+                 n_scales: int = 0, dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.n_scales = n_scales
+
+    def requires(self):
+        dep = _hierarchical_solve_tasks(
+            self, self.n_scales, list(self.dependencies),
+            self.ws_path, self.ws_key,
+        )
+        sub = SubSolutionsTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=dep, scale=self.n_scales,
+            input_path=self.ws_path, input_key=self.ws_key,
+            output_path=self.output_path, output_key=self.output_key,
+        )
+        return [sub]
+
+
+class ReducedSolutionWorkflow(WorkflowBase):
+    """Hierarchical solve to scale ``n_scales``, then write the *reduced*
+    labeling — merged through the reduces but not globally solved — as a
+    segmentation (reference multicut_workflow.py:103-128).  At
+    ``n_scales=0`` this reproduces the fragments."""
+
+    task_name = "reduced_solution_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None, target=None,
+                 ws_path=None, ws_key=None,
+                 output_path=None, output_key=None,
+                 n_scales: int = 0, dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.n_scales = n_scales
+
+    def requires(self):
+        dep = _hierarchical_solve_tasks(
+            self, self.n_scales, list(self.dependencies),
+            self.ws_path, self.ws_key,
+        )
+        assign = ReducedAssignmentsTask(
+            self.tmp_folder, self.config_dir,
+            dependencies=dep, scale=self.n_scales,
+        )
+        write = WriteTask(
+            self.tmp_folder, self.config_dir, self.max_jobs,
+            dependencies=[assign],
+            input_path=self.ws_path, input_key=self.ws_key,
+            output_path=self.output_path, output_key=self.output_key,
+            assignment_path=os.path.join(
+                self.tmp_folder, reduced_assignments_name(self.n_scales)
+            ),
+            identifier=f"reduced_s{self.n_scales}",
+        )
+        return [write]
