@@ -100,6 +100,10 @@ class InferenceTask(VolumeTask):
                 "preprocess": "zero_mean_unit_variance",
                 "batch_size": 1,
                 "prefetch_threads": 2,
+                # mirror test-time augmentation: None (off) or "all"
+                # (reference frameworks.py:103-131 via neurofire)
+                "augmentation_mode": None,
+                "augmentation_dim": 3,
             }
         )
         return conf
@@ -139,6 +143,8 @@ class InferenceTask(VolumeTask):
                 self.halo,
                 prep_model=config.get("prep_model"),
                 use_best=config.get("use_best", True),
+                augmentation_mode=config.get("augmentation_mode"),
+                augmentation_dim=config.get("augmentation_dim", 3),
                 config=config,
             )
         return self._predictor

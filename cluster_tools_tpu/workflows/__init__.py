@@ -41,6 +41,7 @@ from .postprocessing import (
 from .stitching import MulticutStitchingWorkflow, SimpleStitchingWorkflow
 from .ilastik import IlastikCarvingWorkflow, IlastikPredictionWorkflow
 from .relabel import RelabelWorkflow, UniqueWorkflow
+from .transformations import LinearTransformationWorkflow
 from .thresholded_components import (
     ThresholdAndWatershedWorkflow,
     ThresholdedComponentsWorkflow,
@@ -85,6 +86,7 @@ __all__ = [
     "TwoPassMwsWorkflow",
     "MulticutStitchingWorkflow",
     "SimpleStitchingWorkflow",
+    "LinearTransformationWorkflow",
     "RelabelWorkflow",
     "UniqueWorkflow",
     "ThresholdAndWatershedWorkflow",

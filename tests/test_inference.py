@@ -248,3 +248,131 @@ class TestPytorchCompat:
         out = pred(x)
         assert out.shape == (2, 6, 10, 10)  # halo cropped
         assert 0.0 <= out.min() and out.max() <= 1.0
+
+
+class TestMirrorTTA:
+    def test_flip_sets(self):
+        from cluster_tools_tpu.tasks.frameworks import mirror_flip_sets
+
+        assert len(mirror_flip_sets(3)) == 8
+        assert len(mirror_flip_sets(2)) == 4
+        with pytest.raises(ValueError):
+            mirror_flip_sets(1)
+
+    def test_tta_identity_for_equivariant_forward(self, rng):
+        """A flip-equivariant forward (elementwise) must be unchanged by TTA
+        up to float accumulation."""
+        from cluster_tools_tpu.tasks.frameworks import mirror_tta
+
+        x = rng.random((1, 1, 4, 6, 6)).astype("float32")
+        fwd = lambda d: d * 2.0 + 1.0
+        np.testing.assert_allclose(mirror_tta(fwd, 3)(x), fwd(x), rtol=1e-6)
+
+    def test_tta_averages_out_orientation_bias(self, rng):
+        """A forward that leaks absolute position produces a symmetric output
+        under TTA — the averaging cancels the bias."""
+        from cluster_tools_tpu.tasks.frameworks import mirror_tta
+
+        x = np.zeros((1, 1, 2, 4, 4), dtype="float32")
+
+        def biased(d):
+            out = d.copy()
+            out[..., 0] += 1.0  # depends on absolute x position
+            return out
+
+        out = mirror_tta(biased, 3)(x)
+        # averaged over flips, the +1 at x=0 spreads to x=0 and x=-1 equally
+        np.testing.assert_allclose(out[..., 0], out[..., -1])
+        assert np.allclose(out[..., 0], 0.5)
+
+
+    def test_invalid_augmentation_mode_rejected(self, checkpoint):
+        from cluster_tools_tpu.tasks.frameworks import JaxPredictor
+
+        ckpt, model, params = checkpoint
+        with pytest.raises(ValueError, match="augmentation_mode"):
+            JaxPredictor(ckpt, [0, 0, 0], augmentation_mode="offsets")
+
+    def test_jax_predictor_tta_matches_manual_average(self, checkpoint, rng):
+        from cluster_tools_tpu.tasks.frameworks import (
+            JaxPredictor,
+            mirror_flip_sets,
+        )
+
+        ckpt, model, params = checkpoint
+        x = rng.random((8, 16, 16)).astype("float32")
+        plain = JaxPredictor(ckpt, [0, 0, 0])
+        tta = JaxPredictor(ckpt, [0, 0, 0], augmentation_mode="all")
+        got = tta(x)
+        acc = None
+        for axes in mirror_flip_sets(3):
+            out = plain(np.ascontiguousarray(np.flip(x, axes) if axes else x))
+            out = np.flip(out, axes) if axes else out
+            acc = out.astype("float32") if acc is None else acc + out
+        np.testing.assert_allclose(got, acc / 8, rtol=1e-5, atol=1e-6)
+
+    def test_inference_task_with_tta_runs(self, tmp_path, rng, checkpoint):
+        from cluster_tools_tpu.tasks.inference import InferenceTask
+
+        ckpt, model, params = checkpoint
+        path = str(tmp_path / "tta.n5")
+        raw = rng.random((8, 16, 16)).astype("float32")
+        file_reader(path).create_dataset("raw", data=raw, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs_tta")
+        tmp_folder = str(tmp_path / "tmp_tta")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        cfg.write_config(
+            config_dir, "inference",
+            {"augmentation_mode": "all", "dtype": "float32"},
+        )
+        task = InferenceTask(
+            tmp_folder, config_dir,
+            input_path=path, input_key="raw",
+            output_path=path, output_key={"bmap": [0, 1]},
+            checkpoint_path=ckpt, halo=[0, 0, 0], framework="jax",
+        )
+        assert build([task])
+        out = file_reader(path, "r")["bmap"][:]
+        assert out.shape == raw.shape and np.isfinite(out).all()
+
+
+class TestLinearTransformationWorkflow:
+    def test_composite_and_in_place_default(self, tmp_path, rng):
+        import json as _json
+
+        from cluster_tools_tpu.workflows import LinearTransformationWorkflow
+
+        path = str(tmp_path / "lt.n5")
+        raw = rng.random((16, 16, 16)).astype("float32")
+        f = file_reader(path)
+        f.create_dataset("raw", data=raw, chunks=(8, 8, 8))
+        f.create_dataset("raw2", data=raw, chunks=(8, 8, 8))
+        trafo_file = str(tmp_path / "trafo.json")
+        with open(trafo_file, "w") as fh:
+            _json.dump({"a": 3.0, "b": 1.0}, fh)
+        config_dir = str(tmp_path / "configs_lt")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 8, 8]})
+        # explicit output
+        wf = LinearTransformationWorkflow(
+            str(tmp_path / "tmp_lt"), config_dir,
+            input_path=path, input_key="raw",
+            transformation=trafo_file,
+            output_path=path, output_key="out",
+        )
+        assert build([wf])
+        np.testing.assert_allclose(
+            file_reader(path, "r")["out"][:], 3.0 * raw + 1.0, rtol=1e-5
+        )
+        # in-place when output is omitted (reference
+        # transformation_workflows.py:21-24)
+        wf2 = LinearTransformationWorkflow(
+            str(tmp_path / "tmp_lt2"), config_dir,
+            input_path=path, input_key="raw2",
+            transformation=trafo_file,
+        )
+        assert build([wf2])
+        np.testing.assert_allclose(
+            file_reader(path, "r")["raw2"][:], 3.0 * raw + 1.0, rtol=1e-5
+        )
+        # config surface advertises the linear task
+        assert "linear" in LinearTransformationWorkflow.get_config()
