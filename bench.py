@@ -98,11 +98,16 @@ def timeit(fn, repeats, *, sync=None, variants=None):
     return best
 
 
-def _rolled(x, n, axis=1):
+def _rolled(x, n, axis=1, start=0):
     """n distinct same-shape variants of a volume (rolled along ``axis``) —
-    statistically identical workloads for ``timeit(variants=...)``.  Index 0
-    is the unshifted original (the sacrificial warmup slot)."""
-    return [np.roll(x, 7 * i, axis=axis) if i else x for i in range(n)]
+    statistically identical workloads for ``timeit(variants=...)``.  The
+    first returned element is unshifted when ``start == 0`` (the sacrificial
+    warmup slot); ``start`` offsets the roll sequence so disjoint slices can
+    be built lazily per sweep mode."""
+    return [
+        np.roll(x, 7 * i, axis=axis) if i else x
+        for i in range(start, start + n)
+    ]
 
 
 def rolled_pair_variants(x, labels, n, call):
@@ -163,20 +168,28 @@ def bench_dtws(x, repeats):
     from cluster_tools_tpu import native
     from cluster_tools_tpu.ops.watershed import dt_watershed
 
-    # one disjoint (warmup + repeats) slice of distinct inputs per sweep mode
+    # one disjoint (warmup + repeats) slice of distinct inputs per sweep
+    # mode, device_put inside measure(i) so only one mode's span is
+    # HBM-resident at a time (ADVICE r2: a flat 2*span pool doubled the
+    # footprint for no reason)
     span = repeats + 1
-    xds = [jax.device_put(jnp.asarray(v)) for v in _rolled(x, 2 * span)]
-    variants = [
-        (lambda v: lambda: dt_watershed(v, threshold=0.5))(v) for v in xds
-    ]
-    t_dev, mode, times = _best_sweep_mode(
-        lambda i: timeit(
+
+    def measure(i):
+        xds = [
+            jax.device_put(jnp.asarray(v))
+            for v in _rolled(x, span, start=i * span)
+        ]
+        return timeit(
             None,
             repeats,
             sync=lambda r: r[0].block_until_ready(),
-            variants=variants[i * span : (i + 1) * span],
+            variants=[
+                (lambda v: lambda: dt_watershed(v, threshold=0.5))(v)
+                for v in xds
+            ],
         )
-    )
+
+    t_dev, mode, times = _best_sweep_mode(measure)
     t_host = timeit(
         lambda: native.dt_watershed_cpu(x, threshold=0.5), max(repeats // 2, 1)
     )
@@ -247,19 +260,23 @@ def bench_cc(x, repeats):
 
     mask_np = x < 0.5
     span = repeats + 1
-    masks = [jnp.asarray(v < 0.5) for v in _rolled(x, 2 * span)]
-    variants = [
-        (lambda m: lambda: connected_components(m, connectivity=1))(m)
-        for m in masks
-    ]
-    t_dev, mode, times = _best_sweep_mode(
-        lambda i: timeit(
+
+    def measure(i):
+        # lazily per mode: only span masks HBM-resident at a time
+        masks = [
+            jnp.asarray(v < 0.5) for v in _rolled(x, span, start=i * span)
+        ]
+        return timeit(
             None,
             repeats,
             sync=lambda r: r[0].block_until_ready(),
-            variants=variants[i * span : (i + 1) * span],
+            variants=[
+                (lambda m: lambda: connected_components(m, connectivity=1))(m)
+                for m in masks
+            ],
         )
-    )
+
+    t_dev, mode, times = _best_sweep_mode(measure)
     t_host = timeit(lambda: ndimage.label(mask_np), max(repeats // 2, 1))
     mvox = x.size / t_dev / 1e6
     log(

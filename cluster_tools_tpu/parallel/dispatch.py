@@ -46,7 +46,14 @@ def read_block_batch(
 
     Reads fan out over ``n_threads`` (chunk decode is gzip-bound, so threads
     overlap IO + decompression — the intra-batch analog of the executor's
-    batch pipelining)."""
+    batch pipelining).  HDF5 datasets are forced to a single thread: h5py
+    serializes every call behind a global lock, so the fan-out is pure
+    overhead there (and unsafe on non-threadsafe libhdf5 builds)."""
+    if (
+        getattr(ds, "_is_hdf5", False)
+        or type(ds).__module__.split(".")[0] == "h5py"
+    ):
+        n_threads = 1
     ndim = blocking.ndim
     halo = tuple(halo) if halo is not None else (0,) * ndim
     full_shape = tuple(bs + 2 * h for bs, h in zip(blocking.block_shape, halo))

@@ -72,6 +72,12 @@ class ClusterExecutor(BaseExecutor):
             pickle.dump(task, f)
 
         job_name = f"ctt_{task.identifier}_{os.getpid()}"
+        # the driver may hold cached writable h5 handles (dataset creation in
+        # prepare()); under HDF5 file locking they would block the worker
+        # processes' own opens — release before spawning
+        from ..utils.store import release_h5_handles
+
+        release_h5_handles()
         for job_id in range(n_jobs):
             _, config_path, status_path = job_paths(job_dir, job_id)
             if os.path.exists(status_path):

@@ -180,7 +180,11 @@ class ShardedProblemTask(VolumeSimpleTask):
     (``parallel.sharded_rag.sharded_boundary_edge_features``) — the
     collective replacement for the InitialSubGraphs→MergeSubGraphs→MapEdgeIds
     + BlockEdgeFeatures→MergeEdgeFeatures chain when the volume fits the
-    mesh's aggregate HBM.  Writes the standard problem scratch layout
+    mesh's aggregate HBM.  The practical bound is host RAM, not HBM: the
+    volume is materialized on host as several full-size arrays at once
+    (float data + uint64 seg + int32 compact labels, plus padding copies)
+    before the sharded transfer — budget ~16 bytes/voxel of host memory.
+    Writes the standard problem scratch layout
     (graph/nodes, graph/edges + attrs, features/edges) so every downstream
     consumer (costs, global multicut solve, postprocess graph tasks) runs
     unchanged.

@@ -233,12 +233,15 @@ class ShardedComponentsTask(VolumeSimpleTask):
     """Whole-volume connected components over the device mesh in ONE jit
     program — the collective alternative to the 5-step block pipeline above.
 
-    The volume is z-sharded over the mesh (``devices`` config), thresholded
-    on device, and labeled by ``parallel.sharded.sharded_connected_components``
-    (per-shard sweeps + ppermute'd boundary planes + psum convergence): the
-    cross-block merge that steps 2-4 route through the filesystem happens
-    entirely over ICI.  Use when the volume fits in the mesh's aggregate HBM;
-    the block pipeline remains the out-of-core path.  Output is consecutive
+    Smoothing and thresholding run on the host (scipy / numpy over the full
+    volume), so what crosses to the device is the 1-byte/voxel boolean mask,
+    z-sharded over the mesh (``devices`` config) and labeled by
+    ``parallel.sharded.sharded_connected_components`` (per-shard sweeps +
+    ppermute'd boundary planes + psum convergence): the cross-block merge
+    that steps 2-4 route through the filesystem happens entirely over ICI.
+    Bounds: the full volume must fit in host RAM (a float copy + the mask)
+    and the mask in the mesh's aggregate HBM; the block pipeline remains the
+    out-of-core path.  Output is consecutive
     uint64 labels (background 0) matching the block pipeline's partition at
     ``sigma == 0``; with smoothing the two differ at block borders by design
     — the block path smooths each halo-less block (truncating the filter at

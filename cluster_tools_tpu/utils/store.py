@@ -715,6 +715,157 @@ class File(Group):
         pass
 
 
+_H5_HANDLES: Dict[str, Any] = {}
+# RLock: dataset proxies re-enter via _h5_open when lazily reopening
+_H5_LOCK = threading.RLock()
+
+
+class _H5DatasetProxy:
+    """Dataset handle that re-resolves through the process handle cache on
+    every access, so a read-only→writable reopen of the owning file cannot
+    leave the caller with an invalidated HDF5 id.  Every access happens
+    under the cache lock: a concurrent upgrade/release cannot close the
+    handle between resolution and use (h5py serializes globally anyway, so
+    the lock costs no read parallelism)."""
+
+    _is_hdf5 = True  # read_block_batch keys its single-thread guard on this
+
+    def __init__(self, path: str, name: str):
+        self._path = path
+        self._name = name
+
+    def _ds(self):
+        f = _H5_HANDLES.get(self._path)
+        if f is None or not bool(f):
+            # the cached handle was released (e.g. before worker spawn):
+            # reopen read-only — a proxy is only handed out for reads
+            f = _h5_open(self._path, "r")._f
+        return f[self._name]
+
+    def __getitem__(self, key):
+        with _H5_LOCK:
+            return self._ds()[key]
+
+    def __setitem__(self, key, value):
+        with _H5_LOCK:
+            self._ds()[key] = value
+
+    def __getattr__(self, name):
+        with _H5_LOCK:
+            return getattr(self._ds(), name)
+
+    def __len__(self):
+        with _H5_LOCK:
+            return len(self._ds())
+
+
+class _CachedH5File:
+    """Non-closing façade over a process-cached h5py.File.
+
+    HDF5 refuses to open one file twice with different modes in a process,
+    so tasks reading their input and writing their output in the SAME .h5
+    file would fail with "file is already open".  The cache keeps one real
+    handle per path; ``close``/``with`` only flush — call
+    ``release_h5_handles()`` to really close (the cluster executor does,
+    before spawning workers, so the driver's handle cannot hold the HDF5
+    file lock against them).
+
+    Datasets fetched through a *read-only* handle (via ``[]`` or ``get``)
+    come back as lazy re-resolving proxies: a later writable open of the
+    same path reopens the file underneath, and raw h5py ids from the old
+    handle would die.  Writable handles are never reopened (``w``/``w-``/
+    ``x`` keep their loud h5py semantics, see ``_h5_open``), so their
+    datasets are returned raw.  Objects reached through other h5py APIs
+    (group traversal, ``visititems``) are raw and must not be held across a
+    writable reopen of a file first opened read-only.
+    """
+
+    def __init__(self, f, path: str):
+        object.__setattr__(self, "_f", f)
+        object.__setattr__(self, "_path", path)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+    def __getitem__(self, key):
+        obj = self._f[key]
+        if self._f.mode == "r" and isinstance(obj, h5py.Dataset):
+            return _H5DatasetProxy(self._path, key)
+        return obj
+
+    def __setitem__(self, key, value):
+        self._f[key] = value
+
+    def __contains__(self, key):
+        return key in self._f
+
+    def __iter__(self):
+        return iter(self._f)
+
+    def __len__(self):
+        return len(self._f)
+
+    def get(self, key, default=None):
+        if key not in self._f:
+            return default
+        return self[key]  # routes datasets through the proxy path
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def close(self):
+        if self._f and self._f.mode != "r":
+            self._f.flush()
+
+
+def release_h5_handles() -> None:
+    """Close every cached h5 handle (flushing writers).  Call before handing
+    a file to another process: a held writable handle would otherwise block
+    the peer's open under HDF5 file locking."""
+    with _H5_LOCK:
+        for f in _H5_HANDLES.values():
+            if f:
+                f.close()
+        _H5_HANDLES.clear()
+
+
+def _h5_open(path: str, mode: str):
+    key = os.path.abspath(path)
+    with _H5_LOCK:
+        cached = _H5_HANDLES.get(key)
+        if cached is not None and not bool(cached):
+            _H5_HANDLES.pop(key, None)
+            cached = None  # closed underneath us
+        if mode in ("w", "w-", "x"):
+            # truncate / exclusive-create: never satisfiable from a cached
+            # handle — let h5py raise its usual loud errors (truncate of an
+            # open file, FileExistsError) rather than silently clobbering
+            if cached is not None:
+                raise OSError(
+                    f"cannot open {path!r} with mode {mode!r}: the file is "
+                    "open elsewhere in this process "
+                    "(store.release_h5_handles() closes cached handles)"
+                )
+            f = h5py.File(path, mode)
+            _H5_HANDLES[key] = f
+            return _CachedH5File(f, key)
+        if cached is not None and mode in ("a", "r+") and cached.mode == "r":
+            # upgrade read-only → writable; prior reads were handed out as
+            # re-resolving proxies, so nothing is invalidated
+            cached.close()
+            _H5_HANDLES.pop(key, None)
+            cached = None
+            mode = "a"
+        if cached is None:
+            cached = h5py.File(path, mode)
+            _H5_HANDLES[key] = cached
+        return _CachedH5File(cached, key)
+
+
 def file_reader(path: str, mode: str = "a"):
     """Open a chunked container by extension: .zarr/.zr, .n5, .h5/.hdf5.
 
@@ -725,5 +876,5 @@ def file_reader(path: str, mode: str = "a"):
     if ext in (".h5", ".hdf5", ".hdf"):
         if h5py is None:
             raise RuntimeError("h5py is not available")
-        return h5py.File(path, mode)
+        return _h5_open(path, mode)
     return File(path, mode)

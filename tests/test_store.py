@@ -152,3 +152,69 @@ def test_int_index_drops_axis(tmp_path, rng):
     np.testing.assert_array_equal(ds[1:3, -2], data[1:3, -2])
     with pytest.raises(IndexError):
         ds[7]
+
+
+class TestH5HandleCache:
+    def test_same_file_read_then_write(self, tmp_path):
+        """HDF5 refuses two opens with different modes per process; the
+        cached-handle façade must let a task read its input and write its
+        output in the same .h5 (ADVICE r2 follow-up)."""
+        h5py = pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        path = str(tmp_path / "same.h5")
+        f = store.file_reader(path, "a")
+        f.create_dataset("in", data=np.arange(8.0))
+        # hold a read handle open, then open for write — no OSError
+        r = store.file_reader(path, "r")
+        _ = r["in"][:]
+        w = store.file_reader(path, "a")
+        w.create_dataset("out", data=np.arange(8.0) * 2)
+        np.testing.assert_array_equal(w["out"][:], np.arange(8.0) * 2)
+        # `with` must not close the shared cached handle
+        with store.file_reader(path, "r") as fh:
+            np.testing.assert_array_equal(fh["in"][:], np.arange(8.0))
+        np.testing.assert_array_equal(r["in"][:], np.arange(8.0))
+
+    def test_read_first_then_write_keeps_datasets_live(self, tmp_path):
+        """The order tasks/base.py uses: input_ds('r') before output 'a' on
+        the same file — the read-only→writable reopen must not invalidate
+        the dataset handed out earlier."""
+        pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        path = str(tmp_path / "order.h5")
+        store.file_reader(path, "a").create_dataset("in", data=np.arange(6.0))
+        store.release_h5_handles()
+        ds = store.file_reader(path, "r")["in"]  # read-only proxy
+        w = store.file_reader(path, "a")         # triggers the reopen
+        w.create_dataset("out", data=np.zeros(2))
+        np.testing.assert_array_equal(ds[:], np.arange(6.0))  # still live
+
+    def test_mode_w_refuses_while_cached(self, tmp_path):
+        """Truncating a file that is open elsewhere in the process must stay
+        a loud error (raw h5py raises there too), not a silent clobber."""
+        pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        path = str(tmp_path / "trunc.h5")
+        f = store.file_reader(path, "a")
+        f.create_dataset("x", data=np.ones(4))
+        with pytest.raises(OSError, match="open elsewhere"):
+            store.file_reader(path, "w")
+        # after releasing, truncation works
+        store.release_h5_handles()
+        f2 = store.file_reader(path, "w")
+        assert "x" not in f2
+
+    def test_exclusive_create_semantics_preserved(self, tmp_path):
+        pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        path = str(tmp_path / "excl.h5")
+        store.file_reader(path, "a").create_dataset("x", data=np.ones(2))
+        with pytest.raises(OSError):
+            store.file_reader(path, "w-")  # cached handle → loud error
+        store.release_h5_handles()
+        with pytest.raises(Exception):
+            store.file_reader(path, "w-")  # file exists → h5py raises
