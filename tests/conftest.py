@@ -38,3 +38,21 @@ def tmp_env(tmp_path):
     os.makedirs(tmp_folder, exist_ok=True)
     cfg.write_global_config(config_dir, {"block_shape": [16, 32, 32]})
     return tmp_folder, config_dir
+
+
+def boundary_from_gt(gt, rng, sigma=1.0, noise=0.05):
+    """Smoothed gt-edge boundary map + noise — the synthetic boundary
+    evidence recipe shared by the learning/quantile tests."""
+    from scipy import ndimage
+
+    bnd = np.zeros(gt.shape, dtype=bool)
+    for axis in range(gt.ndim):
+        a = [slice(None)] * gt.ndim
+        b = [slice(None)] * gt.ndim
+        a[axis] = slice(1, None)
+        b[axis] = slice(None, -1)
+        edge = gt[tuple(a)] != gt[tuple(b)]
+        bnd[tuple(a)] |= edge
+        bnd[tuple(b)] |= edge
+    bnd = ndimage.gaussian_filter(bnd.astype("float32"), sigma)
+    return bnd + noise * rng.random(gt.shape).astype("float32")

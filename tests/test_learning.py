@@ -25,17 +25,9 @@ def training_volume(tmp_path, rng):
     # oversegmentation: split each gt quadrant in z halves
     ws = (gt * 2 + (np.arange(shape[0]) >= 8)[:, None, None]).astype("uint64")
     # boundary map: high on gt edges
-    bnd = np.zeros(shape, dtype=bool)
-    for axis in range(3):
-        sl_a = [slice(None)] * 3
-        sl_b = [slice(None)] * 3
-        sl_a[axis] = slice(1, None)
-        sl_b[axis] = slice(None, -1)
-        edge = gt[tuple(sl_a)] != gt[tuple(sl_b)]
-        bnd[tuple(sl_a)] |= edge
-        bnd[tuple(sl_b)] |= edge
-    bnd = ndimage.gaussian_filter(bnd.astype("float32"), 1.0)
-    bnd += 0.05 * rng.random(shape).astype("float32")
+    from conftest import boundary_from_gt
+
+    bnd = boundary_from_gt(gt, rng, noise=0.05)
     path = str(tmp_path / "train.n5")
     f = file_reader(path)
     f.create_dataset("gt", data=gt, chunks=(8, 16, 16))

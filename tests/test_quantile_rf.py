@@ -1,0 +1,124 @@
+"""VERDICT r2 item 9: quantify the histogram-sketch quantile error through
+the RF-learning consumer.
+
+The blocked feature merge reconstructs q10..q90 from a fixed-bin histogram
+(ops/rag.py HIST_BINS) where the reference's merge is exact
+(merge_edge_features.py:141).  These tests bound the effect where it
+matters: RF edge probabilities predicted from blocked-merged features must
+match probabilities from exactly recomputed single-shot features — no
+decision flip at 0.5 on any edge, and a small probability drift.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+pytest.importorskip("sklearn")
+
+from cluster_tools_tpu.ops.rag import boundary_edge_features
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+from conftest import boundary_from_gt
+
+
+@pytest.fixture
+def rf_problem(tmp_path, rng):
+    """Cells volume + gt + blocked problem features + exact recompute."""
+    from cluster_tools_tpu.workflows import (
+        EdgeFeaturesWorkflow,
+        GraphWorkflow,
+    )
+
+    shape = (24, 48, 48)
+    gt = np.kron(
+        rng.integers(1, 9, (6, 12, 12)).astype("uint64"),
+        np.ones((4, 4, 4), dtype=np.uint64),
+    )
+    # fragments: gt cells split in halves → RF must merge within cells
+    ws = (gt * 2 + (np.arange(shape[0]) % 8 >= 4)[:, None, None]).astype(
+        "uint64"
+    )
+    bnd = boundary_from_gt(gt, rng, noise=0.1)
+    bnd = (bnd / bnd.max()).astype("float32")
+
+    path = str(tmp_path / "q.n5")
+    f = file_reader(path)
+    f.create_dataset("ws", data=ws, chunks=(8, 16, 16))
+    f.create_dataset("bnd", data=bnd, chunks=(8, 16, 16))
+    config_dir = str(tmp_path / "configs")
+    tmp_folder = str(tmp_path / "tmp")
+    cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+    graph = GraphWorkflow(
+        tmp_folder, config_dir, input_path=path, input_key="ws"
+    )
+    feats_wf = EdgeFeaturesWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="bnd",
+        labels_path=path, labels_key="ws",
+        dependencies=[graph],
+    )
+    assert build([feats_wf])
+    store = file_reader(os.path.join(tmp_folder, "data.zarr"), "r")
+    nodes = store["graph/nodes"][:]
+    edges = store["graph/edges"][:]
+    blocked = store["features/edges"][:]
+
+    exact_edges, exact = boundary_edge_features(ws, bnd.astype(np.float64))
+    by_pair = {tuple(e): i for i, e in enumerate(exact_edges)}
+    order = np.array([by_pair[tuple(p)] for p in nodes[edges]])
+    exact_aligned = exact[order]
+
+    # edge gt labels: cut (1) when the fragments belong to different cells
+    frag_to_cell = {}
+    for frag in np.unique(ws):
+        sel = ws == frag
+        frag_to_cell[frag] = np.bincount(gt[sel].astype(np.int64)).argmax()
+    pairs = nodes[edges]
+    labels = np.array(
+        [frag_to_cell[u] != frag_to_cell[v] for u, v in pairs], dtype=int
+    )
+    return blocked, exact_aligned, labels
+
+
+class TestQuantileSketchRFImpact:
+    def test_probabilities_track_exact_and_no_decision_flip(self, rf_problem):
+        from sklearn.ensemble import RandomForestClassifier
+
+        blocked, exact, labels = rf_problem
+        assert blocked.shape == exact.shape and len(labels) == len(blocked)
+        assert labels.sum() > 5 and (1 - labels).sum() > 5
+
+        # train on the EXACT features (the oracle condition: a model fit on
+        # ground-truth-quality features, evaluated on sketched ones)
+        rf = RandomForestClassifier(n_estimators=50, random_state=0)
+        rf.fit(exact, labels)
+        p_exact = rf.predict_proba(exact)[:, 1]
+        p_blocked = rf.predict_proba(blocked)[:, 1]
+
+        drift = np.abs(p_exact - p_blocked)
+        # no edge may flip its decision at the 0.5 boundary
+        flips = (p_exact > 0.5) != (p_blocked > 0.5)
+        assert not flips.any(), (
+            f"{flips.sum()} RF decisions flipped; max drift {drift.max():.4f}"
+        )
+        # and the probability drift stays small in aggregate
+        assert drift.mean() < 0.02, f"mean drift {drift.mean():.4f}"
+        assert drift.max() < 0.2, f"max drift {drift.max():.4f}"
+
+    def test_feature_columns_drift_bounded(self, rf_problem):
+        """Column-wise: exact columns identical, quantiles within one
+        histogram bin (the sketch's documented bound)."""
+        from cluster_tools_tpu.ops.rag import HIST_BINS
+
+        blocked, exact, _ = rf_problem
+        # mean, var, min, max, count exact (f64 reductions)
+        np.testing.assert_allclose(
+            blocked[:, [0, 1, 2, 8, 9]], exact[:, [0, 1, 2, 8, 9]],
+            rtol=1e-9, atol=1e-9,
+        )
+        tol = 1.0 / HIST_BINS + 1e-6
+        drift = np.abs(blocked[:, 3:8] - exact[:, 3:8])
+        assert drift.max() <= tol, f"quantile drift {drift.max():.4f} > {tol}"
