@@ -283,7 +283,35 @@ def bench_cc(x, repeats):
         f"[cc] device {t_dev*1e3:.1f} ms ({mvox:.1f} Mvox/s, sweep={mode})  "
         f"scipy 1-core {t_host*1e3:.1f} ms"
     )
-    return mvox, t_host / t_dev
+    extra = {}
+    import jax
+
+    if jax.default_backend() == "tpu" and not (
+        x.shape[1] % 8 or x.shape[2] % 128
+    ):
+        # the VMEM-resident per-slice kernel + z-merge — candidate default
+        # (tools/tpu_validate.py decides; this records its bench-volume rate)
+        from cluster_tools_tpu.ops.pallas_cc import pallas_connected_components
+
+        try:
+            t_pal = timeit(
+                None, repeats,
+                sync=lambda r: r[0].block_until_ready(),
+                variants=[
+                    (lambda m: lambda: pallas_connected_components(m))(m)
+                    for m in (
+                        jnp.asarray(v < 0.5)
+                        for v in _rolled(x, span, start=2 * span)
+                    )
+                ],
+            )
+            extra["cc_pallas_mvox_s"] = round(x.size / t_pal / 1e6, 3)
+            log(f"[cc] pallas {t_pal*1e3:.1f} ms "
+                f"({x.size/t_pal/1e6:.1f} Mvox/s)")
+        except Exception as e:
+            extra["cc_pallas_error"] = f"{type(e).__name__}: {e}"[:200]
+            log(f"[cc] pallas FAILED: {e}")
+    return mvox, t_host / t_dev, extra
 
 
 def bench_mws(shape, repeats):
@@ -556,9 +584,12 @@ def main():
         extra["dtws_batched_mvox_s"] = round(b_v, 3)
         _suspect_throughput(b_v, extra, "dtws_batched_timing_suspect")
     if want("cc"):
-        cc_v, cc_r = bench_cc(make_volume(cc_shape, seed=2), args.repeats)
+        cc_v, cc_r, cc_extra = bench_cc(
+            make_volume(cc_shape, seed=2), args.repeats
+        )
         extra["cc_mvox_s"] = round(cc_v, 3)
         extra["cc_vs_baseline"] = round(cc_r, 3)
+        extra.update(cc_extra)
         _suspect_throughput(cc_v, extra, "cc_timing_suspect")
     if want("mws"):
         mws_v, mws_r = bench_mws(mws_shape, args.repeats)

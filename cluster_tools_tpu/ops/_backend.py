@@ -80,3 +80,32 @@ def force_flood_mode(mode):
     finally:
         FORCE_FLOOD_MODE = prev
         jax.clear_caches()
+
+
+# None = read CTT_CC_MODE; force_cc_mode() overrides within a scope
+FORCE_CC_MODE = None
+
+
+def use_pallas_cc() -> bool:
+    """Whether volume CC should use the per-slice Pallas kernel + z-merge
+    (ops/pallas_cc.py).  Read at TRACE time, like ``use_pallas_flood``."""
+    if FORCE_CC_MODE is not None:
+        return FORCE_CC_MODE == "pallas"
+    return os.environ.get("CTT_CC_MODE") == "pallas"
+
+
+@contextmanager
+def force_cc_mode(mode):
+    """Scoped CC-mode override ('pallas' | 'xla'): sets the switch, clears
+    jit caches (traces bake the path in), restores + clears on exit."""
+    global FORCE_CC_MODE
+    import jax
+
+    prev = FORCE_CC_MODE
+    FORCE_CC_MODE = mode
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        FORCE_CC_MODE = prev
+        jax.clear_caches()

@@ -188,18 +188,34 @@ def connected_components(
     Returns ``(labels, n_components)``.  Consecutive ids come from ranking the
     component roots (minimal flat indices) with a cumsum — no dynamic shapes.
     See ``connected_components_raw`` for ``partition`` / ``per_slice``.
+
+    ``CTT_CC_MODE=pallas`` routes eligible volumes (3d, connectivity 1, no
+    partition, lane-aligned slices, TPU backend) through the VMEM-resident
+    per-slice kernel + z-merge (ops/pallas_cc.py) — identical labels.
     """
+    if partition is None:
+        from .pallas_cc import pallas_cc_available, pallas_connected_components
+
+        if pallas_cc_available(mask.shape, connectivity, per_slice):
+            return pallas_connected_components(mask)
     raw = connected_components_raw(mask, connectivity, partition, per_slice)
     size = int(np.prod(mask.shape))
-    flat = raw.reshape(-1)
+    labels, n = consecutive_from_flat_roots(raw.reshape(-1), size)
+    return labels.reshape(mask.shape), n
+
+
+def consecutive_from_flat_roots(
+    flat: jnp.ndarray, size: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rank flat-index component roots into consecutive ids 1..n (background
+    stays 0, marked by negative entries).  Shared by the XLA and Pallas CC
+    paths so their numbering stays in lockstep."""
     # roots are voxels whose label equals their own flat index
     is_root = flat == jnp.arange(size, dtype=jnp.int32)
-    # rank roots in flat-index order → consecutive ids 1..n
     root_rank = jnp.cumsum(is_root.astype(jnp.int32))
     n = root_rank[-1] if size > 0 else jnp.int32(0)
-    # every voxel looks up the rank of its root
     safe = jnp.clip(flat, 0, size - 1)
-    labels = jnp.where(flat >= 0, root_rank[safe], 0).reshape(mask.shape)
+    labels = jnp.where(flat >= 0, root_rank[safe], 0)
     return labels.astype(jnp.int32), n.astype(jnp.int32)
 
 

@@ -1,0 +1,158 @@
+"""Connected components with a per-slice Pallas TPU kernel.
+
+The XLA CC (`ops.cc.connected_components_raw`) iterates (sweeps + pointer
+jumping) as full-array programs under a `lax.while_loop`: every round trips
+each state array through HBM, and the pointer-jump gathers are
+latency-bound.  This path instead labels each z-slice entirely inside VMEM
+(grid = slices, the layout of `ops.pallas_flood`): per slice, min-label
+propagation runs to its fixpoint with log-depth directional sweeps — no
+gathers anywhere in the kernel — so the HBM traffic is one mask read and one
+label write per slice.  Slices are then fused along z by ONE device
+pointer-jumping merge over the (z, z+1) face equivalences
+(`ops.unionfind.merge_labels_device`), whose rounds are O(log n_slices),
+not O(volume diameter).
+
+Labels returned match `ops.cc.connected_components` exactly: components are
+numbered 1..n in minimal-flat-index order (asserted in
+tests/test_pallas_cc.py), so the two paths are drop-in interchangeable.
+
+Activation mirrors the flood kernel: `CTT_CC_MODE=pallas` opts
+`connectivity=1` 3d volumes with lane-aligned slices (H % 8 == 0,
+W % 128 == 0) into this path on the TPU backend; everything else falls back
+to the XLA program.  Off by default until hardware-validated
+(tools/tpu_validate.py measures it when a chip is reachable).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .pallas_flood import _shift  # one shift/pad primitive for both kernels
+
+_SENT = np.int32(np.iinfo(np.int32).max - 1)
+_NEG = np.int32(-1)
+
+
+def _sweep_min(label, mask, axis, reverse):
+    """One directional min-label sweep in log depth.
+
+    Identical clamp-transfer composition to ops.cc._min_sweep (same
+    (u, low) combine), expressed with reverse shifts instead of flips so no
+    data reorientation is lowered.  ``low`` is −1 on conducting edges (the
+    carry passes) and the sentinel on walls (the carry resets)."""
+    prev_m = _shift(mask, 1, axis, reverse, False)
+    conduct = mask & prev_m
+
+    u = jnp.where(mask, label, _SENT)
+    l = jnp.where(conduct, _NEG, _SENT)
+
+    n = label.shape[axis]
+    for k in range(int(np.ceil(np.log2(max(n, 2))))):
+        uf = _shift(u, 1 << k, axis, reverse, _SENT)
+        lf = _shift(l, 1 << k, axis, reverse, _NEG)
+        u = jnp.minimum(u, jnp.maximum(uf, l))
+        l = jnp.maximum(lf, l)
+
+    carry_in = _shift(u, 1, axis, reverse, _SENT)
+    return jnp.where(conduct, jnp.minimum(label, carry_in), label)
+
+
+def _cc_slice_kernel(m_ref, o_ref):
+    """Label one slice's components with its minimal *volume* flat index."""
+    mask = m_ref[0] != 0
+    h_dim, w_dim = mask.shape
+    z = pl.program_id(0)
+    row = lax.broadcasted_iota(jnp.int32, (h_dim, w_dim), 0)
+    col = lax.broadcasted_iota(jnp.int32, (h_dim, w_dim), 1)
+    flat = (z * h_dim + row) * w_dim + col
+    label0 = jnp.where(mask, flat, _SENT)
+
+    # true fixpoint loop: a capped fori_loop is NOT safe here — banded
+    # serpentine corridors need Θ(H·W) rounds, far beyond any H+W-style
+    # bound (each round resolves one directional segment of the
+    # min-label propagation path, and a corridor can turn at every band)
+    def cond(carry):
+        _, changed = carry
+        return changed
+
+    def body(carry):
+        lab, _ = carry
+        new = lab
+        for axis in (0, 1):
+            for rev in (False, True):
+                new = _sweep_min(new, mask, axis, rev)
+        return new, jnp.any(new != lab)
+
+    lab, _ = lax.while_loop(cond, body, (label0, jnp.bool_(True)))
+    o_ref[0] = jnp.where(mask, lab, jnp.int32(-1))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def cc_slices(mask, interpret: bool = False):
+    """Per-slice CC of a (N, H, W) bool volume: every foreground voxel gets
+    the minimal volume-flat-index of its in-slice component; background −1."""
+    n, h, w = mask.shape
+    spec = lambda: pl.BlockSpec((1, h, w), lambda i: (i, 0, 0))  # noqa: E731
+    return pl.pallas_call(
+        _cc_slice_kernel,
+        grid=(n,),
+        in_specs=[spec()],
+        out_specs=spec(),
+        out_shape=jax.ShapeDtypeStruct((n, h, w), jnp.int32),
+        interpret=interpret,
+    )(mask.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_connected_components(mask, interpret: bool = False):
+    """3d connectivity-1 CC: Pallas per-slice labeling + one device
+    pointer-jumping merge over the z-face equivalences.
+
+    Returns ``(labels, n)`` with consecutive components 1..n in minimal-
+    flat-index order — the same contract as ``ops.cc.connected_components``.
+    """
+    from .unionfind import merge_labels_device
+
+    mask = mask.astype(bool)
+    n, h, w = mask.shape
+    sliced = cc_slices(mask, interpret=interpret)
+
+    size = n * h * w
+    # z-face equivalences (self-loops where either side is background pad
+    # the static edge table)
+    up = sliced[:-1].reshape(-1)
+    dn = sliced[1:].reshape(-1)
+    both = (up >= 0) & (dn >= 0)
+    edges = jnp.stack(
+        [jnp.where(both, up, 0), jnp.where(both, dn, 0)], axis=1
+    )
+    parent = jnp.arange(size, dtype=jnp.int32)
+    roots = merge_labels_device(parent, edges)
+
+    flat = jnp.where(mask.reshape(-1), roots[jnp.clip(sliced.reshape(-1), 0, size - 1)], -1)
+    from .cc import consecutive_from_flat_roots
+
+    labels, n_comp = consecutive_from_flat_roots(flat, size)
+    return labels.reshape(mask.shape), n_comp
+
+
+def pallas_cc_available(shape, connectivity: int, per_slice: bool) -> bool:
+    """True when the Pallas CC applies: opted in (CTT_CC_MODE=pallas or a
+    ``force_cc_mode('pallas')`` scope), 3d connectivity-1 volume-wide
+    labeling, TPU backend, lane-aligned slices.  Evaluated at TRACE time
+    (compiled shapes keep their path until the jit caches clear)."""
+    from . import _backend
+
+    if not _backend.use_pallas_cc():
+        return False
+    if per_slice or connectivity != 1 or len(shape) != 3:
+        return False
+    if shape[1] % 8 or shape[2] % 128:
+        return False
+    return jax.default_backend() == "tpu"

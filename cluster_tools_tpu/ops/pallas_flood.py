@@ -133,48 +133,42 @@ def _flood_slice_kernel(h_ref, s_ref, m_ref, o_ref):
     seeds = jnp.where(mask, seeds, 0)
     is_seed = seeds > 0
 
-    # fixpoint guard: rounds early-exit on convergence; the bound is the
-    # slice semi-perimeter + slack — a flood round resolves one directional
-    # segment of the steepest path, and no path in an H x W slice has more
-    # than H + W direction changes (worst-case serpentine/spiral corridors)
-    h_dim, w_dim = hmap.shape
-    max_rounds = h_dim + w_dim + 4
+    # true fixpoint loops: a capped fori_loop is NOT safe — banded
+    # serpentine corridors turn at every band, needing Θ(H·W) rounds (one
+    # directional segment resolves per round), far beyond any H+W bound
 
     # -- phase 1: altitude --------------------------------------------------
-    def alt_round(_, carry):
-        alt, done = carry
+    def alt_cond(carry):
+        _, changed = carry
+        return changed
 
-        def run():
-            new = alt
-            for axis in (0, 1):
-                for rev in (False, True):
-                    new = _sweep_altitude(new, hmap, is_seed, mask, axis, rev)
-            return new, jnp.all(new == alt)
-
-        # converged rounds are skipped (cond, not where: no wasted sweeps)
-        return lax.cond(done, lambda: (alt, done), run)
+    def alt_round(carry):
+        alt, _ = carry
+        new = alt
+        for axis in (0, 1):
+            for rev in (False, True):
+                new = _sweep_altitude(new, hmap, is_seed, mask, axis, rev)
+        return new, jnp.any(new != alt)
 
     alt0 = jnp.where(is_seed, hmap, _BIG)
-    alt, _ = lax.fori_loop(
-        0, max_rounds, alt_round, (alt0, jnp.bool_(False))
-    )
+    alt, _ = lax.while_loop(alt_cond, alt_round, (alt0, jnp.bool_(True)))
 
     # -- phase 2: assignment ------------------------------------------------
-    def asg_round(_, carry):
-        dist, label, done = carry
+    def asg_cond(carry):
+        _, _, changed = carry
+        return changed
 
-        def run():
-            d, l = dist, label
-            for axis in (0, 1):
-                for rev in (False, True):
-                    d, l = _sweep_assign(d, l, alt, hmap, is_seed, mask, axis, rev)
-            return d, l, jnp.all((d == dist) & (l == label))
-
-        return lax.cond(done, lambda: (dist, label, done), run)
+    def asg_round(carry):
+        dist, label, _ = carry
+        d, l = dist, label
+        for axis in (0, 1):
+            for rev in (False, True):
+                d, l = _sweep_assign(d, l, alt, hmap, is_seed, mask, axis, rev)
+        return d, l, jnp.any((d != dist) | (l != label))
 
     dist0 = jnp.where(is_seed, 0, _BIG_DIST)
-    _, label, _ = lax.fori_loop(
-        0, max_rounds, asg_round, (dist0, seeds, jnp.bool_(False))
+    _, label, _ = lax.while_loop(
+        asg_cond, asg_round, (dist0, seeds, jnp.bool_(True))
     )
     o_ref[0] = jnp.where(mask, label, 0)
 

@@ -85,3 +85,31 @@ def test_pallas_gate_requires_optin(monkeypatch):
     assert not pallas_flood_available((8, 16, 128), False)
     assert not pallas_flood_available((8, 17, 128), True)
     assert not pallas_flood_available((8, 16, 100), True)
+
+
+def test_flood_serpentine_corridor_converges():
+    """Banded serpentine corridor (Θ(H·W) directional segments): the kernel
+    must still reach the XLA fixpoint — the case a capped round loop
+    silently truncates."""
+    h, w = 16, 128
+    mask = np.zeros((1, h, w), dtype=bool)
+    for c in range(0, w - 2, 2):
+        mask[0, :, c] = True
+        mask[0, 0 if (c // 2) % 2 else h - 1, c + 1] = True
+    hmap = np.full((1, h, w), 0.5, dtype=np.float32)
+    seeds = np.zeros((1, h, w), dtype=np.int32)
+    seeds[0, 0, 0] = 1  # one seed at the corridor's start: must flood it all
+    ref = np.asarray(
+        _seeded_watershed_scan(
+            jnp.asarray(hmap), jnp.asarray(seeds), jnp.asarray(mask),
+            per_slice=True,
+        )
+    )
+    got = np.asarray(
+        flood_slices(
+            jnp.asarray(hmap), jnp.asarray(seeds), jnp.asarray(mask),
+            interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(got, ref)
+    assert (got[mask] == 1).all()  # the whole corridor is reached

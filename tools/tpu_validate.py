@@ -125,6 +125,34 @@ def main():
         results["pallas_flood_error"] = f"{type(e).__name__}: {e}"[:500]
         print(f"pallas flood FAILED to lower/run: {e}")
 
+    # -- Pallas per-slice CC + z-merge vs the XLA CC ------------------------
+    from cluster_tools_tpu.ops.pallas_cc import pallas_connected_components
+
+    try:
+        want_l, want_n = C.connected_components(masks[0])
+        got_l, got_n = pallas_connected_components(masks[0])
+        cc_agree = bool(jnp.array_equal(got_l, want_l)) and int(got_n) == int(
+            want_n
+        )
+        results["pallas_cc_exact"] = cc_agree
+        t_p = timeit(
+            None, REPEATS,
+            sync=lambda r: r[0].block_until_ready(),
+            variants=[
+                (lambda m: lambda: pallas_connected_components(m))(m)
+                for m in masks[:SPAN]
+            ],
+        )
+        results["pallas_cc_ms"] = round(t_p * 1e3, 1)
+        results["pallas_cc_wins"] = (
+            results["pallas_cc_ms"]
+            < min(results["cc_assoc_ms"], results["cc_seq_ms"])
+        )
+        print(f"pallas cc: {t_p*1e3:.1f} ms (exact={cc_agree})")
+    except Exception as e:  # Mosaic lowering / runtime failure: record, go on
+        results["pallas_cc_error"] = f"{type(e).__name__}: {e}"[:500]
+        print(f"pallas cc FAILED to lower/run: {e}")
+
     # -- device RAG kernel vs numpy -----------------------------------------
     from cluster_tools_tpu import native
     from cluster_tools_tpu.ops import rag
