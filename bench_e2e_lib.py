@@ -14,10 +14,19 @@ import time
 import numpy as np
 
 
-def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False):
+def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
+                 warm=False):
     """Wall-clock of the full pipeline; ``sharded_problem=True`` swaps the
     block-wise graph+features extraction for the one-program collective
-    path (ShardedProblemTask + global solve)."""
+    path (ShardedProblemTask + global solve).
+
+    ``warm=True`` runs the pipeline a second time in fresh scratch folders
+    on a DISTINCT (z-rolled) copy of the volume and returns
+    ``(cold_wall, warm_wall)``: same shapes → every jit cache is reused,
+    different data → no dispatch can be served from the axon tunnel's
+    execution-result cache (which replays identical programs on identical
+    inputs in ~0 ms — the warm number must be steady-state compute, the rate
+    a production sweep over many ROIs pays)."""
     from cluster_tools_tpu.runtime import build, config as cfg
     from cluster_tools_tpu.utils import file_reader
     from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
@@ -29,31 +38,44 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False):
         data_path = os.path.join(td, "data.n5")
         f = file_reader(data_path)
         f.create_dataset("bnd", data=vol, chunks=tuple(block_shape))
+        if warm:
+            f.create_dataset(
+                "bnd_warm", data=np.roll(vol, 7, axis=1),
+                chunks=tuple(block_shape),
+            )
 
-        config_dir = os.path.join(td, "configs")
-        tmp_folder = os.path.join(td, "tmp")
-        cfg.write_global_config(
-            config_dir, {"block_shape": list(block_shape), "target": target}
-        )
-        cfg.write_config(
-            config_dir, "watershed",
-            {"threshold": 0.5, "sigma_seeds": 2.0, "size_filter": 25,
-             "halo": [2, 4, 4]},
-        )
-        cfg.write_config(
-            config_dir, "sharded_problem", {"max_edges": 1 << 17}
-        )
-        wf = MulticutSegmentationWorkflow(
-            tmp_folder, config_dir,
-            input_path=data_path, input_key="bnd",
-            ws_path=data_path, ws_key="ws",
-            output_path=data_path, output_key="seg",
-            n_scales=1,
-            sharded_problem=sharded_problem,
-        )
-        t0 = time.perf_counter()
-        ok = build([wf])
-        wall = time.perf_counter() - t0
-        if not ok:
-            raise RuntimeError("e2e multicut workflow failed")
-    return wall
+        def one_run(tag, input_key):
+            config_dir = os.path.join(td, f"configs{tag}")
+            tmp_folder = os.path.join(td, f"tmp{tag}")
+            cfg.write_global_config(
+                config_dir,
+                {"block_shape": list(block_shape), "target": target},
+            )
+            cfg.write_config(
+                config_dir, "watershed",
+                {"threshold": 0.5, "sigma_seeds": 2.0, "size_filter": 25,
+                 "halo": [2, 4, 4]},
+            )
+            cfg.write_config(
+                config_dir, "sharded_problem", {"max_edges": 1 << 17}
+            )
+            wf = MulticutSegmentationWorkflow(
+                tmp_folder, config_dir,
+                input_path=data_path, input_key=input_key,
+                ws_path=data_path, ws_key=f"ws{tag}",
+                output_path=data_path, output_key=f"seg{tag}",
+                n_scales=1,
+                sharded_problem=sharded_problem,
+            )
+            t0 = time.perf_counter()
+            ok = build([wf])
+            wall = time.perf_counter() - t0
+            if not ok:
+                raise RuntimeError(f"e2e multicut workflow failed ({tag})")
+            return wall
+
+        wall = one_run("", "bnd")
+        if not warm:
+            return wall
+        warm_wall = one_run("_warm", "bnd_warm")
+    return wall, warm_wall
