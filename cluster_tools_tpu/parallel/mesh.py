@@ -49,13 +49,116 @@ def last_batch_sharding():
 
 def resolve_devices(config: Optional[dict] = None):
     """Devices used for block data parallelism: the ``devices`` config entry
-    (indices into ``jax.devices()`` or device objects — the TPU analog of the
-    reference's per-job resource knobs) or all local devices."""
+    (indices into ``jax.devices()``, device objects, or the string
+    ``"global"`` for every device of every process after
+    ``init_distributed``) or all local devices."""
     devices = (config or {}).get("devices")
+    if devices == "global":
+        return jax.devices()
     if devices:
         all_devices = jax.devices()
         return [all_devices[d] if isinstance(d, int) else d for d in devices]
     return jax.local_devices()
+
+
+_DISTRIBUTED_INITIALIZED = False
+
+
+def init_distributed(config: Optional[dict] = None) -> bool:
+    """Join the multi-host jax runtime (idempotent).
+
+    Reads ``coordinator_address`` / ``num_processes`` / ``process_id`` from
+    the config or the ``CTT_COORDINATOR`` / ``CTT_NUM_PROCESSES`` /
+    ``CTT_PROCESS_ID`` environment, and calls ``jax.distributed.initialize``
+    — after which ``jax.devices()`` spans all processes and the collective
+    kernels run their ppermute/psum over ICI within a host and DCN
+    (gRPC/Gloo on CPU) across hosts.  Returns True when a multi-process
+    runtime is active.
+
+    MUST run at process startup, before any jax backend initializes
+    (``jax.distributed.initialize`` refuses afterwards) — call it from the
+    launcher, then drive the ``parallel.sharded*`` kernels directly over a
+    ``resolve_devices({"devices": "global"})`` mesh (each process holds the
+    full host inputs and materializes only its shards via ``put_global``,
+    reading results for its slab via ``fetch_local``).  The block-task
+    layer stays per-process (its cross-host coordination is the runtime's
+    file-based topology); multi-host here is the collective-kernel comm
+    backend — the role NCCL/MPI bootstrap plays in GPU stacks (SURVEY.md
+    §2.9).
+    """
+    global _DISTRIBUTED_INITIALIZED
+    import os
+
+    conf = config or {}
+
+    def _setting(key, env_key, default=None):
+        # explicit key-presence checks: 0 is a legitimate process_id and
+        # must not fall through to a stale environment value
+        if key in conf and conf[key] is not None:
+            return conf[key]
+        return os.environ.get(env_key, default)
+
+    coord = _setting("coordinator_address", "CTT_COORDINATOR")
+    if not coord:
+        return False
+    if _DISTRIBUTED_INITIALIZED:
+        return True
+    n_proc = int(_setting("num_processes", "CTT_NUM_PROCESSES", 1))
+    pid = int(_setting("process_id", "CTT_PROCESS_ID", 0))
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=n_proc, process_id=pid
+    )
+    _DISTRIBUTED_INITIALIZED = True
+    return True
+
+
+def put_global(arr, mesh: Mesh, axis_name: str = "data", dtype=None):
+    """Place a host array onto a mesh sharding, multi-process safe.
+
+    Every process passes the SAME full (global-shape) host array;
+    ``jax.make_array_from_callback`` materializes only the shards addressable
+    by this process, so the call works identically on a single-process mesh
+    (where it is just a sharded device_put) and on a multi-host mesh (where
+    ``jax.device_put`` would fail on non-addressable devices).
+
+    Device arrays already carrying the target sharding pass through
+    untouched (a host round-trip would crash on a global mesh and waste two
+    transfers on a single-host one)."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    if isinstance(arr, jax.Array):
+        ok_dtype = dtype is None or arr.dtype == np.dtype(dtype)
+        if ok_dtype and arr.sharding.is_equivalent_to(sharding, arr.ndim):
+            return arr
+    arr = np.asarray(arr)
+    if dtype is not None:
+        arr = arr.astype(dtype, copy=False)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def fetch_local(arr, axis: int = 0):
+    """Host view of this process's shards of a (possibly multi-host) global
+    array: ``(offset, local_block)`` concatenated along ``axis`` in index
+    order.  Replicated (or otherwise non-``axis``-sharded) arrays return
+    ``(0, full array)`` — duplicate per-device copies are collapsed, not
+    concatenated."""
+    by_index = {}
+    for s in arr.addressable_shards:
+        key = tuple((sl.start, sl.stop) for sl in s.index)
+        by_index.setdefault(key, s)
+        for d, sl in enumerate(s.index):
+            if d != axis and (sl.start or 0) != 0:
+                raise ValueError(
+                    f"fetch_local(axis={axis}) expects sharding along that "
+                    f"axis only, found a shard split on axis {d}"
+                )
+    shards = sorted(
+        by_index.values(), key=lambda s: s.index[axis].start or 0
+    )
+    parts = [np.asarray(s.data) for s in shards]
+    start = shards[0].index[axis].start or 0
+    return start, np.concatenate(parts, axis=axis)
 
 
 def put_sharded(arr, config: Optional[dict] = None, axis_name: str = "data"):

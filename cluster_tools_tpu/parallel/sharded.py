@@ -42,7 +42,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..ops.cc import _min_sweep, _shift, neighbor_offsets
-from .mesh import get_mesh
+from .mesh import get_mesh, put_global
 
 
 def _neighbor_planes(plane, axis_name, direction):
@@ -391,10 +391,11 @@ def sharded_seeded_watershed(
         )
     if mask is None:
         mask = jnp.ones(hmap.shape, dtype=bool)
-    sharding = NamedSharding(mesh, P(axis_name))
-    hmap = jax.device_put(jnp.asarray(hmap, jnp.float32), sharding)
-    seeds = jax.device_put(jnp.asarray(seeds, jnp.int32), sharding)
-    mask = jax.device_put(jnp.asarray(mask, bool), sharding)
+    # put_global: multi-process-safe placement (each process materializes
+    # only its addressable shards)
+    hmap = put_global(hmap, mesh, axis_name, dtype=np.float32)
+    seeds = put_global(seeds, mesh, axis_name, dtype=np.int32)
+    mask = put_global(mask, mesh, axis_name, dtype=bool)
     return _sharded_flood(hmap, seeds, mask, axis_name, mesh)
 
 
@@ -422,7 +423,5 @@ def sharded_connected_components(
         raise ValueError(
             f"z extent {mask.shape[0]} not divisible by mesh size {n}"
         )
-    mask = jax.device_put(
-        jnp.asarray(mask, dtype=bool), NamedSharding(mesh, P(axis_name))
-    )
+    mask = put_global(mask, mesh, axis_name, dtype=bool)
     return _sharded_cc(mask, connectivity, axis_name, mesh)

@@ -215,9 +215,10 @@ def sharded_boundary_edge_features(
         raise ValueError(
             f"z extent {labels.shape[0]} not divisible by mesh size {n}"
         )
-    sharding = NamedSharding(mesh, P(axis_name))
-    lab = jax.device_put(jnp.asarray(labels, jnp.int32), sharding)
-    val = jax.device_put(jnp.asarray(values, jnp.float32), sharding)
+    from .mesh import put_global
+
+    lab = put_global(labels, mesh, axis_name, dtype=np.int32)
+    val = put_global(values, mesh, axis_name, dtype=np.float32)
     e_u, e_v, feats, _, n_edges, n_local_max = _sharded_rag(
         lab, val, int(max_edges), int(hist_bins), axis_name, mesh
     )
