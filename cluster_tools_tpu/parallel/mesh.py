@@ -103,10 +103,14 @@ def init_distributed(config: Optional[dict] = None) -> bool:
         return False
     if _DISTRIBUTED_INITIALIZED:
         return True
-    n_proc = int(_setting("num_processes", "CTT_NUM_PROCESSES", 1))
-    pid = int(_setting("process_id", "CTT_PROCESS_ID", 0))
+    # None passes through so jax's own auto-detection (TPU pod metadata)
+    # still works when only the coordinator is configured
+    n_proc = _setting("num_processes", "CTT_NUM_PROCESSES")
+    pid = _setting("process_id", "CTT_PROCESS_ID")
     jax.distributed.initialize(
-        coordinator_address=coord, num_processes=n_proc, process_id=pid
+        coordinator_address=coord,
+        num_processes=None if n_proc is None else int(n_proc),
+        process_id=None if pid is None else int(pid),
     )
     _DISTRIBUTED_INITIALIZED = True
     return True
@@ -156,9 +160,31 @@ def fetch_local(arr, axis: int = 0):
     shards = sorted(
         by_index.values(), key=lambda s: s.index[axis].start or 0
     )
+    # contiguity: interleaved device orders would give this process
+    # non-adjacent slabs, and a single (offset, block) pair cannot
+    # represent them — fail loudly instead of mislabeling coordinates
+    for prev, cur in zip(shards, shards[1:]):
+        if prev.index[axis].stop != (cur.index[axis].start or 0):
+            raise ValueError(
+                "fetch_local: this process's shards are not contiguous "
+                f"along axis {axis} ({prev.index} then {cur.index}); use a "
+                "process-contiguous device order"
+            )
     parts = [np.asarray(s.data) for s in shards]
     start = shards[0].index[axis].start or 0
     return start, np.concatenate(parts, axis=axis)
+
+
+def fetch_global(arr, axis: int = 0):
+    """Full host copy of a (possibly multi-host) global array in EVERY
+    process: each process contributes its local slab via an allgather.
+    Single-process arrays are just np.asarray."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    _, local = fetch_local(arr, axis)
+    return np.asarray(multihost_utils.process_allgather(local, tiled=True))
 
 
 def put_sharded(arr, config: Optional[dict] = None, axis_name: str = "data"):

@@ -390,7 +390,7 @@ def sharded_seeded_watershed(
             f"z extent {hmap.shape[0]} not divisible by mesh size {n}"
         )
     if mask is None:
-        mask = jnp.ones(hmap.shape, dtype=bool)
+        mask = np.ones(hmap.shape, dtype=bool)  # host: no device round-trip
     # put_global: multi-process-safe placement (each process materializes
     # only its addressable shards)
     hmap = put_global(hmap, mesh, axis_name, dtype=np.float32)

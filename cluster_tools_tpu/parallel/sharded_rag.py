@@ -33,7 +33,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.rag import HIST_BINS, QUANTILES
-from .mesh import get_mesh
+from .mesh import get_mesh, put_global
 from .sharded import _neighbor_planes, shard_map
 
 _BIG_ID = np.int32(np.iinfo(np.int32).max)
@@ -215,8 +215,6 @@ def sharded_boundary_edge_features(
         raise ValueError(
             f"z extent {labels.shape[0]} not divisible by mesh size {n}"
         )
-    from .mesh import put_global
-
     lab = put_global(labels, mesh, axis_name, dtype=np.int32)
     val = put_global(values, mesh, axis_name, dtype=np.float32)
     e_u, e_v, feats, _, n_edges, n_local_max = _sharded_rag(

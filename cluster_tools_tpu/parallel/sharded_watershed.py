@@ -262,10 +262,9 @@ def sharded_dt_watershed(
     pitch = (1.0,) * 3 if pixel_pitch is None else tuple(
         float(p) for p in pixel_pitch
     )
-    sharding = NamedSharding(mesh, P(axis_name))
-    x_d = jax.device_put(
-        jnp.asarray(input_, jnp.float32), sharding
-    )
+    from .mesh import fetch_global, put_global
+
+    x_d = put_global(input_, mesh, axis_name, dtype=np.float32)
 
     fg_d, maxima_d, hmap_d = _stage_a(
         x_d, threshold, pitch, sigma_seeds, sigma_weights, alpha,
@@ -281,7 +280,7 @@ def sharded_dt_watershed(
     labels = sharded_seeded_watershed(
         hmap_d, seeds_d, mask=fg_d, mesh=mesh, axis_name=axis_name
     )
-    labels = np.asarray(labels)
+    labels = fetch_global(labels)
     uniq, counts = np.unique(labels, return_counts=True)
     n_seeds = int((uniq > 0).sum())
     if size_filter > 0:
@@ -290,7 +289,7 @@ def sharded_dt_watershed(
         too_small = uniq[(counts < size_filter) & (uniq > 0)]
         if too_small.size:
             kept = np.where(np.isin(labels, too_small), 0, labels)
-            labels = np.asarray(
+            labels = fetch_global(
                 sharded_seeded_watershed(
                     hmap_d, kept.astype(np.int32), mask=fg_d, mesh=mesh,
                     axis_name=axis_name,
