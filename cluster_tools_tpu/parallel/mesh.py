@@ -141,6 +141,45 @@ def put_global(arr, mesh: Mesh, axis_name: str = "data", dtype=None):
     )
 
 
+def put_from_store(ds, mesh: Mesh, axis_name: str = "data", dtype=None,
+                   pad_to: Optional[int] = None, transform=None):
+    """Stream a chunked-store dataset onto the mesh sharding shard-by-shard:
+    the placement callback reads each shard's region directly from the
+    store, so no full-volume host copy ever exists (the practical bound
+    becomes one shard, not the volume — and on a multi-host mesh each
+    process reads only its own slab from shared storage).
+
+    ``pad_to``: pad the leading axis up to a multiple of this, for meshes
+    that do not divide the raw extent — the pad is zeros of the OUTPUT
+    dtype and never passes through ``transform``.
+
+    ``transform``: host function applied to each shard's real region before
+    it crosses to the device.  Narrowing transforms (e.g. thresholding a
+    float volume to its bool mask) keep HBM at the narrow dtype — only the
+    transformed shard ever leaves the host."""
+    shape = list(ds.shape)
+    z = shape[0]
+    if pad_to:
+        shape[0] = z + ((-z) % pad_to)
+    shape = tuple(shape)
+    sharding = NamedSharding(mesh, P(axis_name))
+    out_dtype = np.dtype(dtype) if dtype is not None else ds.dtype
+
+    def read(idx):
+        sl0 = idx[0]
+        start, stop = sl0.start or 0, sl0.stop or shape[0]
+        stop_real = min(stop, z)
+        block = np.zeros((stop - start,) + shape[1:], dtype=out_dtype)
+        if start < z:
+            part = np.asarray(ds[(slice(start, stop_real),) + idx[1:]])
+            if transform is not None:
+                part = transform(part)
+            block[: stop_real - start] = part.astype(out_dtype, copy=False)
+        return block
+
+    return jax.make_array_from_callback(shape, sharding, read)
+
+
 def fetch_local(arr, axis: int = 0):
     """Host view of this process's shards of a (possibly multi-host) global
     array: ``(offset, local_block)`` concatenated along ``axis`` in index

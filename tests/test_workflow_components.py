@@ -128,3 +128,39 @@ def test_components_workflow_is_resumable(tmp_path, rng):
     # completed workflow: a fresh build() call must be a no-op (complete targets)
     assert wf.complete()
     assert build([wf])
+
+
+def test_sharded_components_streaming_mask_and_nondivisible_z(tmp_path, rng):
+    """The sigma=0 streaming path (per-shard store reads + device
+    threshold) with a store-backed mask and a z extent the 8-device mesh
+    does not divide must match scipy exactly."""
+    from scipy import ndimage
+
+    from cluster_tools_tpu.tasks.thresholded_components import (
+        ShardedComponentsTask,
+    )
+
+    shape = (13, 16, 16)  # 13 % 8 != 0 → internal pad slab
+    raw = rng.random(shape).astype("float32")
+    m = rng.random(shape) < 0.8
+    path = str(tmp_path / "s.n5")
+    f = file_reader(path)
+    f.create_dataset("raw", data=raw, chunks=(8, 16, 16))
+    f.create_dataset("m", data=m.astype("uint8"), chunks=(8, 16, 16))
+    config_dir = str(tmp_path / "configs")
+    cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+    cfg.write_config(
+        config_dir, "sharded_components",
+        {"threshold": 0.5, "threshold_mode": "less"},
+    )
+    task = ShardedComponentsTask(
+        str(tmp_path / "tmp"), config_dir,
+        input_path=path, input_key="raw",
+        output_path=path, output_key="cc",
+        mask_path=path, mask_key="m",
+    )
+    assert build([task])
+    got = file_reader(path, "r")["cc"][:]
+    want, n_want = ndimage.label((raw < 0.5) & m)
+    _assert_same_partition(got, want)
+    assert int(file_reader(path, "r")["cc"].attrs["n_labels"]) == n_want
