@@ -142,7 +142,8 @@ def put_global(arr, mesh: Mesh, axis_name: str = "data", dtype=None):
 
 
 def put_from_store(ds, mesh: Mesh, axis_name: str = "data", dtype=None,
-                   pad_to: Optional[int] = None, transform=None):
+                   pad_to: Optional[int] = None, transform=None,
+                   pad_value=0):
     """Stream a chunked-store dataset onto the mesh sharding shard-by-shard:
     the placement callback reads each shard's region directly from the
     store, so no full-volume host copy ever exists (the practical bound
@@ -150,8 +151,8 @@ def put_from_store(ds, mesh: Mesh, axis_name: str = "data", dtype=None,
     process reads only its own slab from shared storage).
 
     ``pad_to``: pad the leading axis up to a multiple of this, for meshes
-    that do not divide the raw extent — the pad is zeros of the OUTPUT
-    dtype and never passes through ``transform``.
+    that do not divide the raw extent — the pad is ``pad_value`` in the
+    OUTPUT dtype and never passes through ``transform``.
 
     ``transform``: host function applied to each shard's real region before
     it crosses to the device.  Narrowing transforms (e.g. thresholding a
@@ -169,7 +170,7 @@ def put_from_store(ds, mesh: Mesh, axis_name: str = "data", dtype=None,
         sl0 = idx[0]
         start, stop = sl0.start or 0, sl0.stop or shape[0]
         stop_real = min(stop, z)
-        block = np.zeros((stop - start,) + shape[1:], dtype=out_dtype)
+        block = np.full((stop - start,) + shape[1:], pad_value, dtype=out_dtype)
         if start < z:
             part = np.asarray(ds[(slice(start, stop_real),) + idx[1:]])
             if transform is not None:

@@ -230,6 +230,7 @@ def sharded_dt_watershed(
     alpha: float = 0.8,
     size_filter: int = 25,
     invert_input: bool = False,
+    z_valid: Optional[int] = None,
 ) -> Tuple[np.ndarray, int]:
     """DT-watershed of a whole z-sharded volume — the collective form of
     ``dt_watershed(apply_dt_2d=False, apply_ws_2d=False)`` (3d DT + 3d flood).
@@ -244,21 +245,38 @@ def sharded_dt_watershed(
     smoothing, and is excluded from seeds/flood/counts, so the result still
     matches the unpadded single-device kernel.  Shards shallower than a
     gaussian radius are fine (multi-hop halos).
+
+    ``input_`` may also be an already-placed (padded) device array carrying
+    the mesh sharding — e.g. streamed by ``mesh.put_from_store(pad_to=n,
+    pad_value=<foreground side>)`` — in which case ``z_valid`` must give
+    the real (unpadded) z extent.
     """
     from .sharded import sharded_seeded_watershed
 
     mesh = mesh if mesh is not None else get_mesh(axis_name=axis_name)
     n = mesh.shape[axis_name]
-    z_valid = int(input_.shape[0])
-    pad = (-z_valid) % n
-    input_ = np.asarray(input_, dtype=np.float32)
-    if pad:
-        # foreground side of the threshold AFTER the kernel's inversion
-        # (assumes 0 < threshold < 1, the reference's probability range)
-        pad_val = 1.0 if invert_input else 0.0
-        input_ = np.pad(
-            input_, ((0, pad), (0, 0), (0, 0)), constant_values=pad_val
-        )
+    pre_placed = isinstance(input_, jax.Array) and input_.sharding.is_equivalent_to(
+        NamedSharding(mesh, P(axis_name)), input_.ndim
+    )
+    if pre_placed:
+        # streamed/padded placement: the caller owns the pad semantics
+        if z_valid is None:
+            raise ValueError(
+                "pass z_valid when handing sharded_dt_watershed a "
+                "pre-placed (possibly padded) device array"
+            )
+    else:
+        if z_valid is None:
+            z_valid = int(input_.shape[0])
+        pad = (-z_valid) % n
+        input_ = np.asarray(input_, dtype=np.float32)
+        if pad:
+            # foreground side of the threshold AFTER the kernel's inversion
+            # (assumes 0 < threshold < 1, the reference's probability range)
+            pad_val = 1.0 if invert_input else 0.0
+            input_ = np.pad(
+                input_, ((0, pad), (0, 0), (0, 0)), constant_values=pad_val
+            )
     pitch = (1.0,) * 3 if pixel_pitch is None else tuple(
         float(p) for p in pixel_pitch
     )

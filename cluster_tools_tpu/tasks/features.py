@@ -180,11 +180,11 @@ class ShardedProblemTask(VolumeSimpleTask):
     (``parallel.sharded_rag.sharded_boundary_edge_features``) — the
     collective replacement for the InitialSubGraphs→MergeSubGraphs→MapEdgeIds
     + BlockEdgeFeatures→MergeEdgeFeatures chain when the volume fits the
-    mesh's aggregate HBM.  The practical bound is host RAM, not HBM: the
-    volume is materialized on host as several full-size arrays at once
-    (float data + uint64 seg + int32 compact labels, plus padding copies)
-    before the sharded transfer — budget ~16 bytes/voxel of host memory.
-    Writes the standard problem scratch layout
+    mesh's aggregate HBM.  Both volumes stream shard-by-shard from the
+    store (``mesh.put_from_store``) with per-slab label compaction and
+    normalization in the read callbacks, so peak host RAM on ingest is one
+    slab plus the global node table; HBM holds the int32 compact labels and
+    float32 data.  Writes the standard problem scratch layout
     (graph/nodes, graph/edges + attrs, features/edges) so every downstream
     consumer (costs, global multicut solve, postprocess graph tasks) runs
     unchanged.
@@ -209,45 +209,58 @@ class ShardedProblemTask(VolumeSimpleTask):
 
     def run_impl(self) -> None:
         from .graph import EDGES_KEY, NODES_KEY
-        from ..parallel.mesh import get_mesh, resolve_devices
+        from ..parallel.mesh import get_mesh, put_from_store, resolve_devices
         from ..parallel.sharded_rag import sharded_boundary_edge_features
         from ..utils import store
 
         conf = {**self.global_config(), **self.get_task_config()}
-        seg = store.file_reader(self.labels_path, "r")[self.labels_key][:]
-        seg = seg.astype(np.uint64)
+        seg_ds = store.file_reader(self.labels_path, "r")[self.labels_key]
         data_ds = store.file_reader(self.input_path, "r")[self.input_key]
-        if len(data_ds.shape) != seg.ndim:
+        if len(data_ds.shape) != len(seg_ds.shape):
             raise ValueError(
                 "sharded_problem supports 3d boundary maps only — affinity "
                 "(4d) inputs go through the block pipeline "
                 "(sharded_problem=False with block_edge_features offsets)"
             )
-        data = data_ds[:]
-        # the block path's normalization convention (BlockEdgeFeaturesTask.
-        # _normalize): uint8 → /255, every other dtype raw — applied BEFORE
-        # the float cast so the two paths agree
-        if data.dtype == np.uint8:
-            data = data.astype(np.float32) / 255.0
-        else:
-            data = np.asarray(data, dtype=np.float32)
-
-        # compact nonzero labels to 1..n (kernel ids = node index + 1)
-        nodes = np.unique(seg)
-        nodes = nodes[nodes > 0]
-        compact = np.searchsorted(nodes, seg) + 1
-        compact = np.where(seg > 0, compact, 0).astype(np.int32)
 
         devices = resolve_devices(conf)
         mesh = get_mesh(devices)
-        pad = (-compact.shape[0]) % len(devices)
-        if pad:
-            zpad = ((0, pad),) + ((0, 0),) * (compact.ndim - 1)
-            compact = np.pad(compact, zpad)  # label 0: no pairs in the pad
-            data = np.pad(data, zpad)
+        n_dev = len(devices)
+        z = int(seg_ds.shape[0])
+
+        # pass 1 (host, slab-wise): the global node table — peak host RAM
+        # is one slab plus the accumulating uniques.  Slab height follows
+        # the store's z-chunking so no chunk is decompressed twice
+        zc = int((seg_ds.chunks or (8,))[0]) or 8
+        slabs = [np.unique(seg_ds[z0 : z0 + zc]) for z0 in range(0, z, zc)]
+        nodes = np.unique(np.concatenate(slabs)) if slabs else np.zeros(
+            0, np.uint64
+        )
+        nodes = nodes[nodes > 0].astype(np.uint64)
+
+        # pass 2: stream both volumes shard-by-shard; compaction to
+        # 1..n node ids and the block path's normalization convention
+        # (uint8 → /255, other dtypes raw) run per shard in the callbacks
+        def compact_slab(s):
+            s = s.astype(np.uint64)
+            c = np.searchsorted(nodes, s) + 1
+            return np.where(s > 0, c, 0)  # label 0: no pairs in the pad
+
+        def normalize_slab(d):
+            if d.dtype == np.uint8:
+                return d.astype(np.float32) / 255.0
+            return np.asarray(d, dtype=np.float32)
+
+        compact_d = put_from_store(
+            seg_ds, mesh, dtype=np.int32, pad_to=n_dev, transform=compact_slab
+        )
+        data_d = put_from_store(
+            data_ds, mesh, dtype=np.float32, pad_to=n_dev,
+            transform=normalize_slab,
+        )
 
         edges_c, feats = sharded_boundary_edge_features(
-            compact, data, mesh=mesh,
+            compact_d, data_d, mesh=mesh,
             max_edges=int(conf.get("max_edges", 16384)),
         )
         dense = (edges_c - 1).astype(np.int64)  # compact id → node index

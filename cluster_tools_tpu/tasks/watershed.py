@@ -537,7 +537,7 @@ class ShardedWatershedTask(VolumeTask):
 
     def process_block(self, block_id, blocking, config):
         from ..ops.relabel import relabel_consecutive_np
-        from ..parallel.mesh import get_mesh, resolve_devices
+        from ..parallel.mesh import get_mesh, put_from_store, resolve_devices
         from ..parallel.sharded_watershed import sharded_dt_watershed
 
         in_ds = self.input_ds()
@@ -546,14 +546,23 @@ class ShardedWatershedTask(VolumeTask):
                 "sharded_watershed supports 3d volumes (channel inputs go "
                 "through the block pipeline)"
             )
-        raw = _normalize_host(in_ds[:])
         devices = resolve_devices(config)
         mesh = get_mesh(devices)
         n_dev = len(devices)
+        invert = bool(config.get("invert_inputs", False))
+
+        # stream shard-by-shard: peak host RAM on ingest is one shard.
+        # Pad slabs sit on the foreground side of the threshold AFTER the
+        # kernel's inversion, exactly like the host-pad path
+        x_d = put_from_store(
+            in_ds, mesh, dtype=np.float32, pad_to=n_dev,
+            pad_value=1.0 if invert else 0.0,
+            transform=_normalize_host,
+        )
 
         pitch = config.get("pixel_pitch")
         labels, n_seeds = sharded_dt_watershed(
-            raw,
+            x_d,
             mesh=mesh,
             threshold=float(config["threshold"]),
             pixel_pitch=tuple(pitch) if pitch else None,
@@ -561,7 +570,8 @@ class ShardedWatershedTask(VolumeTask):
             sigma_weights=float(config.get("sigma_weights", 2.0)),
             alpha=float(config.get("alpha", 0.8)),
             size_filter=int(config.get("size_filter", 25)),
-            invert_input=bool(config.get("invert_inputs", False)),
+            invert_input=invert,
+            z_valid=int(in_ds.shape[0]),
         )
         out, n_labels = relabel_consecutive_np(labels.astype(np.uint64))
         self.output_ds()[:] = out
