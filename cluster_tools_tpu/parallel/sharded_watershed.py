@@ -265,6 +265,11 @@ def sharded_dt_watershed(
                 "pass z_valid when handing sharded_dt_watershed a "
                 "pre-placed (possibly padded) device array"
             )
+        if input_.dtype != jnp.float32 or input_.shape[0] % n:
+            raise ValueError(
+                "pre-placed input must be float32 with a mesh-divisible z "
+                f"extent, got {input_.dtype} {input_.shape}"
+            )
     else:
         if z_valid is None:
             z_valid = int(input_.shape[0])

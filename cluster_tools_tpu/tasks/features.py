@@ -232,11 +232,17 @@ class ShardedProblemTask(VolumeSimpleTask):
         # is one slab plus the accumulating uniques.  Slab height follows
         # the store's z-chunking so no chunk is decompressed twice
         zc = int((seg_ds.chunks or (8,))[0]) or 8
-        slabs = [np.unique(seg_ds[z0 : z0 + zc]) for z0 in range(0, z, zc)]
+        # cast BEFORE unique: signed ignore labels (e.g. -1) must wrap to
+        # their uint64 identity exactly as the full-volume cast did, or the
+        # node table silently drops/disorders them
+        slabs = [
+            np.unique(np.asarray(seg_ds[z0 : z0 + zc]).astype(np.uint64))
+            for z0 in range(0, z, zc)
+        ]
         nodes = np.unique(np.concatenate(slabs)) if slabs else np.zeros(
             0, np.uint64
         )
-        nodes = nodes[nodes > 0].astype(np.uint64)
+        nodes = nodes[nodes > 0]
 
         # pass 2: stream both volumes shard-by-shard; compaction to
         # 1..n node ids and the block path's normalization convention

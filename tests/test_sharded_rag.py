@@ -151,3 +151,40 @@ def test_sharded_problem_uint8_and_affinity_guard(tmp_path, rng):
     )
     with pytest.raises(Exception, match="3d boundary maps"):
         bad.run()
+
+
+def test_sharded_problem_signed_labels_wrap_like_uint64_cast(tmp_path, rng):
+    """An int64 segmentation with a negative (ignore-style) label must build
+    the same node table the old full-volume uint64 cast produced: -1 wraps
+    to 2**64-1 and stays in the graph, sorted last."""
+    from cluster_tools_tpu.runtime import build, config as cfg
+    from cluster_tools_tpu.tasks.features import ShardedProblemTask
+    from cluster_tools_tpu.utils import file_reader
+
+    labels = rng.integers(1, 6, (8, 8, 16)).astype("int64")
+    labels[:, :, :4] = -1  # signed ignore label
+    values = rng.random((8, 8, 16)).astype("float32")
+    path = str(tmp_path / "signed.n5")
+    f = file_reader(path)
+    f.create_dataset("seg", data=labels, chunks=(4, 8, 16))
+    f.create_dataset("bnd", data=values, chunks=(4, 8, 16))
+    config_dir = str(tmp_path / "configs_signed")
+    tmp_folder = str(tmp_path / "tmp_signed")
+    cfg.write_global_config(config_dir, {"block_shape": [4, 8, 16]})
+    task = ShardedProblemTask(
+        tmp_folder, config_dir,
+        input_path=path, input_key="bnd",
+        labels_path=path, labels_key="seg",
+    )
+    assert build([task])
+    store = file_reader(tmp_folder + "/data.zarr", "r")
+    nodes = store["graph/nodes"][:]
+    edges = store["graph/edges"][:]
+    wrapped = np.uint64(np.iinfo(np.uint64).max)  # -1 as uint64
+    assert nodes[-1] == wrapped  # present AND sorted last
+    assert (np.sort(nodes) == nodes).all()
+    # every edge endpoint indexes into the node table
+    assert edges.max() < nodes.size
+    # the wrapped label borders the positive ones: at least one edge
+    touches = (nodes[edges] == wrapped).any(axis=1)
+    assert touches.any()

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""One-shot TPU session: probe → validate → pin modes → bench.
+
+The axon tunnel admits ONE jax client at a time and wedges on killed
+clients, so a chip session must be a single, careful, sequential run:
+
+    python tools/chip_session.py            # full session
+    python tools/chip_session.py --dry      # probe only
+
+Steps:
+  1. cheap TCP probe of the tunnel endpoint (no jax client, no wedge risk);
+  2. disposable-subprocess jax probe (180 s) requiring a real TPU device;
+  3. tools/tpu_validate.py (assoc-vs-seq, Pallas flood + Pallas CC
+     lowering/exactness/perf, device RAG) → tools/tpu_validate.json;
+  4. derive the production mode pins (CTT_SWEEP_MODE / CTT_FLOOD_MODE /
+     CTT_CC_MODE) from the measurements → tools/chip_modes.json;
+  5. bench.py (driver mode) with those pins exported → the BENCH JSON line
+     on stdout (the last line, as the driver expects).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def port_open(host="127.0.0.1", port=8083, timeout=3.0) -> bool:
+    s = socket.socket()
+    s.settimeout(timeout)
+    try:
+        s.connect((host, port))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+def jax_probe(timeout: float = 600.0) -> bool:
+    """Disposable-subprocess probe requiring a real TPU device.
+
+    Generous timeout + SIGTERM-first escalation: a SIGKILLed jax client can
+    wedge the tunnel (see the axon memory note), so give a slow-but-alive
+    endpoint every chance to answer and let the child exit cleanly."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys, jax; jax.devices(); "
+         "sys.exit(0 if jax.default_backend() == 'tpu' else 3)"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        return proc.wait(timeout=timeout) == 0
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        return False
+
+
+def derive_modes(results: dict) -> dict:
+    """Production mode pins from tpu_validate measurements.
+
+    CTT_SWEEP_MODE is one global switch consumed by BOTH the watershed
+    sweeps and the CC sweeps — pin it on their combined time and report a
+    disagreement rather than letting dtws alone decide."""
+    modes = {}
+    if all(k in results for k in
+           ("dtws_assoc_ms", "dtws_seq_ms", "cc_assoc_ms", "cc_seq_ms")):
+        assoc = results["dtws_assoc_ms"] + results["cc_assoc_ms"]
+        seq = results["dtws_seq_ms"] + results["cc_seq_ms"]
+        modes["CTT_SWEEP_MODE"] = "assoc" if assoc <= seq else "seq"
+        dtws_pick = results["dtws_assoc_ms"] <= results["dtws_seq_ms"]
+        cc_pick = results["cc_assoc_ms"] <= results["cc_seq_ms"]
+        if dtws_pick != cc_pick:
+            log("NOTE: dtws and cc prefer different sweep modes "
+                f"(dtws→{'assoc' if dtws_pick else 'seq'}, "
+                f"cc→{'assoc' if cc_pick else 'seq'}); pinned by total")
+    elif "dtws_assoc_ms" in results and "dtws_seq_ms" in results:
+        modes["CTT_SWEEP_MODE"] = (
+            "assoc" if results["dtws_assoc_ms"] <= results["dtws_seq_ms"]
+            else "seq"
+        )
+    if results.get("pallas_flood_exact") and results.get("pallas_flood_wins"):
+        modes["CTT_FLOOD_MODE"] = "pallas"
+    if results.get("pallas_cc_exact") and results.get("pallas_cc_wins"):
+        modes["CTT_CC_MODE"] = "pallas"
+    return modes
+
+
+def main():
+    if not port_open():
+        log("tunnel endpoint 127.0.0.1:8083 not listening — nothing to do")
+        return 2
+    log("port open; probing jax (disposable subprocess, 180 s cap)")
+    if "--dry" in sys.argv:
+        alive = jax_probe()
+        log(f"jax probe: {'TPU alive' if alive else 'unreachable'}")
+        return 0 if alive else 2
+    if not jax_probe():
+        log("port open but no TPU device behind it — aborting")
+        return 2
+
+    log("== tpu_validate ==")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "tpu_validate.py")], cwd=ROOT
+    ).returncode
+    modes = {}
+    if rc != 0:
+        log(f"tpu_validate failed (rc={rc}); continuing to bench unpinned")
+    else:
+        try:
+            with open(os.path.join(HERE, "tpu_validate.json")) as f:
+                results = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            log(f"tpu_validate.json unreadable ({e}); bench runs unpinned")
+        else:
+            modes = derive_modes(results)
+            with open(os.path.join(HERE, "chip_modes.json"), "w") as f:
+                json.dump(modes, f, indent=2)
+            log(f"mode pins: {modes}")
+
+    log("== bench (driver mode) ==")
+    env = dict(os.environ, **modes)
+    bench = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")], cwd=ROOT, env=env
+    )
+    return bench.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
