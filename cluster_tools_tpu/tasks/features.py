@@ -29,7 +29,7 @@ from ..ops.rag import (
     HIST_BINS,
 )
 from ..utils.blocking import Blocking
-from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, resolve_n_blocks
+from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, read_threads, resolve_n_blocks
 from .graph import read_block_with_upper_halo, load_graph
 
 FEATURE_IDS_KEY = "features/ids"
@@ -216,6 +216,8 @@ class ShardedProblemTask(VolumeSimpleTask):
         conf = {**self.global_config(), **self.get_task_config()}
         seg_ds = store.file_reader(self.labels_path, "r")[self.labels_key]
         data_ds = store.file_reader(self.input_path, "r")[self.input_key]
+        store.set_read_threads(seg_ds, read_threads(conf))
+        store.set_read_threads(data_ds, read_threads(conf))
         if len(data_ds.shape) != len(seg_ds.shape):
             raise ValueError(
                 "sharded_problem supports 3d boundary maps only — affinity "

@@ -303,6 +303,7 @@ class ShardedComponentsTask(VolumeSimpleTask):
         if mode not in ("greater", "less", "equal"):
             raise ValueError(f"unsupported threshold_mode {mode!r}")
         in_ds = store_mod.file_reader(self.input_path, "r")[self.input_key]
+        store_mod.set_read_threads(in_ds, read_threads(conf))
         z = int(in_ds.shape[0])
         devices = resolve_devices(conf)
         mesh = get_mesh(devices)

@@ -27,7 +27,7 @@ from ..parallel.dispatch import read_block_batch, write_block_batch
 from ..parallel.mesh import put_sharded
 from ..utils import store
 from ..utils.blocking import Blocking, make_checkerboard_block_lists
-from .base import VolumeTask
+from .base import VolumeTask, read_threads
 
 MAX_IDS_KEY = "watershed/max_ids"
 
@@ -546,6 +546,7 @@ class ShardedWatershedTask(VolumeTask):
                 "sharded_watershed supports 3d volumes (channel inputs go "
                 "through the block pipeline)"
             )
+        store.set_read_threads(in_ds, read_threads(config))
         devices = resolve_devices(config)
         mesh = get_mesh(devices)
         n_dev = len(devices)

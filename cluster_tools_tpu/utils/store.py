@@ -472,21 +472,36 @@ class Dataset:
         bb, int_axes = self._normalize_bb(bb)
         out_shape = tuple(e - b for b, e in bb)
         out = np.full(out_shape, self.fill_value, dtype=self.dtype)
-        for grid_pos in self._chunks_overlapping(bb):
+
+        def _assemble(grid_pos):
             chunk = self.read_chunk(grid_pos)
             if chunk is None:
-                continue
+                return
             extent = self._chunk_extent(grid_pos)
-            # intersection of chunk extent and requested bb, in both coordinate frames
+            # intersection of chunk extent and requested bb, in both frames
             lo = [max(cb, rb) for (cb, _), (rb, _) in zip(extent, bb)]
             hi = [min(ce, re) for (_, ce), (_, re) in zip(extent, bb)]
             if any(l >= h for l, h in zip(lo, hi)):
-                continue
+                return
             src = tuple(
                 slice(l - cb, h - cb) for l, h, (cb, _) in zip(lo, hi, extent)
             )
             dst = tuple(slice(l - rb, h - rb) for l, h, (rb, _) in zip(lo, hi, bb))
-            out[dst] = chunk[src]
+            out[dst] = chunk[src]  # disjoint regions: thread-safe
+
+        positions = list(self._chunks_overlapping(bb))
+        n_threads = int(getattr(self, "n_threads", 1) or 1)
+        if n_threads > 1 and len(positions) > 1:
+            # the reference's ``ds.n_threads = n`` idiom (z5py datasets):
+            # file IO and zlib/gzip decompression release the GIL, so the
+            # fan-out overlaps chunk decode even on few cores
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(min(n_threads, len(positions))) as pool:
+                list(pool.map(_assemble, positions))
+        else:
+            for grid_pos in positions:
+                _assemble(grid_pos)
         if int_axes:
             out = out.reshape(
                 tuple(s for ax, s in enumerate(out_shape) if ax not in int_axes)
@@ -820,6 +835,18 @@ class _CachedH5File:
     def close(self):
         if self._f and self._f.mode != "r":
             self._f.flush()
+
+
+def set_read_threads(ds, n: int) -> None:
+    """Best-effort ``ds.n_threads = n`` (the reference's z5py idiom).
+
+    Raw h5py datasets refuse attribute assignment — and single-threaded is
+    the correct setting there anyway (global h5 lock), so the failure is
+    swallowed deliberately."""
+    try:
+        ds.n_threads = int(n)
+    except (AttributeError, TypeError):
+        pass
 
 
 def release_h5_handles() -> None:

@@ -218,3 +218,28 @@ class TestH5HandleCache:
         store.release_h5_handles()
         with pytest.raises(Exception):
             store.file_reader(path, "w-")  # file exists → h5py raises
+
+
+class TestThreadedRegionRead:
+    def test_threaded_read_matches_serial(self, tmp_path, rng):
+        from cluster_tools_tpu.utils import store
+
+        data = rng.random((24, 24, 24)).astype("float32")
+        path = str(tmp_path / "thr.n5")
+        f = store.file_reader(path)
+        f.create_dataset("x", data=data, chunks=(8, 8, 8))
+        ds = store.file_reader(path, "r")["x"]
+        serial = ds[2:22, 3:21, 0:24]
+        store.set_read_threads(ds, 4)
+        threaded = ds[2:22, 3:21, 0:24]
+        np.testing.assert_array_equal(serial, threaded)
+        np.testing.assert_array_equal(threaded, data[2:22, 3:21, 0:24])
+
+    def test_set_read_threads_tolerates_h5(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        path = str(tmp_path / "t.h5")
+        f = store.file_reader(path, "a")
+        f.create_dataset("x", data=np.ones(4))
+        store.set_read_threads(f["x"], 4)  # raw h5py dataset: no-op, no raise
