@@ -77,14 +77,15 @@ def init_distributed(config: Optional[dict] = None) -> bool:
 
     MUST run at process startup, before any jax backend initializes
     (``jax.distributed.initialize`` refuses afterwards) — call it from the
-    launcher, then drive the ``parallel.sharded*`` kernels directly over a
-    ``resolve_devices({"devices": "global"})`` mesh (each process holds the
-    full host inputs and materializes only its shards via ``put_global``,
-    reading results for its slab via ``fetch_local``).  The block-task
-    layer stays per-process (its cross-host coordination is the runtime's
-    file-based topology); multi-host here is the collective-kernel comm
-    backend — the role NCCL/MPI bootstrap plays in GPU stacks (SURVEY.md
-    §2.9).
+    launcher.  Then either drive the ``parallel.sharded*`` kernels directly
+    over a ``resolve_devices({"devices": "global"})`` mesh, or run the
+    collective tasks through ``build()``: tasks marked
+    ``collective = True`` (sharded components / watershed / problem)
+    execute their program on EVERY process under the runtime's multi-host
+    topology, with process 0 owning the store writes and the status file
+    (``runtime.task.SimpleTask``).  The block-task layer stays
+    per-process; multi-host here is the comm backend — the role NCCL/MPI
+    bootstrap plays in GPU stacks (SURVEY.md §2.9).
     """
     global _DISTRIBUTED_INITIALIZED
     import os

@@ -210,12 +210,52 @@ class SimpleTask(Task):
     """A single-shot (non-blockwise) task: subclasses implement ``run_impl``.
 
     Under multi-host topology the merge runs on process 0 only (the
-    reference's 1-job merge semantics); peers wait for its status file."""
+    reference's 1-job merge semantics); peers wait for its status file.
+
+    ``collective = True`` inverts that: EVERY process executes ``run_impl``
+    simultaneously — required when the body runs a jax collective over a
+    global (multi-process) mesh, where process 0 alone would deadlock
+    waiting for shards the peers never contribute.  The jax program itself
+    is the synchronization; process 0 owns the status file (and, by
+    convention inside such tasks, the store writes — guard them with
+    ``jax.process_index() == 0``), and peers wait for it before declaring
+    completion.
+
+    Failure semantics: like any NCCL-style collective job, a process dying
+    BEFORE or INSIDE the collective leaves its peers blocked in the
+    program (no file barrier guards device collectives); the
+    ``peer_wait_timeout_s`` protection applies only to the status-file
+    waits around it.  A peer that fails and records an abort is never
+    masked: process 0 re-checks for abort records before stamping
+    completion."""
+
+    collective: bool = False
+
+    def _check_peer_abort(self) -> None:
+        status = self.output().read()
+        if status and status.get("aborted"):
+            raise RuntimeError(
+                f"{self.identifier}: peer process recorded an abort: "
+                f"{status.get('error', 'unknown error')}"
+            )
 
     def run(self) -> None:
         gconf = self.global_config()
         pid, num = cfg.process_topology(gconf)
-        if num > 1 and pid != 0:
+        if num > 1 and self.collective:
+            # the collective contract needs the jax runtime to SPAN the
+            # file-topology processes; otherwise every process believes it
+            # is jax process 0 and all of them race the store writes
+            import jax
+
+            if jax.process_count() != num:
+                raise RuntimeError(
+                    f"{self.identifier} is collective over {num} processes "
+                    f"but the jax runtime spans {jax.process_count()} — "
+                    "call parallel.mesh.init_distributed() at process "
+                    "startup (before any jax use) so the mesh is global"
+                )
+        if num > 1 and pid != 0 and not self.collective:
             timeout = float(gconf.get("peer_wait_timeout_s", 3600.0))
             self.log(f"process {pid}: waiting for process 0 to run "
                      f"{self.identifier}")
@@ -229,6 +269,16 @@ class SimpleTask(Task):
             if num > 1:
                 self._write_abort(f"{type(e).__name__}: {e}")
             raise
+        if num > 1 and pid != 0:
+            # collective peer: work done inside the jax program; p0 stamps
+            # the canonical status once its own (write-owning) body returns
+            timeout = float(gconf.get("peer_wait_timeout_s", 3600.0))
+            self._peer_wait([self.output()], timeout, f"{self.identifier} on p0")
+            self.log(f"done {self.identifier} (collective peer {pid})")
+            return
+        if num > 1:
+            # never stamp completion over a peer's abort record
+            self._check_peer_abort()
         status = {
             "task": self.identifier,
             "complete": True,

@@ -157,3 +157,16 @@ class VolumeSimpleTask(SimpleTask):
 
     def tmp_store(self):
         return store.file_reader(self.tmp_store_path, "a")
+
+    def require_output(self, shape, conf, dtype="uint64"):
+        """Create/open ``output_path/output_key`` with the house convention
+        (block-shape chunks, gzip) — one recipe for every single-shot task
+        that writes a volume."""
+        f = store.file_reader(self.output_path, "a")
+        block_shape = conf.get("block_shape")
+        return f.require_dataset(
+            self.output_key, shape=tuple(shape), dtype=dtype,
+            chunks=tuple(block_shape) if block_shape else None,
+            compression="gzip",
+        )
+

@@ -193,6 +193,7 @@ class ShardedProblemTask(VolumeSimpleTask):
     """
 
     task_name = "sharded_problem"
+    collective = True
 
     def __init__(self, *args, input_path: str = None, input_key: str = None,
                  labels_path: str = None, labels_key: str = None, **kwargs):
@@ -271,6 +272,10 @@ class ShardedProblemTask(VolumeSimpleTask):
             compact_d, data_d, mesh=mesh,
             max_edges=int(conf.get("max_edges", 16384)),
         )
+        import jax as _jax
+
+        if _jax.process_index() != 0:
+            return  # process 0 owns the scratch-store writes
         dense = (edges_c - 1).astype(np.int64)  # compact id → node index
 
         out = self.tmp_store()

@@ -267,6 +267,7 @@ class ShardedComponentsTask(VolumeSimpleTask):
     """
 
     task_name = "sharded_components"
+    collective = True
 
     def __init__(self, *args, input_path: str = None, input_key: str = None,
                  output_path: str = None, output_key: str = None,
@@ -342,12 +343,16 @@ class ShardedComponentsTask(VolumeSimpleTask):
                 m_d = put_from_store(m_ds, mesh, dtype=bool, pad_to=n_dev)
                 mask_d = jax.jit(jax.numpy.logical_and)(mask_d, m_d)
 
-        raw_labels = np.asarray(
+        from ..parallel.mesh import fetch_global
+
+        raw_labels = fetch_global(
             sharded_connected_components(
                 mask_d, mesh=mesh,
                 connectivity=int(conf.get("connectivity", 1)),
             )
         )[:z]
+        if jax.process_index() != 0:
+            return  # process 0 owns the writes
 
         # consecutive uint64 ids in root order (matches the block pipeline's
         # relabeling up to partition equality); background -1 → 0 first so the
@@ -357,13 +362,7 @@ class ShardedComponentsTask(VolumeSimpleTask):
         shifted = np.where(raw_labels < 0, 0, raw_labels.astype(np.int64) + 1)
         out, n_labels = relabel_consecutive_np(shifted.astype(np.uint64))
 
-        f = store_mod.file_reader(self.output_path, "a")
-        block_shape = conf.get("block_shape")
-        ds = f.require_dataset(
-            self.output_key, shape=out.shape, dtype="uint64",
-            chunks=tuple(block_shape) if block_shape else None,
-            compression="gzip",
-        )
+        ds = self.require_output(out.shape, conf)
         ds[:] = out
         ds.attrs["n_labels"] = int(n_labels)
         self.log(

@@ -27,7 +27,7 @@ from ..parallel.dispatch import read_block_batch, write_block_batch
 from ..parallel.mesh import put_sharded
 from ..utils import store
 from ..utils.blocking import Blocking, make_checkerboard_block_lists
-from .base import VolumeTask, read_threads
+from .base import VolumeSimpleTask, VolumeTask, read_threads
 
 MAX_IDS_KEY = "watershed/max_ids"
 
@@ -500,7 +500,7 @@ class TwoPassWatershedTask(WatershedTask):
             max_ids.write_chunk((bid,), np.array([lab.max()], dtype=np.int64))
 
 
-class ShardedWatershedTask(VolumeTask):
+class ShardedWatershedTask(VolumeSimpleTask):
     """Whole-volume DT-watershed over the device mesh in collective form
     (``parallel.sharded_watershed.sharded_dt_watershed``) — the alternative
     to per-block watershed + stitching when the volume fits the mesh's
@@ -509,11 +509,13 @@ class ShardedWatershedTask(VolumeTask):
 
     3d mode only (the collective kernel is the
     ``apply_dt_2d=False, apply_ws_2d=False`` path); masks are not supported
-    yet — use the block pipeline for masked volumes.
+    yet — use the block pipeline for masked volumes.  ``collective``: under
+    a multi-process runtime every process enters the program together
+    (``devices: "global"``); process 0 owns the store writes.
     """
 
     task_name = "sharded_watershed"
-    output_dtype = "uint64"
+    collective = True
 
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
@@ -531,16 +533,15 @@ class ShardedWatershedTask(VolumeTask):
         )
         return conf
 
-    def get_block_list(self, blocking, gconf):
-        # single-shot: the whole volume is one "block" (id 0)
-        return [0]
+    def run_impl(self) -> None:
+        import jax as _jax
 
-    def process_block(self, block_id, blocking, config):
         from ..ops.relabel import relabel_consecutive_np
         from ..parallel.mesh import get_mesh, put_from_store, resolve_devices
         from ..parallel.sharded_watershed import sharded_dt_watershed
 
-        in_ds = self.input_ds()
+        config = {**self.global_config(), **self.get_task_config()}
+        in_ds = store.file_reader(self.input_path, "r")[self.input_key]
         if in_ds.ndim != 3:
             raise ValueError(
                 "sharded_watershed supports 3d volumes (channel inputs go "
@@ -574,8 +575,11 @@ class ShardedWatershedTask(VolumeTask):
             invert_input=invert,
             z_valid=int(in_ds.shape[0]),
         )
+        if _jax.process_index() != 0:
+            return  # process 0 owns the writes
         out, n_labels = relabel_consecutive_np(labels.astype(np.uint64))
-        self.output_ds()[:] = out
+        ds = self.require_output(in_ds.shape, config)
+        ds[:] = out
         self.log(
             f"sharded DT-watershed over {n_dev} devices: {n_labels} fragments"
         )

@@ -134,3 +134,109 @@ def test_collective_kernels_across_processes(tmp_path):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert "sharded CC over 2x4 devices OK" in out
         assert "bitwise == 1-device flood" in out
+
+
+TASK_WORKER = r"""
+import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pid, nproc, port, root = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+)
+import os
+os.environ["CTT_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["CTT_NUM_PROCESSES"] = str(nproc)
+os.environ["CTT_PROCESS_ID"] = str(pid)
+
+from cluster_tools_tpu.parallel import mesh as mesh_mod
+
+assert mesh_mod.init_distributed()  # BEFORE any backend use
+
+import numpy as np
+from scipy import ndimage
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.tasks.thresholded_components import (
+    ShardedComponentsTask,
+)
+from cluster_tools_tpu.utils import file_reader
+
+path = os.path.join(root, "d.n5")
+if pid == 0:
+    rng = np.random.default_rng(0)
+    raw = rng.random((16, 16, 16)).astype("float32")
+    file_reader(path).create_dataset("raw", data=raw, chunks=(8, 16, 16))
+    cfg.write_global_config(
+        os.path.join(root, "configs"),
+        {"block_shape": [8, 16, 16], "devices": "global"},
+    )
+    open(os.path.join(root, "ready"), "w").write("1")
+else:
+    import time
+
+    while not os.path.exists(os.path.join(root, "ready")):
+        time.sleep(0.1)
+
+task = ShardedComponentsTask(
+    os.path.join(root, "tmp"), os.path.join(root, "configs"),
+    input_path=path, input_key="raw",
+    output_path=path, output_key="cc",
+)
+assert build([task])
+if pid == 0:
+    raw = file_reader(path, "r")["raw"][:]
+    got = file_reader(path, "r")["cc"][:]
+    want, n_want = ndimage.label(raw > 0.5)
+    pairs = np.unique(
+        np.stack([got[raw > 0.5], want[raw > 0.5]], axis=1), axis=0
+    )
+    assert len(pairs) == n_want == len(np.unique(got[got > 0]))
+print(f"[p{pid}] collective task build OK over "
+      f"{jax.device_count()} devices / {jax.process_count()} processes",
+      flush=True)
+"""
+
+
+def test_collective_task_layer_across_processes(tmp_path):
+    """build([ShardedComponentsTask]) under a 2-process global mesh: every
+    process enters the collective program (SimpleTask.collective), process 0
+    writes output + status, peers complete via the status barrier."""
+    worker = tmp_path / "task_worker.py"
+    worker.write_text(TASK_WORKER)
+    root = tmp_path / "run"
+    root.mkdir()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        penv = dict(env, CTT_PROCESS_ID=str(pid))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(worker), str(pid), "2", str(port),
+                 str(root)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=penv,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert "collective task build OK" in out
