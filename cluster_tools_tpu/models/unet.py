@@ -122,6 +122,10 @@ def load_checkpoint(path: str):
         conf = json.load(f)
     name = conf.pop("model", "UNet3D")
     in_channels = conf.pop("in_channels", 1)
+    if "dtype" in conf:
+        # mixed-precision knob: "bfloat16" (default — MXU-native compute
+        # with float32 params/norms) or "float32" for full precision
+        conf["dtype"] = jnp.dtype(conf["dtype"])
     model = MODEL_REGISTRY[name](**conf)
     # template params to restore structure
     dummy = jnp.zeros((1, in_channels, 8, 16, 16), jnp.float32)

@@ -376,3 +376,42 @@ class TestLinearTransformationWorkflow:
         )
         # config surface advertises the linear task
         assert "linear" in LinearTransformationWorkflow.get_config()
+
+
+class TestMixedPrecision:
+    def test_checkpoint_dtype_knob(self, tmp_path, rng):
+        """model.json may pin the compute dtype: float32 runs full precision,
+        the bfloat16 default is the MXU-native mixed mode — outputs agree to
+        bf16 tolerance (reference frameworks.py:53-57 apex mixed precision)."""
+        import jax
+        import jax.numpy as jnp
+
+        from cluster_tools_tpu.models import UNet3D, save_checkpoint
+        from cluster_tools_tpu.tasks.frameworks import JaxPredictor
+
+        x = rng.random((8, 16, 16)).astype("float32")
+        outs = {}
+        for dt in ("bfloat16", "float32"):
+            conf = {
+                "model": "UNet3D", "out_channels": 1, "initial_features": 4,
+                "depth": 2, "scale_factors": [[1, 2, 2]], "in_channels": 1,
+                "dtype": dt,
+            }
+            # construct the model FROM the sidecar dict so the saved config
+            # and the tested model cannot diverge
+            kwargs = {k: v for k, v in conf.items()
+                      if k not in ("model", "in_channels")}
+            kwargs["dtype"] = jnp.dtype(kwargs["dtype"])
+            model = UNet3D(**kwargs)
+            params = model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1, 8, 16, 16), "float32")
+            )
+            path = str(tmp_path / f"ckpt_{dt}")
+            save_checkpoint(path, params, conf)
+            pred = JaxPredictor(path, [0, 0, 0])
+            out = pred(x)
+            assert out.dtype == np.float32  # outputs come back f32 either way
+            outs[dt] = out
+        np.testing.assert_allclose(
+            outs["bfloat16"], outs["float32"], atol=0.05, rtol=0.05
+        )
