@@ -109,3 +109,31 @@ def force_cc_mode(mode):
     finally:
         FORCE_CC_MODE = prev
         jax.clear_caches()
+
+
+# None = read CTT_DTWS_MODE; force_dtws_mode() overrides within a scope
+FORCE_DTWS_MODE = None
+
+
+def use_pallas_dtws() -> bool:
+    """Whether the per-slice DT-watershed should use the fused Pallas kernel
+    (ops/pallas_dtws.py).  Read at TRACE time, like the other mode switches."""
+    if FORCE_DTWS_MODE is not None:
+        return FORCE_DTWS_MODE == "pallas"
+    return os.environ.get("CTT_DTWS_MODE") == "pallas"
+
+
+@contextmanager
+def force_dtws_mode(mode):
+    """Scoped DT-watershed-mode override ('pallas' | 'xla')."""
+    global FORCE_DTWS_MODE
+    import jax
+
+    prev = FORCE_DTWS_MODE
+    FORCE_DTWS_MODE = mode
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        FORCE_DTWS_MODE = prev
+        jax.clear_caches()

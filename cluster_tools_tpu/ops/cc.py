@@ -204,19 +204,27 @@ def connected_components(
     return labels.reshape(mask.shape), n
 
 
+def rank_of_flat_roots(flat: jnp.ndarray, size: int):
+    """Prefix-count rank table over flat-index roots: ``rank[i]`` is the
+    1-based consecutive id of the root at flat index i (valid where a root
+    exists).  Shared by every consumer that must number components in
+    minimal-flat-index order."""
+    is_root = flat == jnp.arange(size, dtype=jnp.int32)
+    root_rank = jnp.cumsum(is_root.astype(jnp.int32))
+    n = root_rank[-1] if size > 0 else jnp.int32(0)
+    return root_rank, n.astype(jnp.int32)
+
+
 def consecutive_from_flat_roots(
     flat: jnp.ndarray, size: int
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Rank flat-index component roots into consecutive ids 1..n (background
     stays 0, marked by negative entries).  Shared by the XLA and Pallas CC
     paths so their numbering stays in lockstep."""
-    # roots are voxels whose label equals their own flat index
-    is_root = flat == jnp.arange(size, dtype=jnp.int32)
-    root_rank = jnp.cumsum(is_root.astype(jnp.int32))
-    n = root_rank[-1] if size > 0 else jnp.int32(0)
+    root_rank, n = rank_of_flat_roots(flat, size)
     safe = jnp.clip(flat, 0, size - 1)
     labels = jnp.where(flat >= 0, root_rank[safe], 0)
-    return labels.astype(jnp.int32), n.astype(jnp.int32)
+    return labels.astype(jnp.int32), n
 
 
 def connected_components_labels(

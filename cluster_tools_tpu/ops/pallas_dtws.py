@@ -1,0 +1,379 @@
+"""The ENTIRE per-slice DT-watershed as one Pallas TPU kernel.
+
+The reference's production-default watershed config is the 2d mode
+(``apply_dt_2d: True, apply_ws_2d: True`` — reference watershed.py:54-56):
+every z-slice runs threshold → 2d EDT → smoothed-maxima seeds → height map
+→ seeded flood independently.  The XLA path (`ops.watershed.dt_watershed`)
+runs that as a dozen full-array programs; this kernel runs the WHOLE
+per-slice pipeline inside VMEM — grid = slices, one input read and three
+output writes (labels, seed roots, hmap) of HBM traffic per slice:
+
+  1. threshold (+ mask/valid) → fg;
+  2. 2d squared EDT: exact line distances along H (prefix-max doubling over
+     the nearest-background index), then the dense min-plus parabola pass
+     along W in j-tiles (the same tiled formulation as ops/dt._parabola_pass);
+  3. seeds: gaussian(dt) by explicit symmetric-padded tap sums → 3×3 maxima
+     (plateau-tolerant) → full-connectivity in-slice CC by log-depth
+     min-label sweeps along rows, columns AND diagonals (pallas_cc's clamp
+     composition; diagonal conduction via composed shifts);
+  4. height map α·x + (1-α)·(1 − normalize(dt)), gaussian-smoothed;
+  5. both flood phases to their fixpoint (`pallas_flood.flood_arrays`).
+
+Labels come back as in-slice seed roots encoded as volume-flat indices (+1);
+the host-side wrapper `pallas_dt_watershed` ranks them globally consecutive
+(the same minimal-flat-index order as `ops.watershed.dt_seeds`) and applies
+the size filter with the XLA epilogue — bit-for-bit the label semantics of
+``dt_watershed(apply_dt_2d=True, apply_ws_2d=True)`` up to float-sum
+ordering inside the gaussian taps (asserted partition-identical, and
+near-exact stage-wise, in tests/test_pallas_dtws.py).
+
+Activation: `CTT_DTWS_MODE=pallas` (TPU backend, per-slice mode, lane-aligned
+slices, no NMS, no pixel pitch).  Off by default until hardware-validated;
+tools/tpu_validate.py measures lowering + perf when a chip is reachable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .filters import _gauss_kernel
+from .pallas_flood import _BIG, _shift, flood_arrays
+
+_BIG_DT = np.float32(1e10)  # ops/dt._BIG
+
+
+def _prefix_max(x, axis, reverse):
+    """Inclusive prefix max along a direction by shift-compose doubling."""
+    n = x.shape[axis]
+    out = x
+    for k in range(int(np.ceil(np.log2(max(n, 2))))):
+        out = jnp.maximum(out, _shift(out, 1 << k, axis, reverse, -_BIG))
+    return out
+
+
+def _line_distance_sq(bg, pitch=1.0):
+    """Squared exact 1d distance to the nearest True of ``bg`` along axis 0
+    — the in-VMEM mirror of ops/dt._line_scan_distance (assoc mode):
+    d_i = pitch · (i − nearest bg index), directional via prefix max over
+    bg-carrying indices, two directions, min."""
+    h, w = bg.shape
+    iota = lax.broadcasted_iota(jnp.float32, (h, w), 0)
+
+    # forward: nearest True at or before i
+    last = _prefix_max(jnp.where(bg, iota, -_BIG), 0, False)
+    fwd = jnp.minimum((iota - last) * pitch, _BIG_DT)
+    # backward: nearest True at or after i — mirror via reversed iota
+    riota = jnp.float32(h - 1) - iota
+    rlast = _prefix_max(jnp.where(bg, riota, -_BIG), 0, True)
+    bwd = jnp.minimum((riota - rlast) * pitch, _BIG_DT)
+    d = jnp.minimum(fwd, bwd)
+    return d * d
+
+
+def _parabola_w(g, tile=32):
+    """g'(h, i) = min_j g(h, j) + (i-j)² along axis 1 — the dense j-tiled
+    min-plus product of ops/dt._parabola_pass, j-tiles statically unrolled."""
+    h, w = g.shape
+    n_pad = -w % tile
+    gp = (
+        jnp.concatenate([g, jnp.full((h, n_pad), _BIG_DT, g.dtype)], axis=1)
+        if n_pad else g
+    )
+    i_idx = lax.broadcasted_iota(jnp.float32, (w, tile), 0)
+    out = jnp.full((h, w), _BIG_DT, g.dtype)
+    for t in range(gp.shape[1] // tile):
+        j0 = t * tile
+        j_idx = jnp.float32(j0) + lax.broadcasted_iota(
+            jnp.float32, (w, tile), 1
+        )
+        diff = i_idx - j_idx  # (w_i, tile_j)
+        cost = gp[:, None, j0 : j0 + tile] + (diff * diff)[None, :, :]
+        out = jnp.minimum(out, cost.min(axis=-1))
+    return out
+
+
+def _reflect_pad(x, r, axis):
+    """Symmetric ('reflect-including-edge') padding by r on both sides,
+    built from static single-row/column concatenations (no flips)."""
+    parts = []
+    n = x.shape[axis]
+    take = lambda k: (  # noqa: E731
+        x[k : k + 1] if axis == 0 else x[:, k : k + 1]
+    )
+    for k in range(r - 1, -1, -1):
+        parts.append(take(min(k, n - 1)))
+    parts.append(x)
+    for k in range(r):
+        parts.append(take(max(n - 1 - k, 0)))
+    return jnp.concatenate(parts, axis=axis)
+
+
+def _conv1d(x, taps, axis):
+    """Correlation with a symmetric 1d kernel along ``axis``, symmetric
+    boundary — explicit tap sum over static slices (taps are host floats)."""
+    r = len(taps) // 2
+    xp = _reflect_pad(x, r, axis)
+    n = x.shape[axis]
+    acc = None
+    for k, wgt in enumerate(taps):
+        sl = (
+            xp[k : k + n] if axis == 0 else xp[:, k : k + n]
+        )
+        term = jnp.float32(wgt) * sl
+        acc = term if acc is None else acc + term
+    return acc
+
+
+def _max3(x):
+    """3×3 maximum filter with edge-replicate boundary (symmetric pad of 1)."""
+    xp = _reflect_pad(_reflect_pad(x, 1, 0), 1, 1)
+    h, w = x.shape
+    out = None
+    for dy in range(3):
+        for dx in range(3):
+            v = xp[dy : dy + h, dx : dx + w]
+            out = v if out is None else jnp.maximum(out, v)
+    return out
+
+
+_SENT = np.int32(np.iinfo(np.int32).max - 1)
+
+
+def _shift2(x, d, rev0, rev1, fill):
+    """Diagonal shift: d steps along BOTH axes (direction per axis)."""
+    return _shift(_shift(x, d, 0, rev0, fill), d, 1, rev1, fill)
+
+
+def _cc_full_conn(mask, label0):
+    """In-slice CC over the FULL 8-neighborhood: log-depth min-label sweeps
+    along rows, columns and both diagonals, iterated to the fixpoint —
+    pallas_cc's clamp composition extended with diagonal directions.  The
+    fixpoint (minimal label per component) is schedule-independent, so it
+    matches ops/cc's pointer-jumping result exactly."""
+
+    def sweep(label, shift_fn, prev_mask_fn):
+        conduct = mask & prev_mask_fn(mask)
+        u = jnp.where(mask, label, _SENT)
+        l = jnp.where(conduct, jnp.int32(-1), _SENT)
+        n = max(label.shape)
+        for k in range(int(np.ceil(np.log2(max(n, 2))))):
+            uf = shift_fn(u, 1 << k, _SENT)
+            lf = shift_fn(l, 1 << k, jnp.int32(-1))
+            u = jnp.minimum(u, jnp.maximum(uf, l))
+            l = jnp.maximum(lf, l)
+        carry_in = shift_fn(u, 1, _SENT)
+        return jnp.where(conduct, jnp.minimum(label, carry_in), label)
+
+    directions = []
+    for axis in (0, 1):
+        for rev in (False, True):
+            directions.append((
+                lambda x, d, f, a=axis, r=rev: _shift(x, d, a, r, f),
+                lambda m, a=axis, r=rev: _shift(m, 1, a, r, False),
+            ))
+    for rev0 in (False, True):
+        for rev1 in (False, True):
+            directions.append((
+                lambda x, d, f, r0=rev0, r1=rev1: _shift2(x, d, r0, r1, f),
+                lambda m, r0=rev0, r1=rev1: _shift2(m, 1, r0, r1, False),
+            ))
+
+    def cond(carry):
+        _, changed = carry
+        return changed
+
+    def body(carry):
+        lab, _ = carry
+        new = lab
+        for shift_fn, prev_fn in directions:
+            new = sweep(new, shift_fn, prev_fn)
+        return new, jnp.any(new != lab)
+
+    lab, _ = lax.while_loop(cond, body, (label0, jnp.bool_(True)))
+    return lab
+
+
+def _dtws_slice_kernel(
+    x_ref, m_ref, v_ref, lab_ref, root_ref, hmap_ref,
+    *, threshold, seed_taps, weight_taps, alpha, invert,
+):
+    x = x_ref[0]
+    mask = m_ref[0] != 0
+    valid = v_ref[0] != 0
+    h, w = x.shape
+    if invert:
+        x = 1.0 - x
+    fg = (x < threshold) & mask
+
+    # -- 2d squared EDT: lines along H, parabola along W --------------------
+    g = _line_distance_sq(~fg)
+    g = _parabola_w(g)
+    dt = jnp.sqrt(jnp.minimum(g, _BIG_DT)).astype(jnp.float32)
+
+    # -- seeds ---------------------------------------------------------------
+    sm = dt
+    if seed_taps is not None:
+        sm = _conv1d(_conv1d(sm, seed_taps, 0), seed_taps, 1)
+    local_max = (_max3(sm) == sm) & (dt > 0)
+
+    z = pl.program_id(0)
+    row = lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    col = lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    flat = (z * h + row) * w + col
+    label0 = jnp.where(local_max, flat, _SENT)
+    roots = _cc_full_conn(local_max, label0)
+    seed_ids = jnp.where(local_max, roots + 1, 0)  # volume-flat root + 1
+
+    # -- height map ----------------------------------------------------------
+    lo = dt.min()
+    hi = dt.max()
+    dtn = (dt - lo) / jnp.maximum(hi - lo, jnp.float32(1e-6))
+    hmap = alpha * x + (1.0 - alpha) * (1.0 - dtn)
+    if weight_taps is not None:
+        hmap = _conv1d(_conv1d(hmap, weight_taps, 0), weight_taps, 1)
+
+    # -- flood ---------------------------------------------------------------
+    labels = flood_arrays(hmap, seed_ids, fg & valid)
+
+    lab_ref[0] = labels
+    root_ref[0] = jnp.where(local_max, roots, jnp.int32(-1))
+    hmap_ref[0] = hmap
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "threshold", "sigma_seeds", "sigma_weights", "alpha", "invert",
+        "interpret",
+    ),
+)
+def dtws_slices(
+    x, mask, valid,
+    threshold: float = 0.5,
+    sigma_seeds: float = 2.0,
+    sigma_weights: float = 2.0,
+    alpha: float = 0.8,
+    invert: bool = False,
+    interpret: bool = False,
+):
+    """Run the fused per-slice DT-watershed kernel over an (N, H, W) stack.
+
+    Returns ``(labels, seed_roots, hmap)``: labels carry volume-flat seed
+    roots + 1 (0 background), seed_roots the maxima CC roots (−1 off-seed),
+    hmap the smoothed height map (for the size-filter epilogue)."""
+    n, h, w = x.shape
+    seed_taps = (
+        tuple(float(t) for t in _gauss_kernel(sigma_seeds))
+        if sigma_seeds and sigma_seeds > 0 else None
+    )
+    weight_taps = (
+        tuple(float(t) for t in _gauss_kernel(sigma_weights))
+        if sigma_weights and sigma_weights > 0 else None
+    )
+    kernel = functools.partial(
+        _dtws_slice_kernel,
+        threshold=np.float32(threshold),
+        seed_taps=seed_taps,
+        weight_taps=weight_taps,
+        alpha=np.float32(alpha),
+        invert=bool(invert),
+    )
+    spec = lambda: pl.BlockSpec((1, h, w), lambda i: (i, 0, 0))  # noqa: E731
+    labels, roots, hmap = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[spec(), spec(), spec()],
+        out_specs=(spec(), spec(), spec()),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, h, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, h, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, h, w), jnp.float32),
+        ),
+        interpret=interpret,
+    )(
+        x.astype(jnp.float32),
+        mask.astype(jnp.int32),
+        valid.astype(jnp.int32),
+    )
+    return labels, roots, hmap
+
+
+def pallas_dt_watershed(
+    input_,
+    mask=None,
+    valid=None,
+    threshold: float = 0.5,
+    sigma_seeds: float = 2.0,
+    sigma_weights: float = 2.0,
+    alpha: float = 0.8,
+    size_filter: int = 25,
+    invert_input: bool = False,
+    interpret: bool = False,
+):
+    """Drop-in for ``dt_watershed(apply_dt_2d=True, apply_ws_2d=True)`` on a
+    3d block: fused kernel + the global consecutive seed ranking and the XLA
+    size-filter epilogue.  Returns ``(labels int32, n_seeds)``."""
+    from .cc import rank_of_flat_roots
+    from .watershed import apply_size_filter
+
+    x = jnp.asarray(input_, jnp.float32)
+    n, h, w = x.shape
+    if mask is None:
+        mask = jnp.ones(x.shape, bool)
+    if valid is None:
+        valid = jnp.ones(x.shape, bool)
+    labels_flat, roots, hmap = dtws_slices(
+        x, mask, valid,
+        threshold=threshold, sigma_seeds=sigma_seeds,
+        sigma_weights=sigma_weights, alpha=alpha, invert=invert_input,
+        interpret=interpret,
+    )
+    size = n * h * w
+    # seeds globally consecutive in minimal-flat-index order — identical
+    # numbering to dt_seeds(per_slice=True)
+    rank, n_seeds = rank_of_flat_roots(roots.reshape(-1), size)
+    lf = labels_flat.reshape(-1)
+    safe = jnp.clip(lf - 1, 0, size - 1)
+    labels = jnp.where(lf > 0, rank[safe], 0).reshape(x.shape).astype(
+        jnp.int32
+    )
+    if size_filter > 0:
+        num_segments = int(np.prod(x.shape)) // 2 + 2
+        fg = x if not invert_input else 1.0 - x
+        flood_mask = (fg < threshold) & mask.astype(bool) & valid.astype(bool)
+        labels = apply_size_filter(
+            labels, hmap, size_filter, num_segments, mask=flood_mask,
+            per_slice=True,
+        )
+    return labels, n_seeds
+
+
+def pallas_dtws_available(shape, apply_dt_2d, apply_ws_2d, pixel_pitch,
+                          nms, sigma_seeds=0.0, sigma_weights=0.0) -> bool:
+    """Gate: opted in (CTT_DTWS_MODE=pallas / force_dtws_mode), per-slice
+    mode, 3d, no pitch/NMS, TPU backend, lane-aligned slices, and gaussian
+    radii strictly inside the slice — the kernel's reflect padding clamps
+    at the edge where np.pad(mode="symmetric") cycles, so radii reaching
+    across a full axis would silently diverge from the XLA path."""
+    from . import _backend
+
+    if not _backend.use_pallas_dtws():
+        return False
+    if not (apply_dt_2d and apply_ws_2d) or len(shape) != 3:
+        return False
+    if pixel_pitch is not None or nms:
+        return False
+    if shape[1] % 8 or shape[2] % 128:
+        return False
+    for sigma in (sigma_seeds, sigma_weights):
+        if sigma and sigma > 0:
+            radius = max(int(4.0 * sigma + 0.5), 1)
+            if radius >= min(shape[1], shape[2]):
+                return False
+    return jax.default_backend() == "tpu"

@@ -125,11 +125,10 @@ def _sweep_assign(dist, label, alt, hmap, is_seed, mask, axis, reverse):
     )
 
 
-def _flood_slice_kernel(h_ref, s_ref, m_ref, o_ref):
-    """Whole per-slice flood: both phases iterated to their fixpoint in VMEM."""
-    hmap = h_ref[0]
-    seeds = s_ref[0]
-    mask = m_ref[0] != 0
+def flood_arrays(hmap, seeds, mask):
+    """Both flood phases to their fixpoint over in-VMEM (H, W) arrays —
+    shared by the standalone flood kernel and the fused DT-watershed kernel
+    (ops/pallas_dtws.py)."""
     seeds = jnp.where(mask, seeds, 0)
     is_seed = seeds > 0
 
@@ -170,7 +169,12 @@ def _flood_slice_kernel(h_ref, s_ref, m_ref, o_ref):
     _, label, _ = lax.while_loop(
         asg_cond, asg_round, (dist0, seeds, jnp.bool_(True))
     )
-    o_ref[0] = jnp.where(mask, label, 0)
+    return jnp.where(mask, label, 0)
+
+
+def _flood_slice_kernel(h_ref, s_ref, m_ref, o_ref):
+    """Whole per-slice flood: both phases iterated to their fixpoint in VMEM."""
+    o_ref[0] = flood_arrays(h_ref[0], s_ref[0], m_ref[0] != 0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -1,0 +1,104 @@
+"""Fused per-slice DT-watershed kernel vs the XLA pipeline.
+
+Interpreter-mode (Mosaic lowering is hardware-only — tools/tpu_validate.py).
+The contract is BITWISE equality with
+``dt_watershed(apply_dt_2d=True, apply_ws_2d=True)``: same EDT arithmetic,
+same gaussian taps, same maxima rule, same CC numbering (minimal-flat-index
+order), same flood tie-breaks, same size-filter epilogue."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.pallas_dtws import (
+    pallas_dt_watershed,
+    pallas_dtws_available,
+)
+from cluster_tools_tpu.ops.watershed import dt_watershed
+
+
+def _volume(seed, shape=(3, 16, 128), sigma=1.0):
+    rng = np.random.default_rng(seed)
+    raw = ndimage.gaussian_filter(rng.random(shape), sigma)
+    return ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+
+
+class TestPallasDtws:
+    @pytest.mark.parametrize(
+        "seed,kw",
+        [
+            (0, dict(threshold=0.6, size_filter=5)),
+            (1, dict(threshold=0.45, sigma_seeds=1.0, sigma_weights=0.0,
+                     alpha=0.9, size_filter=0)),
+            (2, dict(threshold=0.55, sigma_seeds=0.0, size_filter=10,
+                     invert_input=True)),
+        ],
+    )
+    def test_bitwise_equal_to_xla(self, seed, kw):
+        raw = _volume(seed)
+        want, nw = dt_watershed(jnp.asarray(raw), **kw)
+        got, ng = pallas_dt_watershed(raw, interpret=True, **kw)
+        assert int(ng) == int(nw)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_mask_and_valid(self, rng):
+        raw = _volume(7, (2, 8, 128))
+        mask = rng.random(raw.shape) < 0.9
+        valid = np.ones(raw.shape, bool)
+        valid[:, -2:, :] = False  # padded batch-edge extent
+        want, nw = dt_watershed(
+            jnp.asarray(raw), mask=jnp.asarray(mask), threshold=0.6,
+            size_filter=4, valid=jnp.asarray(valid),
+        )
+        got, ng = pallas_dt_watershed(
+            raw, mask=mask, valid=valid, threshold=0.6, size_filter=4,
+            interpret=True,
+        )
+        assert int(ng) == int(nw)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert (np.asarray(got)[~valid] == 0).all()
+
+    def test_availability_gating(self):
+        from cluster_tools_tpu.ops import _backend
+
+        shape = (4, 16, 128)
+        assert not pallas_dtws_available(shape, True, True, None, False)
+        with _backend.force_dtws_mode("pallas"):
+            import jax
+
+            on_tpu = jax.default_backend() == "tpu"
+            assert pallas_dtws_available(
+                shape, True, True, None, False
+            ) == on_tpu
+            assert not pallas_dtws_available(shape, False, True, None, False)
+            assert not pallas_dtws_available(shape, True, False, None, False)
+            assert not pallas_dtws_available(
+                shape, True, True, (2.0, 1.0, 1.0), False
+            )
+            assert not pallas_dtws_available(shape, True, True, None, True)
+            assert not pallas_dtws_available((4, 16, 100), True, True, None, False)
+
+    def test_large_sigma_gated_off(self):
+        """Gaussian radius reaching across a full axis uses clamped reflect
+        padding (vs symmetric-cyclic in the XLA path) — such configs must
+        not route to the kernel."""
+        from cluster_tools_tpu.ops import _backend
+
+        with _backend.force_dtws_mode("pallas"):
+            import jax
+
+            on_tpu = jax.default_backend() == "tpu"
+            # radius int(4*2.5+0.5)=10 >= H=8 → gated off regardless
+            assert not pallas_dtws_available(
+                (4, 8, 128), True, True, None, False, sigma_seeds=2.5
+            )
+            assert not pallas_dtws_available(
+                (4, 8, 128), True, True, None, False, sigma_weights=2.5
+            )
+            # comfortably inside: gate is backend-decided
+            assert pallas_dtws_available(
+                (4, 32, 128), True, True, None, False,
+                sigma_seeds=2.0, sigma_weights=2.0,
+            ) == on_tpu

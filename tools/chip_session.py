@@ -94,6 +94,8 @@ def derive_modes(results: dict) -> dict:
         modes["CTT_FLOOD_MODE"] = "pallas"
     if results.get("pallas_cc_exact") and results.get("pallas_cc_wins"):
         modes["CTT_CC_MODE"] = "pallas"
+    if results.get("pallas_dtws_exact") and results.get("pallas_dtws_wins"):
+        modes["CTT_DTWS_MODE"] = "pallas"
     return modes
 
 

@@ -125,6 +125,40 @@ def main():
         results["pallas_flood_error"] = f"{type(e).__name__}: {e}"[:500]
         print(f"pallas flood FAILED to lower/run: {e}")
 
+    # -- fused Pallas DT-watershed vs the XLA pipeline ----------------------
+    from cluster_tools_tpu.ops.pallas_dtws import pallas_dt_watershed
+    from cluster_tools_tpu.ops.watershed import dt_watershed as _dtws
+
+    try:
+        # reference pinned to the XLA path: with CTT_DTWS_MODE=pallas in the
+        # environment the gated dt_watershed would compare Pallas to itself
+        with _backend.force_dtws_mode("xla"):
+            want_l, want_n = _dtws(xs[0], threshold=0.5)
+        got_l, got_n = pallas_dt_watershed(xs[0], threshold=0.5)
+        dtws_agree = bool(jnp.array_equal(got_l, want_l)) and int(
+            got_n
+        ) == int(want_n)
+        results["pallas_dtws_exact"] = dtws_agree
+        t_p = timeit(
+            None, REPEATS,
+            sync=lambda r: r[0].block_until_ready(),
+            # device-resident inputs, like the XLA baselines — a host array
+            # here would bill the H2D transfer to the kernel
+            variants=[
+                (lambda v: lambda: pallas_dt_watershed(v, threshold=0.5))(v)
+                for v in xs[SPAN : 2 * SPAN]
+            ],
+        )
+        results["pallas_dtws_ms"] = round(t_p * 1e3, 1)
+        results["pallas_dtws_wins"] = (
+            results["pallas_dtws_ms"]
+            < min(results["dtws_assoc_ms"], results["dtws_seq_ms"])
+        )
+        print(f"pallas dtws: {t_p*1e3:.1f} ms (exact={dtws_agree})")
+    except Exception as e:  # Mosaic lowering / runtime failure: record, go on
+        results["pallas_dtws_error"] = f"{type(e).__name__}: {e}"[:500]
+        print(f"pallas dtws FAILED to lower/run: {e}")
+
     # -- Pallas per-slice CC + z-merge vs the XLA CC ------------------------
     from cluster_tools_tpu.ops.pallas_cc import pallas_connected_components
 
