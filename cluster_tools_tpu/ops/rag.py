@@ -70,42 +70,28 @@ def _face_values(
     labels: np.ndarray, values: np.ndarray, owner_shape=None
 ):
     """(u, v, sample) triples: for every face between two different labels, the
-    boundary-map values on both sides of the face."""
-    owned = _owner_mask(labels.shape, owner_shape)
-    us, vs, samples = [], [], []
-    for axis in range(labels.ndim):
-        lab0 = np.moveaxis(labels, axis, 0)
-        val0 = np.moveaxis(values, axis, 0)
-        lo, hi = lab0[:-1].reshape(-1), lab0[1:].reshape(-1)
-        vlo, vhi = val0[:-1].reshape(-1), val0[1:].reshape(-1)
-        sel = (lo != hi) & (lo != 0) & (hi != 0)
-        if owned is not None:
-            sel &= np.moveaxis(owned, axis, 0)[:-1].reshape(-1)
-        if not sel.any():
-            continue
-        a = np.minimum(lo[sel], hi[sel])
-        b = np.maximum(lo[sel], hi[sel])
-        # both side values are samples of the boundary evidence for this edge
-        us.append(np.concatenate([a, a]))
-        vs.append(np.concatenate([b, b]))
-        samples.append(np.concatenate([vlo[sel], vhi[sel]]))
-    if not us:
-        return (
-            np.zeros(0, dtype=labels.dtype),
-            np.zeros(0, dtype=labels.dtype),
-            np.zeros(0, dtype=np.float64),
-        )
-    return np.concatenate(us), np.concatenate(vs), np.concatenate(samples)
+    boundary-map values on both sides of the face.  A thin gather over
+    ``face_sample_indices`` — the owned-face rule lives there, once."""
+    u, v, ilo, ihi = face_sample_indices(labels, owner_shape)
+    flat = values.reshape(-1)
+    return (
+        np.concatenate([u, u]),
+        np.concatenate([v, v]),
+        np.concatenate([flat[ilo], flat[ihi]]).astype(np.float64),
+    )
 
 
-def _edge_group_features(u, v, s, dtype, hist_bins: int = 0):
+def _edge_group_features(u, v, s, dtype, hist_bins: int = 0,
+                         return_samples: bool = False):
     """Shared per-edge statistics over (u, v, sample) triples.
 
     Returns ``(edges [m,2], features [m,10])`` with edges sorted
     lexicographically — or ``(edges, features, hist [m,hist_bins] uint32)``
     when ``hist_bins > 0``: the per-edge histogram of the samples (assumed in
     [0, 1], clipped), the compact mergeable quantile sketch consumed by
-    ``merge_edge_features``.
+    ``merge_edge_features``.  With ``return_samples`` the per-edge sorted
+    sample vector (edge-major, spans given by the count column) is appended —
+    the raw material of the exact cross-block quantile merge.
     """
     if u.size == 0:
         empty = (
@@ -113,7 +99,9 @@ def _edge_group_features(u, v, s, dtype, hist_bins: int = 0):
             np.zeros((0, N_FEATURES)),
         )
         if hist_bins:
-            return empty + (np.zeros((0, hist_bins), dtype=np.uint32),)
+            empty = empty + (np.zeros((0, hist_bins), dtype=np.uint32),)
+        if return_samples:
+            empty = empty + (np.zeros(0, dtype=np.float64),)
         return empty
     order = np.lexsort((s, v, u))
     u, v, s = u[order], v[order], s[order]
@@ -137,14 +125,17 @@ def _edge_group_features(u, v, s, dtype, hist_bins: int = 0):
         qs.append(s[pos])
     cols = [mean, var, mins, *qs, maxs, counts]
     feats = np.stack(cols, axis=1)
+    out = (edges, feats)
     if hist_bins:
         group = np.cumsum(first) - 1
         bins = np.clip((s * hist_bins).astype(np.int64), 0, hist_bins - 1)
         hist = np.bincount(
             group * hist_bins + bins, minlength=edges.shape[0] * hist_bins
         ).reshape(edges.shape[0], hist_bins).astype(np.uint32)
-        return edges, feats, hist
-    return edges, feats
+        out = out + (hist,)
+    if return_samples:
+        out = out + (s,)
+    return out
 
 
 def boundary_edge_features(
@@ -152,16 +143,106 @@ def boundary_edge_features(
     boundary_map: np.ndarray,
     hist_bins: int = 0,
     owner_shape=None,
+    return_samples: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Per-edge feature matrix over the label faces of one block.
 
     ``owner_shape`` restricts accumulation to faces owned by the inner block
     when ``labels`` carries a +1 upper halo (see ``_owner_mask``); with
-    ``hist_bins > 0`` a third return carries the per-edge histogram sketch."""
+    ``hist_bins > 0`` a third return carries the per-edge histogram sketch;
+    with ``return_samples`` the last return is the per-edge sorted sample
+    vector (exact quantile-merge partials)."""
     u, v, s = _face_values(
         labels, boundary_map.astype(np.float64), owner_shape
     )
-    return _edge_group_features(u, v, s, labels.dtype, hist_bins)
+    return _edge_group_features(
+        u, v, s, labels.dtype, hist_bins, return_samples
+    )
+
+
+def face_sample_indices(labels: np.ndarray, owner_shape=None):
+    """Face geometry computed once, shared across value channels.
+
+    Returns ``(u, v, ilo, ihi)``: for every owned face between two different
+    non-zero labels, the label pair (u < v) and the flat indices of the two
+    face voxels into ``labels.ravel()``.  A channel's (u, v, sample) triples
+    are then ``(cat(u, u), cat(v, v), cat(vals.flat[ilo], vals.flat[ihi]))`` —
+    both sides of a face sample the boundary evidence, exactly as
+    ``_face_values`` does."""
+    owned = _owner_mask(labels.shape, owner_shape)
+    flat_idx = np.arange(labels.size, dtype=np.int64).reshape(labels.shape)
+    us, vs, ilos, ihis = [], [], [], []
+    for axis in range(labels.ndim):
+        lab0 = np.moveaxis(labels, axis, 0)
+        idx0 = np.moveaxis(flat_idx, axis, 0)
+        lo, hi = lab0[:-1].reshape(-1), lab0[1:].reshape(-1)
+        sel = (lo != hi) & (lo != 0) & (hi != 0)
+        if owned is not None:
+            sel &= np.moveaxis(owned, axis, 0)[:-1].reshape(-1)
+        if not sel.any():
+            continue
+        us.append(np.minimum(lo[sel], hi[sel]))
+        vs.append(np.maximum(lo[sel], hi[sel]))
+        ilos.append(idx0[:-1].reshape(-1)[sel])
+        ihis.append(idx0[1:].reshape(-1)[sel])
+    if not us:
+        z = np.zeros(0, dtype=np.int64)
+        return np.zeros(0, dtype=labels.dtype), np.zeros(0, dtype=labels.dtype), z, z
+    return (
+        np.concatenate(us), np.concatenate(vs),
+        np.concatenate(ilos), np.concatenate(ihis),
+    )
+
+
+def filter_edge_features(
+    labels: np.ndarray,
+    responses: Sequence[np.ndarray],
+    owner_shape=None,
+    return_samples: bool = False,
+):
+    """Edge features over a bank of filter responses (the reference's
+    filter-accumulation path, block_edge_features.py:151-238 via
+    ndist.accumulateInput): 9 statistics [mean, var, min, q10, q25, q50,
+    q75, q90, max] per response channel plus ONE trailing count column.
+
+    ``responses`` are label-shaped float arrays (one per filter × sigma ×
+    channel, the caller's flattening of multichannel filters).  Returns
+    ``(edges [m,2], feats [m, 9*G+1])`` and, with ``return_samples``, the
+    group-major flat sample array ``[G * total_count]`` (each group's
+    samples edge-major sorted — the exact-merge partials consumed by
+    ``merge_edge_features_multi``)."""
+    G = len(responses)
+    u0, v0, ilo, ihi = face_sample_indices(labels, owner_shape)
+    u = np.concatenate([u0, u0])
+    v = np.concatenate([v0, v0])
+    edges = None
+    feat_groups, sample_groups = [], []
+    count = None
+    for resp in responses:
+        if resp.shape != labels.shape:
+            raise ValueError(
+                f"response shape {resp.shape} != labels shape {labels.shape}"
+            )
+        flat = resp.reshape(-1).astype(np.float64)
+        s = np.concatenate([flat[ilo], flat[ihi]])
+        e, f, samp = _edge_group_features(
+            u, v, s, labels.dtype, 0, return_samples=True
+        )
+        if edges is None:
+            edges = e
+            count = f[:, 9]
+        feat_groups.append(f[:, :9])
+        if return_samples:
+            sample_groups.append(samp)
+    if edges is None or edges.shape[0] == 0:
+        feats = np.zeros((0, 9 * G + 1))
+        if return_samples:
+            return np.zeros((0, 2), dtype=labels.dtype), feats, np.zeros(0)
+        return np.zeros((0, 2), dtype=labels.dtype), feats
+    feats = np.concatenate(feat_groups + [count[:, None]], axis=1)
+    if return_samples:
+        return edges, feats, np.concatenate(sample_groups)
+    return edges, feats
 
 
 def affinity_edge_features(
@@ -170,6 +251,7 @@ def affinity_edge_features(
     offsets: Sequence[Sequence[int]],
     hist_bins: int = 0,
     owner_shape=None,
+    return_samples: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Edge features from an affinity map [C, *spatial] with per-channel offsets
     (reference extractBlockFeaturesFromAffinityMaps).  Samples the affinity
@@ -212,12 +294,16 @@ def affinity_edge_features(
             np.zeros((0, N_FEATURES)),
         )
         if hist_bins:
-            return empty + (np.zeros((0, hist_bins), dtype=np.uint32),)
+            empty = empty + (np.zeros((0, hist_bins), dtype=np.uint32),)
+        if return_samples:
+            empty = empty + (np.zeros(0, dtype=np.float64),)
         return empty
     u = np.concatenate(us)
     v = np.concatenate(vs)
     s = np.concatenate(samples)
-    return _edge_group_features(u, v, s, labels.dtype, hist_bins)
+    return _edge_group_features(
+        u, v, s, labels.dtype, hist_bins, return_samples
+    )
 
 
 def _histogram_quantiles(hist: np.ndarray, cum: np.ndarray, counts, q: float):
@@ -315,6 +401,117 @@ def merge_edge_features(
         out[:, 3:8] = qsum / np.maximum(count, 1)[:, None]
     out[:, 8] = np.where(nonzero, maxs, 0.0)
     out[:, 9] = count
+    return out
+
+
+def _exact_group_quantiles(
+    out, col0, ids_list, counts_list, samples_list, group, n_groups
+):
+    """Exact per-edge quantiles for one feature group from the raw sample
+    partials: globally sort (edge, value) pairs pooled over all blocks and
+    index the quantile positions — identical (by construction) to a
+    single-shot whole-volume recompute, the reference's exact
+    ``ndist.mergeFeatureBlocks`` semantics (merge_edge_features.py:141)."""
+    eids, vals = [], []
+    for ids, counts, flat in zip(ids_list, counts_list, samples_list):
+        if ids.size == 0:
+            continue
+        total = int(counts.sum())
+        g_vals = flat.reshape(n_groups, total)[group]
+        eids.append(np.repeat(ids, counts.astype(np.int64)))
+        vals.append(g_vals)
+    if not eids:
+        return
+    eids = np.concatenate(eids)
+    vals = np.concatenate(vals)
+    order = np.lexsort((vals, eids))
+    eids, vals = eids[order], vals[order]
+    first = np.concatenate([[True], eids[1:] != eids[:-1]])
+    starts = np.nonzero(first)[0]
+    counts = np.diff(np.append(starts, eids.size)).astype(np.int64)
+    rows = eids[starts]
+    for qi, q in enumerate(QUANTILES):
+        pos = starts + np.minimum(
+            (q * (counts - 1)).astype(np.int64), counts - 1
+        )
+        out[rows, col0 + qi] = vals[pos]
+
+
+def merge_edge_features_multi(
+    edge_ids_list: Sequence[np.ndarray],
+    feats_list: Sequence[np.ndarray],
+    n_edges: int,
+    samples_list: Optional[Sequence[np.ndarray]] = None,
+) -> np.ndarray:
+    """Merge per-block partials of the G-group feature layout
+    ``[9 stats × G groups, count]`` (``filter_edge_features``; G=1 reproduces
+    the default 10-column layout).
+
+    count/mean/var/min/max merge exactly per group (parallel-variance
+    formula).  Quantiles merge EXACTLY when every partial ships its raw
+    sorted samples in ``samples_list`` (``quantile_mode: "exact"``) —
+    matching a single-shot recompute bit-for-bit; without samples they
+    degrade to count-weighted averaging."""
+    n_cols = next(
+        (f.shape[1] for f in feats_list if f.ndim == 2 and f.shape[0]), None
+    )
+    if n_cols is None:
+        return np.zeros((n_edges, N_FEATURES))
+    n_groups = (n_cols - 1) // 9
+    if n_cols != 9 * n_groups + 1:
+        raise ValueError(f"feature width {n_cols} is not 9*G+1")
+
+    out = np.zeros((n_edges, n_cols))
+    count = np.zeros(n_edges)
+    mean = np.zeros((n_edges, n_groups))
+    m2 = np.zeros((n_edges, n_groups))
+    mins = np.full((n_edges, n_groups), np.inf)
+    maxs = np.full((n_edges, n_groups), -np.inf)
+    qsum = np.zeros((n_edges, n_groups, len(QUANTILES)))
+    counts_list = []
+    for ids, feats in zip(edge_ids_list, feats_list):
+        if ids.size == 0:
+            counts_list.append(np.zeros(0))
+            continue
+        c = feats[:, -1]
+        counts_list.append(c)
+        tot = count[ids] + c
+        safe = np.maximum(tot, 1)
+        for g in range(n_groups):
+            base = 9 * g
+            m = feats[:, base + 0]
+            v = feats[:, base + 1]
+            delta = m - mean[ids, g]
+            m2[ids, g] += v * c + delta**2 * count[ids] * c / safe
+            mean[ids, g] += delta * c / safe
+            mins[ids, g] = np.minimum(mins[ids, g], feats[:, base + 2])
+            maxs[ids, g] = np.maximum(maxs[ids, g], feats[:, base + 8])
+            qsum[ids, g] += feats[:, base + 3 : base + 8] * c[:, None]
+        count[ids] = tot
+
+    nonzero = count > 0
+    use_exact = (
+        samples_list is not None
+        and len(samples_list) == len(feats_list)
+        and all(s is not None for s in samples_list)
+    )
+    for g in range(n_groups):
+        base = 9 * g
+        out[:, base + 0] = mean[:, g]
+        out[:, base + 1] = np.where(nonzero, m2[:, g] / np.maximum(count, 1), 0.0)
+        out[:, base + 2] = np.where(nonzero, mins[:, g], 0.0)
+        if not use_exact:
+            out[:, base + 3 : base + 8] = (
+                qsum[:, g] / np.maximum(count, 1)[:, None]
+            )
+        out[:, base + 8] = np.where(nonzero, maxs[:, g], 0.0)
+    if use_exact:
+        for g in range(n_groups):
+            _exact_group_quantiles(
+                out, 9 * g + 3, edge_ids_list, counts_list, samples_list,
+                g, n_groups,
+            )
+    out[:, -1] = count
     return out
 
 

@@ -255,6 +255,17 @@ class SimpleTask(Task):
                     "call parallel.mesh.init_distributed() at process "
                     "startup (before any jax use) so the mesh is global"
                 )
+            # store writes are guarded by jax.process_index()==0 while the
+            # completion status is stamped by config-pid 0; they must be the
+            # SAME process, or pid 0 can stamp 'complete' while the
+            # write-owning process is still writing
+            if jax.process_index() != pid:
+                raise RuntimeError(
+                    f"{self.identifier}: config process_id {pid} != "
+                    f"jax.process_index() {jax.process_index()} — pass "
+                    "process_id to init_distributed() matching the "
+                    "config topology so write and status ownership coincide"
+                )
         if num > 1 and pid != 0 and not self.collective:
             timeout = float(gconf.get("peer_wait_timeout_s", 3600.0))
             self.log(f"process {pid}: waiting for process 0 to run "

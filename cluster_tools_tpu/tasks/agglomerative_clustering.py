@@ -44,7 +44,7 @@ class AgglomerativeClusteringTask(VolumeSimpleTask):
             edges,
             feats[:, 0],            # mean boundary evidence per edge
             float(config.get("threshold", 0.9)),
-            edge_sizes=feats[:, 9],  # edge face size
+            edge_sizes=feats[:, -1],  # edge face size (last col in all layouts)
         )
         # segments 1-based; a background node label 0 stays 0
         table = np.stack(

@@ -107,7 +107,9 @@ class ProbsToCostsTask(VolumeSimpleTask):
             probs = feats[:, 0]
         if conf.get("invert_inputs", False):
             probs = 1.0 - probs
-        sizes = feats[:, 9] if conf["weight_edges"] else None
+        # count is always the LAST column (10-col default layout or the
+        # filter bank's 9*G+1 layout — tasks/features.py)
+        sizes = feats[:, -1] if conf["weight_edges"] else None
         costs = transform_probabilities_to_costs(
             probs,
             beta=float(conf.get("beta", 0.5)),

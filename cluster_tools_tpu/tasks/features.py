@@ -25,7 +25,9 @@ from ..ops.rag import (
     N_FEATURES,
     affinity_edge_features,
     boundary_edge_features,
+    filter_edge_features,
     merge_edge_features,
+    merge_edge_features_multi,
     HIST_BINS,
 )
 from ..utils.blocking import Blocking
@@ -35,6 +37,7 @@ from .graph import read_block_with_upper_halo, load_graph
 FEATURE_IDS_KEY = "features/ids"
 FEATURE_VALS_KEY = "features/vals"
 FEATURE_HISTS_KEY = "features/hists"
+FEATURE_SAMPLES_KEY = "features/samples"
 FEATURES_KEY = "features/edges"
 
 
@@ -60,6 +63,23 @@ class BlockEdgeFeaturesTask(VolumeTask):
         conf.update(
             {
                 "offsets": None,  # affinity offsets, None → boundary map
+                # filter-bank accumulation (reference
+                # block_edge_features.py:40-41,151-238): a bank of device
+                # filters (ops/filters) × sigmas, 9 stats per response
+                # channel + one trailing count column
+                "filters": None,
+                "sigmas": None,
+                "halo": [0, 0, 0],
+                "apply_in_2d": False,
+                "channel_agglomeration": "mean",
+                # quantile merge strategy: "auto" (sketch for the 10-column
+                # default path, exact raw-sample partials for the filter
+                # bank), "exact" (raw samples everywhere — zero drift vs a
+                # single-shot recompute), "sketch" (histogram sketch; filter
+                # responses leave the sketch's [0,1] domain so the filter
+                # path degrades to "approx"), or "approx" (count-weighted
+                # quantile averaging — smallest partials, largest drift)
+                "quantile_mode": "auto",
                 # fused device accumulator (ops/rag.boundary_edge_features_tpu)
                 # for boundary-map blocks without halos; numpy path otherwise.
                 # Off by default: wins on TPU (hardware sort), loses on XLA-CPU
@@ -74,6 +94,71 @@ class BlockEdgeFeaturesTask(VolumeTask):
 
         return store.file_reader(self.labels_path, "r")[self.labels_key]
 
+    def _quantile_plan(self, config):
+        """(exact, sketch) from quantile_mode × path — see the config
+        comment.  "sketch" and "approx" on the filter path both mean
+        approx (filter responses escape the sketch's [0,1] bin domain)."""
+        mode = config.get("quantile_mode", "auto")
+        if mode not in ("auto", "exact", "sketch", "approx"):
+            raise ValueError(f"unknown quantile_mode {mode!r}")
+        filters = config.get("filters") is not None
+        exact = mode == "exact" or (mode == "auto" and filters)
+        sketch = not exact and not filters and mode != "approx"
+        return exact, sketch
+
+    def _filter_responses(self, blocking: Blocking, block_id: int, config):
+        """Halo'd read → device filter bank → per-channel responses cropped
+        to the inner(+1-upper-halo) region (reference
+        block_edge_features.py:172-238 via vu.apply_filter).
+
+        Unlike the reference's per-block min-max ``vu.normalize`` this uses
+        the task's deterministic normalization (uint8 → /255, floats raw), so
+        blocked responses equal a single-shot whole-volume recompute wherever
+        the halo covers the filter support."""
+        import jax.numpy as jnp
+
+        from ..ops import filters as F
+
+        block = blocking.block(block_id)
+        shape = blocking.shape
+        halo = [int(h) for h in (config.get("halo") or [0, 0, 0])]
+        # the accumulated region carries a +1 upper halo (cross-block faces
+        # are owned by the lower block), so the upper read extends halo + 1:
+        # even the +1-slab voxels then see the full filter support
+        ob = [max(b - h, 0) for b, h in zip(block.begin, halo)]
+        oe = [min(e + h + 1, s) for e, h, s in zip(block.end, halo, shape)]
+        bb = tuple(slice(b, e) for b, e in zip(ob, oe))
+        data_ds = self.input_ds()
+        if len(data_ds.shape) == 4:
+            # agglomerate over ALL channels (the reference hardcodes the
+            # first three, block_edge_features.py:214-215 — a marked TODO
+            # there; silent truncation is worse than the divergence)
+            data = self._normalize(data_ds[(slice(None),) + bb])
+            agglo = config.get("channel_agglomeration") or "mean"
+            data = getattr(np, agglo)(data, axis=0)
+        else:
+            data = self._normalize(data_ds[bb])
+        ie = [min(e + 1, s) for e, s in zip(block.end, shape)]
+        local = tuple(
+            slice(b - o, e - o) for b, o, e in zip(block.begin, ob, ie)
+        )
+        responses = []
+        x = jnp.asarray(data.astype(np.float32))
+        in_2d = bool(config.get("apply_in_2d", False))
+        for name in config["filters"]:
+            for sigma in config["sigmas"]:
+                resp = np.asarray(
+                    F.apply_filter(x, name, sigma, apply_in_2d=in_2d),
+                    dtype=np.float64,
+                )
+                if resp.ndim == 4:  # multichannel filters: channels last
+                    responses.extend(
+                        resp[..., c][local] for c in range(resp.shape[-1])
+                    )
+                else:
+                    responses.append(resp[local])
+        return responses
+
     def process_block(self, block_id: int, blocking: Blocking, config):
         seg = read_block_with_upper_halo(
             self.labels_ds(), blocking, block_id
@@ -83,14 +168,34 @@ class BlockEdgeFeaturesTask(VolumeTask):
         block = blocking.block(block_id)
         end = tuple(min(e + 1, s) for e, s in zip(block.end, blocking.shape))
         bb = tuple(slice(b, e) for b, e in zip(block.begin, end))
-        if offsets is not None:
-            data = data_ds[(slice(0, len(offsets)),) + bb]
-            data = self._normalize(data)
-            edges, feats, hists = affinity_edge_features(
-                seg, data, offsets, hist_bins=HIST_BINS,
-                owner_shape=block.shape,
+        exact, sketch = self._quantile_plan(config)
+        hist_bins = HIST_BINS if sketch else 0
+        hists = samples = None
+        if config.get("filters") is not None:
+            if offsets is not None:
+                raise ValueError(
+                    "filters and offsets are mutually exclusive "
+                    "(reference block_edge_features.py:311)"
+                )
+            responses = self._filter_responses(blocking, block_id, config)
+            out = filter_edge_features(
+                seg, responses, owner_shape=block.shape, return_samples=exact
             )
-        elif config.get("device_accumulation"):
+            edges, feats = out[0], out[1]
+            if exact:
+                samples = out[2]
+        elif offsets is not None:
+            data = self._normalize(data_ds[(slice(0, len(offsets)),) + bb])
+            out = affinity_edge_features(
+                seg, data, offsets, hist_bins=hist_bins,
+                owner_shape=block.shape, return_samples=exact,
+            )
+            edges, feats = out[0], out[1]
+            if exact:
+                samples = out[2]
+            elif sketch:
+                hists = out[2]
+        elif config.get("device_accumulation") and not exact:
             from ..ops.rag import boundary_edge_features_tpu
 
             data = self._normalize(data_ds[bb])
@@ -98,21 +203,41 @@ class BlockEdgeFeaturesTask(VolumeTask):
                 seg, data, hist_bins=HIST_BINS, owner_shape=block.shape,
                 max_edges=int(config.get("max_edges_per_block", 16384)),
             )
+            if not sketch:
+                hists = None
         else:
             data = self._normalize(data_ds[bb])
-            edges, feats, hists = boundary_edge_features(
-                seg, data, hist_bins=HIST_BINS, owner_shape=block.shape
+            out = boundary_edge_features(
+                seg, data, hist_bins=hist_bins, owner_shape=block.shape,
+                return_samples=exact,
             )
+            edges, feats = out[0], out[1]
+            if exact:
+                samples = out[2]
+            elif sketch:
+                hists = out[2]
 
         store = self.tmp_store()
         nodes, gedges = load_graph(store)
         ids_out = self.tmp_ragged(FEATURE_IDS_KEY, blocking.n_blocks, np.int64)
         vals_out = self.tmp_ragged(FEATURE_VALS_KEY, blocking.n_blocks, np.float64)
         hists_out = self.tmp_ragged(FEATURE_HISTS_KEY, blocking.n_blocks, np.uint32)
+        # keep the samples dataset in lockstep even when this run does not
+        # produce samples: a previous exact-mode run's stale chunks must not
+        # poison this run's merge (empty chunk ⇒ merge rejects exact path)
+        samples_out = (
+            self.tmp_ragged(FEATURE_SAMPLES_KEY, blocking.n_blocks, np.float64)
+            if (samples is not None or FEATURE_SAMPLES_KEY in store)
+            else None
+        )
         if edges.shape[0] == 0:
             ids_out.write_chunk((block_id,), np.array([], dtype=np.int64))
             vals_out.write_chunk((block_id,), np.array([], dtype=np.float64))
             hists_out.write_chunk((block_id,), np.array([], dtype=np.uint32))
+            if samples_out is not None:
+                samples_out.write_chunk(
+                    (block_id,), np.array([], dtype=np.float64)
+                )
             return
         pairs = np.searchsorted(nodes, edges).astype(np.int64)
         keys = gedges[:, 0] * (nodes.size + 1) + gedges[:, 1]
@@ -121,7 +246,27 @@ class BlockEdgeFeaturesTask(VolumeTask):
         valid = keys[np.clip(ids, 0, keys.size - 1)] == want
         ids_out.write_chunk((block_id,), ids[valid].astype(np.int64))
         vals_out.write_chunk((block_id,), feats[valid].reshape(-1))
-        hists_out.write_chunk((block_id,), hists[valid].reshape(-1))
+        hists_out.write_chunk(
+            (block_id,),
+            hists[valid].reshape(-1) if hists is not None
+            else np.array([], dtype=np.uint32),
+        )
+        if samples_out is not None:
+            if samples is None:
+                samples_out.write_chunk(
+                    (block_id,), np.array([], dtype=np.float64)
+                )
+            else:
+                counts = feats[:, -1].astype(np.int64)
+                total = int(counts.sum())
+                n_groups = (feats.shape[1] - 1) // 9
+                keep = np.repeat(valid, counts)
+                kept = (
+                    samples.reshape(n_groups, total)[:, keep].reshape(-1)
+                    if total
+                    else samples
+                )
+                samples_out.write_chunk((block_id,), kept)
 
     @staticmethod
     def _normalize(data: np.ndarray) -> np.ndarray:
@@ -146,7 +291,7 @@ class MergeEdgeFeaturesTask(VolumeSimpleTask):
         n_edges = store["graph/edges"].attrs["n_edges"]
         ids_ds = store[FEATURE_IDS_KEY]
         vals_ds = store[FEATURE_VALS_KEY]
-        ids_list, feats_list, hists_list = [], [], []
+        ids_list, feats_list, hists_list, samples_list = [], [], [], []
         n_thr = merge_threads(self)
         all_ids = read_ragged_chunks(ids_ds, n_blocks, n_thr)
         all_vals = read_ragged_chunks(vals_ds, n_blocks, n_thr)
@@ -156,22 +301,62 @@ class MergeEdgeFeaturesTask(VolumeSimpleTask):
             all_hists = read_ragged_chunks(store[FEATURE_HISTS_KEY], n_blocks, n_thr)
         else:
             all_hists = [None] * n_blocks
-        for ids, vals, hists in zip(all_ids, all_vals, all_hists):
+        # raw sorted samples: only written in exact quantile mode
+        if FEATURE_SAMPLES_KEY in store:
+            all_samples = read_ragged_chunks(
+                store[FEATURE_SAMPLES_KEY], n_blocks, n_thr
+            )
+        else:
+            all_samples = [None] * n_blocks
+        for ids, vals, hists, samples in zip(
+            all_ids, all_vals, all_hists, all_samples
+        ):
             if ids is None or ids.size == 0:
                 continue
             ids_list.append(ids)
             feats_list.append(vals.reshape(ids.size, -1))
             hists_list.append(
-                hists.reshape(ids.size, -1) if hists is not None else None
+                hists.reshape(ids.size, -1)
+                if hists is not None and hists.size
+                else None
             )
-        merged = merge_edge_features(ids_list, feats_list, n_edges, hists_list)
-        store.create_dataset(
+            samples_list.append(samples)
+        n_cols = next(
+            (f.shape[1] for f in feats_list if f.shape[0]), N_FEATURES
+        )
+        widths = {f.shape[1] for f in feats_list if f.shape[0]}
+        if len(widths) > 1:
+            raise ValueError(
+                f"mixed per-block feature widths {sorted(widths)} — stale "
+                "partials from a config switch; rerun block_edge_features "
+                "over all blocks"
+            )
+        # exact merge only when EVERY nonempty block shipped a size-consistent
+        # sample partial (stale/empty chunks from a mode switch disqualify)
+        n_groups = (n_cols - 1) // 9
+        exact = bool(samples_list) and all(
+            s is not None and s.size == n_groups * int(f[:, -1].sum())
+            for s, f in zip(samples_list, feats_list)
+        )
+        if n_cols == N_FEATURES and not exact:
+            merged = merge_edge_features(
+                ids_list, feats_list, n_edges, hists_list
+            )
+        else:
+            merged = merge_edge_features_multi(
+                ids_list, feats_list, n_edges,
+                samples_list if exact else None,
+            )
+        ds = store.create_dataset(
             FEATURES_KEY,
             data=merged,
-            chunks=(max(merged.shape[0], 1), N_FEATURES),
+            chunks=(max(merged.shape[0], 1), merged.shape[1]),
             exist_ok=True,
         )
-        self.log(f"merged features for {n_edges} edges")
+        ds.attrs["n_features"] = int(merged.shape[1])
+        self.log(
+            f"merged {merged.shape[1]}-column features for {n_edges} edges"
+        )
 
 
 class ShardedProblemTask(VolumeSimpleTask):

@@ -670,7 +670,14 @@ class Group:
         if self._fmt.is_array(p):
             if not exist_ok:
                 raise ValueError(f"dataset exists: {p}")
-            return Dataset(p, self._fmt)
+            if data is None:
+                return Dataset(p, self._fmt)
+            # overwrite semantics: a rerun that brings new data must not
+            # silently keep the stale array (shape/width may have changed —
+            # e.g. merge_edge_features after a quantile_mode switch)
+            import shutil
+
+            shutil.rmtree(p)
         # intermediate groups
         parts = key.split("/")
         grp = self

@@ -1,0 +1,262 @@
+"""Filter-bank edge features + exact quantile merge (VERDICT r3 items 2/6).
+
+Oracle idiom (SURVEY.md §4): blocked-and-merged features must reproduce a
+single-shot whole-volume recompute — exactly for count/mean/var/min/max, and
+exactly for quantiles too when the exact raw-sample merge is active
+(reference block_edge_features.py:151-238 filter path; merge is exact as in
+merge_edge_features.py:141)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.ops.rag import (
+    boundary_edge_features,
+    filter_edge_features,
+    merge_edge_features_multi,
+)
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+FILTERS = ["gaussianSmoothing", "gaussianGradientMagnitude"]
+SIGMAS = [1.0]
+
+
+def _apply_bank(data, filters=FILTERS, sigmas=SIGMAS, apply_in_2d=False):
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.ops import filters as F
+
+    x = jnp.asarray(data.astype(np.float32))
+    responses = []
+    for name in filters:
+        for sigma in sigmas:
+            resp = np.asarray(
+                F.apply_filter(x, name, sigma, apply_in_2d=apply_in_2d),
+                dtype=np.float64,
+            )
+            if resp.ndim == 4:
+                responses.extend(resp[..., c] for c in range(resp.shape[-1]))
+            else:
+                responses.append(resp)
+    return responses
+
+
+@pytest.fixture
+def volume(rng):
+    labels = rng.integers(1, 20, (4, 8, 8)).astype(np.uint64)
+    labels = np.kron(labels, np.ones((4, 4, 4), dtype=np.uint64))
+    data = rng.random(labels.shape).astype(np.float32)
+    return labels, data
+
+
+class TestFilterFeatureOps:
+    def test_single_group_matches_default_path(self, volume):
+        """G=1 filter layout must equal the classic 10-column accumulation on
+        the same response."""
+        labels, data = volume
+        resp = data.astype(np.float64)
+        edges_f, feats_f = filter_edge_features(labels, [resp])
+        edges_b, feats_b = boundary_edge_features(labels, resp)
+        np.testing.assert_array_equal(edges_f, edges_b)
+        np.testing.assert_allclose(feats_f, feats_b, rtol=1e-12)
+
+    def test_multichannel_column_count(self, volume):
+        """hessianOfGaussianEigenvalues contributes ndim channels → 9*ndim
+        columns plus the shared count column."""
+        labels, data = volume
+        responses = _apply_bank(
+            data, filters=["hessianOfGaussianEigenvalues"], sigmas=[1.0]
+        )
+        assert len(responses) == 3
+        edges, feats = filter_edge_features(labels, responses)
+        assert feats.shape[1] == 9 * 3 + 1
+
+    def test_blocked_merge_exact_vs_single_shot(self, volume, rng):
+        """Blocked partials + exact-sample merge ≡ whole-volume recompute,
+        bit-for-bit, on precomputed (identical) responses."""
+        labels, data = volume
+        responses = _apply_bank(data)
+        want_edges, want = filter_edge_features(labels, responses)
+        key_of = {tuple(e): i for i, e in enumerate(want_edges)}
+
+        ids_list, feats_list, samples_list = [], [], []
+        zb = 8
+        for z0 in range(0, labels.shape[0], zb):
+            z1 = min(z0 + zb + 1, labels.shape[0])  # +1 upper halo
+            lab = labels[z0:z1]
+            resp_blk = [r[z0:z1] for r in responses]
+            owner = (min(zb, labels.shape[0] - z0),) + labels.shape[1:]
+            e, f, s = filter_edge_features(
+                lab, resp_blk, owner_shape=owner, return_samples=True
+            )
+            ids_list.append(
+                np.array([key_of[tuple(x)] for x in e], dtype=np.int64)
+            )
+            feats_list.append(f)
+            samples_list.append(s)
+        merged = merge_edge_features_multi(
+            ids_list, feats_list, len(want_edges), samples_list
+        )
+        np.testing.assert_allclose(merged, want, rtol=1e-12, atol=1e-12)
+
+    def test_merge_without_samples_degrades_not_crashes(self, volume):
+        labels, data = volume
+        responses = _apply_bank(data)
+        edges, feats = filter_edge_features(labels, responses)
+        ids = np.arange(len(edges), dtype=np.int64)
+        merged = merge_edge_features_multi([ids], [feats], len(edges), None)
+        # single block: weighted average of one partial = the partial
+        np.testing.assert_allclose(merged, feats, rtol=1e-12)
+
+
+class TestFilterFeatureWorkflow:
+    def _run(self, tmp_path, labels, data, task_conf, name):
+        from cluster_tools_tpu.workflows import (
+            EdgeFeaturesWorkflow,
+            GraphWorkflow,
+        )
+
+        path = str(tmp_path / f"{name}.n5")
+        f = file_reader(path)
+        f.create_dataset("seg", data=labels, chunks=(8, 16, 16))
+        f.create_dataset("bnd", data=data, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / f"configs_{name}")
+        tmp_folder = str(tmp_path / f"tmp_{name}")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        if task_conf:
+            cfg.write_config(config_dir, "block_edge_features", task_conf)
+        graph = GraphWorkflow(
+            tmp_folder, config_dir, input_path=path, input_key="seg"
+        )
+        wf = EdgeFeaturesWorkflow(
+            tmp_folder, config_dir,
+            input_path=path, input_key="bnd",
+            labels_path=path, labels_key="seg",
+            dependencies=[graph],
+        )
+        assert build([wf])
+        store = file_reader(os.path.join(tmp_folder, "data.zarr"), "r")
+        return (
+            store["graph/nodes"][:],
+            store["graph/edges"][:],
+            store["features/edges"][:],
+            store["features/edges"].attrs.get("n_features"),
+        )
+
+    def test_filter_bank_blocked_equals_single_shot(self, tmp_path, rng):
+        """The workflow with filters/sigmas/halo config must reproduce the
+        whole-volume filter-feature recompute (halo ≥ kernel radius so the
+        blocked responses match the global ones in the accumulated region)."""
+        labels = rng.integers(1, 20, (4, 8, 8)).astype(np.uint64)
+        labels = np.kron(labels, np.ones((4, 4, 4), dtype=np.uint64))
+        data = rng.random(labels.shape).astype(np.float32)
+        nodes, edges, merged, n_feats = self._run(
+            tmp_path, labels, data,
+            {"filters": FILTERS, "sigmas": SIGMAS, "halo": [4, 4, 4]},
+            "fb",
+        )
+        assert n_feats == merged.shape[1] == 9 * len(FILTERS) * len(SIGMAS) + 1
+
+        responses = _apply_bank(data)
+        want_edges, want = filter_edge_features(labels, responses)
+        by_pair = {tuple(e): i for i, e in enumerate(want_edges)}
+        assert len(edges) == len(want_edges)
+        for gid, (ui, vi) in enumerate(edges):
+            i = by_pair[(nodes[ui], nodes[vi])]
+            np.testing.assert_allclose(
+                merged[gid], want[i], rtol=1e-4, atol=1e-6,
+                err_msg=f"edge {gid}",
+            )
+
+    def test_exact_quantile_mode_default_path(self, tmp_path, rng):
+        """VERDICT item 6: quantile_mode='exact' on the classic boundary path
+        → zero quantile drift vs the single-shot recompute."""
+        labels = rng.integers(1, 30, (4, 8, 8)).astype(np.uint64)
+        labels = np.kron(labels, np.ones((4, 4, 4), dtype=np.uint64))
+        data = rng.random(labels.shape).astype(np.float32)
+        nodes, edges, merged, _ = self._run(
+            tmp_path, labels, data, {"quantile_mode": "exact"}, "exact"
+        )
+        want_edges, want = boundary_edge_features(
+            labels, data.astype(np.float64)
+        )
+        by_pair = {tuple(e): i for i, e in enumerate(want_edges)}
+        assert len(edges) == len(want_edges)
+        for gid, (ui, vi) in enumerate(edges):
+            i = by_pair[(nodes[ui], nodes[vi])]
+            np.testing.assert_allclose(
+                merged[gid], want[i], rtol=1e-12, atol=1e-12,
+                err_msg=f"edge {gid}",
+            )
+
+    def test_mode_switch_does_not_poison_merge(self, tmp_path, rng):
+        """A sketch-mode rerun in a tmp folder that previously ran exact mode
+        must not consume the stale sample chunks (code-review finding): the
+        blocks rewrite features/samples with empty chunks and the merge
+        rejects the exact path."""
+        import shutil
+
+        from cluster_tools_tpu.workflows import (
+            EdgeFeaturesWorkflow,
+            GraphWorkflow,
+        )
+
+        labels = rng.integers(1, 20, (4, 8, 8)).astype(np.uint64)
+        labels = np.kron(labels, np.ones((4, 4, 4), dtype=np.uint64))
+        data = rng.random(labels.shape).astype(np.float32)
+        path = str(tmp_path / "ms.n5")
+        f = file_reader(path)
+        f.create_dataset("seg", data=labels, chunks=(8, 16, 16))
+        f.create_dataset("bnd", data=data, chunks=(8, 16, 16))
+        config_dir = str(tmp_path / "configs_ms")
+        tmp_folder = str(tmp_path / "tmp_ms")
+        cfg.write_global_config(config_dir, {"block_shape": [8, 16, 16]})
+        merged = {}
+        for mode in ("exact", "sketch"):
+            cfg.write_config(
+                config_dir, "block_edge_features", {"quantile_mode": mode}
+            )
+            # force a rerun over the same scratch store (resume would skip)
+            shutil.rmtree(os.path.join(tmp_folder, "status"),
+                          ignore_errors=True)
+            graph = GraphWorkflow(
+                tmp_folder, config_dir, input_path=path, input_key="seg"
+            )
+            wf = EdgeFeaturesWorkflow(
+                tmp_folder, config_dir,
+                input_path=path, input_key="bnd",
+                labels_path=path, labels_key="seg",
+                dependencies=[graph],
+            )
+            assert build([wf])
+            store = file_reader(os.path.join(tmp_folder, "data.zarr"), "r")
+            merged[mode] = store["features/edges"][:]
+        # a fresh sketch-only run is the oracle for the post-switch result
+        nodes, edges, fresh, _ = self._run(
+            tmp_path, labels, data, {"quantile_mode": "sketch"}, "fresh"
+        )
+        np.testing.assert_allclose(merged["sketch"], fresh, rtol=1e-12)
+        # and it genuinely differs from the exact run's quantile columns
+        assert not np.allclose(merged["sketch"][:, 3:8], merged["exact"][:, 3:8])
+
+    def test_filter_bank_feeds_costs(self, tmp_path, rng):
+        """Costs must consume the wide layout (count = last column)."""
+        from cluster_tools_tpu.tasks.costs import ProbsToCostsTask
+
+        labels = rng.integers(1, 20, (4, 8, 8)).astype(np.uint64)
+        labels = np.kron(labels, np.ones((4, 4, 4), dtype=np.uint64))
+        data = rng.random(labels.shape).astype(np.float32)
+        nodes, edges, merged, _ = self._run(
+            tmp_path, labels, data,
+            {"filters": FILTERS, "sigmas": SIGMAS, "halo": [4, 4, 4]},
+            "costs",
+        )
+        tmp_folder = str(tmp_path / "tmp_costs")
+        config_dir = str(tmp_path / "configs_costs")
+        task = ProbsToCostsTask(tmp_folder, config_dir)
+        assert build([task])
+        costs = np.load(os.path.join(tmp_folder, "costs.npy"))
+        assert costs.shape[0] == len(edges)
+        assert np.isfinite(costs).all()
