@@ -155,4 +155,9 @@ def pallas_cc_available(shape, connectivity: int, per_slice: bool) -> bool:
         return False
     if shape[1] % 8 or shape[2] % 128:
         return False
+    # VMEM budget (ADVICE r3): the per-slice kernel holds ~8 full-slice i32
+    # buffers; oversized slices must take the XLA path instead of failing
+    # Mosaic lowering at runtime
+    if shape[1] * shape[2] * 4 * 8 > 12 * 1024 * 1024:
+        return False
     return jax.default_backend() == "tpu"

@@ -371,6 +371,13 @@ def pallas_dtws_available(shape, apply_dt_2d, apply_ws_2d, pixel_pitch,
         return False
     if shape[1] % 8 or shape[2] % 128:
         return False
+    # VMEM budget (ADVICE r3): _parabola_w materializes an (H, W, 32) f32
+    # cost tensor plus ~a dozen full-slice f32 temporaries; slices whose
+    # working set exceeds the ~16 MB VMEM would fail Mosaic lowering at
+    # runtime inside the gated dt_watershed instead of falling back
+    vmem = shape[1] * shape[2] * 4 * (32 + 12)
+    if vmem > 12 * 1024 * 1024:
+        return False
     for sigma in (sigma_seeds, sigma_weights):
         if sigma and sigma > 0:
             radius = max(int(4.0 * sigma + 0.5), 1)

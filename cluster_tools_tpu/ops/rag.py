@@ -404,37 +404,44 @@ def merge_edge_features(
     return out
 
 
-def _exact_group_quantiles(
-    out, col0, ids_list, counts_list, samples_list, group, n_groups
+def _exact_quantiles_all_groups(
+    out, ids_list, counts_list, samples_list, n_groups
 ):
-    """Exact per-edge quantiles for one feature group from the raw sample
+    """Exact per-edge quantiles for every feature group from the raw sample
     partials: globally sort (edge, value) pairs pooled over all blocks and
     index the quantile positions — identical (by construction) to a
     single-shot whole-volume recompute, the reference's exact
-    ``ndist.mergeFeatureBlocks`` semantics (merge_edge_features.py:141)."""
-    eids, vals = [], []
+    ``ndist.mergeFeatureBlocks`` semantics (merge_edge_features.py:141).
+
+    The edge-id expansion and the per-edge spans are group-invariant
+    (lexsort's primary key is the edge id), so they are computed once; only
+    the value sort repeats per group."""
+    eids, val_groups = [], []
     for ids, counts, flat in zip(ids_list, counts_list, samples_list):
         if ids.size == 0:
             continue
         total = int(counts.sum())
-        g_vals = flat.reshape(n_groups, total)[group]
         eids.append(np.repeat(ids, counts.astype(np.int64)))
-        vals.append(g_vals)
+        val_groups.append(flat.reshape(n_groups, total))
     if not eids:
         return
     eids = np.concatenate(eids)
-    vals = np.concatenate(vals)
-    order = np.lexsort((vals, eids))
-    eids, vals = eids[order], vals[order]
-    first = np.concatenate([[True], eids[1:] != eids[:-1]])
+    vals_all = np.concatenate(val_groups, axis=1)
+    # spans from the eids-sorted view: identical for every group, since any
+    # lexsort((vals_g, eids)) orders groups by edge id first
+    sorted_eids = np.sort(eids)
+    first = np.concatenate([[True], sorted_eids[1:] != sorted_eids[:-1]])
     starts = np.nonzero(first)[0]
     counts = np.diff(np.append(starts, eids.size)).astype(np.int64)
-    rows = eids[starts]
-    for qi, q in enumerate(QUANTILES):
-        pos = starts + np.minimum(
-            (q * (counts - 1)).astype(np.int64), counts - 1
-        )
-        out[rows, col0 + qi] = vals[pos]
+    rows = sorted_eids[starts]
+    qpos = [
+        starts + np.minimum((q * (counts - 1)).astype(np.int64), counts - 1)
+        for q in QUANTILES
+    ]
+    for g in range(n_groups):
+        svals = vals_all[g][np.lexsort((vals_all[g], eids))]
+        for qi in range(len(QUANTILES)):
+            out[rows, 9 * g + 3 + qi] = svals[qpos[qi]]
 
 
 def merge_edge_features_multi(
@@ -506,11 +513,9 @@ def merge_edge_features_multi(
             )
         out[:, base + 8] = np.where(nonzero, maxs[:, g], 0.0)
     if use_exact:
-        for g in range(n_groups):
-            _exact_group_quantiles(
-                out, 9 * g + 3, edge_ids_list, counts_list, samples_list,
-                g, n_groups,
-            )
+        _exact_quantiles_all_groups(
+            out, edge_ids_list, counts_list, samples_list, n_groups
+        )
     out[:, -1] = count
     return out
 

@@ -30,6 +30,7 @@ from ..ops.rag import (
     merge_edge_features_multi,
     HIST_BINS,
 )
+from ..runtime import config as cfg
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, read_threads, resolve_n_blocks
 from .graph import read_block_with_upper_halo, load_graph
@@ -142,6 +143,12 @@ class BlockEdgeFeaturesTask(VolumeTask):
         local = tuple(
             slice(b - o, e - o) for b, o, e in zip(block.begin, ob, ie)
         )
+        if not config.get("sigmas"):
+            raise ValueError(
+                "filter-bank accumulation needs 'sigmas' (a list of filter "
+                "scales) alongside 'filters' in the block_edge_features "
+                "config (reference block_edge_features.py:312)"
+            )
         responses = []
         x = jnp.asarray(data.astype(np.float32))
         in_2d = bool(config.get("apply_in_2d", False))
@@ -338,6 +345,21 @@ class MergeEdgeFeaturesTask(VolumeSimpleTask):
             s is not None and s.size == n_groups * int(f[:, -1].sum())
             for s, f in zip(samples_list, feats_list)
         )
+        # never silently downgrade a configured exact merge: partials from a
+        # sketch-mode run (e.g. mode switched without rerunning the blocks)
+        # lack usable samples
+        bconf = cfg.read_config(self.config_dir, "block_edge_features")
+        mode = bconf.get("quantile_mode", "auto")
+        wants_exact = mode == "exact" or (
+            mode == "auto" and bconf.get("filters") is not None
+        )
+        if wants_exact and not exact and ids_list:
+            raise ValueError(
+                "quantile_mode requests the exact merge but the block "
+                "partials carry no usable sample arrays — rerun "
+                "block_edge_features (clear its status) so the blocks "
+                "write exact-mode partials"
+            )
         if n_cols == N_FEATURES and not exact:
             merged = merge_edge_features(
                 ids_list, feats_list, n_edges, hists_list

@@ -738,8 +738,22 @@ class File(Group):
 
 
 _H5_HANDLES: Dict[str, Any] = {}
+# façade open-count per path (ADVICE r3): `with file_reader(...)` really
+# closes the cached handle on the LAST façade close, releasing the HDF5
+# file lock; handles opened without close() stay cached process-wide
+_H5_REFS: Dict[str, int] = {}
 # RLock: dataset proxies re-enter via _h5_open when lazily reopening
 _H5_LOCK = threading.RLock()
+
+
+def _h5_cached_handle(key: str):
+    """Raw cached read handle for proxy re-resolution — does NOT touch the
+    refcount (nobody will close a proxy's implicit reopen)."""
+    cached = _H5_HANDLES.get(key)
+    if cached is None or not bool(cached):
+        cached = h5py.File(key, "r")
+        _H5_HANDLES[key] = cached
+    return cached
 
 
 class _H5DatasetProxy:
@@ -757,12 +771,10 @@ class _H5DatasetProxy:
         self._name = name
 
     def _ds(self):
-        f = _H5_HANDLES.get(self._path)
-        if f is None or not bool(f):
-            # the cached handle was released (e.g. before worker spawn):
-            # reopen read-only — a proxy is only handed out for reads
-            f = _h5_open(self._path, "r")._f
-        return f[self._name]
+        # the cached handle may have been released (e.g. before worker
+        # spawn or by the last façade close): reopen read-only — a proxy
+        # is only handed out for reads
+        return _h5_cached_handle(self._path)[self._name]
 
     def __getitem__(self, key):
         with _H5_LOCK:
@@ -787,10 +799,12 @@ class _CachedH5File:
     HDF5 refuses to open one file twice with different modes in a process,
     so tasks reading their input and writing their output in the SAME .h5
     file would fail with "file is already open".  The cache keeps one real
-    handle per path; ``close``/``with`` only flush — call
-    ``release_h5_handles()`` to really close (the cluster executor does,
-    before spawning workers, so the driver's handle cannot hold the HDF5
-    file lock against them).
+    handle per path, refcounted per façade: ``close``/``with`` flush, and
+    the LAST close for a path really closes the handle (releasing the HDF5
+    file lock for other processes).  Handles opened without a matching
+    close stay cached for the process; ``release_h5_handles()`` force-closes
+    everything (the cluster executor does, before spawning workers, so the
+    driver's handle cannot hold the file lock against them).
 
     Datasets fetched through a *read-only* handle (via ``[]`` or ``get``)
     come back as lazy re-resolving proxies: a later writable open of the
@@ -840,8 +854,28 @@ class _CachedH5File:
         return False
 
     def close(self):
-        if self._f and self._f.mode != "r":
-            self._f.flush()
+        """Flush, and really close the cached handle on the LAST façade
+        close for this path (refcounted — ADVICE r3: a `with` user expects
+        the HDF5 file lock released).  Stale façades over a handle that a
+        read→write upgrade already replaced are a no-op."""
+        with _H5_LOCK:
+            if getattr(self, "_released", False):
+                return
+            object.__setattr__(self, "_released", True)
+            f = self._f
+            if f and f.mode != "r":
+                f.flush()
+            key = self._path
+            if _H5_HANDLES.get(key) is not f:
+                return  # replaced by an upgrade; its refs were reset there
+            n = _H5_REFS.get(key, 1) - 1
+            if n > 0:
+                _H5_REFS[key] = n
+                return
+            _H5_REFS.pop(key, None)
+            _H5_HANDLES.pop(key, None)
+            if f:
+                f.close()
 
 
 def set_read_threads(ds, n: int) -> None:
@@ -865,6 +899,7 @@ def release_h5_handles() -> None:
             if f:
                 f.close()
         _H5_HANDLES.clear()
+        _H5_REFS.clear()
 
 
 def _h5_open(path: str, mode: str):
@@ -873,6 +908,7 @@ def _h5_open(path: str, mode: str):
         cached = _H5_HANDLES.get(key)
         if cached is not None and not bool(cached):
             _H5_HANDLES.pop(key, None)
+            _H5_REFS.pop(key, None)
             cached = None  # closed underneath us
         if mode in ("w", "w-", "x"):
             # truncate / exclusive-create: never satisfiable from a cached
@@ -886,17 +922,22 @@ def _h5_open(path: str, mode: str):
                 )
             f = h5py.File(path, mode)
             _H5_HANDLES[key] = f
+            _H5_REFS[key] = _H5_REFS.get(key, 0) + 1
             return _CachedH5File(f, key)
         if cached is not None and mode in ("a", "r+") and cached.mode == "r":
             # upgrade read-only → writable; prior reads were handed out as
-            # re-resolving proxies, so nothing is invalidated
+            # re-resolving proxies, so nothing is invalidated.  Refs reset:
+            # stale façades over the replaced handle must not decrement the
+            # new handle's count (they no-op on the identity check)
             cached.close()
             _H5_HANDLES.pop(key, None)
+            _H5_REFS.pop(key, None)
             cached = None
             mode = "a"
         if cached is None:
             cached = h5py.File(path, mode)
             _H5_HANDLES[key] = cached
+        _H5_REFS[key] = _H5_REFS.get(key, 0) + 1
         return _CachedH5File(cached, key)
 
 

@@ -113,6 +113,8 @@ class TestPallasCC:
             assert not pallas_cc_available(shape, 3, False)
             assert not pallas_cc_available((6, 16, 100), 1, False)
             assert not pallas_cc_available((16, 128), 1, False)
+            # VMEM budget (ADVICE r3): oversized slices take the XLA path
+            assert not pallas_cc_available((4, 1024, 1024), 1, False)
 
     def test_empty_and_full(self):
         for mask in (

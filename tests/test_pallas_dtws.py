@@ -79,6 +79,11 @@ class TestPallasDtws:
             )
             assert not pallas_dtws_available(shape, True, True, None, True)
             assert not pallas_dtws_available((4, 16, 100), True, True, None, False)
+            # VMEM budget (ADVICE r3): 1024x1024 slices overflow the ~16 MB
+            # VMEM working set and must take the XLA path
+            assert not pallas_dtws_available(
+                (4, 1024, 1024), True, True, None, False
+            )
 
     def test_large_sigma_gated_off(self):
         """Gaussian radius reaching across a full axis uses clamped reflect

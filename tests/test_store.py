@@ -1,3 +1,5 @@
+import os
+
 import numpy as np
 import pytest
 
@@ -206,6 +208,37 @@ class TestH5HandleCache:
         store.release_h5_handles()
         f2 = store.file_reader(path, "w")
         assert "x" not in f2
+
+    def test_last_close_releases_handle(self, tmp_path):
+        """ADVICE r3: `with file_reader(...)` must really close the cached
+        handle (and the HDF5 file lock) on the LAST close — while earlier
+        closes over still-referenced handles only flush."""
+        pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        path = str(tmp_path / "refs.h5")
+        with store.file_reader(path, "a") as f:
+            f.create_dataset("x", data=np.arange(4.0))
+        assert os.path.abspath(path) not in store._H5_HANDLES  # really closed
+        # nested opens: inner close keeps the handle, outer close releases
+        a = store.file_reader(path, "r")
+        with store.file_reader(path, "r") as b:
+            _ = b["x"][:]
+        assert os.path.abspath(path) in store._H5_HANDLES
+        a.close()
+        assert os.path.abspath(path) not in store._H5_HANDLES
+        # double-close of one façade must not steal someone else's ref
+        c = store.file_reader(path, "r")
+        d = store.file_reader(path, "r")
+        c.close()
+        c.close()
+        assert os.path.abspath(path) in store._H5_HANDLES
+        d.close()
+        assert os.path.abspath(path) not in store._H5_HANDLES
+        # proxies re-resolve after the release
+        ds = store.file_reader(path, "r")["x"]
+        store.release_h5_handles()
+        np.testing.assert_array_equal(ds[:], np.arange(4.0))
 
     def test_exclusive_create_semantics_preserved(self, tmp_path):
         pytest.importorskip("h5py")

@@ -9,11 +9,12 @@ clients, so a chip session must be a single, careful, sequential run:
 
 Steps:
   1. cheap TCP probe of the tunnel endpoint (no jax client, no wedge risk);
-  2. disposable-subprocess jax probe (180 s) requiring a real TPU device;
+  2. disposable-subprocess jax probe (600 s) requiring a real TPU device;
   3. tools/tpu_validate.py (assoc-vs-seq, Pallas flood + Pallas CC
      lowering/exactness/perf, device RAG) → tools/tpu_validate.json;
   4. derive the production mode pins (CTT_SWEEP_MODE / CTT_FLOOD_MODE /
-     CTT_CC_MODE) from the measurements → tools/chip_modes.json;
+     CTT_CC_MODE / CTT_DTWS_MODE) from the measurements
+     → tools/chip_modes.json;
   5. bench.py (driver mode) with those pins exported → the BENCH JSON line
      on stdout (the last line, as the driver expects).
 """
@@ -103,7 +104,7 @@ def main():
     if not port_open():
         log("tunnel endpoint 127.0.0.1:8083 not listening — nothing to do")
         return 2
-    log("port open; probing jax (disposable subprocess, 180 s cap)")
+    log("port open; probing jax (disposable subprocess, 600 s cap)")
     if "--dry" in sys.argv:
         alive = jax_probe()
         log(f"jax probe: {'TPU alive' if alive else 'unreachable'}")
