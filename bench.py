@@ -36,6 +36,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
 import argparse
 import json
+from functools import partial
 import os
 import subprocess
 import sys
@@ -349,11 +350,51 @@ def bench_mws(shape, repeats):
 
     t_blocked = timeit(blocked, max(repeats // 2, 1))
     mvox = n_vox / t_blocked / 1e6
+
+    # device formulation (mutually-best-edge parallel greedy,
+    # ops/mws_device.py).  Round count is data-dependent (monotone
+    # attractive chains serialize — see the kernel docstring), so this
+    # variant runs on a SMALL sub-volume with a wall-clock guard: it
+    # characterizes the kernel without eating the bench budget.  Fresh
+    # noise per timed round so a remote execution cache cannot fake the
+    # timing.
+    from cluster_tools_tpu.ops import _backend
+
+    dev_shape = tuple(min(s, c) for s, c in zip(shape, (8, 16, 16)))
+    dev_affs = affs[(slice(None),) + tuple(slice(0, s) for s in dev_shape)]
+    dev_vox = int(np.prod(dev_shape))
+    dev_mvox = dev_err = None
+    try:
+        with _backend.force_mws_mode("device"):
+            t0 = time.perf_counter()
+            compute_mws_segmentation(dev_affs, offsets, strides=strides)
+            warm = time.perf_counter() - t0
+            if warm > 120.0:
+                log(f"[mws] device variant skipped (warmup {warm:.0f}s > 120s)")
+            else:
+                t_device = timeit(
+                    None, 2,
+                    variants=[
+                        partial(
+                            compute_mws_segmentation, dev_affs, offsets,
+                            strides=strides, noise_level=1e-4, seed=100 + i,
+                        )
+                        for i in range(3)
+                    ],
+                )
+                dev_mvox = dev_vox / t_device / 1e6
+                log(
+                    f"[mws] device {t_device*1e3:.1f} ms on {dev_shape} "
+                    f"({dev_mvox:.3f} Mvox/s)"
+                )
+    except Exception as e:  # experimental path must not sink the run
+        dev_err = f"{type(e).__name__}: {e}"
+        log(f"[mws] device variant failed: {dev_err}")
     log(
         f"[mws] blocked {t_blocked*1e3:.1f} ms ({mvox:.1f} Mvox/s)  "
         f"whole-volume 1-core {t_host*1e3:.1f} ms"
     )
-    return mvox, t_host / t_blocked
+    return mvox, t_host / t_blocked, dev_mvox, dev_err
 
 
 def bench_rag(x, repeats):
@@ -606,9 +647,14 @@ def main():
         extra.update(cc_extra)
         _suspect_throughput(cc_v, extra, "cc_timing_suspect")
     if want("mws"):
-        mws_v, mws_r = bench_mws(mws_shape, args.repeats)
+        mws_v, mws_r, mwsd_v, mwsd_err = bench_mws(mws_shape, args.repeats)
         extra["mws_kernel_mvox_s"] = round(mws_v, 3)
         extra["mws_kernel_vs_baseline"] = round(mws_r, 3)
+        extra["mws_device_mvox_s"] = (
+            round(mwsd_v, 6) if mwsd_v is not None else None
+        )
+        if mwsd_err:
+            extra["mws_device_error"] = mwsd_err
     if want("rag"):
         rag_v, rag_r = bench_rag(make_volume(block), args.repeats)
         extra["rag_mvox_s"] = round(rag_v, 3)

@@ -1,16 +1,20 @@
-"""Shared backend-mode switch for the log-depth sweep kernels.
+"""Shared backend-mode switches for the log-depth sweep kernels.
 
 The flood (ops/watershed.py), connected-components (ops/cc.py), and EDT line
 scans (ops/dt.py) all choose between log-depth formulations
 (``lax.associative_scan`` / ``lax.cummax`` — win on dispatch/latency-bound
 TPUs) and sequential carry chains (O(n) work — win on work-bound XLA-CPU).
-One switch keeps every kernel on the same path:
+Further opt-in kernel switches route whole pipelines to Pallas
+(flood/cc/dtws) or to the device MWS formulation.  One registry keeps every
+switch on the same contract:
 
-  * default: by backend (assoc off-cpu, seq on cpu);
-  * ``CTT_SWEEP_MODE=assoc|seq`` pins the choice for production runs (the
-    supported way to deploy whichever mode bench/tpu_validate measured best);
-  * ``force_sweep_mode(mode)`` scopes an override for tests and benchmarks,
-    owning both the restore and the jit-cache invalidation.
+  * default: by env var (``CTT_<KIND>_MODE``), else the kind's default rule;
+  * the env pin is the supported way to deploy whichever mode
+    bench/tpu_validate measured best (tools/chip_session.py derives them);
+  * ``force_<kind>_mode(mode)`` scopes an override for tests and
+    benchmarks, owning both the restore and the jit-cache invalidation
+    (traces bake the mode in — all switches are read at TRACE time, so
+    already-compiled shapes keep their path until the caches clear).
 """
 
 from __future__ import annotations
@@ -18,122 +22,96 @@ from __future__ import annotations
 import os
 from contextlib import contextmanager
 
-# None = pick by env/backend; force_sweep_mode() overrides within a scope
-FORCE_SWEEP_MODE = None
+# kind -> forced mode (None = fall back to env var / default rule)
+_FORCED: dict = {}
+
+_ENV = {
+    "sweep": "CTT_SWEEP_MODE",
+    "flood": "CTT_FLOOD_MODE",
+    "cc": "CTT_CC_MODE",
+    "dtws": "CTT_DTWS_MODE",
+    "mws": "CTT_MWS_MODE",
+}
+
+
+def _mode(kind: str):
+    forced = _FORCED.get(kind)
+    if forced is not None:
+        return forced
+    return os.environ.get(_ENV[kind])
+
+
+@contextmanager
+def _force(kind: str, mode):
+    """Scoped mode override: set, clear jit caches, restore + clear on exit
+    even on error — the single implementation behind every force_*_mode."""
+    import jax
+
+    prev = _FORCED.get(kind)
+    _FORCED[kind] = mode
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        _FORCED[kind] = prev
+        jax.clear_caches()
 
 
 def use_assoc() -> bool:
-    if FORCE_SWEEP_MODE is not None:
-        return FORCE_SWEEP_MODE == "assoc"
-    env = os.environ.get("CTT_SWEEP_MODE")
-    if env in ("assoc", "seq"):
-        return env == "assoc"
+    """Sweep formulation: associative-scan (TPU) vs sequential carry (CPU);
+    CTT_SWEEP_MODE=assoc|seq pins it."""
+    mode = _mode("sweep")
+    if mode in ("assoc", "seq"):
+        return mode == "assoc"
     import jax
 
     return jax.default_backend() != "cpu"
 
 
-@contextmanager
-def force_sweep_mode(mode):
-    """Scoped sweep-mode override: sets the switch, clears jit caches (traces
-    bake the mode in), and restores + clears on exit even on error."""
-    global FORCE_SWEEP_MODE
-    import jax
-
-    prev = FORCE_SWEEP_MODE
-    FORCE_SWEEP_MODE = mode
-    jax.clear_caches()
-    try:
-        yield
-    finally:
-        FORCE_SWEEP_MODE = prev
-        jax.clear_caches()
-
-
-# None = read CTT_FLOOD_MODE; force_flood_mode() overrides within a scope
-FORCE_FLOOD_MODE = None
-
-
 def use_pallas_flood() -> bool:
-    """Whether the per-slice flood should use the Pallas kernel
-    (ops/pallas_flood.py).  Like ``use_assoc`` this is read at TRACE time —
-    already-compiled shapes keep their path; pin the mode before first use
-    (CTT_FLOOD_MODE=pallas) or flip it under ``force_flood_mode``, which owns
-    the jit-cache invalidation."""
-    if FORCE_FLOOD_MODE is not None:
-        return FORCE_FLOOD_MODE == "pallas"
-    return os.environ.get("CTT_FLOOD_MODE") == "pallas"
-
-
-@contextmanager
-def force_flood_mode(mode):
-    """Scoped flood-mode override ('pallas' | 'xla'): sets the switch, clears
-    jit caches (traces bake the path in), restores + clears on exit."""
-    global FORCE_FLOOD_MODE
-    import jax
-
-    prev = FORCE_FLOOD_MODE
-    FORCE_FLOOD_MODE = mode
-    jax.clear_caches()
-    try:
-        yield
-    finally:
-        FORCE_FLOOD_MODE = prev
-        jax.clear_caches()
-
-
-# None = read CTT_CC_MODE; force_cc_mode() overrides within a scope
-FORCE_CC_MODE = None
+    """Whether the per-slice flood uses the Pallas kernel
+    (ops/pallas_flood.py, CTT_FLOOD_MODE=pallas)."""
+    return _mode("flood") == "pallas"
 
 
 def use_pallas_cc() -> bool:
-    """Whether volume CC should use the per-slice Pallas kernel + z-merge
-    (ops/pallas_cc.py).  Read at TRACE time, like ``use_pallas_flood``."""
-    if FORCE_CC_MODE is not None:
-        return FORCE_CC_MODE == "pallas"
-    return os.environ.get("CTT_CC_MODE") == "pallas"
-
-
-@contextmanager
-def force_cc_mode(mode):
-    """Scoped CC-mode override ('pallas' | 'xla'): sets the switch, clears
-    jit caches (traces bake the path in), restores + clears on exit."""
-    global FORCE_CC_MODE
-    import jax
-
-    prev = FORCE_CC_MODE
-    FORCE_CC_MODE = mode
-    jax.clear_caches()
-    try:
-        yield
-    finally:
-        FORCE_CC_MODE = prev
-        jax.clear_caches()
-
-
-# None = read CTT_DTWS_MODE; force_dtws_mode() overrides within a scope
-FORCE_DTWS_MODE = None
+    """Whether volume CC uses the per-slice Pallas kernel + z-merge
+    (ops/pallas_cc.py, CTT_CC_MODE=pallas)."""
+    return _mode("cc") == "pallas"
 
 
 def use_pallas_dtws() -> bool:
-    """Whether the per-slice DT-watershed should use the fused Pallas kernel
-    (ops/pallas_dtws.py).  Read at TRACE time, like the other mode switches."""
-    if FORCE_DTWS_MODE is not None:
-        return FORCE_DTWS_MODE == "pallas"
-    return os.environ.get("CTT_DTWS_MODE") == "pallas"
+    """Whether the per-slice DT-watershed uses the fused Pallas kernel
+    (ops/pallas_dtws.py, CTT_DTWS_MODE=pallas)."""
+    return _mode("dtws") == "pallas"
 
 
-@contextmanager
+def use_mws_device() -> bool:
+    """Whether graph-domain MWS solves route to the parallel-greedy device
+    kernel (ops/mws_device.py, CTT_MWS_MODE=device) instead of host C++."""
+    return _mode("mws") == "device"
+
+
+def force_sweep_mode(mode):
+    """Scoped sweep-mode override ('assoc' | 'seq')."""
+    return _force("sweep", mode)
+
+
+def force_flood_mode(mode):
+    """Scoped flood-mode override ('pallas' | 'xla')."""
+    return _force("flood", mode)
+
+
+def force_cc_mode(mode):
+    """Scoped CC-mode override ('pallas' | 'xla')."""
+    return _force("cc", mode)
+
+
 def force_dtws_mode(mode):
     """Scoped DT-watershed-mode override ('pallas' | 'xla')."""
-    global FORCE_DTWS_MODE
-    import jax
+    return _force("dtws", mode)
 
-    prev = FORCE_DTWS_MODE
-    FORCE_DTWS_MODE = mode
-    jax.clear_caches()
-    try:
-        yield
-    finally:
-        FORCE_DTWS_MODE = prev
-        jax.clear_caches()
+
+def force_mws_mode(mode):
+    """Scoped MWS-mode override ('device' | 'host')."""
+    return _force("mws", mode)

@@ -1,9 +1,10 @@
 """Mutex watershed (reference mutex_watershed/mws_blocks.py via affogato C++).
 
-The MWS is a Kruskal-with-mutex-constraints algorithm — inherently sequential
-(SURVEY.md §7 hard-parts #2), so the per-block solve stays on the host (C++ via
-``native``, python fallback); block results are stitched with the standard
-offset + stitching machinery.
+The MWS is a Kruskal-with-mutex-constraints algorithm (SURVEY.md §7
+hard-parts #2).  The per-block solve defaults to the host (C++ via
+``native``, python fallback); a data-parallel device formulation exists in
+``ops/mws_device.py`` (mutually-best-edge parallel greedy, CTT_MWS_MODE=device).
+Block results are stitched with the standard offset + stitching machinery.
 
 ``compute_mws_segmentation`` builds the pixel grid graph from long-range
 affinities: the first ``ndim`` offsets are attractive (nearest-neighbor), the
@@ -125,7 +126,18 @@ def mutex_watershed_graph(
     attractive: np.ndarray,
     use_native: bool = True,
 ) -> np.ndarray:
-    """Graph-domain MWS returning root per node."""
+    """Graph-domain MWS returning root per node.
+
+    Routes to the mutually-best-edge parallel-greedy device kernel
+    (ops/mws_device.py — the TPU formulation) when CTT_MWS_MODE=device /
+    ``force_mws_mode("device")``; otherwise host C++ (default) or the
+    python fallback."""
+    from . import _backend
+
+    if _backend.use_mws_device() and n_nodes < np.iinfo(np.int32).max:
+        from .mws_device import mutex_watershed_device
+
+        return mutex_watershed_device(n_nodes, uv, weights, attractive)
     if use_native and native.available():
         return native.mutex_watershed(n_nodes, uv, weights, attractive)
     return _mws_python(n_nodes, uv, weights, attractive)

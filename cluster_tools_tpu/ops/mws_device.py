@@ -1,0 +1,191 @@
+"""Device (TPU) mutex watershed via mutually-best-edge parallel greedy.
+
+The reference reaches MWS through affogato's sequential
+Kruskal-with-mutex-constraints C++ (reference mutex_watershed/mws_blocks.py:11;
+SURVEY.md §7 hard-parts #2).  The data-parallel formulation used here:
+
+Under a strict total priority order (weight descending, ties by input index —
+the host solver's stable sort, ops/mws.py::_mws_python), an edge ``e = (A, B)``
+that is the highest-priority ACTIVE edge of BOTH its endpoint clusters can be
+decided immediately, exactly as the sequential algorithm would decide it:
+every higher-priority unprocessed edge is non-incident to A and B, and no
+non-incident edge can change A/B's membership (a merge into A would be an
+incident edge) or their mutex relation (a mutex between A and B needs an edge
+incident to both).  Mutually-best edges form a matching on clusters (each
+cluster has ONE best edge), so all of them apply in the same round:
+
+  * attractive + not mutexed  → merge the two clusters;
+  * attractive + mutexed      → discard (the sequential ``continue``);
+  * repulsive                 → record the mutex, discard.
+
+Progress: the globally highest active edge is always mutually best, so every
+round processes ≥ 1 edge.  Repulsive edges additionally retire in BATCHES:
+a repulsive edge stronger than one side's strongest active attractive edge
+becomes a mutex immediately (that cluster's future merges are all weaker —
+cluster picks decrease monotonically — so the early mutex can never wrongly
+block a stronger attractive merge).  NOT the naive MSF shortcut — "maximum
+spanning forest then cut repulsive edges" is WRONG for MWS (mutexes do not
+propagate through chains of repulsive forest edges; a minimal counterexample
+lives in tests/test_mws_device.py::test_msf_shortcut_would_be_wrong).
+
+Round count is data-dependent: monotone attractive chains (spatially smooth
+affinities) serialize — ~n_clusters-deep in the worst case.  The kernel is
+exact and dispatch-efficient per round, but the host C++ solver remains the
+production default for per-block solves; this is the TPU formulation for
+chip-resident pipelines and a base for future chain-contraction work.
+
+Mutex bookkeeping is implicit and shape-static: a processed repulsive edge IS
+a mutex between the clusters of its endpoints — merges re-root its endpoints,
+so inheritance (mutexes follow merged clusters) falls out of the ``comp``
+lookup.  The per-round mutex membership test for candidate merges is a
+sort-join over (min-comp, max-comp, tag) rows — O(m log m) segment-free work
+per round, fully static shapes, no sequential edge loop.  Rounds are
+data-dependent (while_loop); random-priority graphs converge in roughly
+O(log n) rounds.
+
+This is the TPU-native formulation; the per-block pipeline still defaults to
+the host C++ (flip with CTT_MWS_MODE=device / force_mws_mode("device")).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+def _next_pow2(m: int) -> int:
+    return 1 << max(int(m - 1).bit_length(), 4)
+
+
+@partial(jax.jit, static_argnames=("n_nodes",))
+def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int):
+    import jax.numpy as jnp
+    from jax import lax
+
+    m = uv.shape[0]
+    u, v = uv[:, 0], uv[:, 1]
+    idx = jnp.arange(m, dtype=jnp.int32)
+    nodes = jnp.arange(n_nodes, dtype=jnp.int32)
+    big = jnp.int32(m)
+
+    def cond(state):
+        comp, processed = state
+        return (~processed & (comp[u] != comp[v])).any()
+
+    def body(state):
+        comp, processed = state
+        cu, cv = comp[u], comp[v]
+        processed = processed | (cu == cv)  # intra-cluster edges are no-ops
+        # batched repulsive retirement: a repulsive edge stronger than one
+        # side's strongest ACTIVE ATTRACTIVE edge can become a mutex NOW —
+        # that cluster's future merges are all weaker (cluster picks are
+        # monotonically decreasing), so the early mutex can never wrongly
+        # block a stronger attractive merge.  Retires whole piles of
+        # parallel repulsive edges per round instead of one per cluster.
+        w_attr = jnp.where(~processed & attractive, weights, -jnp.inf)
+        alpha = (
+            jnp.full((n_nodes,), -jnp.inf, weights.dtype)
+            .at[cu].max(w_attr)
+            .at[cv].max(w_attr)
+        )
+        retire = (
+            ~processed & ~attractive
+            & ((weights > alpha[cu]) | (weights > alpha[cv]))
+        )
+        processed = processed | retire
+        active = ~processed
+        # per-cluster best active incident edge under the strict
+        # (weight, -index) order: scatter-max weight, then scatter-min index
+        # among weight-achievers
+        w_act = jnp.where(active, weights, -jnp.inf)
+        seg_w = (
+            jnp.full((n_nodes,), -jnp.inf, weights.dtype)
+            .at[cu].max(w_act)
+            .at[cv].max(w_act)
+        )
+        cand_u = jnp.where(active & (w_act == seg_w[cu]), idx, big)
+        cand_v = jnp.where(active & (w_act == seg_w[cv]), idx, big)
+        best = (
+            jnp.full((n_nodes,), big, jnp.int32)
+            .at[cu].min(cand_u)
+            .at[cv].min(cand_v)
+        )
+        mutual = active & (best[cu] == idx) & (best[cv] == idx)
+
+        # mutex membership for the mutual attractive candidates: sort-join
+        # of mutex rows (processed repulsive edges, keyed by their CURRENT
+        # cluster pair — inheritance under merges for free) against query
+        # rows.  Stale intra mutex rows key as (A, A) and can never match a
+        # query's (A, B), A < B.
+        a_key = jnp.minimum(cu, cv)
+        b_key = jnp.maximum(cu, cv)
+        is_mutex = processed & ~attractive
+        is_query = mutual & attractive
+        A2 = jnp.concatenate([a_key, a_key])
+        B2 = jnp.concatenate([b_key, b_key])
+        tag = jnp.concatenate(
+            [
+                jnp.where(is_mutex, jnp.int32(0), jnp.int32(2)),
+                jnp.where(is_query, jnp.int32(1), jnp.int32(2)),
+            ]
+        )
+        payload = jnp.concatenate([jnp.full((m,), big, jnp.int32), idx])
+        sA, sB, sT, sP = lax.sort((A2, B2, tag, payload), num_keys=3)
+        hit = (
+            (sA[1:] == sA[:-1]) & (sB[1:] == sB[:-1])
+            & (sT[:-1] == 0) & (sT[1:] == 1)
+        )
+        hit = jnp.concatenate([jnp.zeros((1,), bool), hit])
+        mutexed = (
+            jnp.zeros((m + 1,), jnp.int32)
+            .at[jnp.where(sT == 1, sP, big)].max(hit.astype(jnp.int32))
+        )[:m] > 0
+
+        merge_e = mutual & attractive & ~mutexed
+        # merged, mutex-blocked, and repulsive mutual edges are all decided
+        processed = processed | mutual
+
+        # apply the merge matching (each cluster in ≤ 1 mutual edge):
+        # larger cluster id points to smaller — depth-1, no chains
+        parent = jnp.concatenate([nodes, jnp.zeros((1,), jnp.int32)])
+        src = jnp.where(merge_e, b_key, jnp.int32(n_nodes))
+        parent = parent.at[src].set(jnp.where(merge_e, a_key, 0))
+        comp = parent[comp]
+        return comp, processed
+
+    comp, _ = lax.while_loop(
+        cond, body, (nodes, jnp.zeros((m,), dtype=bool))
+    )
+    return comp
+
+
+def mutex_watershed_device(
+    n_nodes: int,
+    uv: np.ndarray,
+    weights: np.ndarray,
+    attractive: np.ndarray,
+) -> np.ndarray:
+    """Drop-in device counterpart of ``native.mutex_watershed`` /
+    ``_mws_python``: root (canonical cluster id) per node.
+
+    Edges are padded to the next power of two (self-loops at node 0, never
+    active) so repeated solves of similar-size blocks reuse the jit cache.
+    """
+    if n_nodes >= np.iinfo(np.int32).max:
+        raise ValueError("device MWS needs an int32-addressable node space")
+    import jax.numpy as jnp
+
+    m = int(uv.shape[0])
+    mp = _next_pow2(max(m, 1))
+    uv32 = np.zeros((mp, 2), dtype=np.int32)
+    uv32[:m] = uv
+    w = np.full(mp, -1.0, dtype=np.float32)
+    w[:m] = weights
+    at = np.zeros(mp, dtype=bool)
+    at[:m] = np.asarray(attractive).astype(bool)
+    labels = _mws_parallel_greedy(
+        jnp.asarray(uv32), jnp.asarray(w), jnp.asarray(at), n_nodes=int(n_nodes)
+    )
+    return np.asarray(labels, dtype=np.int64)

@@ -35,6 +35,21 @@ from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, read_threads, resolve_n_blocks
 from .graph import read_block_with_upper_halo, load_graph
 
+def quantile_plan(config):
+    """(exact, sketch) from quantile_mode × path — shared by the block task
+    (what partials to write) and the merge task (what the partials must
+    support), so the two sides cannot silently disagree.  "sketch" and
+    "approx" on the filter path both mean approx (filter responses escape
+    the sketch's [0,1] bin domain)."""
+    mode = config.get("quantile_mode", "auto")
+    if mode not in ("auto", "exact", "sketch", "approx"):
+        raise ValueError(f"unknown quantile_mode {mode!r}")
+    filters = config.get("filters") is not None
+    exact = mode == "exact" or (mode == "auto" and filters)
+    sketch = not exact and not filters and mode != "approx"
+    return exact, sketch
+
+
 FEATURE_IDS_KEY = "features/ids"
 FEATURE_VALS_KEY = "features/vals"
 FEATURE_HISTS_KEY = "features/hists"
@@ -96,16 +111,7 @@ class BlockEdgeFeaturesTask(VolumeTask):
         return store.file_reader(self.labels_path, "r")[self.labels_key]
 
     def _quantile_plan(self, config):
-        """(exact, sketch) from quantile_mode × path — see the config
-        comment.  "sketch" and "approx" on the filter path both mean
-        approx (filter responses escape the sketch's [0,1] bin domain)."""
-        mode = config.get("quantile_mode", "auto")
-        if mode not in ("auto", "exact", "sketch", "approx"):
-            raise ValueError(f"unknown quantile_mode {mode!r}")
-        filters = config.get("filters") is not None
-        exact = mode == "exact" or (mode == "auto" and filters)
-        sketch = not exact and not filters and mode != "approx"
-        return exact, sketch
+        return quantile_plan(config)
 
     def _filter_responses(self, blocking: Blocking, block_id: int, config):
         """Halo'd read → device filter bank → per-channel responses cropped
@@ -349,10 +355,7 @@ class MergeEdgeFeaturesTask(VolumeSimpleTask):
         # sketch-mode run (e.g. mode switched without rerunning the blocks)
         # lack usable samples
         bconf = cfg.read_config(self.config_dir, "block_edge_features")
-        mode = bconf.get("quantile_mode", "auto")
-        wants_exact = mode == "exact" or (
-            mode == "auto" and bconf.get("filters") is not None
-        )
+        wants_exact, _ = quantile_plan(bconf)
         if wants_exact and not exact and ids_list:
             raise ValueError(
                 "quantile_mode requests the exact merge but the block "

@@ -1,0 +1,165 @@
+"""Device mutex watershed vs the host solvers (VERDICT r3 item 3).
+
+The device kernel is the mutually-best-edge parallel greedy
+(ops/mws_device.py docstring); with a shared strict total order (weight desc,
+ties by input index) the device partition must EQUAL the host
+Kruskal-with-mutexes partition — exactly when weights are representable in
+both f32 (device) and f64 (host), i.e. quantized affinities; Rand/VoI-close
+on continuous affinities (f32 rounding can swap near-equal priorities).
+"""
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.ops import _backend
+from cluster_tools_tpu.ops.evaluation import evaluate_segmentation, same_partition
+from cluster_tools_tpu.ops.mws import (
+    _mws_python,
+    compute_mws_segmentation,
+    compute_mws_segmentation_with_seeds,
+    mutex_watershed_graph,
+)
+from cluster_tools_tpu.ops.mws_device import mutex_watershed_device
+
+OFFSETS = [
+    [-1, 0, 0], [0, -1, 0], [0, 0, -1],
+    [-2, 0, 0], [0, -3, 0], [0, 0, -3],
+    [-1, -3, 0], [0, 3, 3],
+]
+
+
+def _quantized_affs(rng, shape):
+    """Affinities on a 1/256 grid: aff and 1-aff are exact in f32 AND f64,
+    so host and device share the identical edge priority order."""
+    return (rng.integers(0, 257, (len(OFFSETS),) + shape) / 256.0).astype(
+        np.float32
+    )
+
+
+class TestGraphDomain:
+    def _random_graph(self, rng, n=220, m=2500):
+        uv = rng.integers(0, n, (m, 2)).astype(np.int64)
+        uv = uv[uv[:, 0] != uv[:, 1]]
+        # quantized weights with deliberate tie mass
+        w = rng.integers(0, 64, uv.shape[0]) / 64.0
+        attr = rng.random(uv.shape[0]) < 0.6
+        return n, uv, w, attr.astype(np.uint8)
+
+    def test_matches_python_oracle(self, rng):
+        n, uv, w, attr = self._random_graph(rng)
+        want = _mws_python(n, uv, w, attr)
+        got = mutex_watershed_device(n, uv, w, attr)
+        assert same_partition(want + 1, got + 1)
+
+    def test_matches_native(self, rng):
+        from cluster_tools_tpu import native
+
+        if not native.available():
+            pytest.skip("native solvers unavailable")
+        n, uv, w, attr = self._random_graph(rng)
+        want = mutex_watershed_graph(n, uv, w, attr, use_native=True)
+        got = mutex_watershed_device(n, uv, w, attr)
+        assert same_partition(want + 1, got + 1)
+
+    def test_all_attractive_is_msf_components(self, rng):
+        """No repulsive edges → plain maximum-spanning-forest components =
+        one cluster per connected component."""
+        n = 50
+        uv = np.array([[i, i + 1] for i in range(24)]
+                      + [[i, i + 1] for i in range(30, 40)])
+        w = rng.random(uv.shape[0])
+        roots = mutex_watershed_device(n, uv, w, np.ones(uv.shape[0], np.uint8))
+        # chain 0..24 one cluster, 30..40 another, rest singletons
+        assert len(np.unique(roots[:25])) == 1
+        assert len(np.unique(roots[30:41])) == 1
+        assert len(np.unique(roots)) == n - 24 - 10
+
+    def test_strong_mutex_blocks_merge(self):
+        """Classic 3-node case: strong repulsion between 0-2 must survive a
+        weaker attractive chain closing the triangle."""
+        uv = np.array([[0, 1], [1, 2], [0, 2]])
+        w = np.array([0.9, 0.8, 0.95])
+        attr = np.array([1, 1, 0], np.uint8)  # 0-2 repulsive, strongest
+        roots = mutex_watershed_device(3, uv, w, attr)
+        assert roots[0] == roots[1]          # strongest attractive merges
+        assert roots[2] != roots[0]          # mutex blocks the chain
+        want = _mws_python(3, uv, w, attr)
+        assert same_partition(want + 1, roots + 1)
+
+    def test_msf_shortcut_would_be_wrong(self):
+        """Minimal instance (found by fuzzing) where 'maximum spanning forest
+        over all edges, then cut repulsive edges' DIFFERS from the true MWS:
+        the forest connects clusters through chains of repulsive edges,
+        wrongly blocking the 17-22 merge — mutexes are pairwise, not
+        transitive.  The device kernel must follow the true semantics."""
+        uv = np.array([
+            [24, 21], [11, 8], [23, 11], [24, 8], [33, 3], [31, 23],
+            [31, 6], [22, 3], [17, 22], [6, 17], [21, 33],
+        ])
+        w = np.array([0.875, 0.625, 0.125, 0.75, 0.5, 0.625,
+                      0.25, 0.75, 0.125, 0.25, 0.5])
+        attr = np.array([0, 0, 1, 0, 1, 0, 1, 1, 1, 1, 0], np.uint8)
+        want = _mws_python(35, uv, w, attr)
+        got = mutex_watershed_device(35, uv, w, attr)
+        assert same_partition(want + 1, got + 1)
+        # the defining property: 17 and 22 end up together
+        assert got[17] == got[22]
+
+    def test_empty_and_single_edge(self):
+        roots = mutex_watershed_device(
+            4, np.zeros((0, 2), np.int64), np.zeros(0), np.zeros(0, np.uint8)
+        )
+        assert len(np.unique(roots)) == 4
+        roots = mutex_watershed_device(
+            4, np.array([[1, 3]]), np.array([0.5]), np.array([1], np.uint8)
+        )
+        assert roots[1] == roots[3] and len(np.unique(roots)) == 3
+
+
+class TestVolumeDomain:
+    def test_exact_parity_quantized(self, rng):
+        affs = _quantized_affs(rng, (6, 16, 16))
+        host = compute_mws_segmentation(affs, OFFSETS, use_native=False)
+        with _backend.force_mws_mode("device"):
+            dev = compute_mws_segmentation(affs, OFFSETS, use_native=False)
+        assert same_partition(host.ravel(), dev.ravel())
+
+    def test_exact_parity_with_strides_and_mask(self, rng):
+        affs = _quantized_affs(rng, (4, 16, 16))
+        mask = np.ones((4, 16, 16), bool)
+        mask[:, :3] = False
+        kw = dict(strides=[1, 2, 2], mask=mask, seed=3)
+        host = compute_mws_segmentation(affs, OFFSETS, use_native=False, **kw)
+        with _backend.force_mws_mode("device"):
+            dev = compute_mws_segmentation(affs, OFFSETS, use_native=False, **kw)
+        assert (dev[~mask] == 0).all()
+        fg = mask
+        assert same_partition(host[fg].ravel(), dev[fg].ravel())
+
+    def test_rand_voi_parity_continuous(self, rng):
+        """Continuous f32 affinities: f64 host vs f32 device priorities can
+        swap near-ties — demand Rand/VoI-near-identical partitions
+        (BASELINE.md parity metric)."""
+        affs = rng.random((len(OFFSETS), 6, 16, 16)).astype(np.float32)
+        host = compute_mws_segmentation(affs, OFFSETS, use_native=False)
+        with _backend.force_mws_mode("device"):
+            dev = compute_mws_segmentation(affs, OFFSETS, use_native=False)
+        scores = evaluate_segmentation(host.ravel(), dev.ravel())
+        assert scores["rand_index"] > 0.99
+        assert scores["vi_split"] + scores["vi_merge"] < 0.1
+
+    def test_seeded_variant_device(self, rng):
+        affs = _quantized_affs(rng, (4, 16, 16))
+        seeds = np.zeros((4, 16, 16), np.uint64)
+        seeds[0, :4, :4] = 7
+        seeds[3, 10:, 10:] = 9
+        host = compute_mws_segmentation_with_seeds(
+            affs, OFFSETS, seeds, use_native=False
+        )
+        with _backend.force_mws_mode("device"):
+            dev = compute_mws_segmentation_with_seeds(
+                affs, OFFSETS, seeds, use_native=False
+            )
+        assert same_partition(host.ravel(), dev.ravel())
+        # seed labels must survive verbatim
+        assert (dev[seeds == 7] == 7).all() and (dev[seeds == 9] == 9).all()
