@@ -1,5 +1,5 @@
 from .agglomerative_clustering import AgglomerativeClusteringWorkflow
-from .downscaling import DownscalingWorkflow
+from .downscaling import DownscalingWorkflow, PainteraToBdvWorkflow
 from .learning import LearningWorkflow
 from .skeletons import (
     DistanceWorkflow,
@@ -51,6 +51,7 @@ from .watershed import WatershedWorkflow
 __all__ = [
     "AgglomerativeClusteringWorkflow",
     "DownscalingWorkflow",
+    "PainteraToBdvWorkflow",
     "LearningWorkflow",
     "DistanceWorkflow",
     "MeshWorkflow",

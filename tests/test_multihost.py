@@ -216,27 +216,7 @@ def test_collective_task_layer_across_processes(tmp_path):
         + env.get("PYTHONPATH", "")
     )
     port = _free_port()
-    procs = []
-    for pid in range(2):
-        penv = dict(env, CTT_PROCESS_ID=str(pid))
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, str(worker), str(pid), "2", str(port),
-                 str(root)],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, env=penv,
-            )
-        )
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=420)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+    procs, outs = _spawn(worker, 2, env, extra_args=[port, root], timeout=420)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert "collective task build OK" in out
