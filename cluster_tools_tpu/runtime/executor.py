@@ -119,7 +119,13 @@ class TpuExecutor(BaseExecutor):
                 task, blocking, block_ids, config
             )
 
-        batch_size = max(int(config.get("device_batch_size", 8)), 1)
+        bs_conf = config.get("device_batch_size")
+        if bs_conf is None:
+            import jax
+
+            # backend-aware default: see runtime/config.py
+            bs_conf = 1 if jax.default_backend() == "cpu" else 8
+        batch_size = max(int(bs_conf), 1)
         n_dev = self._n_devices(config)
         batch_size *= n_dev
 

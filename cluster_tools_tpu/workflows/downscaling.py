@@ -216,8 +216,6 @@ class WriteDownscalingMetadataTask(SimpleTask):
         tables at the file root, plus the XML sidecar."""
         import numpy as np
 
-        import numpy as _np
-
         f = store.file_reader(self.output_path, "a")
         resolution = self.metadata_dict.get("resolution", [1.0] * 3)
         unit = self.metadata_dict.get("unit", "pixel")
@@ -226,7 +224,7 @@ class WriteDownscalingMetadataTask(SimpleTask):
         # path); new levels accumulate on top of the last existing row
         existing = []
         if self.scale_offset > 0 and "s00/resolutions" in f:
-            prior = _np.asarray(f["s00/resolutions"][:])
+            prior = np.asarray(f["s00/resolutions"][:])
             existing = [
                 list(map(float, row)) for row in prior[: self.scale_offset + 1]
             ]
@@ -437,7 +435,17 @@ class PainteraToBdvWorkflow(WorkflowBase):
         self.skip_existing_levels = skip_existing_levels
 
     def _scales(self) -> List[int]:
-        g = store.file_reader(self.input_path, "r")[self.input_key_prefix]
+        try:
+            g = store.file_reader(self.input_path, "r")[self.input_key_prefix]
+        except (OSError, KeyError) as e:
+            # requires() builds the task graph EAGERLY (as the reference's
+            # luigi requires() does), so the paintera group must already
+            # exist — a dependency that would create it cannot gate this
+            raise ValueError(
+                f"PainteraToBdvWorkflow needs the paintera group "
+                f"{self.input_key_prefix!r} in {self.input_path!r} to exist "
+                "when the workflow is constructed — build the pyramid first"
+            ) from e
         return sorted(int(name[1:]) for name in g.keys())
 
     def requires(self):
