@@ -8,6 +8,7 @@ Returns the workflow wall-clock in seconds (data staging excluded).
 """
 
 import os
+import sys
 import tempfile
 import time
 
@@ -44,6 +45,38 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
                 chunks=tuple(block_shape),
             )
 
+        def task_breakdown(tmp_folder):
+            """Per-task busy seconds from the status files — the data behind
+            'where did the e2e wall go' (printed to stderr on the warm run).
+
+            Counts one aggregate per dispatch round: the local executor's
+            "blocks_total" records (its companion "block_max" is a max, not
+            an addend) and the tpu executor's per-batch "batch_*" walls.
+            Batch walls can overlap under ``pipeline_depth`` > 1, so a
+            task's busy seconds may legitimately exceed its wall share."""
+            import json
+
+            out = {}
+            sdir = os.path.join(tmp_folder, "status")
+            if not os.path.isdir(sdir):
+                return out
+            for name in sorted(os.listdir(sdir)):
+                if not name.endswith(".status.json"):
+                    continue
+                try:
+                    with open(os.path.join(sdir, name)) as fh:
+                        st = json.load(fh)
+                except (OSError, ValueError):
+                    continue
+                disp = sum(
+                    t.get("seconds", 0.0) for t in st.get("timings", [])
+                    if t.get("label") == "blocks_total"
+                    or str(t.get("label", "")).startswith("batch_")
+                )
+                blk = sum(float(r) for r in st.get("block_runtimes", []))
+                out[st.get("task", name)] = round(max(disp, blk), 3)
+            return out
+
         def one_run(tag, input_key):
             config_dir = os.path.join(td, f"configs{tag}")
             tmp_folder = os.path.join(td, f"tmp{tag}")
@@ -72,10 +105,16 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
             wall = time.perf_counter() - t0
             if not ok:
                 raise RuntimeError(f"e2e multicut workflow failed ({tag})")
-            return wall
+            return wall, task_breakdown(tmp_folder)
 
-        wall = one_run("", "bnd")
+        wall, _ = one_run("", "bnd")
         if not warm:
             return wall
-        warm_wall = one_run("_warm", "bnd_warm")
+        warm_wall, breakdown = one_run("_warm", "bnd_warm")
+        accounted = round(sum(breakdown.values()), 2)
+        print(f"[e2e breakdown warm, wall {warm_wall:.2f} s, task-busy "
+              f"{accounted} s] "
+              + " ".join(f"{k}={v}" for k, v in sorted(
+                  breakdown.items(), key=lambda kv: -kv[1])),
+              file=sys.stderr, flush=True)
     return wall, warm_wall

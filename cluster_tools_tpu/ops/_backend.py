@@ -8,9 +8,12 @@ Further opt-in kernel switches route whole pipelines to Pallas
 (flood/cc/dtws) or to the device MWS formulation.  One registry keeps every
 switch on the same contract:
 
-  * default: by env var (``CTT_<KIND>_MODE``), else the kind's default rule;
-  * the env pin is the supported way to deploy whichever mode
-    bench/tpu_validate measured best (tools/chip_session.py derives them);
+  * default: by env var (``CTT_<KIND>_MODE``), else a backend-tagged pin
+    file (``tools/chip_modes.json``, written by tools/chip_session.py from
+    on-chip measurements; applied only when the running backend matches the
+    one the pins were measured on), else the kind's default rule;
+  * the env pin remains the explicit way to deploy a mode and always
+    overrides the pin file;
   * ``force_<kind>_mode(mode)`` scopes an override for tests and
     benchmarks, owning both the restore and the jit-cache invalidation
     (traces bake the mode in — all switches are read at TRACE time, so
@@ -34,11 +37,50 @@ _ENV = {
 }
 
 
+# measured-pin file: {"backend": "<jax backend>", "modes": {ENVVAR: mode}}
+_PINS_CACHE: dict = {}
+
+
+def _file_pins() -> dict:
+    """Mode pins from tools/chip_modes.json, keyed by env-var name.
+
+    Loaded once per backend: pins measured on one backend (e.g. pallas
+    kernels validated on TPU) must not leak into runs on another (the CPU
+    test mesh), so a backend-tagged file only applies when
+    jax.default_backend() matches its tag."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend in _PINS_CACHE:
+        return _PINS_CACHE[backend]
+    path = os.environ.get("CTT_MODES_FILE")
+    if path is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.path.join(root, "tools", "chip_modes.json")
+    pins: dict = {}
+    try:
+        import json
+
+        with open(path) as f:
+            data = json.load(f)
+        if (isinstance(data, dict) and isinstance(data.get("modes"), dict)
+                and data.get("backend") == backend):
+            pins = dict(data["modes"])
+    except (OSError, ValueError):
+        pins = {}
+    _PINS_CACHE[backend] = pins
+    return pins
+
+
 def _mode(kind: str):
     forced = _FORCED.get(kind)
     if forced is not None:
         return forced
-    return os.environ.get(_ENV[kind])
+    env = os.environ.get(_ENV[kind])
+    if env is not None:
+        return env
+    return _file_pins().get(_ENV[kind])
 
 
 @contextmanager

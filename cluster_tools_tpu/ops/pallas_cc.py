@@ -39,15 +39,20 @@ _SENT = np.int32(np.iinfo(np.int32).max - 1)
 _NEG = np.int32(-1)
 
 
-def _sweep_min(label, mask, axis, reverse):
+def _sweep_min(label, mask_i, axis, reverse):
     """One directional min-label sweep in log depth.
 
     Identical clamp-transfer composition to ops.cc._min_sweep (same
     (u, low) combine), expressed with reverse shifts instead of flips so no
     data reorientation is lowered.  ``low`` is −1 on conducting edges (the
-    carry passes) and the sentinel on walls (the carry resets)."""
-    prev_m = _shift(mask, 1, axis, reverse, False)
-    conduct = mask & prev_m
+    carry passes) and the sentinel on walls (the carry resets).
+
+    ``mask_i`` is int32 0/1, not bool: Mosaic cannot concatenate/pad i1
+    vregs (invalid bitcast_vreg i1->i32 on hardware), so the shifted mask
+    must be full-width."""
+    prev_m = _shift(mask_i, 1, axis, reverse, jnp.int32(0))
+    conduct = (mask_i & prev_m) != 0
+    mask = mask_i != 0
 
     u = jnp.where(mask, label, _SENT)
     l = jnp.where(conduct, _NEG, _SENT)
@@ -65,7 +70,8 @@ def _sweep_min(label, mask, axis, reverse):
 
 def _cc_slice_kernel(m_ref, o_ref):
     """Label one slice's components with its minimal *volume* flat index."""
-    mask = m_ref[0] != 0
+    mask_i = m_ref[0]
+    mask = mask_i != 0
     h_dim, w_dim = mask.shape
     z = pl.program_id(0)
     row = lax.broadcasted_iota(jnp.int32, (h_dim, w_dim), 0)
@@ -86,8 +92,9 @@ def _cc_slice_kernel(m_ref, o_ref):
         new = lab
         for axis in (0, 1):
             for rev in (False, True):
-                new = _sweep_min(new, mask, axis, rev)
-        return new, jnp.any(new != lab)
+                new = _sweep_min(new, mask_i, axis, rev)
+        # reduce over int32, not i1 (same Mosaic i1 limitation)
+        return new, jnp.max((new != lab).astype(jnp.int32)) > 0
 
     lab, _ = lax.while_loop(cond, body, (label0, jnp.bool_(True)))
     o_ref[0] = jnp.where(mask, lab, jnp.int32(-1))

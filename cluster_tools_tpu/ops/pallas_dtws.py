@@ -93,7 +93,12 @@ def _parabola_w(g, tile=32):
             jnp.float32, (w, tile), 1
         )
         diff = i_idx - j_idx  # (w_i, tile_j)
-        cost = gp[:, None, j0 : j0 + tile] + (diff * diff)[None, :, :]
+        # static slice via lax.slice_in_dim + expand_dims: the jnp mixed
+        # None+slice indexing path lowers to lax.gather, which Mosaic
+        # rejects ("Shape mismatch in input, indices and output")
+        g_tile = jnp.expand_dims(
+            lax.slice_in_dim(gp, j0, j0 + tile, axis=1), 1)
+        cost = g_tile + jnp.expand_dims(diff * diff, 0)
         out = jnp.minimum(out, cost.min(axis=-1))
     return out
 
@@ -156,9 +161,12 @@ def _cc_full_conn(mask, label0):
     pallas_cc's clamp composition extended with diagonal directions.  The
     fixpoint (minimal label per component) is schedule-independent, so it
     matches ops/cc's pointer-jumping result exactly."""
+    # int32 mirror of the mask for everything that flows through _shift:
+    # Mosaic cannot concatenate/pad i1 vregs (invalid bitcast_vreg on chip)
+    mask_i = mask.astype(jnp.int32)
 
     def sweep(label, shift_fn, prev_mask_fn):
-        conduct = mask & prev_mask_fn(mask)
+        conduct = mask & (prev_mask_fn(mask_i) != 0)
         u = jnp.where(mask, label, _SENT)
         l = jnp.where(conduct, jnp.int32(-1), _SENT)
         n = max(label.shape)
@@ -175,13 +183,14 @@ def _cc_full_conn(mask, label0):
         for rev in (False, True):
             directions.append((
                 lambda x, d, f, a=axis, r=rev: _shift(x, d, a, r, f),
-                lambda m, a=axis, r=rev: _shift(m, 1, a, r, False),
+                lambda m, a=axis, r=rev: _shift(m, 1, a, r, jnp.int32(0)),
             ))
     for rev0 in (False, True):
         for rev1 in (False, True):
             directions.append((
                 lambda x, d, f, r0=rev0, r1=rev1: _shift2(x, d, r0, r1, f),
-                lambda m, r0=rev0, r1=rev1: _shift2(m, 1, r0, r1, False),
+                lambda m, r0=rev0, r1=rev1: _shift2(
+                    m, 1, r0, r1, jnp.int32(0)),
             ))
 
     def cond(carry):
@@ -193,7 +202,7 @@ def _cc_full_conn(mask, label0):
         new = lab
         for shift_fn, prev_fn in directions:
             new = sweep(new, shift_fn, prev_fn)
-        return new, jnp.any(new != lab)
+        return new, jnp.max((new != lab).astype(jnp.int32)) > 0
 
     lab, _ = lax.while_loop(cond, body, (label0, jnp.bool_(True)))
     return lab

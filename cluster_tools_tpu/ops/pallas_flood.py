@@ -97,16 +97,19 @@ def _sweep_assign(dist, label, alt, hmap, is_seed, mask, axis, reverse):
     cd = jnp.where(mask, dist, _BIG_DIST)
     cl = jnp.where(mask, label, 0)
     step = jnp.ones_like(dist)
-    pas = can_update
+    # pass-through flag as int32 0/1, not i1: Mosaic cannot concatenate/pad
+    # i1 vregs (invalid bitcast_vreg i1->i32 on real hardware), so every
+    # value that flows through _shift must be a full-width dtype
+    pas = can_update.astype(jnp.int32)
 
     n = dist.shape[axis]
     for k in range(int(np.ceil(np.log2(max(n, 2))))):
         fd = _shift(cd, 1 << k, axis, reverse, _BIG_DIST)
         fl = _shift(cl, 1 << k, axis, reverse, jnp.int32(0))
         fk = _shift(step, 1 << k, axis, reverse, jnp.int32(0))
-        fp = _shift(pas, 1 << k, axis, reverse, False)
+        fp = _shift(pas, 1 << k, axis, reverse, jnp.int32(0))
         cand_d = fd + step
-        cand_l = jnp.where(pas, fl, 0)
+        cand_l = jnp.where(pas != 0, fl, 0)
         cd, cl = _minlex(cd, cl, cand_d, cand_l)
         step = fk + step
         pas = fp & pas
@@ -147,7 +150,8 @@ def flood_arrays(hmap, seeds, mask):
         for axis in (0, 1):
             for rev in (False, True):
                 new = _sweep_altitude(new, hmap, is_seed, mask, axis, rev)
-        return new, jnp.any(new != alt)
+        # reduce over int32, not i1 (Mosaic i1 vreg bitcast limitation)
+        return new, jnp.max((new != alt).astype(jnp.int32)) > 0
 
     alt0 = jnp.where(is_seed, hmap, _BIG)
     alt, _ = lax.while_loop(alt_cond, alt_round, (alt0, jnp.bool_(True)))
@@ -163,7 +167,8 @@ def flood_arrays(hmap, seeds, mask):
         for axis in (0, 1):
             for rev in (False, True):
                 d, l = _sweep_assign(d, l, alt, hmap, is_seed, mask, axis, rev)
-        return d, l, jnp.any((d != dist) | (l != label))
+        changed = ((d != dist) | (l != label)).astype(jnp.int32)
+        return d, l, jnp.max(changed) > 0
 
     dist0 = jnp.where(is_seed, 0, _BIG_DIST)
     _, label, _ = lax.while_loop(

@@ -1,0 +1,74 @@
+"""Mode-pin precedence in ops._backend: forced > env > pin file > default.
+
+The pin file (tools/chip_modes.json, CTT_MODES_FILE to relocate) carries
+on-chip measured mode choices; it must apply only when the running backend
+matches its tag so TPU pins never leak into CPU runs.
+"""
+
+import json
+
+import jax
+import pytest
+
+from cluster_tools_tpu.ops import _backend
+
+# the running backend, whatever the host provides (cpu under conftest's
+# virtual mesh) — tests tag pin files with it so they hold on any host
+HERE = jax.default_backend()
+OTHER = "tpu" if HERE != "tpu" else "cpu"
+
+
+@pytest.fixture
+def pin_file(tmp_path, monkeypatch):
+    path = tmp_path / "chip_modes.json"
+
+    def write(payload):
+        path.write_text(json.dumps(payload))
+        monkeypatch.setenv("CTT_MODES_FILE", str(path))
+        _backend._PINS_CACHE.clear()
+        return path
+
+    yield write
+    _backend._PINS_CACHE.clear()
+
+
+def test_matching_backend_pins_apply(pin_file, monkeypatch):
+    monkeypatch.delenv("CTT_FLOOD_MODE", raising=False)
+    pin_file({"backend": HERE, "modes": {"CTT_FLOOD_MODE": "pallas"}})
+    assert _backend.use_pallas_flood()
+
+
+def test_mismatched_backend_pins_ignored(pin_file, monkeypatch):
+    monkeypatch.delenv("CTT_FLOOD_MODE", raising=False)
+    pin_file({"backend": OTHER, "modes": {"CTT_FLOOD_MODE": "pallas"}})
+    assert not _backend.use_pallas_flood()
+
+
+def test_env_overrides_pin_file(pin_file, monkeypatch):
+    pin_file({"backend": HERE, "modes": {"CTT_SWEEP_MODE": "assoc"}})
+    monkeypatch.setenv("CTT_SWEEP_MODE", "seq")
+    assert not _backend.use_assoc()
+
+
+def test_forced_overrides_everything(pin_file, monkeypatch):
+    monkeypatch.delenv("CTT_CC_MODE", raising=False)
+    pin_file({"backend": HERE, "modes": {"CTT_CC_MODE": "pallas"}})
+    with _backend.force_cc_mode("xla"):
+        assert not _backend.use_pallas_cc()
+    assert _backend.use_pallas_cc()
+
+
+def test_untagged_flat_file_is_rejected(pin_file, monkeypatch):
+    # a pin file without a backend tag carries measurements of unknown
+    # provenance — never apply it (cross-backend leak risk)
+    monkeypatch.delenv("CTT_FLOOD_MODE", raising=False)
+    pin_file({"CTT_FLOOD_MODE": "pallas"})
+    assert not _backend.use_pallas_flood()
+
+
+def test_missing_or_bad_file_falls_through(pin_file, monkeypatch):
+    monkeypatch.delenv("CTT_FLOOD_MODE", raising=False)
+    monkeypatch.setenv("CTT_MODES_FILE", "/nonexistent/chip_modes.json")
+    _backend._PINS_CACHE.clear()
+    assert not _backend.use_pallas_flood()
+    _backend._PINS_CACHE.clear()

@@ -128,8 +128,15 @@ def main():
             log(f"tpu_validate.json unreadable ({e}); bench runs unpinned")
         else:
             modes = derive_modes(results)
+            # backend-tagged pin file: ops/_backend.py loads it as the
+            # default mode source (env vars still override) ONLY when the
+            # running backend matches — so the driver's plain `python
+            # bench.py` and production runs get the measured winners
+            # without leaking TPU pins into CPU runs.
             with open(os.path.join(HERE, "chip_modes.json"), "w") as f:
-                json.dump(modes, f, indent=2)
+                json.dump(
+                    {"backend": results.get("backend", "tpu"),
+                     "modes": modes}, f, indent=2)
             log(f"mode pins: {modes}")
 
     log("== bench (driver mode) ==")

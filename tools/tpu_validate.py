@@ -28,7 +28,12 @@ from scipy import ndimage
 # repo root on sys.path; bench.timeit owns the distinct-input timing scheme
 # (variant 0 = sacrificial warmup, one fresh variant per timed round — see its
 # docstring for the axon execution-cache rationale)
-from bench import timeit, _rolled, rolled_pair_variants  # noqa: E402
+from bench import (  # noqa: E402
+    _rolled,
+    fetch_floor_s,
+    rolled_pair_variants,
+    timeit,
+)
 
 REPEATS = 3
 SPAN = REPEATS + 1  # warmup + timed rounds — one disjoint span per sweep mode
@@ -40,6 +45,11 @@ def main():
 
     print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
     results = {"backend": jax.default_backend()}
+    # additive per-call floor of the host-fetch completion barrier every
+    # timeit round ends in (tunnel RTT; ~0 on a local device) — subtract
+    # from sub-10ms entries when comparing kernels
+    results["fetch_floor_ms"] = round(fetch_floor_s() * 1e3, 2)
+    print(f"fetch floor: {results['fetch_floor_ms']} ms")
 
     rng = np.random.default_rng(0)
     shape = (32, 256, 256)
