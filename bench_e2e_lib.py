@@ -74,7 +74,11 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
                     or str(t.get("label", "")).startswith("batch_")
                 )
                 blk = sum(float(r) for r in st.get("block_runtimes", []))
-                out[st.get("task", name)] = round(max(disp, blk), 3)
+                # sum, don't assign: multi-host topologies write one status
+                # file PER PROCESS (<task>.p<pid>.status.json) under the
+                # same task identifier
+                key = st.get("task", name)
+                out[key] = round(out.get(key, 0.0) + max(disp, blk), 3)
             return out
 
         def one_run(tag, input_key):

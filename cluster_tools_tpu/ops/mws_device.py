@@ -28,11 +28,31 @@ spanning forest then cut repulsive edges" is WRONG for MWS (mutexes do not
 propagate through chains of repulsive forest edges; a minimal counterexample
 lives in tests/test_mws_device.py::test_msf_shortcut_would_be_wrong).
 
-Round count is data-dependent: monotone attractive chains (spatially smooth
-affinities) serialize — ~n_clusters-deep in the worst case.  The kernel is
-exact and dispatch-efficient per round, but the host C++ solver remains the
-production default for per-block solves; this is the TPU formulation for
-chip-resident pipelines and a base for future chain-contraction work.
+Chain contraction (log-depth rounds on smooth data): beyond the mutual
+matching, a cluster X whose best active edge ``e = (X, Y)`` is attractive
+merges along it in the SAME round — even when ``e`` is not Y's best —
+provided X is *mutex-immune*: no repulsive edge incident to X's cluster
+(active or already processed) is stronger than ``e``.  Sequential
+justification: at ``e``'s turn in the priority order, X's cluster is
+unchanged (every X-incident edge is weaker than ``e``), and a mutex
+involving X would need a processed — hence stronger — X-incident repulsive
+edge, which immunity rules out; early-retired mutexes recorded via the
+OTHER side cannot key against (X, Ycl) either, because reaching Ycl would
+need a best-of-cluster merge chain through an edge weaker than the retired
+mutex while ``e`` (stronger) is still pending on Ycl — contradicting the
+best-of-cluster rule.  Immunity is tested under the full lexicographic
+(weight desc, index asc) order — scatter-max weight plus scatter-min index
+among the achievers — so equal-weight repulsive neighbors that
+sequentially come later do not revoke it.  The eligible edges form a
+forest on clusters (each cluster
+has one best edge; acyclic because the strict (weight, -index) order
+descends along chains), applied with log-depth pointer jumping, so
+monotone attractive chains — which previously serialized one merge per
+round — contract in one round (measured: 1024-node chain, 1023 rounds -> 1).  On
+boundary-heavy bimodal affinities the win is partial (measured on an
+8k-node quantized problem: 4354 rounds without the rule): near-boundary
+attractive/repulsive interleaving still serializes through the mutual
+matching and repulsive retirement.
 
 Mutex bookkeeping is implicit and shape-static: a processed repulsive edge IS
 a mutex between the clusters of its endpoints — merges re-root its endpoints,
@@ -59,8 +79,9 @@ def _next_pow2(m: int) -> int:
     return 1 << max(int(m - 1).bit_length(), 4)
 
 
-@partial(jax.jit, static_argnames=("n_nodes",))
-def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int):
+@partial(jax.jit, static_argnames=("n_nodes", "enable_chain"))
+def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int,
+                         enable_chain: bool = True):
     import jax.numpy as jnp
     from jax import lax
 
@@ -71,11 +92,11 @@ def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int):
     big = jnp.int32(m)
 
     def cond(state):
-        comp, processed = state
+        comp, processed, _ = state
         return (~processed & (comp[u] != comp[v])).any()
 
     def body(state):
-        comp, processed = state
+        comp, processed, rounds = state
         cu, cv = comp[u], comp[v]
         processed = processed | (cu == cv)  # intra-cluster edges are no-ops
         # batched repulsive retirement: a repulsive edge stronger than one
@@ -122,7 +143,13 @@ def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int):
         a_key = jnp.minimum(cu, cv)
         b_key = jnp.maximum(cu, cv)
         is_mutex = processed & ~attractive
-        is_query = mutual & attractive
+        # query every best-edge candidate, mutual or chain: the chain proof
+        # says an immune side cannot be mutexed, but running the join over
+        # them too costs nothing (same sort size) and keeps the kernel safe
+        # against a proof gap
+        is_query = (
+            active & attractive & ((best[cu] == idx) | (best[cv] == idx))
+        )
         A2 = jnp.concatenate([a_key, a_key])
         B2 = jnp.concatenate([b_key, b_key])
         tag = jnp.concatenate(
@@ -147,18 +174,99 @@ def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int):
         # merged, mutex-blocked, and repulsive mutual edges are all decided
         processed = processed | mutual
 
-        # apply the merge matching (each cluster in ≤ 1 mutual edge):
-        # larger cluster id points to smaller — depth-1, no chains
+        # chain contraction: a cluster whose best edge is attractive and
+        # which is mutex-immune (no incident repulsive edge, active or
+        # processed, at least as strong) merges along its best edge even
+        # without mutuality — see the module docstring for the proof.
+        # beta[c]: strongest repulsive edge still incident to cluster c
+        # under the strict (weight desc, index asc) order — weight
+        # scatter-max, then index scatter-min among the weight-achievers
+        # (intra-cluster rows are stale mutexes and excluded)
+        is_rep = ~attractive & (cu != cv)
+        w_rep = jnp.where(is_rep, weights, -jnp.inf)
+        beta = (
+            jnp.full((n_nodes,), -jnp.inf, weights.dtype)
+            .at[cu].max(w_rep)
+            .at[cv].max(w_rep)
+        )
+        beta_i = (
+            jnp.full((n_nodes,), big, jnp.int32)
+            .at[cu].min(jnp.where(is_rep & (weights == beta[cu]), idx, big))
+            .at[cv].min(jnp.where(is_rep & (weights == beta[cv]), idx, big))
+        )
+        # X immune for its best edge e: every incident repulsive edge comes
+        # AFTER e in the total order — (w_e, -i_e) strictly above the
+        # strongest repulsive (beta, -beta_i)
+        immune_u = (weights > beta[cu]) | (
+            (weights == beta[cu]) & (idx < beta_i[cu])
+        )
+        immune_v = (weights > beta[cv]) | (
+            (weights == beta[cv]) & (idx < beta_i[cv])
+        )
+        # e best-for-X (best[cu] == idx), attractive, not mutexed, X immune;
+        # direction X -> Y.  The mutex join above already queried every
+        # mutual candidate; non-mutual chain edges cannot be mutexed (proof
+        # in the docstring), so the immunity test alone decides them.
+        enable = jnp.bool_(enable_chain)
+        chain_u = (
+            enable & active & attractive & ~mutexed
+            & (best[cu] == idx) & immune_u
+        )
+        chain_v = (
+            enable & active & attractive & ~mutexed
+            & (best[cv] == idx) & immune_v
+        )
+        merge_u = chain_u & ~mutual  # mutual pairs keep b_key -> a_key
+        merge_v = chain_v & ~mutual
+        processed = processed | merge_u | merge_v
+
+        # parent forest: mutual pairs point larger -> smaller; chain edges
+        # point the immune side at its partner's cluster.  Each cluster has
+        # at most one best edge, so the scatters never collide.
         parent = jnp.concatenate([nodes, jnp.zeros((1,), jnp.int32)])
         src = jnp.where(merge_e, b_key, jnp.int32(n_nodes))
         parent = parent.at[src].set(jnp.where(merge_e, a_key, 0))
-        comp = parent[comp]
-        return comp, processed
+        src_u = jnp.where(merge_u, cu, jnp.int32(n_nodes))
+        parent = parent.at[src_u].set(jnp.where(merge_u, cv, 0))
+        src_v = jnp.where(merge_v, cv, jnp.int32(n_nodes))
+        parent = parent.at[src_v].set(jnp.where(merge_v, cu, 0))
+        # collapse chains/trees to their roots by log-depth pointer jumping.
+        # The parent graph is a strict forest: best-edge weights strictly
+        # increase along a chain (an equal-weight continuation would be the
+        # mutual pair, which points larger -> smaller and roots at the
+        # smaller id), so p <- p[p] reaches every root in log2(n) steps.
+        p = parent[:n_nodes]
 
-    comp, _ = lax.while_loop(
-        cond, body, (nodes, jnp.zeros((m,), dtype=bool))
+        def jump(_, p):
+            return p[p]
+
+        p = lax.fori_loop(
+            0, max(int(np.ceil(np.log2(max(n_nodes, 2)))) + 1, 1), jump, p
+        )
+        comp = p[comp]
+        return comp, processed, rounds + 1
+
+    comp, _, rounds = lax.while_loop(
+        cond, body, (nodes, jnp.zeros((m,), dtype=bool), jnp.int32(0))
     )
-    return comp
+    return comp, rounds
+
+
+def _pad_problem(uv, weights, attractive):
+    """Pad the edge lists to the next power of two so repeated solves of
+    similar-size blocks reuse the jit cache.  Padding rows are repulsive
+    self-loops at node 0 with weight −1 — intra-cluster from round one,
+    never active.  The single staging path for the solver and the rounds
+    diagnostic."""
+    m = int(uv.shape[0])
+    mp = _next_pow2(max(m, 1))
+    uv32 = np.zeros((mp, 2), dtype=np.int32)
+    uv32[:m] = uv
+    w = np.full(mp, -1.0, dtype=np.float32)
+    w[:m] = weights
+    at = np.zeros(mp, dtype=bool)
+    at[:m] = np.asarray(attractive).astype(bool)
+    return uv32, w, at
 
 
 def mutex_watershed_device(
@@ -168,24 +276,36 @@ def mutex_watershed_device(
     attractive: np.ndarray,
 ) -> np.ndarray:
     """Drop-in device counterpart of ``native.mutex_watershed`` /
-    ``_mws_python``: root (canonical cluster id) per node.
-
-    Edges are padded to the next power of two (self-loops at node 0, never
-    active) so repeated solves of similar-size blocks reuse the jit cache.
-    """
+    ``_mws_python``: root (canonical cluster id) per node."""
     if n_nodes >= np.iinfo(np.int32).max:
         raise ValueError("device MWS needs an int32-addressable node space")
     import jax.numpy as jnp
 
-    m = int(uv.shape[0])
-    mp = _next_pow2(max(m, 1))
-    uv32 = np.zeros((mp, 2), dtype=np.int32)
-    uv32[:m] = uv
-    w = np.full(mp, -1.0, dtype=np.float32)
-    w[:m] = weights
-    at = np.zeros(mp, dtype=bool)
-    at[:m] = np.asarray(attractive).astype(bool)
-    labels = _mws_parallel_greedy(
+    uv32, w, at = _pad_problem(uv, weights, attractive)
+    labels, _ = _mws_parallel_greedy(
         jnp.asarray(uv32), jnp.asarray(w), jnp.asarray(at), n_nodes=int(n_nodes)
     )
     return np.asarray(labels, dtype=np.int64)
+
+
+def mutex_watershed_device_rounds(
+    n_nodes: int,
+    uv: np.ndarray,
+    weights: np.ndarray,
+    attractive: np.ndarray,
+    enable_chain: bool = True,
+) -> int:
+    """Round count of the while_loop for the given problem — the convergence
+    diagnostic behind the chain-contraction tests and bench.
+
+    ``enable_chain=False`` runs the mutual-matching-only algorithm, kept
+    measurable so the contraction win stays reproducible (and the legacy
+    path covered) from the tests."""
+    import jax.numpy as jnp
+
+    uv32, w, at = _pad_problem(uv, weights, attractive)
+    _, rounds = _mws_parallel_greedy(
+        jnp.asarray(uv32), jnp.asarray(w), jnp.asarray(at),
+        n_nodes=int(n_nodes), enable_chain=bool(enable_chain),
+    )
+    return int(rounds)

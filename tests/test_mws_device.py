@@ -163,3 +163,66 @@ class TestVolumeDomain:
         assert same_partition(host.ravel(), dev.ravel())
         # seed labels must survive verbatim
         assert (dev[seeds == 7] == 7).all() and (dev[seeds == 9] == 9).all()
+
+
+class TestChainContraction:
+    """The chain rule (mws_device docstring): a cluster whose best edge is
+    attractive and mutex-immune merges without mutuality, so monotone
+    attractive chains contract in O(log) rounds instead of one per round."""
+
+    def test_monotone_chain_single_round(self):
+        from cluster_tools_tpu.ops.mws_device import (
+            mutex_watershed_device_rounds,
+        )
+
+        n = 512
+        uv = np.stack([np.arange(n - 1), np.arange(1, n)], axis=1)
+        w = np.linspace(1.0, 0.5, n - 1).astype(np.float32)
+        att = np.ones(n - 1, bool)
+        rounds = mutex_watershed_device_rounds(n, uv, w, att)
+        # whole chain is immune (no repulsive edges): one contraction round
+        assert rounds <= 2, rounds
+        # the mutual-only algorithm serializes the same chain one merge per
+        # round — the A/B that keeps the contraction win reproducible
+        legacy = mutex_watershed_device_rounds(
+            n, uv, w, att, enable_chain=False
+        )
+        assert legacy >= n - 2, legacy
+        lab = mutex_watershed_device(n, uv, w, att)
+        want = _mws_python(n, uv, w, att)
+        assert same_partition(lab + 1, want + 1)
+
+    def test_chain_with_weak_repulsive_exact(self, rng):
+        """Chains + weak long-range repulsive: still few rounds, exact."""
+        from cluster_tools_tpu.ops.mws_device import (
+            mutex_watershed_device_rounds,
+        )
+
+        n = 256
+        uv_c = np.stack([np.arange(n - 1), np.arange(1, n)], axis=1)
+        w_c = (rng.integers(128, 257, n - 1) / 256.0).astype(np.float32)
+        rep = rng.integers(0, n, (300, 2))
+        rep = rep[rep[:, 0] != rep[:, 1]]
+        w_r = (rng.integers(0, 128, len(rep)) / 256.0).astype(np.float32)
+        uv = np.concatenate([uv_c, rep])
+        w = np.concatenate([w_c, w_r])
+        att = np.concatenate([np.ones(n - 1, bool), np.zeros(len(rep), bool)])
+        rounds = mutex_watershed_device_rounds(n, uv, w, att)
+        assert rounds <= 16, rounds
+        lab = mutex_watershed_device(n, uv, w, att)
+        want = _mws_python(n, uv, w, att)
+        assert same_partition(lab + 1, want + 1)
+
+    def test_tie_heavy_random_graphs_exact(self):
+        """Heavy duplicate-weight mass across many seeds: the chain rule
+        must preserve exact parity with the sequential oracle."""
+        for seed in range(8):
+            tr = np.random.default_rng(100 + seed)
+            nn, m = 200, 800
+            uv = tr.integers(0, nn, (m, 2)).astype(np.int32)
+            uv = uv[uv[:, 0] != uv[:, 1]]
+            w = (tr.integers(0, 32, len(uv)) / 32.0).astype(np.float32)
+            att = tr.random(len(uv)) < 0.6
+            want = _mws_python(nn, uv, w, att)
+            got = mutex_watershed_device(nn, uv, w, att)
+            assert same_partition(want + 1, got + 1), seed

@@ -17,6 +17,12 @@ Steps:
      → tools/chip_modes.json;
   5. bench.py (driver mode) with those pins exported → the BENCH JSON line
      on stdout (the last line, as the driver expects).
+
+Both artifacts (tools/tpu_validate.json, tools/chip_modes.json) are MEANT
+to be committed: the validate record is the audit trail of what ran on
+silicon, and the backend-tagged pin file is how plain `python bench.py` and
+production runs inherit the measured mode winners (ops/_backend.py loads
+it; env vars override; non-matching backends ignore it).
 """
 
 import json
