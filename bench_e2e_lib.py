@@ -47,7 +47,8 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
 
         def task_breakdown(tmp_folder):
             """Per-task busy seconds from the status files — the data behind
-            'where did the e2e wall go' (printed to stderr on the warm run).
+            'where did the e2e wall go' (printed to stderr for the cold AND
+            warm runs; cold-minus-warm per task isolates compile cost).
 
             Counts one aggregate per dispatch round: the local executor's
             "blocks_total" records (its companion "block_max" is a max, not
@@ -111,14 +112,20 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
                 raise RuntimeError(f"e2e multicut workflow failed ({tag})")
             return wall, task_breakdown(tmp_folder)
 
-        wall, _ = one_run("", "bnd")
+        def show(tag, wall_s, breakdown):
+            accounted = round(sum(breakdown.values()), 2)
+            print(f"[e2e breakdown {tag}, wall {wall_s:.2f} s, task-busy "
+                  f"{accounted} s] "
+                  + " ".join(f"{k}={v}" for k, v in sorted(
+                      breakdown.items(), key=lambda kv: -kv[1])),
+                  file=sys.stderr, flush=True)
+
+        wall, cold_breakdown = one_run("", "bnd")
         if not warm:
             return wall
+        # cold-vs-warm per task separates compile cost (cold only) from
+        # steady-state compute — the data behind cold-wall attribution
+        show("cold", wall, cold_breakdown)
         warm_wall, breakdown = one_run("_warm", "bnd_warm")
-        accounted = round(sum(breakdown.values()), 2)
-        print(f"[e2e breakdown warm, wall {warm_wall:.2f} s, task-busy "
-              f"{accounted} s] "
-              + " ".join(f"{k}={v}" for k, v in sorted(
-                  breakdown.items(), key=lambda kv: -kv[1])),
-              file=sys.stderr, flush=True)
+        show("warm", warm_wall, breakdown)
     return wall, warm_wall

@@ -99,21 +99,37 @@ def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int,
         comp, processed, rounds = state
         cu, cv = comp[u], comp[v]
         processed = processed | (cu == cv)  # intra-cluster edges are no-ops
-        # batched repulsive retirement: a repulsive edge stronger than one
-        # side's strongest ACTIVE ATTRACTIVE edge can become a mutex NOW —
-        # that cluster's future merges are all weaker (cluster picks are
-        # monotonically decreasing), so the early mutex can never wrongly
-        # block a stronger attractive merge.  Retires whole piles of
-        # parallel repulsive edges per round instead of one per cluster.
+        # batched repulsive retirement: a repulsive edge that PRECEDES one
+        # side's strongest active attractive edge in the strict
+        # (weight desc, index asc) order can become a mutex NOW — that
+        # cluster's future merges all come later in the order, so the early
+        # mutex can never wrongly block a merge the sequential algorithm
+        # would have done first.  Retires whole piles of parallel repulsive
+        # edges per round instead of one per cluster.  The tie-break is
+        # lexicographic (alpha weight scatter-max + index scatter-min among
+        # achievers), so equal-weight attractive/repulsive interleavings
+        # retire at full rate instead of one mutual pair per round.
         w_attr = jnp.where(~processed & attractive, weights, -jnp.inf)
         alpha = (
             jnp.full((n_nodes,), -jnp.inf, weights.dtype)
             .at[cu].max(w_attr)
             .at[cv].max(w_attr)
         )
+        is_attr_act = ~processed & attractive
+        alpha_i = (
+            jnp.full((n_nodes,), big, jnp.int32)
+            .at[cu].min(
+                jnp.where(is_attr_act & (weights == alpha[cu]), idx, big))
+            .at[cv].min(
+                jnp.where(is_attr_act & (weights == alpha[cv]), idx, big))
+        )
+
+        def _precedes(side):
+            a_w, a_i = alpha[side], alpha_i[side]
+            return (weights > a_w) | ((weights == a_w) & (idx < a_i))
+
         retire = (
-            ~processed & ~attractive
-            & ((weights > alpha[cu]) | (weights > alpha[cv]))
+            ~processed & ~attractive & (_precedes(cu) | _precedes(cv))
         )
         processed = processed | retire
         active = ~processed
