@@ -587,6 +587,12 @@ def main():
         "sitecustomize pins JAX_PLATFORMS, so the env var alone is too late",
     )
     args = parser.parse_args()
+
+    # persistent XLA executable cache — cold kernel configs and the e2e
+    # subprocesses all profit across runs (CTT_COMPILE_CACHE=0 disables)
+    from cluster_tools_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     if args.platform:
         import jax
 

@@ -20,10 +20,11 @@ cluster has ONE best edge), so all of them apply in the same round:
 
 Progress: the globally highest active edge is always mutually best, so every
 round processes ≥ 1 edge.  Repulsive edges additionally retire in BATCHES:
-a repulsive edge stronger than one side's strongest active attractive edge
-becomes a mutex immediately (that cluster's future merges are all weaker —
-cluster picks decrease monotonically — so the early mutex can never wrongly
-block a stronger attractive merge).  NOT the naive MSF shortcut — "maximum
+a repulsive edge that PRECEDES one side's strongest active attractive edge
+in the strict (weight desc, index asc) priority order becomes a mutex
+immediately (that cluster's future merges all come later in the order —
+cluster picks descend monotonically — so the early mutex can never wrongly
+block a merge the sequential algorithm would have done first).  NOT the naive MSF shortcut — "maximum
 spanning forest then cut repulsive edges" is WRONG for MWS (mutexes do not
 propagate through chains of repulsive forest edges; a minimal counterexample
 lives in tests/test_mws_device.py::test_msf_shortcut_would_be_wrong).
@@ -109,13 +110,13 @@ def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int,
         # lexicographic (alpha weight scatter-max + index scatter-min among
         # achievers), so equal-weight attractive/repulsive interleavings
         # retire at full rate instead of one mutual pair per round.
-        w_attr = jnp.where(~processed & attractive, weights, -jnp.inf)
+        is_attr_act = ~processed & attractive
+        w_attr = jnp.where(is_attr_act, weights, -jnp.inf)
         alpha = (
             jnp.full((n_nodes,), -jnp.inf, weights.dtype)
             .at[cu].max(w_attr)
             .at[cv].max(w_attr)
         )
-        is_attr_act = ~processed & attractive
         alpha_i = (
             jnp.full((n_nodes,), big, jnp.int32)
             .at[cu].min(

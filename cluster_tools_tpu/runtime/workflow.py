@@ -77,6 +77,12 @@ def _toposort(roots: Sequence[Task]) -> List[Task]:
 
 def build(tasks: Sequence[Task], raise_on_failure: bool = True) -> bool:
     """Run a set of root tasks and their dependencies.  Returns success."""
+    # persistent XLA executable cache: fresh worker processes skip the
+    # multi-second jit compiles of the big fused programs (CTT_COMPILE_CACHE
+    # relocates/disables — see utils/compile_cache.py)
+    from ..utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     order = _toposort(tasks)
     for task in order:
         # resume after a multi-host failure: stale aborted flags from the

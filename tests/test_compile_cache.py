@@ -1,0 +1,32 @@
+"""Persistent compilation cache wiring (utils/compile_cache.py)."""
+
+import os
+
+from cluster_tools_tpu.utils import compile_cache
+
+
+def test_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("CTT_COMPILE_CACHE", "0")
+    monkeypatch.setattr(compile_cache, "_ACTIVE_DIR", None)
+    assert compile_cache.enable_compile_cache() is None
+
+
+def test_enable_points_jax_at_the_dir(tmp_path, monkeypatch):
+    import jax
+
+    target = str(tmp_path / "xla")
+    monkeypatch.setenv("CTT_COMPILE_CACHE", target)
+    prev = jax.config.jax_compilation_cache_dir
+    prev_active = compile_cache._ACTIVE_DIR
+    compile_cache._ACTIVE_DIR = None
+    try:
+        got = compile_cache.enable_compile_cache()
+        assert got == target
+        assert os.path.isdir(target)
+        assert jax.config.jax_compilation_cache_dir == target
+        # once enabled, later calls return the ACTIVE dir even when asked
+        # for another (re-pointing a live cache is unsupported)
+        assert compile_cache.enable_compile_cache("/elsewhere") == target
+    finally:
+        compile_cache._ACTIVE_DIR = prev_active
+        jax.config.update("jax_compilation_cache_dir", prev)
