@@ -73,14 +73,22 @@ def _file_pins() -> dict:
     return pins
 
 
+def pinned_value(env_name: str):
+    """Resolve a measured pin by its env-var name: the env var wins, else
+    the backend-tagged pin file entry, else None.  The one precedence
+    implementation for every CTT_* value that is not a mode switch
+    (e.g. CTT_DEVICE_BATCH in runtime/executor.py)."""
+    env = os.environ.get(env_name)
+    if env is not None:
+        return env
+    return _file_pins().get(env_name)
+
+
 def _mode(kind: str):
     forced = _FORCED.get(kind)
     if forced is not None:
         return forced
-    env = os.environ.get(_ENV[kind])
-    if env is not None:
-        return env
-    return _file_pins().get(_ENV[kind])
+    return pinned_value(_ENV[kind])
 
 
 @contextmanager

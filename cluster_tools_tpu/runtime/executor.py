@@ -121,10 +121,20 @@ class TpuExecutor(BaseExecutor):
 
         bs_conf = config.get("device_batch_size")
         if bs_conf is None:
-            import jax
+            # measured pin (env var, else the backend-tagged pin file —
+            # tools/chip_session.py writes CTT_DEVICE_BATCH), else the
+            # backend-aware default; malformed pins degrade to the default
+            # like every other CTT_* switch
+            from ..ops import _backend
 
-            # backend-aware default: see runtime/config.py
-            bs_conf = 1 if jax.default_backend() == "cpu" else 8
+            pin = _backend.pinned_value("CTT_DEVICE_BATCH")
+            try:
+                bs_conf = int(pin)
+            except (TypeError, ValueError):
+                import jax
+
+                # backend-aware default: see runtime/config.py
+                bs_conf = 1 if jax.default_backend() == "cpu" else 8
         batch_size = max(int(bs_conf), 1)
         n_dev = self._n_devices(config)
         batch_size *= n_dev

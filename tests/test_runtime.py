@@ -270,3 +270,45 @@ def test_local_executor_honors_pipeline_safe(tmp_env):
     build([t])
     assert sorted(out["calls"]) == list(range(8))
     assert len(out["threads"]) == 1
+
+
+def test_device_batch_size_pin_resolution(tmp_env, monkeypatch):
+    """device_batch_size: null resolves CTT_DEVICE_BATCH (env, then the
+    backend-tagged pin file) before the backend default."""
+    import json
+
+    from cluster_tools_tpu.ops import _backend
+
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(
+        config_dir,
+        {"block_shape": [4, 32, 32], "target": "tpu",
+         "device_batch_size": None, "devices": [0]},
+    )
+
+    # env pin: 8 blocks at batch 4 -> 2 batches
+    monkeypatch.setenv("CTT_DEVICE_BATCH", "4")
+    out = {}
+    t = BatchRecordingTask(tmp_folder, config_dir, out=out)
+    build([t])
+    assert sorted(out["calls"]) == list(range(8))
+    assert sorted(len(b) for _, b in out["batches"]) == [4, 4]
+
+    # pin file (backend-tagged): batch 2 -> 4 batches
+    monkeypatch.delenv("CTT_DEVICE_BATCH")
+    import jax
+
+    pin_path = os.path.join(tmp_folder, "modes.json")
+    with open(pin_path, "w") as f:
+        json.dump({"backend": jax.default_backend(),
+                   "modes": {"CTT_DEVICE_BATCH": "2"}}, f)
+    monkeypatch.setenv("CTT_MODES_FILE", pin_path)
+    _backend._PINS_CACHE.clear()
+    out2 = {}
+    t2 = BatchRecordingTask(
+        tmp_folder + "_pin", config_dir, out=out2)
+    try:
+        build([t2])
+    finally:
+        _backend._PINS_CACHE.clear()
+    assert sorted(len(b) for _, b in out2["batches"]) == [2, 2, 2, 2]

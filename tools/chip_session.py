@@ -103,6 +103,8 @@ def derive_modes(results: dict) -> dict:
         modes["CTT_CC_MODE"] = "pallas"
     if results.get("pallas_dtws_exact") and results.get("pallas_dtws_wins"):
         modes["CTT_DTWS_MODE"] = "pallas"
+    if "best_device_batch" in results:
+        modes["CTT_DEVICE_BATCH"] = str(results["best_device_batch"])
     return modes
 
 

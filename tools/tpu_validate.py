@@ -217,6 +217,38 @@ def main():
     results["rag_numpy_ms"] = round(t_host * 1e3, 1)
     print(f"rag device: {t_dev*1e3:.1f} ms, numpy: {t_host*1e3:.1f} ms")
 
+    # -- device batch-size sweep (CTT_DEVICE_BATCH pin) ---------------------
+    # per-block voxel rate of the vmapped DT-watershed at several batch
+    # sizes: a batch amortizes dispatch/tunnel latency but vmap can
+    # serialize while_loop rounds across the batch (max-over-batch) — only
+    # measurement can pick the winner for a backend
+    block = raw[:16, :128, :128]
+    best_rate, best_bs = -1.0, 1
+    for bs in (1, 4, 8, 16):
+        fn = jax.jit(jax.vmap(lambda v: dt_watershed(v, threshold=0.5)[0]))
+        stacks = [
+            jnp.asarray(np.stack([
+                np.roll(v, j + 1, axis=1) for j in range(bs)
+            ]))
+            for v in _rolled(block, SPAN)
+        ]
+        try:
+            t = timeit(
+                None, REPEATS,
+                sync=lambda r: r.block_until_ready(),
+                variants=[(lambda s: lambda: fn(s))(s) for s in stacks],
+            )
+        except Exception as e:
+            results[f"batch{bs}_error"] = f"{type(e).__name__}: {e}"[:200]
+            continue
+        rate = bs * block.size / t / 1e6
+        results[f"batch{bs}_mvox_s"] = round(rate, 1)
+        print(f"batch sweep x{bs}: {t*1e3:.1f} ms ({rate:.1f} Mvox/s)")
+        if rate > best_rate:
+            best_rate, best_bs = rate, bs
+    if best_rate > 0:  # never pin from an all-errored sweep
+        results["best_device_batch"] = best_bs
+
     # -- verdicts ------------------------------------------------------------
     results["flood_assoc_wins"] = results["dtws_assoc_ms"] < results["dtws_seq_ms"]
     results["cc_assoc_wins"] = results["cc_assoc_ms"] < results["cc_seq_ms"]
