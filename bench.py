@@ -478,6 +478,71 @@ def bench_rag(x, repeats):
     return mvox, t_host / t_dev
 
 
+def bench_ws_e2e(x, block_shape):
+    """WatershedWorkflow wall-clock, tpu vs cpu-local — the literal
+    BASELINE.md north-star workload (block IO + fused DT-WS dispatch +
+    label writes, no multicut stages).  Warm-to-warm is the steady-state
+    comparison a production sweep pays; both sides report cold too.  The
+    device run is in-process and inherits the session platform (the chip
+    under the driver, or whatever --platform forced in main)."""
+    from bench_e2e_lib import run_ws_pipeline
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as td:
+        vol_path = os.path.join(td, "vol.npy")
+        np.save(vol_path, x)
+
+        t_dev, t_dev_warm = run_ws_pipeline(
+            vol_path, x.shape, block_shape, "tpu", warm=True
+        )
+        log(f"[ws-e2e] tpu target {t_dev:.2f} s (warm {t_dev_warm:.2f} s)")
+
+        script = os.path.join(td, "ws_cpu.py")
+        with open(script, "w") as f:
+            f.write(
+                "import json, os, sys\n"
+                # env var AND config update, like e2e_cpu.py: sitecustomize
+                # pins the tunnel platform, and an accidental tunnel client
+                # here would collide with the parent's chip session
+                "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                f"sys.path.insert(0, {here!r})\n"
+                "import jax\n"
+                "jax.config.update('jax_platforms', 'cpu')\n"
+                "from bench_e2e_lib import run_ws_pipeline\n"
+                f"t, t_warm = run_ws_pipeline({vol_path!r}, "
+                f"{tuple(x.shape)!r}, {tuple(block_shape)!r}, 'local', "
+                "warm=True)\n"
+                "print(json.dumps({'wall_s': t, 'warm_s': t_warm}))\n"
+            )
+        res = {
+            "ws_e2e_wall_s": round(t_dev, 2),
+            "ws_e2e_warm_wall_s": round(t_dev_warm, 2),
+        }
+        try:
+            # below the driver's 1200 s ws budget so a slow baseline can
+            # never take the already-measured device numbers down with it
+            out = subprocess.run(
+                [sys.executable, script], capture_output=True, text=True,
+                timeout=900,
+            )
+        except subprocess.TimeoutExpired:
+            log("[ws-e2e] cpu baseline timed out; reporting device side only")
+            return res
+        if out.returncode != 0:
+            log(f"[ws-e2e] cpu baseline failed:\n{out.stderr[-1000:]}")
+            return res
+        host = json.loads(out.stdout.strip().splitlines()[-1])
+        res["ws_e2e_local_wall_s"] = round(host["wall_s"], 2)
+        res["ws_e2e_local_warm_wall_s"] = round(host["warm_s"], 2)
+        res["ws_e2e_speedup_warm"] = round(host["warm_s"] / t_dev_warm, 2)
+        log(
+            f"[ws-e2e] cpu-local {host['wall_s']:.2f} s "
+            f"(warm {host['warm_s']:.2f} s) -> warm speedup "
+            f"{res['ws_e2e_speedup_warm']}x"
+        )
+    return res
+
+
 def bench_e2e(x, block_shape, platform=None):
     """Full watershed→graph→features→costs→multicut pipeline wall-clock."""
     from bench_e2e_lib import run_pipeline
@@ -579,7 +644,7 @@ def main():
     parser.add_argument("--repeats", type=int, default=5)
     parser.add_argument(
         "--only", default=None,
-        help="comma-separated subset: dtws,batched,cc,mws,rag,e2e",
+        help="comma-separated subset: dtws,batched,cc,mws,rag,ws,e2e",
     )
     parser.add_argument(
         "--platform", default=None,
@@ -638,7 +703,7 @@ def main():
         here = os.path.abspath(__file__)
         for cfg, budget_s in [
             ("dtws", 900), ("batched", 900), ("cc", 900),
-            ("mws", 600), ("rag", 600), ("e2e", 1800),
+            ("mws", 600), ("rag", 600), ("ws", 1200), ("e2e", 1800),
         ]:
             cmd = [sys.executable, here, "--only", cfg,
                    "--repeats", str(args.repeats)]
@@ -713,6 +778,8 @@ def main():
         extra["rag_mvox_s"] = round(rag_v, 3)
         extra["rag_vs_baseline"] = round(rag_r, 3) if rag_r is not None else None
         _suspect_throughput(rag_v, extra, "rag_timing_suspect")
+    if want("ws"):
+        extra.update(bench_ws_e2e(make_volume(e2e_shape, seed=3), e2e_block))
     if want("e2e"):
         e2e_v, e2e_r, e2e_sharded, e2e_warm = bench_e2e(
             make_volume(e2e_shape, seed=3), e2e_block, platform=args.platform

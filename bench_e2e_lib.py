@@ -15,6 +15,33 @@ import time
 import numpy as np
 
 
+# the benchmarked watershed task config — ONE definition so the ws-only
+# benchmark measures exactly the workload the full pipeline's first stage
+# runs (run_pipeline and run_ws_pipeline must not drift apart)
+WS_TASK_CONFIG = {
+    "threshold": 0.5, "sigma_seeds": 2.0, "size_filter": 25,
+    "halo": [2, 4, 4],
+}
+
+
+def _stage_volume(td, vol_path, shape, block_shape, warm):
+    """Load the benchmark volume into a fresh n5 container; with ``warm``
+    also stage a DISTINCT (z-rolled) copy for the jit-cache-warm rerun."""
+    from cluster_tools_tpu.utils import file_reader
+
+    vol = np.load(vol_path).astype(np.float32)
+    assert vol.shape == tuple(shape)
+    data_path = os.path.join(td, "data.n5")
+    f = file_reader(data_path)
+    f.create_dataset("bnd", data=vol, chunks=tuple(block_shape))
+    if warm:
+        f.create_dataset(
+            "bnd_warm", data=np.roll(vol, 7, axis=1),
+            chunks=tuple(block_shape),
+        )
+    return data_path
+
+
 def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
                  warm=False):
     """Wall-clock of the full pipeline; ``sharded_problem=True`` swaps the
@@ -29,21 +56,10 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
     inputs in ~0 ms — the warm number must be steady-state compute, the rate
     a production sweep over many ROIs pays)."""
     from cluster_tools_tpu.runtime import build, config as cfg
-    from cluster_tools_tpu.utils import file_reader
     from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
 
-    vol = np.load(vol_path).astype(np.float32)
-    assert vol.shape == tuple(shape)
-
     with tempfile.TemporaryDirectory() as td:
-        data_path = os.path.join(td, "data.n5")
-        f = file_reader(data_path)
-        f.create_dataset("bnd", data=vol, chunks=tuple(block_shape))
-        if warm:
-            f.create_dataset(
-                "bnd_warm", data=np.roll(vol, 7, axis=1),
-                chunks=tuple(block_shape),
-            )
+        data_path = _stage_volume(td, vol_path, shape, block_shape, warm)
 
         def task_breakdown(tmp_folder):
             """Per-task busy seconds from the status files — the data behind
@@ -89,11 +105,7 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
                 config_dir,
                 {"block_shape": list(block_shape), "target": target},
             )
-            cfg.write_config(
-                config_dir, "watershed",
-                {"threshold": 0.5, "sigma_seeds": 2.0, "size_filter": 25,
-                 "halo": [2, 4, 4]},
-            )
+            cfg.write_config(config_dir, "watershed", dict(WS_TASK_CONFIG))
             cfg.write_config(
                 config_dir, "sharded_problem", {"max_edges": 1 << 17}
             )
@@ -128,4 +140,43 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
         show("cold", wall, cold_breakdown)
         warm_wall, breakdown = one_run("_warm", "bnd_warm")
         show("warm", warm_wall, breakdown)
+    return wall, warm_wall
+
+
+def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False):
+    """Wall-clock of the WatershedWorkflow alone — the BASELINE.md north
+    star is "≥10x wall-clock vs target='local' on CREMI sample-A
+    DT-watershed", i.e. THIS workload (block reads → fused DT-WS program →
+    label writes), not the full multicut pipeline whose host-bound merge
+    and solve stages dilute the device speedup.  Same cold/warm and
+    distinct-volume discipline as ``run_pipeline``."""
+    from cluster_tools_tpu.runtime import build, config as cfg
+    from cluster_tools_tpu.workflows import WatershedWorkflow
+
+    with tempfile.TemporaryDirectory() as td:
+        data_path = _stage_volume(td, vol_path, shape, block_shape, warm)
+
+        def one_run(tag, input_key):
+            config_dir = os.path.join(td, f"configs{tag}")
+            cfg.write_global_config(
+                config_dir,
+                {"block_shape": list(block_shape), "target": target},
+            )
+            cfg.write_config(config_dir, "watershed", dict(WS_TASK_CONFIG))
+            wf = WatershedWorkflow(
+                os.path.join(td, f"tmp{tag}"), config_dir,
+                input_path=data_path, input_key=input_key,
+                output_path=data_path, output_key=f"ws{tag}",
+            )
+            t0 = time.perf_counter()
+            ok = build([wf])
+            wall = time.perf_counter() - t0
+            if not ok:
+                raise RuntimeError(f"watershed workflow failed ({tag})")
+            return wall
+
+        wall = one_run("", "bnd")
+        if not warm:
+            return wall
+        warm_wall = one_run("_warm", "bnd_warm")
     return wall, warm_wall
