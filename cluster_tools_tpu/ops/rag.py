@@ -526,13 +526,23 @@ def merge_edge_features_multi(
 
 
 def _boundary_edge_features_device_impl(
-    labels, values, max_edges, hist_bins, owner_shape=None
+    labels, values, max_edges, hist_bins, owner_shape=None, packed=False
 ):
     """One fused XLA program: face-pair extraction → 3-key lexicographic sort
     (u, v, sample) → segment reductions (count/mean/var/min/max), in-segment
     rank gathers for the five sample quantiles, and the per-edge histogram
     sketch.  Fixed shapes throughout: outputs are padded to ``max_edges``
     (ragged edge counts are the host's problem — SURVEY.md §7 #4).
+
+    ``packed=True`` (static; caller must guarantee every label id ≤ 32766 —
+    the host wrappers enforce ``uniq.size < 32767`` — so the largest packed
+    key 32766*65536+65535 stays strictly below the int32-max sentinel) packs
+    the (u, v) pair into ONE int32 sort key ``u*65536 + v``: the dominant
+    sort drops
+    from 3 streams (12 B/element) to 2 (8 B), and the edge-endpoint
+    reduction collapses to a single segment-min.  The packing is
+    order-preserving (same lexicographic (u, v) order, same sentinel-last
+    layout), so results are bit-identical to the unpacked path.
 
     The device-side answer to ndist.extractBlockFeaturesFromBoundaryMaps
     (reference block_edge_features.py:127-148) — no int64 keys needed, so it
@@ -574,14 +584,23 @@ def _boundary_edge_features_device_impl(
     v = jnp.concatenate(vs)
     s = jnp.concatenate(ss).astype(jnp.float32)
 
-    u, v, s = lax.sort((u, v, s), num_keys=3)
     big = jnp.int32(np.iinfo(np.int32).max)
-    valid = u != big
+    if packed:
+        # one int32 key, lexicographic order preserved; the sentinel pair
+        # (big, big) maps to the int32 max so invalid rows still sort last
+        p = jnp.where(u != big, u * jnp.int32(65536) + v, big)
+        p, s = lax.sort((p, s), num_keys=2)
+        valid = p != big
+        first = jnp.concatenate([valid[:1], p[1:] != p[:-1]]) & valid
+        # endpoints are recovered from edge_p after the segment reduction;
+        # no per-sample unpack is needed
+    else:
+        u, v, s = lax.sort((u, v, s), num_keys=3)
+        valid = u != big
+        first = jnp.concatenate(
+            [valid[:1], (u[1:] != u[:-1]) | (v[1:] != v[:-1])]
+        ) & valid
     n_samples = valid.sum()
-
-    first = jnp.concatenate(
-        [valid[:1], (u[1:] != u[:-1]) | (v[1:] != v[:-1])]
-    ) & valid
     seg = jnp.cumsum(first.astype(jnp.int32)) - 1  # -1 before first edge
     seg = jnp.where(valid, seg, max_edges)  # invalid → overflow bucket
     n_edges = first.sum()
@@ -596,7 +615,7 @@ def _boundary_edge_features_device_impl(
     smax = jax.ops.segment_max(
         jnp.where(valid, s, -jnp.inf), seg, num_segments=max_edges + 1
     )
-    idx = jnp.arange(u.shape[0], dtype=jnp.int32)
+    idx = jnp.arange(s.shape[0], dtype=jnp.int32)
     starts = jax.ops.segment_min(
         jnp.where(valid, idx, jnp.int32(np.iinfo(np.int32).max)),
         seg,
@@ -639,17 +658,26 @@ def _boundary_edge_features_device_impl(
         num_segments=max_edges * hist_bins + 1,
     )[: max_edges * hist_bins].reshape(max_edges, hist_bins)
 
-    edge_u = jax.ops.segment_min(
-        jnp.where(valid, u, big), seg, num_segments=max_edges + 1
-    )[:max_edges]
-    edge_v = jax.ops.segment_min(
-        jnp.where(valid, v, big), seg, num_segments=max_edges + 1
-    )[:max_edges]
+    if packed:
+        # p is constant within a segment: one reduction, then unpack
+        edge_p = jax.ops.segment_min(
+            jnp.where(valid, p, big), seg, num_segments=max_edges + 1
+        )[:max_edges]
+        edge_u = jnp.where(edge_p != big, edge_p // jnp.int32(65536), big)
+        edge_v = jnp.where(edge_p != big, edge_p % jnp.int32(65536), big)
+    else:
+        edge_u = jax.ops.segment_min(
+            jnp.where(valid, u, big), seg, num_segments=max_edges + 1
+        )[:max_edges]
+        edge_v = jax.ops.segment_min(
+            jnp.where(valid, v, big), seg, num_segments=max_edges + 1
+        )[:max_edges]
     return edge_u, edge_v, feats, hist, n_edges, n_samples
 
 
 @lru_cache(maxsize=32)
-def _jitted_device_features(max_edges: int, hist_bins: int, owner_shape):
+def _jitted_device_features(max_edges: int, hist_bins: int, owner_shape,
+                            packed: bool = False):
     """One cached jitted kernel per static configuration — a fresh jax.jit
     per call would re-trace and re-compile for every block."""
     import jax
@@ -659,6 +687,7 @@ def _jitted_device_features(max_edges: int, hist_bins: int, owner_shape):
         max_edges=max_edges,
         hist_bins=hist_bins,
         owner_shape=owner_shape,
+        packed=packed,
     )
     return jax.jit(fn)
 
@@ -669,16 +698,20 @@ def boundary_edge_features_device(
     max_edges: int = 16384,
     hist_bins: int = HIST_BINS,
     owner_shape=None,
+    packed: bool = False,
 ):
     """Jitted device RAG accumulator; see ``_boundary_edge_features_device_impl``.
 
     ``labels`` must be int32 (compact per-block ids — the host wrapper
     ``boundary_edge_features_tpu`` handles uint64 global labels).
+    ``packed`` is static and only valid when every label id < 32768 — the
+    host wrapper decides it from the compact id count.
     """
     fn = _jitted_device_features(
         int(max_edges),
         int(hist_bins),
         None if owner_shape is None else tuple(owner_shape),
+        bool(packed),
     )
     return fn(labels, values)
 
@@ -711,6 +744,8 @@ def boundary_edge_features_tpu(
         jnp.asarray(compact), jnp.asarray(boundary_map, jnp.float32),
         max_edges=max_edges, hist_bins=hist_bins or HIST_BINS,
         owner_shape=owner_shape,
+        # single-key packed sort whenever the compact id space fits
+        packed=uniq.size < 32767,
     )
     n = int(n_edges)
     if n > max_edges:

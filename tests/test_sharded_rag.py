@@ -188,3 +188,39 @@ def test_sharded_problem_signed_labels_wrap_like_uint64_cast(tmp_path, rng):
     # the wrapped label borders the positive ones: at least one edge
     touches = (nodes[edges] == wrapped).any(axis=1)
     assert touches.any()
+
+
+def test_packed_sort_key_bit_identical(rng):
+    """The single-int32-key RAG sort (packed=True, used whenever the compact
+    label space fits 15 bits) must be bit-identical to the 3-key path."""
+    import jax.numpy as jnp
+    from scipy import ndimage
+
+    from cluster_tools_tpu.ops import rag
+
+    raw = ndimage.gaussian_filter(rng.random((6, 32, 64)), (1, 3, 3))
+    raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype(np.float32)
+    gz, gy, gx = np.meshgrid(
+        np.arange(6) // 2, np.arange(32) // 8, np.arange(64) // 8,
+        indexing="ij",
+    )
+    lab = (1 + gz * 32 + gy * 8 + gx).astype(np.int32)
+    # a zero-label hole exercises the background skip in both paths
+    lab[2:4, 10:20, 30:40] = 0
+    for owner in (None, (4, 24, 48)):
+        outs = {}
+        for packed in (False, True):
+            outs[packed] = tuple(
+                np.asarray(x)
+                for x in rag.boundary_edge_features_device(
+                    jnp.asarray(lab), jnp.asarray(raw),
+                    max_edges=2048, packed=packed, owner_shape=owner,
+                )
+            )
+        for a, b in zip(outs[False], outs[True]):
+            assert np.array_equal(a, b)
+    # the host wrapper picks packed automatically and must match numpy
+    edges, feats = rag.boundary_edge_features_tpu(lab.astype(np.uint64), raw)
+    e2, f2 = rag.boundary_edge_features(lab.astype(np.uint64), raw)
+    assert np.array_equal(edges, e2)
+    assert np.allclose(feats, f2, rtol=1e-4, atol=1e-5)

@@ -202,14 +202,19 @@ def main():
     from cluster_tools_tpu.ops import rag
 
     labels, _ = native.dt_watershed_cpu(raw, threshold=0.5)
+    # the production wrapper packs the sort key whenever the compact label
+    # space fits 15 bits — measure the same path
+    packed = int(labels.max()) < 32767
     t_dev = timeit(
         None, REPEATS,
         sync=lambda r: r[0].block_until_ready(),
         variants=rolled_pair_variants(
             raw, labels.astype(np.int32), SPAN,
-            lambda l, v: rag.boundary_edge_features_device(l, v, max_edges=65536),
+            lambda l, v: rag.boundary_edge_features_device(
+                l, v, max_edges=65536, packed=packed),
         ),
     )
+    results["rag_packed"] = bool(packed)
     t0 = time.perf_counter()
     rag.boundary_edge_features(labels.astype(np.uint64), raw)
     t_host = time.perf_counter() - t0
