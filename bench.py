@@ -463,7 +463,9 @@ def bench_rag(x, repeats):
 
     # production (boundary_edge_features_tpu) packs the sort key whenever
     # the compact label space fits 15 bits — measure the same path
-    packed = int(labels.max()) < 32767
+    from cluster_tools_tpu.ops.rag import PACK_MAX_ID
+
+    packed = int(labels.max()) <= PACK_MAX_ID
     t_dev = timeit(
         None,
         repeats,

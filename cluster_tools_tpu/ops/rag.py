@@ -33,6 +33,32 @@ N_FEATURES = 10
 HIST_BINS = 64
 QUANTILES = (0.1, 0.25, 0.5, 0.75, 0.9)
 
+# largest label id the single-int32-key sort packing can carry: the packed
+# key u*PACK_SHIFT+v of the worst pair (PACK_MAX_ID, PACK_SHIFT-1) must stay
+# strictly below the int32-max sentinel.  ONE definition — every pack,
+# unpack, and gate site must agree or edge endpoints corrupt silently.
+PACK_SHIFT = 65536
+PACK_MAX_ID = 32766
+
+
+def pack_uv(u, v, sentinel):
+    """Order-preserving single-int32 key for (u, v) pairs (u ≤ v ≤
+    PACK_MAX_ID); sentinel rows stay the sentinel (sort last)."""
+    import jax.numpy as jnp
+
+    return jnp.where(u != sentinel, u * jnp.int32(PACK_SHIFT) + v, sentinel)
+
+
+def unpack_uv(p, sentinel):
+    """Inverse of ``pack_uv``: (u, v) per key, sentinel rows stay sentinel."""
+    import jax.numpy as jnp
+
+    ok = p != sentinel
+    return (
+        jnp.where(ok, p // jnp.int32(PACK_SHIFT), sentinel),
+        jnp.where(ok, p % jnp.int32(PACK_SHIFT), sentinel),
+    )
+
 
 def block_edges(labels: np.ndarray, ignore_zero: bool = True) -> np.ndarray:
     """Unique adjacent label pairs (u < v) over face-neighbor voxels."""
@@ -588,7 +614,7 @@ def _boundary_edge_features_device_impl(
     if packed:
         # one int32 key, lexicographic order preserved; the sentinel pair
         # (big, big) maps to the int32 max so invalid rows still sort last
-        p = jnp.where(u != big, u * jnp.int32(65536) + v, big)
+        p = pack_uv(u, v, big)
         p, s = lax.sort((p, s), num_keys=2)
         valid = p != big
         first = jnp.concatenate([valid[:1], p[1:] != p[:-1]]) & valid
@@ -663,8 +689,7 @@ def _boundary_edge_features_device_impl(
         edge_p = jax.ops.segment_min(
             jnp.where(valid, p, big), seg, num_segments=max_edges + 1
         )[:max_edges]
-        edge_u = jnp.where(edge_p != big, edge_p // jnp.int32(65536), big)
-        edge_v = jnp.where(edge_p != big, edge_p % jnp.int32(65536), big)
+        edge_u, edge_v = unpack_uv(edge_p, big)
     else:
         edge_u = jax.ops.segment_min(
             jnp.where(valid, u, big), seg, num_segments=max_edges + 1
@@ -745,7 +770,7 @@ def boundary_edge_features_tpu(
         max_edges=max_edges, hist_bins=hist_bins or HIST_BINS,
         owner_shape=owner_shape,
         # single-key packed sort whenever the compact id space fits
-        packed=uniq.size < 32767,
+        packed=uniq.size <= PACK_MAX_ID,
     )
     n = int(n_edges)
     if n > max_edges:

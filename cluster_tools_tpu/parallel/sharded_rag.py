@@ -57,8 +57,14 @@ def _edge_segments(u, v, max_edges):
     return valid, seg, n_distinct, red
 
 
-def _local_stats_table(lab, val, lab_hi, val_hi, max_edges, hist_bins):
-    """Per-shard samples → sorted sufficient-statistics table (fixed size)."""
+def _local_stats_table(lab, val, lab_hi, val_hi, max_edges, hist_bins,
+                       packed=False):
+    """Per-shard samples → sorted sufficient-statistics table (fixed size).
+
+    ``packed`` (static): single-int32-key sort ``u*65536 + v`` when every
+    global label id ≤ 32766 (caller-gated) — same order-preserving packing
+    as ops/rag._boundary_edge_features_device_impl, same bit-identical
+    results, one sort stream fewer."""
     lab_e = jnp.concatenate([lab, lab_hi[None]], 0)
     val_e = jnp.concatenate([val, val_hi[None]], 0)
 
@@ -81,7 +87,14 @@ def _local_stats_table(lab, val, lab_hi, val_hi, max_edges, hist_bins):
     v = jnp.concatenate(vs)
     s = jnp.concatenate(ss).astype(jnp.float32)
 
-    u, v, s = lax.sort((u, v, s), num_keys=3)
+    if packed:
+        from ..ops.rag import pack_uv, unpack_uv
+
+        p = pack_uv(u, v, _BIG_ID)
+        p, s = lax.sort((p, s), num_keys=2)
+        u, v = unpack_uv(p, _BIG_ID)
+    else:
+        u, v, s = lax.sort((u, v, s), num_keys=3)
     valid, seg, n_local, red = _edge_segments(u, v, max_edges)
     ones = valid.astype(jnp.float32)
 
@@ -115,15 +128,17 @@ def _hist_quantile(hist, cum, counts, q):
 
 
 @partial(
-    jax.jit, static_argnames=("max_edges", "hist_bins", "axis_name", "mesh")
+    jax.jit,
+    static_argnames=("max_edges", "hist_bins", "axis_name", "mesh", "packed"),
 )
-def _sharded_rag(labels, values, max_edges, hist_bins, axis_name, mesh):
+def _sharded_rag(labels, values, max_edges, hist_bins, axis_name, mesh,
+                 packed=False):
     def local_fn(lab, val):
         lab_hi = _neighbor_planes(lab[0], axis_name, -1)  # +z neighbor plane
         val_hi = _neighbor_planes(val[0], axis_name, -1)
         (e_u, e_v, count, ssum, ssum2, smin, smax, hist,
          n_local) = _local_stats_table(
-            lab, val, lab_hi, val_hi, max_edges, hist_bins
+            lab, val, lab_hi, val_hi, max_edges, hist_bins, packed
         )
         # a local table that truncated (> max_edges distinct edges in one
         # shard) silently drops the lexicographic tail IDENTICALLY on every
@@ -144,9 +159,15 @@ def _sharded_rag(labels, values, max_edges, hist_bins, axis_name, mesh):
         smax = gather(smax)
         hist = gather(hist)
 
-        # lexicographic (u, v) order via two stable argsorts
-        perm = jnp.argsort(v, stable=True)
-        perm = perm[jnp.argsort(u[perm], stable=True)]
+        # lexicographic (u, v) order: one argsort of the packed key when
+        # the id space fits, else two stable argsorts
+        if packed:
+            from ..ops.rag import pack_uv
+
+            perm = jnp.argsort(pack_uv(u, v, _BIG_ID), stable=True)
+        else:
+            perm = jnp.argsort(v, stable=True)
+            perm = perm[jnp.argsort(u[perm], stable=True)]
         u, v = u[perm], v[perm]
         count, ssum, ssum2 = count[perm], ssum[perm], ssum2[perm]
         smin, smax, hist = smin[perm], smax[perm], hist[perm]
@@ -200,8 +221,13 @@ def sharded_boundary_edge_features(
     axis_name: str = "data",
     max_edges: int = 16384,
     hist_bins: int = HIST_BINS,
+    max_id=None,
 ):
     """10 RAG edge features of a z-sharded volume in one collective program.
+
+    ``max_id``: the largest label id, when the caller knows it (e.g. the
+    compact node count) — gates the packed single-key sort without touching
+    the (possibly multi-host global) labels array.
 
     ``labels``: int32 compact ids (0 = background), z-extent divisible by the
     mesh size.  Returns host arrays ``(edges [n,2] int64, feats [n,10])`` in
@@ -217,8 +243,18 @@ def sharded_boundary_edge_features(
         )
     lab = put_global(labels, mesh, axis_name, dtype=np.int32)
     val = put_global(values, mesh, axis_name, dtype=np.float32)
+    # single-key packed sorts whenever the global id space fits 15 bits.
+    # The bound must come from the caller (max_id) or a HOST array: an
+    # eager labels.max() on a multi-host global jax.Array would crash
+    # (non-addressable shards) and adds a blocking reduction otherwise.
+    from ..ops.rag import PACK_MAX_ID
+
+    if max_id is None and isinstance(labels, np.ndarray) and labels.size:
+        max_id = int(labels.max())
+    packed = max_id is not None and 0 <= int(max_id) <= PACK_MAX_ID
     e_u, e_v, feats, _, n_edges, n_local_max = _sharded_rag(
-        lab, val, int(max_edges), int(hist_bins), axis_name, mesh
+        lab, val, int(max_edges), int(hist_bins), axis_name, mesh,
+        packed=bool(packed),
     )
     n_edges = int(n_edges)
     if int(n_local_max) > max_edges or n_edges > max_edges:

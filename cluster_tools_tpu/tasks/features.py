@@ -481,6 +481,10 @@ class ShardedProblemTask(VolumeSimpleTask):
         edges_c, feats = sharded_boundary_edge_features(
             compact_d, data_d, mesh=mesh,
             max_edges=int(conf.get("max_edges", 16384)),
+            # compact ids are 1..nodes.size (searchsorted+1): the exact
+            # bound gates the packed single-key sort without touching the
+            # (possibly multi-host global) device array
+            max_id=int(nodes.size),
         )
         import jax as _jax
 

@@ -224,3 +224,20 @@ def test_packed_sort_key_bit_identical(rng):
     e2, f2 = rag.boundary_edge_features(lab.astype(np.uint64), raw)
     assert np.array_equal(edges, e2)
     assert np.allclose(feats, f2, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_rag_unpacked_fallback_with_large_ids(rng):
+    """A label id past the 15-bit packing bound forces the 3-key sort path;
+    results must still match the host oracle (the packed path is covered by
+    the small-id tests above, where the gate selects it automatically)."""
+    labels, values = _fixture(rng)
+    big = labels.copy()
+    big[big == big.max()] = 40000  # > 32767: packing gate must decline
+    edges, feats = sharded_boundary_edge_features(big, values)
+    want_edges, want = boundary_edge_features(
+        big.astype(np.uint64), values.astype(np.float64)
+    )
+    np.testing.assert_array_equal(edges, want_edges)
+    np.testing.assert_allclose(
+        feats[:, [0, 2, 8, 9]], want[:, [0, 2, 8, 9]], rtol=1e-5, atol=1e-6
+    )

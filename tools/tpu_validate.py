@@ -204,7 +204,7 @@ def main():
     labels, _ = native.dt_watershed_cpu(raw, threshold=0.5)
     # the production wrapper packs the sort key whenever the compact label
     # space fits 15 bits — measure the same path
-    packed = int(labels.max()) < 32767
+    packed = int(labels.max()) <= rag.PACK_MAX_ID
     t_dev = timeit(
         None, REPEATS,
         sync=lambda r: r[0].block_until_ready(),
