@@ -23,6 +23,11 @@ The ``extra`` field carries the remaining BASELINE.md configs:
   * ``rag``           — RAG extraction + 10-feature edge accumulation vs the
     single-core vectorized numpy path (reference
     ndist.extractBlockFeaturesFromBoundaryMaps)
+  * ``infer``         — 3D U-Net forward throughput (the MXU workload:
+    bf16 convs), jax/flax predictor vs the identical model on the host
+    XLA-CPU backend
+  * ``ws_e2e``        — the WatershedWorkflow alone, tpu vs cpu-local
+    (cold + jit-cache-warm) — the literal BASELINE.md north-star workload
   * ``e2e_multicut``  — full MulticutSegmentationWorkflow wall-clock,
     ``target='tpu'`` on the default device vs the identical workflow with
     ``target='local'`` forced onto the host XLA-CPU backend in a subprocess
@@ -483,6 +488,82 @@ def bench_rag(x, repeats):
     return mvox, t_host / t_dev
 
 
+def bench_inference(repeats, shape=(32, 256, 256), quick=False):
+    """3D U-Net forward throughput — the MXU workload (bf16 convs).
+
+    The reference's inference subsystem is its production NN path
+    (inference/inference.py; frameworks wrap external torch models); here
+    the jax/flax UNet3D predictor runs the same block geometry.  Baseline:
+    the IDENTICAL model on the host XLA-CPU backend in a subprocess (the
+    same same-framework/local-backend methodology as the e2e configs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.models.unet import UNet3D
+
+    if quick:
+        shape = (16, 128, 128)
+    model = UNet3D(out_channels=3, initial_features=16, depth=3,
+                   scale_factors=[[1, 2, 2], [2, 2, 2]])
+    rng0 = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((1, 1) + shape, jnp.float32)
+    params = model.init(rng0, x0)
+    fwd = jax.jit(lambda p, v: model.apply(p, v))
+
+    vol = make_volume(shape, seed=5)
+    variants = [
+        (lambda v: lambda: fwd(params, jnp.asarray(v[None, None])))(v)
+        for v in _rolled(vol, repeats + 1)
+    ]
+    t_dev = timeit(None, repeats, variants=variants)
+    mvox = np.prod(shape) / t_dev / 1e6
+    res = {"infer_mvox_s": round(mvox, 3)}
+    _suspect_throughput(mvox, res, "infer_timing_suspect")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "infer_cpu.py")
+        with open(script, "w") as f:
+            f.write(
+                "import json, os, sys, time\n"
+                "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                f"sys.path.insert(0, {here!r})\n"
+                "import jax\n"
+                "jax.config.update('jax_platforms', 'cpu')\n"
+                "import jax.numpy as jnp\n"
+                "import numpy as np\n"
+                "from cluster_tools_tpu.models.unet import UNet3D\n"
+                "from bench import make_volume, timeit\n"
+                "model = UNet3D(out_channels=3, initial_features=16, "
+                "depth=3, scale_factors=[[1, 2, 2], [2, 2, 2]])\n"
+                f"shape = {tuple(shape)!r}\n"
+                "x0 = jnp.zeros((1, 1) + shape, jnp.float32)\n"
+                "params = model.init(jax.random.PRNGKey(0), x0)\n"
+                "fwd = jax.jit(lambda p, v: model.apply(p, v))\n"
+                "vol = make_volume(shape, seed=5)\n"
+                "t = timeit(lambda: fwd(params, "
+                "jnp.asarray(vol[None, None])), 2)\n"
+                "print(json.dumps({'t': t}))\n"
+            )
+        try:
+            # well under the driver's 900 s infer budget: a slow baseline
+            # must not take the measured device numbers down with it
+            out = subprocess.run(
+                [sys.executable, script], capture_output=True, text=True,
+                timeout=420,
+            )
+            if out.returncode != 0:
+                raise RuntimeError(out.stderr[-400:])
+            t_host = json.loads(out.stdout.strip().splitlines()[-1])["t"]
+            res["infer_vs_local"] = round(t_host / t_dev, 2)
+            log(f"[infer] device {t_dev*1e3:.1f} ms ({mvox:.1f} Mvox/s)  "
+                f"cpu-local {t_host*1e3:.1f} ms -> {res['infer_vs_local']}x")
+        except Exception as e:
+            log(f"[infer] cpu baseline failed ({e}); device "
+                f"{t_dev*1e3:.1f} ms ({mvox:.1f} Mvox/s)")
+    return res
+
+
 def bench_ws_e2e(x, block_shape):
     """WatershedWorkflow wall-clock, tpu vs cpu-local — the literal
     BASELINE.md north-star workload (block IO + fused DT-WS dispatch +
@@ -649,7 +730,7 @@ def main():
     parser.add_argument("--repeats", type=int, default=5)
     parser.add_argument(
         "--only", default=None,
-        help="comma-separated subset: dtws,batched,cc,mws,rag,ws,e2e",
+        help="comma-separated subset: dtws,batched,cc,mws,rag,infer,ws,e2e",
     )
     parser.add_argument(
         "--platform", default=None,
@@ -708,7 +789,8 @@ def main():
         here = os.path.abspath(__file__)
         for cfg, budget_s in [
             ("dtws", 900), ("batched", 900), ("cc", 900),
-            ("mws", 600), ("rag", 600), ("ws", 1200), ("e2e", 1800),
+            ("mws", 600), ("rag", 600), ("infer", 900), ("ws", 1200),
+            ("e2e", 1800),
         ]:
             cmd = [sys.executable, here, "--only", cfg,
                    "--repeats", str(args.repeats)]
@@ -783,6 +865,8 @@ def main():
         extra["rag_mvox_s"] = round(rag_v, 3)
         extra["rag_vs_baseline"] = round(rag_r, 3) if rag_r is not None else None
         _suspect_throughput(rag_v, extra, "rag_timing_suspect")
+    if want("infer"):
+        extra.update(bench_inference(args.repeats, quick=args.quick))
     if want("ws"):
         extra.update(bench_ws_e2e(make_volume(e2e_shape, seed=3), e2e_block))
     if want("e2e"):

@@ -92,10 +92,25 @@ def _local_stats_table(lab, val, lab_hi, val_hi, max_edges, hist_bins,
 
         p = pack_uv(u, v, _BIG_ID)
         p, s = lax.sort((p, s), num_keys=2)
-        u, v = unpack_uv(p, _BIG_ID)
+        # segment machinery straight off the packed key: one diff per
+        # boundary, and endpoints recovered by ONE edge-level reduction +
+        # unpack — no per-sample div/mod (mirrors ops/rag's packed path)
+        valid = p != _BIG_ID
+        first = jnp.concatenate([valid[:1], p[1:] != p[:-1]]) & valid
+        seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+        seg = jnp.where(valid, seg, max_edges)
+        n_local = first.sum()
+
+        def red(x, op=jax.ops.segment_sum):
+            return op(x, seg, num_segments=max_edges + 1)[:max_edges]
+
+        e_p = red(jnp.where(valid, p, _BIG_ID), op=jax.ops.segment_min)
+        e_u, e_v = unpack_uv(e_p, _BIG_ID)
     else:
         u, v, s = lax.sort((u, v, s), num_keys=3)
-    valid, seg, n_local, red = _edge_segments(u, v, max_edges)
+        valid, seg, n_local, red = _edge_segments(u, v, max_edges)
+        e_u = red(jnp.where(valid, u, _BIG_ID), op=jax.ops.segment_min)
+        e_v = red(jnp.where(valid, v, _BIG_ID), op=jax.ops.segment_min)
     ones = valid.astype(jnp.float32)
 
     count = red(ones)
@@ -103,8 +118,6 @@ def _local_stats_table(lab, val, lab_hi, val_hi, max_edges, hist_bins,
     ssum2 = red(s * s * ones)
     smin = red(jnp.where(valid, s, jnp.inf), op=jax.ops.segment_min)
     smax = red(jnp.where(valid, s, -jnp.inf), op=jax.ops.segment_max)
-    e_u = red(jnp.where(valid, u, _BIG_ID), op=jax.ops.segment_min)
-    e_v = red(jnp.where(valid, v, _BIG_ID), op=jax.ops.segment_min)
     bins = jnp.clip((s * hist_bins).astype(jnp.int32), 0, hist_bins - 1)
     flat = jnp.where(valid, seg * hist_bins + bins, max_edges * hist_bins)
     hist = jax.ops.segment_sum(
