@@ -130,6 +130,12 @@ def use_pallas_cc() -> bool:
     return _mode("cc") == "pallas"
 
 
+def use_slices_cc() -> bool:
+    """Whether volume CC uses the XLA per-slice sweeps + z-merge structure
+    (CTT_CC_MODE=slices) instead of whole-volume 3d propagation."""
+    return _mode("cc") == "slices"
+
+
 def use_pallas_dtws() -> bool:
     """Whether the per-slice DT-watershed uses the fused Pallas kernel
     (ops/pallas_dtws.py, CTT_DTWS_MODE=pallas)."""
@@ -153,7 +159,7 @@ def force_flood_mode(mode):
 
 
 def force_cc_mode(mode):
-    """Scoped CC-mode override ('pallas' | 'xla')."""
+    """Scoped CC-mode override ('pallas' | 'slices' | 'xla')."""
     return _force("cc", mode)
 
 

@@ -176,6 +176,37 @@ def connected_components_raw(
     return jnp.where(mask, label, jnp.int32(-1))
 
 
+def merge_slice_labels(
+    mask: jnp.ndarray, sliced: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Volume CC from per-slice minimal-flat-index labels (−1 background):
+    one device pointer-jumping union-find over the z-face equivalences, then
+    consecutive ranking.  Shared by the Pallas per-slice kernel
+    (ops/pallas_cc.py) and the XLA ``slices`` CC mode — valid for
+    connectivity 1 only (z-diagonal adjacency would need more edges)."""
+    from .unionfind import merge_labels_device
+
+    n, h, w = mask.shape
+    size = n * h * w
+    # z-face equivalences (self-loops where either side is background pad
+    # the static edge table)
+    up = sliced[:-1].reshape(-1)
+    dn = sliced[1:].reshape(-1)
+    both = (up >= 0) & (dn >= 0)
+    edges = jnp.stack(
+        [jnp.where(both, up, 0), jnp.where(both, dn, 0)], axis=1
+    )
+    parent = jnp.arange(size, dtype=jnp.int32)
+    roots = merge_labels_device(parent, edges)
+    flat = jnp.where(
+        mask.reshape(-1),
+        roots[jnp.clip(sliced.reshape(-1), 0, size - 1)],
+        -1,
+    )
+    labels, n_comp = consecutive_from_flat_roots(flat, size)
+    return labels.reshape(mask.shape), n_comp
+
+
 @partial(jax.jit, static_argnames=("connectivity", "per_slice"))
 def connected_components(
     mask: jnp.ndarray,
@@ -189,15 +220,30 @@ def connected_components(
     component roots (minimal flat indices) with a cumsum — no dynamic shapes.
     See ``connected_components_raw`` for ``partition`` / ``per_slice``.
 
-    ``CTT_CC_MODE=pallas`` routes eligible volumes (3d, connectivity 1, no
-    partition, lane-aligned slices, TPU backend) through the VMEM-resident
-    per-slice kernel + z-merge (ops/pallas_cc.py) — identical labels.
+    Mode switches (read at trace time, ops/_backend.py):
+      * ``CTT_CC_MODE=pallas`` — VMEM-resident per-slice kernel + z-merge
+        (ops/pallas_cc.py) on eligible volumes (3d, connectivity 1, no
+        partition, lane-aligned slices, TPU backend);
+      * ``CTT_CC_MODE=slices`` — the same slices+z-merge STRUCTURE in plain
+        XLA: per-slice 2d sweeps converge in far fewer rounds than
+        whole-volume 3d propagation (a 3d component can wind through z),
+        and the z-faces merge in one log-depth union-find.
+    Both produce identical labels to the default path.
     """
     if partition is None:
+        from . import _backend
         from .pallas_cc import pallas_cc_available, pallas_connected_components
 
         if pallas_cc_available(mask.shape, connectivity, per_slice):
             return pallas_connected_components(mask)
+        if (
+            _backend.use_slices_cc()
+            and not per_slice and mask.ndim == 3 and connectivity == 1
+        ):
+            sliced = connected_components_raw(
+                mask, connectivity, None, per_slice=True
+            )
+            return merge_slice_labels(mask, sliced)
     raw = connected_components_raw(mask, connectivity, partition, per_slice)
     size = int(np.prod(mask.shape))
     labels, n = consecutive_from_flat_roots(raw.reshape(-1), size)

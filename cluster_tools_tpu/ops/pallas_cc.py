@@ -124,29 +124,11 @@ def pallas_connected_components(mask, interpret: bool = False):
     Returns ``(labels, n)`` with consecutive components 1..n in minimal-
     flat-index order — the same contract as ``ops.cc.connected_components``.
     """
-    from .unionfind import merge_labels_device
+    from .cc import merge_slice_labels
 
     mask = mask.astype(bool)
-    n, h, w = mask.shape
     sliced = cc_slices(mask, interpret=interpret)
-
-    size = n * h * w
-    # z-face equivalences (self-loops where either side is background pad
-    # the static edge table)
-    up = sliced[:-1].reshape(-1)
-    dn = sliced[1:].reshape(-1)
-    both = (up >= 0) & (dn >= 0)
-    edges = jnp.stack(
-        [jnp.where(both, up, 0), jnp.where(both, dn, 0)], axis=1
-    )
-    parent = jnp.arange(size, dtype=jnp.int32)
-    roots = merge_labels_device(parent, edges)
-
-    flat = jnp.where(mask.reshape(-1), roots[jnp.clip(sliced.reshape(-1), 0, size - 1)], -1)
-    from .cc import consecutive_from_flat_roots
-
-    labels, n_comp = consecutive_from_flat_roots(flat, size)
-    return labels.reshape(mask.shape), n_comp
+    return merge_slice_labels(mask, sliced)
 
 
 def pallas_cc_available(shape, connectivity: int, per_slice: bool) -> bool:

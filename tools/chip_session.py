@@ -101,6 +101,15 @@ def derive_modes(results: dict) -> dict:
         modes["CTT_FLOOD_MODE"] = "pallas"
     if results.get("pallas_cc_exact") and results.get("pallas_cc_wins"):
         modes["CTT_CC_MODE"] = "pallas"
+    elif (
+        results.get("cc_slices_exact")
+        and "cc_slices_ms" in results
+        and "cc_assoc_ms" in results
+        and "cc_seq_ms" in results
+        and results["cc_slices_ms"]
+        < min(results["cc_assoc_ms"], results["cc_seq_ms"])
+    ):
+        modes["CTT_CC_MODE"] = "slices"
     if results.get("pallas_dtws_exact") and results.get("pallas_dtws_wins"):
         modes["CTT_DTWS_MODE"] = "pallas"
     if "best_device_batch" in results:

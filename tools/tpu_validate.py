@@ -90,6 +90,34 @@ def main():
         results[f"cc_{mode}_ms"] = round(t * 1e3, 1)
         print(f"connected_components[{mode}]: {t*1e3:.1f} ms")
 
+    # -- XLA slices+z-merge CC mode (CTT_CC_MODE=slices) --------------------
+    # structure of the Pallas path in plain XLA; measured 5x SLOWER on the
+    # 1-core CPU fallback (both stages are round-bound) — only pinned if
+    # the chip's bandwidth flips it.  Baseline pinned to the default XLA
+    # path (a live pin file could otherwise make the reference the slices
+    # path itself); timing runs on a FRESH disjoint input span.
+    with _backend.force_cc_mode("xla"):
+        want_l, want_n = C.connected_components(masks[0])
+    slices_masks = [
+        jnp.asarray(v < 0.5) for v in _rolled(raw, SPAN, start=2 * SPAN)
+    ]
+    with _backend.force_cc_mode("slices"):
+        got_l, got_n = C.connected_components(masks[0])
+        slices_agree = bool(jnp.array_equal(got_l, want_l)) and int(
+            got_n) == int(want_n)
+        results["cc_slices_exact"] = slices_agree
+        t = timeit(
+            None, REPEATS,
+            sync=lambda r: r[0].block_until_ready(),
+            variants=[
+                (lambda m: lambda: C.connected_components(m))(m)
+                for m in slices_masks
+            ],
+        )
+        results["cc_slices_ms"] = round(t * 1e3, 1)
+        print(f"connected_components[slices]: {t*1e3:.1f} ms "
+              f"(exact={slices_agree})")
+
     # -- Pallas per-slice flood: Mosaic lowering + perf vs the XLA flood ----
     # (the only place the real-hardware lowering of ops/pallas_flood.py is
     # exercised — the CPU interpreter covers correctness, not Mosaic)
