@@ -91,7 +91,16 @@ def fetch_floor_s(repeats: int = 5) -> float:
     import jax.numpy as jnp
 
     x = jnp.arange(8, dtype=jnp.int32)
-    np.asarray(x[:1])  # materialize + first-fetch path
+    warm = x + jnp.int32(100)  # same shape/dtype, DIFFERENT buffer
+    np.asarray(x[:1])  # materialize x itself
+    # Pre-compile every distinct slice start (each start is its own sliced
+    # executable; timing a first-time compile would overstate the floor) —
+    # but warm on a DIFFERENT input array: executables are shared per
+    # (program, shape) while any remote execution-result cache is keyed on
+    # the input, so each timed call below is a first execution of
+    # (program_i, x) and cannot be served from cache.
+    for i in range(min(repeats, 8)):
+        np.asarray(warm[i % 8 : i % 8 + 1])
     samples = []
     for i in range(repeats):
         t0 = time.perf_counter()
@@ -546,11 +555,11 @@ def bench_inference(repeats, shape=(32, 256, 256), quick=False):
                 "print(json.dumps({'t': t}))\n"
             )
         try:
-            # well under the driver's 900 s infer budget: a slow baseline
+            # well under the driver's 150 s infer budget: a slow baseline
             # must not take the measured device numbers down with it
             out = subprocess.run(
                 [sys.executable, script], capture_output=True, text=True,
-                timeout=420,
+                timeout=90,
             )
             if out.returncode != 0:
                 raise RuntimeError(out.stderr[-400:])
@@ -605,11 +614,11 @@ def bench_ws_e2e(x, block_shape):
             "ws_e2e_warm_wall_s": round(t_dev_warm, 2),
         }
         try:
-            # below the driver's 1200 s ws budget so a slow baseline can
+            # below the driver's 450 s ws budget so a slow baseline can
             # never take the already-measured device numbers down with it
             out = subprocess.run(
                 [sys.executable, script], capture_output=True, text=True,
-                timeout=900,
+                timeout=300,
             )
         except subprocess.TimeoutExpired:
             log("[ws-e2e] cpu baseline timed out; reporting device side only")
@@ -671,7 +680,9 @@ def bench_e2e(x, block_shape, platform=None):
         try:
             sh_out = subprocess.run(
                 [sys.executable, sh_script], capture_output=True, text=True,
-                timeout=2400,  # warm=True runs the pipeline twice
+                # warm=True runs the pipeline twice, but the share of the
+                # driver's 840 s e2e budget left for the baseline caps this
+                timeout=360,
             )
             if sh_out.returncode != 0:
                 raise RuntimeError(sh_out.stderr[-500:])
@@ -704,12 +715,24 @@ def bench_e2e(x, block_shape, platform=None):
                 "print(json.dumps({'wall_s': t}))\n"
             )
         t0 = time.perf_counter()
-        out = subprocess.run(
-            [sys.executable, script], capture_output=True, text=True, timeout=3600
-        )
         warm = {"e2e_warm_wall_s": round(t_dev_warm, 2)}
         if t_sharded_warm is not None:
             warm["e2e_sharded_problem_warm_wall_s"] = round(t_sharded_warm, 2)
+        # keep the baseline timeout safely below the driver's e2e config
+        # budget: a slow CPU baseline must cost only the vs_baseline ratio,
+        # never the device numbers already measured above
+        baseline_budget = float(
+            os.environ.get("CTT_BENCH_E2E_BASELINE_TIMEOUT_S", "360")
+        )
+        try:
+            out = subprocess.run(
+                [sys.executable, script], capture_output=True, text=True,
+                timeout=baseline_budget,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"[e2e] cpu baseline timed out after {baseline_budget:.0f}s; "
+                "reporting device numbers without vs_baseline")
+            return x.size / t_dev / 1e6, None, t_sharded, warm
         if out.returncode != 0:
             log(f"[e2e] cpu baseline failed:\n{out.stderr[-2000:]}")
             return x.size / t_dev / 1e6, None, t_sharded, warm
@@ -754,6 +777,21 @@ def main():
         # per-config timeout, so one slow/failing/hanging config cannot lose
         # the headline metric or the JSON line.  Sequential — the single TPU
         # chip tolerates no concurrent clients.
+        #
+        # The contract is UNLOSABLE by construction (round 3 lost it to a
+        # dead tunnel, round 4 to a driver-budget mismatch — see VERDICT r4):
+        #   * the merged JSON line is (re)printed after EVERY config, flushed
+        #     — the last stdout line always wins, so a SIGKILL mid-run still
+        #     leaves the best contract measured so far;
+        #   * a global wall-clock deadline is enforced HERE, inside bench.py
+        #     (CTT_BENCH_DEADLINE_S, default 2400 s), clamping each config's
+        #     budget to the time remaining and skipping configs that no
+        #     longer fit — bench.py exits 0 with a valid contract well before
+        #     any sane driver budget expires;
+        #   * configs run in priority order: the headline metric first, then
+        #     the north-star workloads, then the per-kernel configs.
+        t_start = time.perf_counter()
+        deadline_s = float(os.environ.get("CTT_BENCH_DEADLINE_S", "2400"))
         merged = {
             "metric": "dt_watershed_throughput_per_chip",
             "value": None,
@@ -761,6 +799,11 @@ def main():
             "vs_baseline": None,
             "extra": {},
         }
+
+        def emit():
+            print(json.dumps(merged), flush=True)
+
+        emit()  # a valid (null) contract exists from second zero
         if args.platform is None:
             # the default backend is the TPU chip behind the axon tunnel; a
             # wedged tunnel makes every device query HANG (not fail), which
@@ -774,7 +817,7 @@ def main():
                     [sys.executable, "-c",
                      "import sys, jax; jax.devices(); "
                      "sys.exit(0 if jax.default_backend() == 'tpu' else 3)"],
-                    capture_output=True, timeout=180,
+                    capture_output=True, timeout=150,
                 )
                 alive = probe.returncode == 0
             except subprocess.TimeoutExpired:
@@ -787,11 +830,19 @@ def main():
                 merged["extra"]["tpu_unreachable"] = True
         merged["extra"]["platform"] = args.platform or "default(tpu)"
         here = os.path.abspath(__file__)
+        # Priority order; worst-case static sum (2370 s) fits the default
+        # deadline, and the remaining-time clamp keeps any overrun honest.
         for cfg, budget_s in [
-            ("dtws", 900), ("batched", 900), ("cc", 900),
-            ("mws", 600), ("rag", 600), ("infer", 900), ("ws", 1200),
-            ("e2e", 1800),
+            ("dtws", 420), ("ws", 450), ("e2e", 840),
+            ("cc", 180), ("mws", 120), ("rag", 120),
+            ("batched", 90), ("infer", 150),
         ]:
+            remaining = deadline_s - (time.perf_counter() - t_start)
+            budget_s = min(budget_s, int(remaining) - 15)
+            if budget_s < 60:
+                log(f"[{cfg}] skipped: {remaining:.0f}s left of the "
+                    f"{deadline_s:.0f}s global bench deadline")
+                continue
             cmd = [sys.executable, here, "--only", cfg,
                    "--repeats", str(args.repeats)]
             if args.quick:
@@ -818,7 +869,8 @@ def main():
                 merged["value"] = part["value"]
                 merged["vs_baseline"] = part["vs_baseline"]
             merged["extra"].update(part.get("extra") or {})
-        print(json.dumps(merged))
+            emit()  # checkpoint the contract — last line wins
+        emit()
         return
 
     only = set(args.only.split(","))

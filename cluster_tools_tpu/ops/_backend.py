@@ -69,6 +69,15 @@ def _file_pins() -> dict:
             pins = dict(data["modes"])
     except (OSError, ValueError):
         pins = {}
+    if pins:
+        # implicit mode changes must be traceable: a process whose backend
+        # happens to match the committed pin file inherits these silently
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "loaded %s pin(s) for backend %r from %s: %s",
+            len(pins), backend, path, pins,
+        )
     _PINS_CACHE[backend] = pins
     return pins
 

@@ -177,11 +177,21 @@ def _mws_parallel_greedy(uv, weights, attractive, n_nodes: int,
         )
         payload = jnp.concatenate([jnp.full((m,), big, jnp.int32), idx])
         sA, sB, sT, sP = lax.sort((A2, B2, tag, payload), num_keys=3)
-        hit = (
-            (sA[1:] == sA[:-1]) & (sB[1:] == sB[:-1])
-            & (sT[:-1] == 0) & (sT[1:] == 1)
+        # a (A, B) run may hold SEVERAL query rows (best-of-A and best-of-B
+        # edges of the same cluster pair); tags sort mutex(0) < query(1), so
+        # "run contains a mutex row" == "the run's first row is a mutex row".
+        # Propagate that over the whole run (cummax of run-start positions +
+        # gather) so every query row in the run sees the flag — not just the
+        # one adjacent to a mutex row.
+        idx2 = jnp.arange(2 * m, dtype=jnp.int32)
+        run_start = jnp.concatenate(
+            [
+                jnp.ones((1,), bool),
+                (sA[1:] != sA[:-1]) | (sB[1:] != sB[:-1]),
+            ]
         )
-        hit = jnp.concatenate([jnp.zeros((1,), bool), hit])
+        start_pos = lax.cummax(jnp.where(run_start, idx2, 0))
+        hit = (sT == 1) & (sT[start_pos] == 0)
         mutexed = (
             jnp.zeros((m + 1,), jnp.int32)
             .at[jnp.where(sT == 1, sP, big)].max(hit.astype(jnp.int32))

@@ -43,10 +43,19 @@ PACK_MAX_ID = 32766
 
 def pack_uv(u, v, sentinel):
     """Order-preserving single-int32 key for (u, v) pairs (u ≤ v ≤
-    PACK_MAX_ID); sentinel rows stay the sentinel (sort last)."""
+    PACK_MAX_ID); sentinel rows stay the sentinel (sort last).
+
+    Sentinel endpoints are masked to 0 BEFORE the multiply: packing the
+    sentinel itself would overflow int32, and while XLA wraps
+    deterministically, relying on wrap semantics would trip any future
+    overflow checking."""
     import jax.numpy as jnp
 
-    return jnp.where(u != sentinel, u * jnp.int32(PACK_SHIFT) + v, sentinel)
+    ok = u != sentinel
+    packed = (
+        jnp.where(ok, u, 0) * jnp.int32(PACK_SHIFT) + jnp.where(ok, v, 0)
+    )
+    return jnp.where(ok, packed, sentinel)
 
 
 def unpack_uv(p, sentinel):
