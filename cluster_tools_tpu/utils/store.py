@@ -6,9 +6,12 @@ module provides the same ``file_reader(path, mode)`` façade as a small self-con
 implementation:
 
   * ``.zarr`` → zarr v2 directory store (``.zarray`` metadata, ``i.j.k`` chunk files,
-    raw or zlib compression) — readable by standard zarr implementations;
+    raw/zlib/blosc compression — blosc via the system libblosc, all cnames +
+    byte/bit shuffle, the zarr-python default codec) — readable by standard zarr
+    implementations;
   * ``.n5``   → n5 directory store (``attributes.json``, reversed dimension order,
-    big-endian chunks with the mode-0 header, raw/gzip) — readable by z5py/n5 java;
+    big-endian chunks with the mode-0 header, raw/gzip/blosc) — readable by
+    z5py/n5 java;
   * ``.h5`` / ``.hdf5`` → h5py.
 
 A ``RaggedDataset`` covers the reference's variable-length chunks (per-block graph /
@@ -117,6 +120,31 @@ class Attributes:
 # ---------------------------------------------------------------------------
 
 
+def _blosc_mod():
+    from . import blosc
+
+    return blosc
+
+
+# internal compression spec: None | "zlib" | "gzip" | blosc dict
+def _is_blosc(compression) -> bool:
+    return isinstance(compression, dict) and compression.get("id") == "blosc"
+
+
+def _normalize_blosc(spec) -> dict:
+    """Blosc spec with the ecosystem defaults (zarr-python: lz4, clevel 5,
+    byte shuffle, auto blocksize) filled in; ``spec`` may be the string
+    'blosc', a zarr compressor dict, or an n5 compression dict."""
+    src = spec if isinstance(spec, dict) else {}
+    return {
+        "id": "blosc",
+        "cname": src.get("cname", "lz4"),
+        "clevel": int(src.get("clevel", 5)),
+        "shuffle": int(src.get("shuffle", 1)),
+        "blocksize": int(src.get("blocksize", 0)),
+    }
+
+
 class _ZarrFormat:
     """zarr v2 directory layout."""
 
@@ -131,7 +159,18 @@ class _ZarrFormat:
 
     @staticmethod
     def write_meta(path: str, shape, chunks, dtype: np.dtype, compression) -> None:
-        compressor = None if compression is None else {"id": "zlib", "level": 1}
+        if compression is None:
+            compressor = None
+        elif _is_blosc(compression):
+            compressor = {
+                "id": "blosc",
+                "cname": compression["cname"],
+                "clevel": compression["clevel"],
+                "shuffle": compression["shuffle"],
+                "blocksize": compression["blocksize"],
+            }
+        else:
+            compressor = {"id": "zlib", "level": 1}
         meta = {
             "zarr_format": 2,
             "shape": list(shape),
@@ -153,10 +192,12 @@ class _ZarrFormat:
             compression = None
         elif comp.get("id") in ("zlib", "gzip"):
             compression = comp["id"]
+        elif comp.get("id") == "blosc":
+            compression = _normalize_blosc(comp)
         else:
             raise ValueError(
                 f"unsupported zarr compressor {comp.get('id')!r} in {path} "
-                "(supported: null, zlib, gzip)"
+                "(supported: null, zlib, gzip, blosc)"
             )
         if meta.get("filters"):
             raise ValueError(f"zarr filters are not supported ({path})")
@@ -181,13 +222,21 @@ class _ZarrFormat:
             full[tuple(slice(0, s) for s in data.shape)] = data
             data = full
         raw = np.ascontiguousarray(data).tobytes()
+        if _is_blosc(compression):
+            return _blosc_mod().compress(
+                raw, data.dtype.itemsize, cname=compression["cname"],
+                clevel=compression["clevel"], shuffle=compression["shuffle"],
+                blocksize=compression["blocksize"],
+            )
         if compression == "gzip":
             return gzip.compress(raw, 1)
         return zlib.compress(raw, 1) if compression else raw
 
     @staticmethod
     def decode_chunk(payload: bytes, chunk_shape, dtype: np.dtype, compression):
-        if compression == "gzip":
+        if _is_blosc(compression):
+            payload = _blosc_mod().decompress(payload)
+        elif compression == "gzip":
             payload = gzip.decompress(payload)
         elif compression:
             payload = zlib.decompress(payload)
@@ -226,16 +275,25 @@ class _N5Format:
     def write_meta(path: str, shape, chunks, dtype: np.dtype, compression) -> None:
         meta_path = os.path.join(path, _N5Format.array_meta)
         meta = _read_json(meta_path) if os.path.exists(meta_path) else {}
+        if compression is None:
+            n5_comp = {"type": "raw"}
+        elif _is_blosc(compression):
+            n5_comp = {
+                "type": "blosc",
+                "cname": compression["cname"],
+                "clevel": compression["clevel"],
+                "shuffle": compression["shuffle"],
+                "blocksize": compression["blocksize"],
+                "nthreads": 1,
+            }
+        else:
+            n5_comp = {"type": "gzip", "level": 1}
         meta.update(
             {
                 "dimensions": list(reversed(shape)),
                 "blockSize": list(reversed(chunks)),
                 "dataType": dtype.name,
-                "compression": (
-                    {"type": "raw"}
-                    if compression is None
-                    else {"type": "gzip", "level": 1}
-                ),
+                "compression": n5_comp,
             }
         )
         _write_json(meta_path, meta)
@@ -243,14 +301,21 @@ class _N5Format:
     @staticmethod
     def read_meta(path: str):
         meta = _read_json(os.path.join(path, _N5Format.array_meta))
-        ctype = meta.get("compression", {"type": "raw"})["type"]
-        if ctype not in ("raw", "gzip"):
+        n5_comp = meta.get("compression", {"type": "raw"})
+        ctype = n5_comp["type"]
+        if ctype not in ("raw", "gzip", "blosc"):
             raise ValueError(f"unsupported n5 compression {ctype!r} in {path}")
+        if ctype == "raw":
+            compression = None
+        elif ctype == "blosc":
+            compression = _normalize_blosc(n5_comp)
+        else:
+            compression = "gzip"
         return {
             "shape": tuple(reversed(meta["dimensions"])),
             "chunks": tuple(reversed(meta["blockSize"])),
             "dtype": np.dtype(meta["dataType"]),
-            "compression": None if ctype == "raw" else "gzip",
+            "compression": compression,
             "separator": "/",
             "fill_value": 0,
         }
@@ -267,7 +332,13 @@ class _N5Format:
         if n_varlen is not None:
             header += struct.pack(">I", n_varlen)
         raw = np.ascontiguousarray(be).tobytes()
-        if compression:
+        if _is_blosc(compression):
+            raw = _blosc_mod().compress(
+                raw, be.dtype.itemsize, cname=compression["cname"],
+                clevel=compression["clevel"], shuffle=compression["shuffle"],
+                blocksize=compression["blocksize"],
+            )
+        elif compression:
             raw = gzip.compress(raw, 1)
         return header + raw
 
@@ -285,7 +356,9 @@ class _N5Format:
         if mode == 1:  # varlength mode carries an extra element count
             offset += 4
         raw = payload[offset:]
-        if compression:
+        if _is_blosc(compression):
+            raw = _blosc_mod().decompress(raw)
+        elif compression:
             raw = gzip.decompress(raw)
         be_dtype = np.dtype(_N5Format._DTYPES[dtype.name])
         arr = np.frombuffer(raw, dtype=be_dtype).astype(dtype)
@@ -428,7 +501,9 @@ class Dataset:
         offset = 4 + 4 * ndim
         (n_elements,) = struct.unpack(">I", payload[offset : offset + 4])
         raw = payload[offset + 4 :]
-        if self.compression:
+        if _is_blosc(self.compression):
+            raw = _blosc_mod().decompress(raw)
+        elif self.compression:
             raw = gzip.decompress(raw)
         be_dtype = np.dtype(_N5Format._DTYPES[self.dtype.name])
         return np.frombuffer(raw, dtype=be_dtype)[:n_elements].astype(self.dtype)
@@ -666,6 +741,19 @@ class Group:
         if chunks is None:
             chunks = tuple(min(s, 64) for s in shape)
         chunks = tuple(min(c, s) if s > 0 else c for c, s in zip(chunks, shape))
+        # normalize/validate the compression spec BEFORE any destructive
+        # step: the exist_ok overwrite below rmtree's the old array, and a
+        # late failure (e.g. missing libblosc) must not have deleted data
+        if compression == "blosc" or _is_blosc(compression):
+            compression = _normalize_blosc(compression)
+            if not _blosc_mod().available():
+                raise RuntimeError(
+                    "compression='blosc' requires the system libblosc"
+                )
+        elif compression not in (None, "raw", "gzip"):
+            compression = "gzip"
+        if compression == "raw":
+            compression = None
         p = os.path.join(self.path, key)
         if self._fmt.is_array(p):
             if not exist_ok:
@@ -685,10 +773,6 @@ class Group:
             grp = grp.require_group(part)
         dpath = os.path.join(grp.path, parts[-1])
         os.makedirs(dpath, exist_ok=True)
-        if compression not in (None, "raw", "gzip"):
-            compression = "gzip"
-        if compression == "raw":
-            compression = None
         self._fmt.write_meta(dpath, tuple(shape), tuple(chunks), np.dtype(dtype), compression)
         ds = Dataset(dpath, self._fmt)
         if data is not None:

