@@ -61,6 +61,29 @@ def prep_add_sigmoid(apply_fn):
 PREP_MODELS = {"add_sigmoid": prep_add_sigmoid, None: lambda f: f}
 
 
+# torch-side surgery: operates on nn.Module objects (the reference's hooks
+# mutate the module graph, prep_model.py:9-23); the jax hooks above wrap the
+# apply function instead — same contract, idiomatic to each framework
+def _torch_extract_unet(model):
+    return model.unet
+
+
+def _torch_add_sigmoid(model):
+    import torch.nn as nn
+
+    wrapped = nn.Sequential(model, nn.Sigmoid())
+    # keep channel introspection working through the wrapper
+    wrapped.out_channels = getattr(model, "out_channels", None)
+    return wrapped
+
+
+TORCH_PREP_MODELS = {
+    "extract_unet": _torch_extract_unet,
+    "add_sigmoid": _torch_add_sigmoid,
+    None: lambda m: m,
+}
+
+
 # -- test-time augmentation ---------------------------------------------------
 
 
@@ -195,31 +218,139 @@ class JaxPredictor(BasePredictor):
         return np.asarray(self._apply(self.params, xb))[:n]
 
 
+def _import_dotted(path: str):
+    """Resolve ``package.module.Attr`` to the attribute object."""
+    import importlib
+
+    mod_name, _, attr = path.rpartition(".")
+    if not mod_name:
+        raise ValueError(f"model_class must be a dotted path, got {path!r}")
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def _load_torch_model(checkpoint_path, use_best, model_class, model_kwargs):
+    """Every checkpoint flavor the reference stack produces, one loader:
+
+      * TorchScript archive → ``torch.jit.load`` (no class import needed);
+      * pickled eager ``nn.Module`` → ``torch.load`` (reference
+        PytorchPredicter, frameworks.py:76: ``torch.load(model_path)``);
+      * state-dict checkpoint (bare state dict or a dict nesting it under
+        ``state_dict``/``model_state_dict``/``model``/``_model``) →
+        construct ``model_class(**model_kwargs)`` and load the weights —
+        the loader the reference left as a TODO (frameworks.py:37);
+      * inferno ``Trainer`` checkpoint DIRECTORY → pick
+        ``Weights/best_checkpoint.pytorch`` (``use_best``) or
+        ``Weights/checkpoint.pytorch`` and recurse (reference
+        InfernoPredicter, frameworks.py:145 ``Trainer().load(best=...)``).
+    """
+    import os
+
+    import torch
+
+    if os.path.isdir(checkpoint_path):
+        name = "best_checkpoint.pytorch" if use_best else "checkpoint.pytorch"
+        for sub in (os.path.join("Weights", name), name):
+            p = os.path.join(checkpoint_path, sub)
+            if os.path.exists(p):
+                return _load_torch_model(p, use_best, model_class, model_kwargs)
+        raise FileNotFoundError(
+            f"no {name} under inferno checkpoint directory {checkpoint_path}"
+        )
+    try:
+        return torch.jit.load(checkpoint_path, map_location="cpu")
+    except RuntimeError:
+        pass
+    obj = torch.load(checkpoint_path, map_location="cpu", weights_only=False)
+    if isinstance(obj, torch.nn.Module):
+        return obj
+    if isinstance(obj, dict):
+        state = obj
+        for key in ("state_dict", "model_state_dict", "model", "_model"):
+            if key in obj:
+                state = obj[key]
+                break
+        if isinstance(state, torch.nn.Module):  # e.g. {'model': module}
+            return state
+        if model_class is None:
+            raise ValueError(
+                f"{checkpoint_path} holds a state dict; pass model_class="
+                "'pkg.module.Class' (+ model_kwargs) so the module can be "
+                "constructed to receive the weights"
+            )
+        cls = (
+            _import_dotted(model_class)
+            if isinstance(model_class, str) else model_class
+        )
+        model = cls(**(model_kwargs or {}))
+        model.load_state_dict(state)
+        return model
+    raise TypeError(
+        f"unsupported torch checkpoint content {type(obj).__name__} "
+        f"in {checkpoint_path}"
+    )
+
+
 class PytorchPredictor(BasePredictor):
     """Host torch forward for foreign checkpoints (compat path; the model is
     shared across prefetch threads behind a lock like the reference's,
-    frameworks.py:63,88)."""
+    frameworks.py:63,88).
+
+    Accepts every reference checkpoint flavor (see ``_load_torch_model``)
+    plus ``prep_model`` surgery on the loaded module ('extract_unet',
+    'add_sigmoid' — reference prep_model.py:9-23).  ``mixed_precision`` runs
+    the forward under bf16 autocast — the host analog of the reference's
+    apex O1 mode (frameworks.py:55-57); there is no CUDA in this deployment,
+    the MXU path for mixed precision is the jax predictor."""
 
     def __init__(self, checkpoint_path: str, halo, use_best: bool = True,
+                 prep_model: Optional[str] = None,
+                 model_class: Optional[str] = None,
+                 model_kwargs: Optional[dict] = None,
+                 mixed_precision: bool = False,
                  augmentation_mode: Optional[str] = None,
                  augmentation_dim: int = 3, **_unused):
         import torch
 
         self.torch = torch
-        try:
-            self.model = torch.jit.load(checkpoint_path, map_location="cpu")
-        except RuntimeError:
-            self.model = torch.load(
-                checkpoint_path, map_location="cpu", weights_only=False
-            )
+        self.model = _load_torch_model(
+            checkpoint_path, use_best, model_class, model_kwargs
+        )
+        if prep_model is not None:
+            if prep_model not in TORCH_PREP_MODELS:
+                raise ValueError(
+                    f"prep_model must be one of "
+                    f"{sorted(k for k in TORCH_PREP_MODELS if k)}, "
+                    f"got {prep_model!r}"
+                )
+            if isinstance(self.model, torch.jit.ScriptModule):
+                if prep_model == "add_sigmoid":
+                    # scripted graphs cannot be rewritten; compose outside
+                    self._post = torch.nn.Sigmoid()
+                else:
+                    raise ValueError(
+                        f"prep_model={prep_model!r} cannot rewrite a "
+                        "TorchScript archive; apply it before scripting"
+                    )
+            else:
+                self.model = TORCH_PREP_MODELS[prep_model](self.model)
         self.model.eval()
+        self.mixed_precision = bool(mixed_precision)
         self.lock = threading.Lock()
         self._init_base(halo, augmentation_mode, augmentation_dim)
 
     def _forward_raw(self, data: np.ndarray) -> np.ndarray:
         torch = self.torch
         with self.lock, torch.no_grad():
-            out = self.model(torch.from_numpy(np.ascontiguousarray(data)))
+            x = torch.from_numpy(np.ascontiguousarray(data))
+            if self.mixed_precision:
+                with torch.autocast("cpu", dtype=torch.bfloat16):
+                    out = self.model(x)
+                out = out.float()
+            else:
+                out = self.model(x)
+            post = getattr(self, "_post", None)
+            if post is not None:
+                out = post(out)
         return out.cpu().numpy()
 
 

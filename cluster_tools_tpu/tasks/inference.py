@@ -97,6 +97,13 @@ class InferenceTask(VolumeTask):
                 "chunks": None,
                 "channel_accumulation": None,
                 "prep_model": None,
+                # eager-torch checkpoint knobs (frameworks._load_torch_model):
+                # state-dict checkpoints need the module class to construct;
+                # use_best picks best_checkpoint.pytorch in inferno dirs
+                "model_class": None,
+                "model_kwargs": None,
+                "mixed_precision": False,
+                "use_best": True,
                 "preprocess": "zero_mean_unit_variance",
                 "batch_size": 1,
                 "prefetch_threads": 2,
@@ -143,6 +150,9 @@ class InferenceTask(VolumeTask):
                 self.halo,
                 prep_model=config.get("prep_model"),
                 use_best=config.get("use_best", True),
+                model_class=config.get("model_class"),
+                model_kwargs=config.get("model_kwargs"),
+                mixed_precision=config.get("mixed_precision", False),
                 augmentation_mode=config.get("augmentation_mode"),
                 augmentation_dim=config.get("augmentation_dim", 3),
                 config=config,
