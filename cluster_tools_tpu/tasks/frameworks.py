@@ -72,8 +72,10 @@ def _torch_add_sigmoid(model):
     import torch.nn as nn
 
     wrapped = nn.Sequential(model, nn.Sigmoid())
-    # keep channel introspection working through the wrapper
-    wrapped.out_channels = getattr(model, "out_channels", None)
+    # keep channel introspection working through the wrapper (only when the
+    # wrapped model exposes it — don't materialize a None attribute)
+    if hasattr(model, "out_channels"):
+        wrapped.out_channels = model.out_channels
     return wrapped
 
 
