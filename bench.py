@@ -503,16 +503,29 @@ def bench_rag(x, repeats):
 
     # production (boundary_edge_features_tpu) packs the sort key whenever
     # the compact label space fits 15 bits — measure the same path
-    from cluster_tools_tpu.ops.rag import PACK_MAX_ID
+    from cluster_tools_tpu.ops.rag import (
+        PACK_MAX_ID, count_boundary_samples, sample_capacity,
+    )
 
     packed = int(labels.max()) <= PACK_MAX_ID
+    # production sizing: pre-sort compaction capacity from the exact host
+    # count (boundary_edge_features_tpu does the same) — maxed over the
+    # rolled timing variants, whose wrap seam adds boundary faces the
+    # unrolled volume does not have
+    lab32 = labels.astype(np.int32)
+    cap = sample_capacity(max(
+        count_boundary_samples(np.roll(lab32, 7 * i, axis=1) if i else lab32)
+        for i in range(repeats + 1)
+    ))
     t_dev = timeit(
         None,
         repeats,
         sync=lambda r: r[0].block_until_ready(),
         variants=rolled_pair_variants(
             x, labels.astype(np.int32), repeats + 1,
-            lambda l, v: dev_fn(l, v, max_edges=65536, packed=packed),
+            lambda l, v: dev_fn(
+                l, v, max_edges=65536, packed=packed, max_samples=cap
+            ),
         ),
     )
     mvox = x.size / t_dev / 1e6

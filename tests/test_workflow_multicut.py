@@ -157,6 +157,42 @@ class TestFeatureMergeAccuracy:
         )
         np.testing.assert_array_equal(got_hist, want_hist)
 
+    def test_device_kernel_sample_compaction(self, rng):
+        """Pre-sort compaction (max_samples) must be invisible in the
+        results, report the TRUE sample count, and only drop rows when the
+        cap is deliberately undersized."""
+        import jax.numpy as jnp
+
+        from cluster_tools_tpu.ops.rag import (
+            boundary_edge_features_device,
+            count_boundary_samples,
+            sample_capacity,
+        )
+
+        labels = rng.integers(0, 20, (8, 16, 16)).astype(np.int32)
+        values = rng.random((8, 16, 16)).astype(np.float32)
+        n_valid = count_boundary_samples(labels)
+        assert n_valid > 0
+        ref = boundary_edge_features_device(
+            jnp.asarray(labels), jnp.asarray(values), max_edges=1024
+        )
+        cap = sample_capacity(n_valid)
+        assert cap >= n_valid
+        got = boundary_edge_features_device(
+            jnp.asarray(labels), jnp.asarray(values), max_edges=1024,
+            max_samples=cap,
+        )
+        for r, g in zip(ref, got):
+            np.testing.assert_allclose(np.asarray(r), np.asarray(g), atol=1e-6)
+        assert int(got[5]) == n_valid  # n_samples is the pre-compaction truth
+        # undersized cap: the true count still comes back larger than the
+        # cap, so a caller can detect the dropped rows
+        small = boundary_edge_features_device(
+            jnp.asarray(labels), jnp.asarray(values), max_edges=1024,
+            max_samples=max(n_valid // 2, 1),
+        )
+        assert int(small[5]) == n_valid > n_valid // 2
+
     def test_device_kernel_uint64_ids_no_background(self, rng):
         """Blocks without label 0 and with block-offset-scale uint64 ids must
         keep exact uint64 edge ids (a bare [0]-prepend would promote the id
