@@ -33,8 +33,9 @@ The ``extra`` field carries the remaining BASELINE.md configs:
     ``target='local'`` forced onto the host XLA-CPU backend in a subprocess
     (the reference's deployment model: all-cores local execution,
     cluster_tasks.py:514-555); plus the same pipeline with
-    ``sharded_problem=True`` (the one-program collective RAG+features path)
-    as ``e2e_sharded_problem_wall_s``
+    ``sharded_problem=True, sharded_ws=True`` (since round 5: the
+    device-resident collective front — fused watershed+RAG session, one
+    volume upload — plus global solve) as ``e2e_sharded_problem_wall_s``
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
@@ -713,7 +714,7 @@ def bench_e2e(x, block_shape, platform=None):
                 "from bench_e2e_lib import run_pipeline\n"
                 f"t, t_warm = run_pipeline({vol_path!r}, {tuple(x.shape)!r}, "
                 f"{tuple(block_shape)!r}, 'tpu', sharded_problem=True, "
-                "warm=True)\n"
+                "sharded_ws=True, warm=True)\n"
                 "print(json.dumps({'wall_s': t, 'warm_s': t_warm}))\n"
             )
         try:

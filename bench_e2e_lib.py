@@ -43,10 +43,14 @@ def _stage_volume(td, vol_path, shape, block_shape, warm):
 
 
 def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
-                 warm=False):
+                 sharded_ws=False, warm=False):
     """Wall-clock of the full pipeline; ``sharded_problem=True`` swaps the
     block-wise graph+features extraction for the one-program collective
-    path (ShardedProblemTask + global solve).
+    path (ShardedProblemTask + global solve); ``sharded_ws=True``
+    additionally fuses the watershed into that collective session
+    (ShardedWsProblemTask: the boundary volume crosses host→device ONCE
+    and stays resident through watershed and RAG — since round 5 the
+    bench's sharded configuration measures THIS path).
 
     ``warm=True`` runs the pipeline a second time in fresh scratch folders
     on a DISTINCT (z-rolled) copy of the volume and returns
@@ -109,6 +113,11 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
             cfg.write_config(
                 config_dir, "sharded_problem", {"max_edges": 1 << 17}
             )
+            cfg.write_config(
+                config_dir, "sharded_ws_problem",
+                {"max_edges": 1 << 17,
+                 **{k: v for k, v in WS_TASK_CONFIG.items() if k != "halo"}},
+            )
             wf = MulticutSegmentationWorkflow(
                 tmp_folder, config_dir,
                 input_path=data_path, input_key=input_key,
@@ -116,6 +125,7 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
                 output_path=data_path, output_key=f"seg{tag}",
                 n_scales=1,
                 sharded_problem=sharded_problem,
+                sharded_ws=sharded_ws,
             )
             t0 = time.perf_counter()
             ok = build([wf])

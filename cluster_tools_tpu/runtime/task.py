@@ -85,6 +85,15 @@ class Task:
         self.dependencies = list(dependencies)
         self._timings: List[Dict[str, Any]] = []
 
+    def record_timing(self, label: str, n_blocks: int, seconds: float) -> None:
+        """Per-dispatch timing record (one batch on the tpu executor, one
+        block on the local executor, one phase in a single-shot collective
+        task) — surfaced in the status file so perf work is data-driven
+        (SURVEY.md §5 'strictly additive' tracing)."""
+        self._timings.append(
+            {"label": label, "blocks": int(n_blocks), "seconds": float(seconds)}
+        )
+
     # -- identity ------------------------------------------------------------
 
     @property
@@ -294,6 +303,7 @@ class SimpleTask(Task):
             "task": self.identifier,
             "complete": True,
             "runtime_s": time.time() - t0,
+            "timings": list(self._timings),
         }
         self.output().write(status)
         self.log(f"done {self.identifier} in {status['runtime_s']:.2f}s")
@@ -500,14 +510,6 @@ class BlockTask(Task):
             attempt += 1
             self.log(f"retry {attempt}/{max_retries}: {len(failed)} failed blocks")
             todo = failed
-
-    def record_timing(self, label: str, n_blocks: int, seconds: float) -> None:
-        """Per-dispatch timing record (one batch on the tpu executor, one
-        block on the local executor) — surfaced in the status file so perf
-        work is data-driven (SURVEY.md §5 'strictly additive' tracing)."""
-        self._timings.append(
-            {"label": label, "blocks": int(n_blocks), "seconds": float(seconds)}
-        )
 
     def _write_status(
         self, target, block_ids, done, failed, runtimes, complete,

@@ -133,6 +133,7 @@ class ProblemWorkflow(WorkflowBase):
                  probs_path=None,                       # RF edge probabilities
                  node_label_dict=None,
                  sharded_problem: bool = False,
+                 sharded_ws: bool = False,
                  dependencies=()):
         super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
         self.input_path = input_path
@@ -145,9 +146,15 @@ class ProblemWorkflow(WorkflowBase):
         self.probs_path = probs_path
         self.node_label_dict = dict(node_label_dict or {})
         self.sharded_problem = sharded_problem
+        self.sharded_ws = sharded_ws
 
     def requires(self):
         dep = list(self.dependencies)
+        if self.sharded_ws and not self.sharded_problem:
+            raise ValueError(
+                "sharded_ws=True requires sharded_problem=True (the fused "
+                "task produces the collective problem layout)"
+            )
         if self.sharded_problem:
             if self.sanity_checks:
                 # the collective path has no per-block subgraph
@@ -158,14 +165,26 @@ class ProblemWorkflow(WorkflowBase):
                     "sharded_problem=True: the collective problem "
                     "extraction has no per-block subgraphs to check"
                 )
-            from ..tasks.features import ShardedProblemTask
+            if self.sharded_ws:
+                # device-resident front: watershed + RAG share one
+                # collective session (and the ws dataset is ITS output)
+                from ..tasks.features import ShardedWsProblemTask
 
-            problem = ShardedProblemTask(
-                self.tmp_folder, self.config_dir, self.max_jobs,
-                dependencies=dep,
-                input_path=self.input_path, input_key=self.input_key,
-                labels_path=self.ws_path, labels_key=self.ws_key,
-            )
+                problem = ShardedWsProblemTask(
+                    self.tmp_folder, self.config_dir, self.max_jobs,
+                    dependencies=dep,
+                    input_path=self.input_path, input_key=self.input_key,
+                    output_path=self.ws_path, output_key=self.ws_key,
+                )
+            else:
+                from ..tasks.features import ShardedProblemTask
+
+                problem = ShardedProblemTask(
+                    self.tmp_folder, self.config_dir, self.max_jobs,
+                    dependencies=dep,
+                    input_path=self.input_path, input_key=self.input_key,
+                    labels_path=self.ws_path, labels_key=self.ws_key,
+                )
             dep = [problem]
         else:
             graph = GraphWorkflow(
@@ -278,6 +297,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         n_scales: int = 1,
         skip_ws: bool = False,
         sharded_problem: bool = False,
+        sharded_ws: bool = False,
         sanity_checks: bool = False,
         node_label_dict: Optional[dict] = None,
         dependencies=(),
@@ -294,12 +314,29 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         self.n_scales = n_scales
         self.skip_ws = skip_ws
         self.sharded_problem = sharded_problem
+        self.sharded_ws = sharded_ws
         self.sanity_checks = sanity_checks
         self.node_label_dict = dict(node_label_dict or {})
 
     def requires(self):
+        if self.sharded_ws and not self.sharded_problem:
+            raise ValueError(
+                "sharded_ws=True requires sharded_problem=True (the fused "
+                "task produces the collective problem layout)"
+            )
+        if self.sharded_ws and self.mask_path:
+            raise ValueError(
+                "sharded_ws does not support masked volumes — use the "
+                "block watershed (sharded_ws=False)"
+            )
+        if self.sharded_ws and self.skip_ws:
+            raise ValueError(
+                "skip_ws=True contradicts sharded_ws=True: the fused task "
+                "computes the watershed and would overwrite the "
+                "precomputed ws dataset — use sharded_ws=False to reuse it"
+            )
         dep = list(self.dependencies)
-        if not self.skip_ws:
+        if not self.skip_ws and not self.sharded_ws:
             ws = WatershedTask(
                 self.tmp_folder, self.config_dir, self.max_jobs,
                 dependencies=dep,
@@ -315,6 +352,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
             sanity_checks=self.sanity_checks,
             node_label_dict=self.node_label_dict,
             sharded_problem=self.sharded_problem,
+            sharded_ws=self.sharded_ws,
             dependencies=dep,
         )
         # the collective problem path has no block edge-id maps, so the solve
@@ -341,9 +379,10 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         conf["watershed"] = WatershedTask.default_task_config()
         conf["block_edge_features"] = BlockEdgeFeaturesTask.default_task_config()
         conf["probs_to_costs"] = ProbsToCostsTask.default_task_config()
-        from ..tasks.features import ShardedProblemTask
+        from ..tasks.features import ShardedProblemTask, ShardedWsProblemTask
 
         conf["sharded_problem"] = ShardedProblemTask.default_task_config()
+        conf["sharded_ws_problem"] = ShardedWsProblemTask.default_task_config()
         return conf
 
 

@@ -1,0 +1,178 @@
+"""The device-resident watershed→RAG fusion (ShardedWsProblemTask).
+
+Parity contract: the fused task must produce EXACTLY what the split
+pipeline (ShardedWatershedTask → ShardedProblemTask) produces — same ws
+dataset, same node table, same edges, same features — while uploading the
+boundary volume once and never re-reading it from the store.
+"""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+import jax
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+
+N_DEV = 8
+
+WS_CONF = {"threshold": 0.6, "sigma_seeds": 1.0, "size_filter": 10,
+           "max_edges": 4096}
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _require_devices():
+    if jax.device_count() < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices, have {jax.device_count()}")
+
+
+def _volume(rng, shape=(24, 32, 32)):
+    raw = ndimage.gaussian_filter(rng.random(shape), (1.0, 2.0, 2.0))
+    raw = (raw - raw.min()) / (raw.max() - raw.min())
+    return raw.astype("float32")
+
+
+def _scratch(tmp_folder):
+    from cluster_tools_tpu.tasks.base import scratch_store_path
+
+    return file_reader(scratch_store_path(tmp_folder), "r")
+
+
+def _run_split(path, tmp_path, tag):
+    from cluster_tools_tpu.tasks.features import ShardedProblemTask
+    from cluster_tools_tpu.tasks.watershed import ShardedWatershedTask
+
+    config_dir = str(tmp_path / f"configs_{tag}")
+    tmp_folder = str(tmp_path / f"tmp_{tag}")
+    cfg.write_global_config(
+        config_dir, {"block_shape": [12, 16, 16], "target": "tpu"}
+    )
+    cfg.write_config(config_dir, "sharded_watershed", dict(WS_CONF))
+    cfg.write_config(config_dir, "sharded_problem", dict(WS_CONF))
+    ws = ShardedWatershedTask(
+        tmp_folder, config_dir,
+        input_path=path, input_key="bnd",
+        output_path=path, output_key=f"ws_{tag}",
+    )
+    problem = ShardedProblemTask(
+        tmp_folder, config_dir, dependencies=[ws],
+        input_path=path, input_key="bnd",
+        labels_path=path, labels_key=f"ws_{tag}",
+    )
+    assert build([problem])
+    return tmp_folder
+
+
+def _run_fused(path, tmp_path, tag):
+    from cluster_tools_tpu.tasks.features import ShardedWsProblemTask
+
+    config_dir = str(tmp_path / f"configs_{tag}")
+    tmp_folder = str(tmp_path / f"tmp_{tag}")
+    cfg.write_global_config(
+        config_dir, {"block_shape": [12, 16, 16], "target": "tpu"}
+    )
+    cfg.write_config(config_dir, "sharded_ws_problem", dict(WS_CONF))
+    task = ShardedWsProblemTask(
+        tmp_folder, config_dir,
+        input_path=path, input_key="bnd",
+        output_path=path, output_key=f"ws_{tag}",
+    )
+    assert build([task])
+    return tmp_folder
+
+
+def test_fused_matches_split_pipeline(tmp_path, rng):
+    _require_devices()
+    raw = _volume(rng)
+    path = str(tmp_path / "d.n5")
+    file_reader(path).create_dataset("bnd", data=raw, chunks=(12, 16, 16))
+
+    split_tmp = _run_split(path, tmp_path, "split")
+    fused_tmp = _run_fused(path, tmp_path, "fused")
+
+    f = file_reader(path, "r")
+    ws_split = f["ws_split"][:]
+    ws_fused = f["ws_fused"][:]
+    np.testing.assert_array_equal(ws_fused, ws_split)
+    assert len(np.unique(ws_fused)) > 2  # a real fragmentation
+
+    a, b = _scratch(split_tmp), _scratch(fused_tmp)
+    np.testing.assert_array_equal(a["graph/nodes"][:], b["graph/nodes"][:])
+    np.testing.assert_array_equal(a["graph/edges"][:], b["graph/edges"][:])
+    np.testing.assert_allclose(
+        a["features/edges"][:], b["features/edges"][:], rtol=1e-5, atol=1e-6
+    )
+    assert (
+        a["graph/edges"].attrs["n_nodes"] == b["graph/edges"].attrs["n_nodes"]
+    )
+
+
+def test_full_workflow_with_sharded_ws(tmp_path, rng):
+    """MulticutSegmentationWorkflow(sharded_problem=True, sharded_ws=True)
+    end-to-end: one fused front task, global solve, written segmentation."""
+    from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+
+    _require_devices()
+    raw = _volume(rng)
+    path = str(tmp_path / "d.n5")
+    file_reader(path).create_dataset("bnd", data=raw, chunks=(12, 16, 16))
+    config_dir = str(tmp_path / "configs_wf")
+    tmp_folder = str(tmp_path / "tmp_wf")
+    cfg.write_global_config(
+        config_dir, {"block_shape": [12, 16, 16], "target": "tpu"}
+    )
+    cfg.write_config(config_dir, "sharded_ws_problem", dict(WS_CONF))
+    wf = MulticutSegmentationWorkflow(
+        tmp_folder, config_dir,
+        input_path=path, input_key="bnd",
+        ws_path=path, ws_key="ws_wf",
+        output_path=path, output_key="seg_wf",
+        sharded_problem=True, sharded_ws=True,
+    )
+    assert build([wf])
+    f = file_reader(path, "r")
+    seg = f["seg_wf"][:]
+    ws = f["ws_wf"][:]
+    assert seg.shape == raw.shape
+    # the multicut merges fragments: a coarsening of the ws partition
+    n_seg = len(np.unique(seg[seg > 0]))
+    n_ws = len(np.unique(ws[ws > 0]))
+    assert 0 < n_seg <= n_ws
+    # background is preserved
+    np.testing.assert_array_equal(seg == 0, ws == 0)
+
+
+def test_sharded_ws_flag_validation(tmp_path):
+    from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+
+    with pytest.raises(ValueError, match="sharded_problem"):
+        MulticutSegmentationWorkflow(
+            str(tmp_path / "t"), str(tmp_path / "c"),
+            input_path="x.n5", input_key="bnd",
+            ws_path="x.n5", ws_key="ws",
+            output_path="x.n5", output_key="seg",
+            sharded_ws=True,
+        ).requires()
+    with pytest.raises(ValueError, match="mask"):
+        MulticutSegmentationWorkflow(
+            str(tmp_path / "t"), str(tmp_path / "c"),
+            input_path="x.n5", input_key="bnd",
+            ws_path="x.n5", ws_key="ws",
+            output_path="x.n5", output_key="seg",
+            mask_path="x.n5", mask_key="m",
+            sharded_problem=True, sharded_ws=True,
+        ).requires()
+    # a precomputed watershed must never be silently overwritten
+    with pytest.raises(ValueError, match="skip_ws"):
+        MulticutSegmentationWorkflow(
+            str(tmp_path / "t"), str(tmp_path / "c"),
+            input_path="x.n5", input_key="bnd",
+            ws_path="x.n5", ws_key="ws",
+            output_path="x.n5", output_key="seg",
+            skip_ws=True, sharded_problem=True, sharded_ws=True,
+        ).requires()
