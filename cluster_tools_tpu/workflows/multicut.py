@@ -111,6 +111,17 @@ class EdgeFeaturesWorkflow(WorkflowBase):
         return [merge]
 
 
+def _check_sharded_ws_flags(sharded_ws: bool, sharded_problem: bool) -> None:
+    """One definition of the flag contract, raised by BOTH workflow entry
+    points (construction-time in MulticutSegmentationWorkflow.requires,
+    build-time in ProblemWorkflow.requires)."""
+    if sharded_ws and not sharded_problem:
+        raise ValueError(
+            "sharded_ws=True requires sharded_problem=True (the fused "
+            "task produces the collective problem layout)"
+        )
+
+
 class ProblemWorkflow(WorkflowBase):
     """Graph extraction → (optional sanity checks) → edge features →
     (optional) costs: the standalone "problem" pipeline
@@ -150,11 +161,7 @@ class ProblemWorkflow(WorkflowBase):
 
     def requires(self):
         dep = list(self.dependencies)
-        if self.sharded_ws and not self.sharded_problem:
-            raise ValueError(
-                "sharded_ws=True requires sharded_problem=True (the fused "
-                "task produces the collective problem layout)"
-            )
+        _check_sharded_ws_flags(self.sharded_ws, self.sharded_problem)
         if self.sharded_problem:
             if self.sanity_checks:
                 # the collective path has no per-block subgraph
@@ -319,11 +326,7 @@ class MulticutSegmentationWorkflow(WorkflowBase):
         self.node_label_dict = dict(node_label_dict or {})
 
     def requires(self):
-        if self.sharded_ws and not self.sharded_problem:
-            raise ValueError(
-                "sharded_ws=True requires sharded_problem=True (the fused "
-                "task produces the collective problem layout)"
-            )
+        _check_sharded_ws_flags(self.sharded_ws, self.sharded_problem)
         if self.sharded_ws and self.mask_path:
             raise ValueError(
                 "sharded_ws does not support masked volumes — use the "

@@ -45,6 +45,20 @@ def main():
 
     print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
     results = {"backend": jax.default_backend()}
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tpu_validate.json"
+    )
+
+    def save():
+        # checkpoint after every section: a tunnel drop mid-run must not
+        # lose the measurements already taken (same unlosable-contract
+        # rule as bench.py driver mode).  Atomic via temp + os.replace —
+        # chip_session's SIGTERM on timeout must never catch a truncating
+        # in-place write and destroy the checkpoints it exists to keep
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=2)
+        os.replace(tmp, out_path)
     # additive per-call floor of the host-fetch completion barrier every
     # timeit round ends in (tunnel RTT; ~0 on a local device) — subtract
     # from sub-10ms entries when comparing kernels
@@ -89,6 +103,9 @@ def main():
         )
         results[f"cc_{mode}_ms"] = round(t * 1e3, 1)
         print(f"connected_components[{mode}]: {t*1e3:.1f} ms")
+        save()
+
+    save()
 
     # -- XLA slices+z-merge CC mode (CTT_CC_MODE=slices) --------------------
     # structure of the Pallas path in plain XLA; measured 5x SLOWER on the
@@ -197,6 +214,8 @@ def main():
         results["pallas_dtws_error"] = f"{type(e).__name__}: {e}"[:500]
         print(f"pallas dtws FAILED to lower/run: {e}")
 
+    save()
+
     # -- Pallas per-slice CC + z-merge vs the XLA CC ------------------------
     from cluster_tools_tpu.ops.pallas_cc import pallas_connected_components
 
@@ -225,30 +244,110 @@ def main():
         results["pallas_cc_error"] = f"{type(e).__name__}: {e}"[:500]
         print(f"pallas cc FAILED to lower/run: {e}")
 
+    save()
+
     # -- device RAG kernel vs numpy -----------------------------------------
     from cluster_tools_tpu import native
     from cluster_tools_tpu.ops import rag
 
     labels, _ = native.dt_watershed_cpu(raw, threshold=0.5)
     # the production wrapper packs the sort key whenever the compact label
-    # space fits 15 bits — measure the same path
+    # space fits 15 bits AND compacts valid face rows before the sort —
+    # measure the same path (cap maxed over the rolled variants, whose
+    # wrap seams add boundary faces)
     packed = int(labels.max()) <= rag.PACK_MAX_ID
+    lab32 = labels.astype(np.int32)
+    cap = rag.sample_capacity(max(
+        rag.count_boundary_samples(np.roll(lab32, 7 * i, axis=1) if i else lab32)
+        for i in range(SPAN)
+    ))
     t_dev = timeit(
         None, REPEATS,
         sync=lambda r: r[0].block_until_ready(),
         variants=rolled_pair_variants(
-            raw, labels.astype(np.int32), SPAN,
+            raw, lab32, SPAN,
             lambda l, v: rag.boundary_edge_features_device(
-                l, v, max_edges=65536, packed=packed),
+                l, v, max_edges=65536, packed=packed, max_samples=cap),
         ),
     )
     results["rag_packed"] = bool(packed)
+    results["rag_sample_cap"] = int(cap)
     t0 = time.perf_counter()
     rag.boundary_edge_features(labels.astype(np.uint64), raw)
     t_host = time.perf_counter() - t0
     results["rag_device_ms"] = round(t_dev * 1e3, 1)
     results["rag_numpy_ms"] = round(t_host * 1e3, 1)
     print(f"rag device: {t_dev*1e3:.1f} ms, numpy: {t_host*1e3:.1f} ms")
+
+    save()
+
+    # -- device MWS vs host C++ (CTT_MWS_MODE pin) --------------------------
+    # the graph-domain device kernel on the bench's realistic bimodal
+    # affinity problem (doomed-pair discard keeps rounds low since r5);
+    # the winner decides whether per-block MWS solves route to the device
+    try:
+        from scipy import ndimage as _ndi
+
+        from cluster_tools_tpu.ops.mws import _affinity_edge_lists
+        from cluster_tools_tpu.ops.mws_device import (
+            mutex_watershed_device, mutex_watershed_device_rounds,
+        )
+
+        offsets = [[-1, 0, 0], [0, -1, 0], [0, 0, -1],
+                   [-2, 0, 0], [0, -4, 0], [0, 0, -4]]
+        mws_shape = (8, 32, 32)
+        mws_rng = np.random.default_rng(1)
+        affs = _ndi.gaussian_filter(
+            mws_rng.random((len(offsets),) + mws_shape).astype(np.float32),
+            (0, 1, 2, 2),
+        )
+        n_mws = int(np.prod(mws_shape))
+        # one problem per rolled affinity volume: distinct inputs per timed
+        # round (tunnel result caches), conversions prepared OUTSIDE the
+        # timed window, and the pin decided by timeit like every other
+        # pin-deciding section — one RTT spike must not flip CTT_MWS_MODE
+        problems = []
+        for i in range(SPAN):
+            a_i = np.roll(affs, 3 * i, axis=2) if i else affs
+            us, vs, ws_l, at_l = _affinity_edge_lists(
+                a_i, offsets, [1, 2, 2], False, 0.0,
+                np.random.default_rng(0), 3,
+            )
+            uv = np.stack([np.concatenate(us), np.concatenate(vs)], axis=1)
+            w = np.concatenate(ws_l).astype(np.float32)
+            at = np.concatenate(at_l).astype(bool)
+            problems.append(
+                (uv, w, at, uv.astype(np.int64), w.astype(np.float64),
+                 at.astype(np.uint8))
+            )
+        results["mws_device_rounds"] = mutex_watershed_device_rounds(
+            n_mws, *problems[0][:3]
+        )
+        t_mws_dev = timeit(
+            None, REPEATS,
+            variants=[
+                (lambda p: lambda: mutex_watershed_device(n_mws, *p[:3]))(p)
+                for p in problems
+            ],
+        )
+        t_mws_host = timeit(
+            None, REPEATS,
+            variants=[
+                (lambda p: lambda: native.mutex_watershed(n_mws, *p[3:]))(p)
+                for p in problems
+            ],
+        )
+        results["mws_device_ms"] = round(t_mws_dev * 1e3, 1)
+        results["mws_host_ms"] = round(t_mws_host * 1e3, 1)
+        results["mws_device_wins"] = t_mws_dev < t_mws_host
+        print(f"mws device: {t_mws_dev*1e3:.1f} ms "
+              f"({results['mws_device_rounds']} rounds), "
+              f"host C++: {t_mws_host*1e3:.1f} ms")
+    except Exception as e:
+        results["mws_device_error"] = f"{type(e).__name__}: {e}"[:500]
+        print(f"mws device FAILED: {e}")
+
+    save()
 
     # -- device batch-size sweep (CTT_DEVICE_BATCH pin) ---------------------
     # per-block voxel rate of the vmapped DT-watershed at several batch
@@ -281,6 +380,8 @@ def main():
             best_rate, best_bs = rate, bs
     if best_rate > 0:  # never pin from an all-errored sweep
         results["best_device_batch"] = best_bs
+
+    save()
 
     # -- verdicts ------------------------------------------------------------
     results["flood_assoc_wins"] = results["dtws_assoc_ms"] < results["dtws_seq_ms"]
