@@ -41,6 +41,30 @@ PACK_SHIFT = 65536
 PACK_MAX_ID = 32766
 
 
+def compact_valid_rows(u, v, s, max_samples, sentinel):
+    """Static-capacity compaction of valid (u != sentinel) face rows BEFORE
+    the dominant sort: only ~a quarter of the rows are real label-boundary
+    samples at CREMI-like boundary densities, and sentinel rows cost the
+    same to sort as real ones (measured on the 32x256x256 bench block, CPU
+    fallback: 12.4M rows -> 3.5M valid; pack+sort 5.2 s -> the whole kernel
+    lands near 1-core numpy).  A stable cumsum/scatter keeps row order;
+    rows beyond the cap are dropped by scatter 'drop' mode — callers
+    compare the pre-compaction valid count against the cap and raise
+    rather than silently lose samples.  Shared by the single-device and
+    the sharded (per-shard) kernels."""
+    import jax.numpy as jnp
+
+    valid0 = u != sentinel
+    dest = jnp.where(
+        valid0, jnp.cumsum(valid0.astype(jnp.int32)) - 1,
+        jnp.int32(max_samples),
+    )
+    u = jnp.full((max_samples,), sentinel, u.dtype).at[dest].set(u, mode="drop")
+    v = jnp.full((max_samples,), sentinel, v.dtype).at[dest].set(v, mode="drop")
+    s = jnp.zeros((max_samples,), s.dtype).at[dest].set(s, mode="drop")
+    return u, v, s
+
+
 def pack_uv(u, v, sentinel):
     """Order-preserving single-int32 key for (u, v) pairs (u ≤ v ≤
     PACK_MAX_ID); sentinel rows stay the sentinel (sort last).
@@ -623,23 +647,7 @@ def _boundary_edge_features_device_impl(
     big = jnp.int32(np.iinfo(np.int32).max)
     n_true = (u != big).sum()
     if max_samples is not None:
-        # static-capacity compaction BEFORE the dominant sort: only ~a
-        # quarter of the face rows are real label-boundary samples at
-        # CREMI-like boundary densities, and sentinel rows cost the same
-        # to sort as real ones (measured on the 32x256x256 bench block,
-        # CPU fallback: 12.4M rows -> 3.5M valid; pack+sort 5.2 s -> the
-        # whole kernel lands within ~2x of 1-core numpy).  A stable
-        # cumsum/scatter keeps row order; rows beyond the cap are dropped
-        # by scatter 'drop' mode and surfaced via n_true so the host
-        # wrapper can raise instead of silently losing samples.
-        valid0 = u != big
-        dest = jnp.where(
-            valid0, jnp.cumsum(valid0.astype(jnp.int32)) - 1,
-            jnp.int32(max_samples),
-        )
-        u = jnp.full((max_samples,), big, u.dtype).at[dest].set(u, mode="drop")
-        v = jnp.full((max_samples,), big, v.dtype).at[dest].set(v, mode="drop")
-        s = jnp.zeros((max_samples,), s.dtype).at[dest].set(s, mode="drop")
+        u, v, s = compact_valid_rows(u, v, s, max_samples, big)
     if packed:
         # one int32 key, lexicographic order preserved; the sentinel pair
         # (big, big) maps to the int32 max so invalid rows still sort last

@@ -58,13 +58,20 @@ def _edge_segments(u, v, max_edges):
 
 
 def _local_stats_table(lab, val, lab_hi, val_hi, max_edges, hist_bins,
-                       packed=False):
+                       packed=False, max_samples=None):
     """Per-shard samples → sorted sufficient-statistics table (fixed size).
 
     ``packed`` (static): single-int32-key sort ``u*65536 + v`` when every
     global label id ≤ 32766 (caller-gated) — same order-preserving packing
     as ops/rag._boundary_edge_features_device_impl, same bit-identical
-    results, one sort stream fewer."""
+    results, one sort stream fewer.
+
+    ``max_samples`` (static): pre-sort compaction of the shard's valid face
+    rows to a fixed cap, exactly like the single-device kernel — at
+    CREMI-like boundary densities ~3/4 of the rows are sentinels that cost
+    the same to sort as real samples.  The cap must bound EVERY shard's
+    valid count (callers size it host-side); the true per-shard count is
+    returned so the caller can fail loudly on overflow."""
     lab_e = jnp.concatenate([lab, lab_hi[None]], 0)
     val_e = jnp.concatenate([val, val_hi[None]], 0)
 
@@ -86,6 +93,12 @@ def _local_stats_table(lab, val, lab_hi, val_hi, max_edges, hist_bins,
     u = jnp.concatenate(us)
     v = jnp.concatenate(vs)
     s = jnp.concatenate(ss).astype(jnp.float32)
+
+    n_true = (u != _BIG_ID).sum()
+    if max_samples is not None:
+        from ..ops.rag import compact_valid_rows
+
+        u, v, s = compact_valid_rows(u, v, s, max_samples, _BIG_ID)
 
     if packed:
         from ..ops.rag import pack_uv, unpack_uv
@@ -123,7 +136,7 @@ def _local_stats_table(lab, val, lab_hi, val_hi, max_edges, hist_bins,
     hist = jax.ops.segment_sum(
         ones, flat, num_segments=max_edges * hist_bins + 1
     )[: max_edges * hist_bins].reshape(max_edges, hist_bins)
-    return e_u, e_v, count, ssum, ssum2, smin, smax, hist, n_local
+    return e_u, e_v, count, ssum, ssum2, smin, smax, hist, n_local, n_true
 
 
 def _hist_quantile(hist, cum, counts, q):
@@ -142,22 +155,27 @@ def _hist_quantile(hist, cum, counts, q):
 
 @partial(
     jax.jit,
-    static_argnames=("max_edges", "hist_bins", "axis_name", "mesh", "packed"),
+    static_argnames=(
+        "max_edges", "hist_bins", "axis_name", "mesh", "packed",
+        "max_samples",
+    ),
 )
 def _sharded_rag(labels, values, max_edges, hist_bins, axis_name, mesh,
-                 packed=False):
+                 packed=False, max_samples=None):
     def local_fn(lab, val):
         lab_hi = _neighbor_planes(lab[0], axis_name, -1)  # +z neighbor plane
         val_hi = _neighbor_planes(val[0], axis_name, -1)
         (e_u, e_v, count, ssum, ssum2, smin, smax, hist,
-         n_local) = _local_stats_table(
-            lab, val, lab_hi, val_hi, max_edges, hist_bins, packed
+         n_local, n_true) = _local_stats_table(
+            lab, val, lab_hi, val_hi, max_edges, hist_bins, packed,
+            max_samples,
         )
         # a local table that truncated (> max_edges distinct edges in one
         # shard) silently drops the lexicographic tail IDENTICALLY on every
         # shard, so the merged count cannot detect it — report the max local
-        # count so the host can fail loudly
+        # count so the host can fail loudly; same for the sample cap
         n_local_max = lax.pmax(n_local, axis_name)
+        n_true_max = lax.pmax(n_true, axis_name)
 
         def gather(x):
             g = lax.all_gather(x, axis_name)
@@ -216,15 +234,34 @@ def _sharded_rag(labels, values, max_edges, hist_bins, axis_name, mesh,
             ],
             axis=1,
         )
-        return m_u, m_v, feats, m_hist, n_edges, n_local_max
+        return m_u, m_v, feats, m_hist, n_edges, n_local_max, n_true_max
 
     return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name)),
-        out_specs=(P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P(), P()),
         check_vma=False,
     )(labels, values)
+
+
+def shard_sample_cap(labels_host: np.ndarray, n_shards: int) -> int:
+    """Static per-shard compaction capacity from a HOST label volume
+    (padded z divisible by ``n_shards``): the max over shards of the
+    shard's valid face rows — in-slab pairs plus the +z cross-shard plane
+    the shard owns — with ``sample_capacity``'s headroom/bucketing.  The
+    extended-slab count includes the borrowed plane's in-plane pairs too
+    (not owned), so it upper-bounds the kernel's count — safe for a cap."""
+    from ..ops.rag import count_boundary_samples, sample_capacity
+
+    z = labels_host.shape[0]
+    h = z // n_shards
+    worst = 1
+    for i in range(n_shards):
+        z0, z1 = i * h, (i + 1) * h
+        ext = labels_host[z0 : min(z1 + 1, z)]  # +z neighbor plane if any
+        worst = max(worst, count_boundary_samples(ext))
+    return sample_capacity(worst)
 
 
 def sharded_boundary_edge_features(
@@ -235,6 +272,7 @@ def sharded_boundary_edge_features(
     max_edges: int = 16384,
     hist_bins: int = HIST_BINS,
     max_id=None,
+    max_samples=None,
 ):
     """10 RAG edge features of a z-sharded volume in one collective program.
 
@@ -265,15 +303,33 @@ def sharded_boundary_edge_features(
     if max_id is None and isinstance(labels, np.ndarray) and labels.size:
         max_id = int(labels.max())
     packed = max_id is not None and 0 <= int(max_id) <= PACK_MAX_ID
-    e_u, e_v, feats, _, n_edges, n_local_max = _sharded_rag(
+    # pre-sort compaction: size the per-shard cap from the host labels when
+    # available; device-resident callers pass max_samples themselves
+    if max_samples is None and isinstance(labels, np.ndarray) and labels.size:
+        max_samples = shard_sample_cap(labels, n)
+    if max_samples is not None:
+        # skip compaction that cannot shrink the sort (small or
+        # boundary-dense shards) — same guard as the single-device wrapper
+        h, y, x_ = lab.shape[0] // n, lab.shape[1], lab.shape[2]
+        raw_rows = 2 * (h * y * x_ + h * (y - 1) * x_ + h * y * (x_ - 1))
+        if int(max_samples) >= raw_rows:
+            max_samples = None
+    e_u, e_v, feats, _, n_edges, n_local_max, n_true_max = _sharded_rag(
         lab, val, int(max_edges), int(hist_bins), axis_name, mesh,
         packed=bool(packed),
+        max_samples=None if max_samples is None else int(max_samples),
     )
     n_edges = int(n_edges)
     if int(n_local_max) > max_edges or n_edges > max_edges:
         raise RuntimeError(
             f"edge table overflow (local max {int(n_local_max)}, merged "
             f"{n_edges} vs max_edges={max_edges}); raise the bound"
+        )
+    if max_samples is not None and int(n_true_max) > int(max_samples):
+        raise RuntimeError(
+            f"sample compaction overflow ({int(n_true_max)} valid rows in "
+            f"one shard vs max_samples={int(max_samples)}) — a dropped row "
+            "would corrupt features; raise the cap"
         )
     edges = np.stack(
         [np.asarray(e_u)[:n_edges], np.asarray(e_v)[:n_edges]], axis=1

@@ -442,19 +442,50 @@ class ShardedProblemTask(VolumeSimpleTask):
 
         # pass 1 (host, slab-wise): the global node table — peak host RAM
         # is one slab plus the accumulating uniques.  Slab height follows
-        # the store's z-chunking so no chunk is decompressed twice
+        # the store's z-chunking so no chunk is decompressed twice.  The
+        # same pass counts boundary face rows per z-plane, from which the
+        # per-shard sample-compaction cap is sized (shard_sample_cap needs
+        # the whole volume; here only per-plane counts accumulate).
         zc = int((seg_ds.chunks or (8,))[0]) or 8
-        # cast BEFORE unique: signed ignore labels (e.g. -1) must wrap to
-        # their uint64 identity exactly as the full-volume cast did, or the
-        # node table silently drops/disorders them
-        slabs = [
-            np.unique(np.asarray(seg_ds[z0 : z0 + zc]).astype(np.uint64))
-            for z0 in range(0, z, zc)
-        ]
+        zp = z + (-z) % n_dev  # padded extent (pad planes count 0)
+        c_in = np.zeros(zp, np.int64)   # in-plane pairs of plane zi
+        c_z = np.zeros(zp, np.int64)    # pairs between planes zi and zi+1
+        prev_last = None
+        slabs = []
+        for z0 in range(0, z, zc):
+            # cast BEFORE unique: signed ignore labels (e.g. -1) must wrap
+            # to their uint64 identity exactly as the full-volume cast did,
+            # or the node table silently drops/disorders them
+            slab = np.asarray(seg_ds[z0 : z0 + zc]).astype(np.uint64)
+            slabs.append(np.unique(slab))
+            nz = slab != 0
+            for ax in (1, 2):
+                lo = np.moveaxis(slab, ax, 1)[:, :-1]
+                hi = np.moveaxis(slab, ax, 1)[:, 1:]
+                c_in[z0 : z0 + slab.shape[0]] += 2 * (
+                    (lo != hi) & (lo != 0) & (hi != 0)
+                ).sum(axis=(1, 2))
+            pair = (slab[:-1] != slab[1:]) & nz[:-1] & nz[1:]
+            c_z[z0 : z0 + slab.shape[0] - 1] += 2 * pair.sum(axis=(1, 2))
+            if prev_last is not None:
+                p = (prev_last != slab[0]) & (prev_last != 0) & (slab[0] != 0)
+                c_z[z0 - 1] += 2 * int(p.sum())
+            prev_last = slab[-1]
         nodes = np.unique(np.concatenate(slabs)) if slabs else np.zeros(
             0, np.uint64
         )
         nodes = nodes[nodes > 0]
+        # shard i owns planes [i*h, (i+1)*h) plus the z-pair into the next
+        # shard's first plane (mesh-edge shard: ppermute zero-fill)
+        h = zp // n_dev
+        worst = 1
+        for i in range(n_dev):
+            zo, z1 = i * h, (i + 1) * h
+            cnt = int(c_in[zo:z1].sum() + c_z[zo:z1].sum())
+            worst = max(worst, cnt)
+        from ..ops.rag import sample_capacity
+
+        sample_cap = sample_capacity(worst)
 
         # pass 2: stream both volumes shard-by-shard; compaction to
         # 1..n node ids and the block path's normalization convention
@@ -484,6 +515,7 @@ class ShardedProblemTask(VolumeSimpleTask):
             # bound gates the packed single-key sort without touching the
             # (possibly multi-host global) device array
             max_id=int(nodes.size),
+            max_samples=sample_cap,
         )
         import jax as _jax
 
@@ -632,10 +664,15 @@ class ShardedWsProblemTask(ShardedProblemTask):
             compact32 = np.pad(compact32, ((0, pad), (0, 0), (0, 0)))
         compact_d = put_global(compact32, mesh, dtype=np.int32)
 
+        from ..parallel.sharded_rag import shard_sample_cap
+
         edges_c, feats = timed("rag", lambda: sharded_boundary_edge_features(
             compact_d, x_d, mesh=mesh,
             max_edges=int(conf.get("max_edges", 16384)),
             max_id=int(n_labels),
+            # the padded compact labels are on host anyway — size the
+            # per-shard compaction cap from them
+            max_samples=shard_sample_cap(compact32, n_dev),
         ))
 
         if _jax.process_index() != 0:
