@@ -22,6 +22,9 @@ WS_TASK_CONFIG = {
     "threshold": 0.5, "sigma_seeds": 2.0, "size_filter": 25,
     "halo": [2, 4, 4],
 }
+# the collective (whole-volume) watershed variants take the same kernel
+# knobs minus the block-only halo — one derivation for every sharded config
+SHARDED_WS_CONFIG = {k: v for k, v in WS_TASK_CONFIG.items() if k != "halo"}
 
 
 def _stage_volume(td, vol_path, shape, block_shape, warm):
@@ -115,8 +118,7 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
             )
             cfg.write_config(
                 config_dir, "sharded_ws_problem",
-                {"max_edges": 1 << 17,
-                 **{k: v for k, v in WS_TASK_CONFIG.items() if k != "halo"}},
+                {"max_edges": 1 << 17, **SHARDED_WS_CONFIG},
             )
             wf = MulticutSegmentationWorkflow(
                 tmp_folder, config_dir,
@@ -153,13 +155,19 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
     return wall, warm_wall
 
 
-def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False):
+def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False,
+                    sharded=False):
     """Wall-clock of the WatershedWorkflow alone — the BASELINE.md north
     star is "≥10x wall-clock vs target='local' on CREMI sample-A
     DT-watershed", i.e. THIS workload (block reads → fused DT-WS program →
     label writes), not the full multicut pipeline whose host-bound merge
     and solve stages dilute the device speedup.  Same cold/warm and
-    distinct-volume discipline as ``run_pipeline``."""
+    distinct-volume discipline as ``run_pipeline``.
+
+    ``sharded=True`` runs the collective whole-volume watershed
+    (WatershedWorkflow(sharded=True): one upload, one program over the
+    mesh, one label write) instead of the block pipeline — the 3d
+    collective fragmentation, reported separately by the bench."""
     from cluster_tools_tpu.runtime import build, config as cfg
     from cluster_tools_tpu.workflows import WatershedWorkflow
 
@@ -173,10 +181,14 @@ def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False):
                 {"block_shape": list(block_shape), "target": target},
             )
             cfg.write_config(config_dir, "watershed", dict(WS_TASK_CONFIG))
+            cfg.write_config(
+                config_dir, "sharded_watershed", dict(SHARDED_WS_CONFIG)
+            )
             wf = WatershedWorkflow(
                 os.path.join(td, f"tmp{tag}"), config_dir,
                 input_path=data_path, input_key=input_key,
                 output_path=data_path, output_key=f"ws{tag}",
+                sharded=sharded,
             )
             t0 = time.perf_counter()
             ok = build([wf])

@@ -770,6 +770,14 @@ def sample_capacity(n_valid: int) -> int:
     return base * 2
 
 
+def _face_mask(lo, hi):
+    """THE face predicate of every RAG accumulator (device, sharded, host
+    counts): an inter-label face with both sides foreground.  One
+    definition — the host-side cap sizing must bound exactly what the
+    kernels generate (each face contributes 2 sample rows)."""
+    return (lo != hi) & (lo != 0) & (hi != 0)
+
+
 def count_boundary_samples(labels: np.ndarray) -> int:
     """Host-side exact count of the kernel's valid face rows (2 samples per
     inter-label face, zero labels excluded) — cheap numpy comparisons, used
@@ -778,8 +786,30 @@ def count_boundary_samples(labels: np.ndarray) -> int:
     for axis in range(labels.ndim):
         lo = np.moveaxis(labels, axis, 0)[:-1]
         hi = np.moveaxis(labels, axis, 0)[1:]
-        n += 2 * int(((lo != hi) & (lo != 0) & (hi != 0)).sum())
+        n += 2 * int(_face_mask(lo, hi).sum())
     return n
+
+
+def plane_face_counts(slab: np.ndarray, prev_last=None):
+    """Per-z-plane valid-sample counts of one 3d slab, for streaming cap
+    sizing (a caller that never holds the whole volume accumulates these
+    slab by slab): returns ``(c_in, c_z, last_plane)`` where ``c_in[z]``
+    counts the in-plane (y/x-axis) samples of plane ``z`` and ``c_z[z]``
+    the samples of the pair (z, z+1) — ``c_z[-1]`` covers the pair into the
+    NEXT slab and is only filled once that slab's first plane is seen, via
+    ``prev_last`` on the next call."""
+    c_in = np.zeros(slab.shape[0], np.int64)
+    for ax in (1, 2):
+        lo = np.moveaxis(slab, ax, 1)[:, :-1]
+        hi = np.moveaxis(slab, ax, 1)[:, 1:]
+        c_in += 2 * _face_mask(lo, hi).sum(axis=(1, 2))
+    c_z = np.zeros(slab.shape[0], np.int64)
+    c_z[:-1] = 2 * _face_mask(slab[:-1], slab[1:]).sum(axis=(1, 2))
+    boundary = (
+        2 * int(_face_mask(prev_last, slab[0]).sum())
+        if prev_last is not None else 0
+    )
+    return c_in, c_z, boundary, slab[-1]
 
 
 def boundary_edge_features_device(

@@ -452,25 +452,21 @@ class ShardedProblemTask(VolumeSimpleTask):
         c_z = np.zeros(zp, np.int64)    # pairs between planes zi and zi+1
         prev_last = None
         slabs = []
+        from ..ops.rag import plane_face_counts
+
         for z0 in range(0, z, zc):
             # cast BEFORE unique: signed ignore labels (e.g. -1) must wrap
             # to their uint64 identity exactly as the full-volume cast did,
             # or the node table silently drops/disorders them
             slab = np.asarray(seg_ds[z0 : z0 + zc]).astype(np.uint64)
             slabs.append(np.unique(slab))
-            nz = slab != 0
-            for ax in (1, 2):
-                lo = np.moveaxis(slab, ax, 1)[:, :-1]
-                hi = np.moveaxis(slab, ax, 1)[:, 1:]
-                c_in[z0 : z0 + slab.shape[0]] += 2 * (
-                    (lo != hi) & (lo != 0) & (hi != 0)
-                ).sum(axis=(1, 2))
-            pair = (slab[:-1] != slab[1:]) & nz[:-1] & nz[1:]
-            c_z[z0 : z0 + slab.shape[0] - 1] += 2 * pair.sum(axis=(1, 2))
-            if prev_last is not None:
-                p = (prev_last != slab[0]) & (prev_last != 0) & (slab[0] != 0)
-                c_z[z0 - 1] += 2 * int(p.sum())
-            prev_last = slab[-1]
+            s_in, s_z, boundary, prev_last = plane_face_counts(
+                slab, prev_last
+            )
+            c_in[z0 : z0 + slab.shape[0]] += s_in
+            c_z[z0 : z0 + slab.shape[0]] += s_z
+            if z0:
+                c_z[z0 - 1] += boundary
         nodes = np.unique(np.concatenate(slabs)) if slabs else np.zeros(
             0, np.uint64
         )

@@ -222,6 +222,99 @@ def test_collective_task_layer_across_processes(tmp_path):
         assert "collective task build OK" in out
 
 
+FUSED_WORKER = r"""
+import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pid, nproc, port, root = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+)
+import os
+os.environ["CTT_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["CTT_NUM_PROCESSES"] = str(nproc)
+os.environ["CTT_PROCESS_ID"] = str(pid)
+
+from cluster_tools_tpu.parallel import mesh as mesh_mod
+
+assert mesh_mod.init_distributed()  # BEFORE any backend use
+
+import numpy as np
+from scipy import ndimage
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.tasks.features import ShardedWsProblemTask
+from cluster_tools_tpu.utils import file_reader
+
+path = os.path.join(root, "d.n5")
+if pid == 0:
+    rng = np.random.default_rng(3)
+    raw = ndimage.gaussian_filter(rng.random((16, 24, 24)), (1.0, 2.0, 2.0))
+    raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+    file_reader(path).create_dataset("bnd", data=raw, chunks=(8, 24, 24))
+    cfg.write_global_config(
+        os.path.join(root, "configs"),
+        {"block_shape": [8, 24, 24], "devices": "global"},
+    )
+    cfg.write_config(
+        os.path.join(root, "configs"), "sharded_ws_problem",
+        {"threshold": 0.6, "sigma_seeds": 1.0, "size_filter": 5,
+         "max_edges": 2048},
+    )
+    open(os.path.join(root, "ready"), "w").write("1")
+else:
+    import time
+
+    while not os.path.exists(os.path.join(root, "ready")):
+        time.sleep(0.1)
+
+task = ShardedWsProblemTask(
+    os.path.join(root, "tmp"), os.path.join(root, "configs"),
+    input_path=path, input_key="bnd",
+    output_path=path, output_key="ws",
+)
+assert build([task])
+if pid == 0:
+    from cluster_tools_tpu.tasks.base import scratch_store_path
+
+    ws = file_reader(path, "r")["ws"][:]
+    n_frag = len(np.unique(ws[ws > 0]))
+    assert n_frag > 2, n_frag
+    scratch = file_reader(scratch_store_path(os.path.join(root, "tmp")), "r")
+    edges = scratch["graph/edges"][:]
+    feats = scratch["features/edges"][:]
+    assert edges.shape[0] == feats.shape[0] > 0
+    assert scratch["graph/edges"].attrs["n_nodes"] == n_frag
+    # edges reference real fragments and counts are positive
+    assert edges.max() < n_frag and (feats[:, 9] > 0).all()
+print(f"[p{pid}] fused ws+problem collective build OK", flush=True)
+"""
+
+
+def test_fused_ws_problem_across_processes(tmp_path):
+    """The round-5 fused device-resident front under a 2-process global
+    mesh: every process enters the collective watershed AND the collective
+    RAG; process 0 owns the ws + scratch writes."""
+    worker = tmp_path / "fused_worker.py"
+    worker.write_text(FUSED_WORKER)
+    root = tmp_path / "runf"
+    root.mkdir()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    port = _free_port()
+    procs, outs = _spawn(worker, 2, env, extra_args=[port, root], timeout=420)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert "fused ws+problem collective build OK" in out
+
+
 def _spawn(worker_path, n_procs, env, extra_args=(), timeout=600):
     procs = [
         subprocess.Popen(
