@@ -793,11 +793,14 @@ def count_boundary_samples(labels: np.ndarray) -> int:
 def plane_face_counts(slab: np.ndarray, prev_last=None):
     """Per-z-plane valid-sample counts of one 3d slab, for streaming cap
     sizing (a caller that never holds the whole volume accumulates these
-    slab by slab): returns ``(c_in, c_z, last_plane)`` where ``c_in[z]``
-    counts the in-plane (y/x-axis) samples of plane ``z`` and ``c_z[z]``
-    the samples of the pair (z, z+1) — ``c_z[-1]`` covers the pair into the
-    NEXT slab and is only filled once that slab's first plane is seen, via
-    ``prev_last`` on the next call."""
+    slab by slab): returns ``(c_in, c_z, boundary, last_plane)`` where
+    ``c_in[z]`` counts the in-plane (y/x-axis) samples of plane ``z``,
+    ``c_z[z]`` the samples of the pair (z, z+1) WITHIN the slab
+    (``c_z[-1]`` is always 0 — the pair into the next slab cannot be
+    counted yet), and ``boundary`` the samples of the pair between
+    ``prev_last`` (the previous slab's last plane, from the previous
+    call's 4th element) and this slab's first plane — the caller adds it
+    at the previous slab's last index."""
     c_in = np.zeros(slab.shape[0], np.int64)
     for ax in (1, 2):
         lo = np.moveaxis(slab, ax, 1)[:, :-1]

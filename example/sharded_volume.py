@@ -80,14 +80,33 @@ def main():
     if not build([mc]):
         raise RuntimeError("sharded-problem multicut failed")
 
+    # the fully device-resident front (sharded_ws=True): watershed + RAG +
+    # features in ONE collective session — the boundary volume crosses
+    # host→device once and stays on the mesh through both stages; fastest
+    # path on real chips (per-stage store round-trips disappear)
+    cfg.write_config(config_dir, "sharded_ws_problem", {
+        "threshold": 0.4, "sigma_seeds": 1.0, "size_filter": 5,
+    })
+    fused = MulticutSegmentationWorkflow(
+        tmp_folder + "_fused", config_dir,
+        input_path=args.input, input_key=args.input_key,
+        ws_path=args.input, ws_key="sharded/fused_ws",
+        output_path=args.input, output_key="sharded/fused_multicut",
+        sharded_problem=True, sharded_ws=True,
+    )
+    if not build([fused]):
+        raise RuntimeError("fused sharded multicut failed")
+
     f = file_reader(args.input, "r")
     n_cc = len(np.unique(f["sharded/components"][:])) - 1
     n_ws = len(np.unique(f["sharded/watershed"][:])) - 1
     n_mc = len(np.unique(f["sharded/multicut"][:])) - 1
+    n_f = len(np.unique(f["sharded/fused_multicut"][:])) - 1
     import jax
 
     print(f"collective CC: {n_cc} components, collective DT-watershed: "
-          f"{n_ws} fragments, collective-problem multicut: {n_mc} segments "
+          f"{n_ws} fragments, collective-problem multicut: {n_mc} segments, "
+          f"fused device-resident multicut: {n_f} segments "
           f"over {jax.device_count()} devices")
 
 
