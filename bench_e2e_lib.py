@@ -23,8 +23,15 @@ WS_TASK_CONFIG = {
     "halo": [2, 4, 4],
 }
 # the collective (whole-volume) watershed variants take the same kernel
-# knobs minus the block-only halo — one derivation for every sharded config
-SHARDED_WS_CONFIG = {k: v for k, v in WS_TASK_CONFIG.items() if k != "halo"}
+# knobs minus the block-only halo, PLUS the per-slice mode flags matching
+# the block pipeline's default (apply_dt_2d/apply_ws_2d default True
+# there) — the collective 2d kernel is embarrassingly parallel over the
+# z-shards and measures the same algorithm the baseline runs
+SHARDED_WS_CONFIG = {
+    **{k: v for k, v in WS_TASK_CONFIG.items() if k != "halo"},
+    "apply_dt_2d": True,
+    "apply_ws_2d": True,
+}
 
 
 def _stage_volume(td, vol_path, shape, block_shape, warm):
@@ -166,8 +173,11 @@ def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False,
 
     ``sharded=True`` runs the collective whole-volume watershed
     (WatershedWorkflow(sharded=True): one upload, one program over the
-    mesh, one label write) instead of the block pipeline — the 3d
-    collective fragmentation, reported separately by the bench."""
+    mesh, one label write) instead of the block pipeline.  Since round 5
+    SHARDED_WS_CONFIG selects the per-slice (2d) collective kernel — the
+    SAME algorithm the block pipeline and the cpu-local baseline run
+    (apples-to-apples), zero cross-shard collectives; rounds before that
+    measured the 3d collective."""
     from cluster_tools_tpu.runtime import build, config as cfg
     from cluster_tools_tpu.workflows import WatershedWorkflow
 

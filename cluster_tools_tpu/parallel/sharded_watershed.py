@@ -219,6 +219,129 @@ def _stage_a(
     )(x)
 
 
+def _stage_input(input_, mesh, axis_name, invert_input, z_valid, who):
+    """Shared placement contract of both collective watershed kernels:
+    accept a pre-placed (padded) device array carrying the mesh sharding —
+    validated float32 with a mesh-divisible z extent, ``z_valid``
+    required — or a host array, padded on the foreground side of the
+    threshold and placed via ``put_global``.  Returns ``(x_d, z_valid)``."""
+    from .mesh import put_global
+
+    n = mesh.shape[axis_name]
+    pre_placed = isinstance(input_, jax.Array) and input_.sharding.is_equivalent_to(
+        NamedSharding(mesh, P(axis_name)), input_.ndim
+    )
+    if pre_placed:
+        if z_valid is None:
+            raise ValueError(
+                f"pass z_valid when handing {who} a pre-placed (possibly "
+                "padded) device array"
+            )
+        if input_.dtype != jnp.float32 or input_.shape[0] % n:
+            raise ValueError(
+                "pre-placed input must be float32 with a mesh-divisible z "
+                f"extent, got {input_.dtype} {input_.shape}"
+            )
+        return input_, int(z_valid)
+    if z_valid is None:
+        z_valid = int(input_.shape[0])
+    pad = (-z_valid) % n
+    arr = np.asarray(input_, dtype=np.float32)
+    if pad:
+        # foreground side of the threshold AFTER the kernel's inversion
+        # (assumes 0 < threshold < 1, the reference's probability range)
+        pad_val = 1.0 if invert_input else 0.0
+        arr = np.pad(
+            arr, ((0, pad), (0, 0), (0, 0)), constant_values=pad_val
+        )
+    return put_global(arr, mesh, axis_name, dtype=np.float32), int(z_valid)
+
+
+def sharded_dt_watershed_2d(
+    input_,
+    mesh=None,
+    axis_name: str = "data",
+    threshold: float = 0.25,
+    sigma_seeds: float = 2.0,
+    sigma_weights: float = 2.0,
+    alpha: float = 0.8,
+    size_filter: int = 25,
+    invert_input: bool = False,
+    z_valid: Optional[int] = None,
+) -> Tuple[np.ndarray, int]:
+    """Per-slice (2d DT + 2d flood) whole-volume watershed over the mesh —
+    the collective form of the reference's CREMI default
+    (``apply_dt_2d=True, apply_ws_2d=True``, watershed.py:286-344's 2d
+    branch).
+
+    z-slices are INDEPENDENT in this mode, so z-sharding makes the whole
+    computation embarrassingly parallel: every shard runs the fused
+    single-device kernel on its slab and NO collective is needed at all —
+    the cheapest possible mapping onto the mesh (no cross-shard rounds, no
+    boundary exchanges; contrast ``sharded_dt_watershed``'s 3d fixpoints).
+    Slices are processed by the identical single-device kernel, so the
+    PARTITION equals ``dt_watershed(x, apply_dt_2d=True,
+    apply_ws_2d=True)`` exactly (tested).  Label values are slab-local
+    (the kernel numbers seeds consecutively within its input) made
+    globally unique by the shard's plane offset ``z0*Y*X`` — callers
+    relabel consecutively anyway (both tasks do).
+
+    Pad slabs (z not divisible by the mesh) are excluded via the kernel's
+    ``valid`` mask, so they produce no labels.  Returns
+    ``(labels int32 [host, z_valid], n_bound)`` where ``n_bound`` is the
+    summed per-slab max id — the exact distinct count when
+    ``size_filter=0`` and an upper bound otherwise (the filter removes ids
+    without renumbering); production callers relabel consecutively anyway.
+    """
+    from ..ops.watershed import dt_watershed
+    from .mesh import fetch_global
+
+    mesh = mesh if mesh is not None else get_mesh(axis_name=axis_name)
+    n = mesh.shape[axis_name]
+    x_d, z_valid = _stage_input(
+        input_, mesh, axis_name, invert_input, z_valid,
+        "sharded_dt_watershed_2d",
+    )
+    zp, Y, X = x_d.shape
+    if zp * Y * X >= np.iinfo(np.int32).max:
+        raise ValueError(
+            "volume exceeds the int32 flat-index label space "
+            f"({zp}x{Y}x{X}); split it into ROIs"
+        )
+    h = zp // n
+
+    def local_fn(x):
+        idx = lax.axis_index(axis_name)
+        z0 = idx * h
+        plane = z0 + jnp.arange(h, dtype=jnp.int32)
+        valid = jnp.broadcast_to(
+            (plane < z_valid)[:, None, None], x.shape
+        )
+        lab, _ = dt_watershed(
+            x, threshold=threshold, apply_dt_2d=True, apply_ws_2d=True,
+            sigma_seeds=sigma_seeds, sigma_weights=sigma_weights,
+            alpha=alpha, size_filter=size_filter,
+            invert_input=invert_input, valid=valid,
+        )
+        off = z0 * jnp.int32(Y * X)
+        # the kernel numbers its slab's seeds 1..k consecutively, so the
+        # slab max bounds the slab's distinct count (exact when no size
+        # filter removes ids) — summed on host below, no full-volume
+        # unique pass for a value production callers discard
+        return jnp.where(lab > 0, lab + off, 0), jnp.max(lab)[None]
+
+    labels_d, n_per_shard = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=P(axis_name),
+        out_specs=(P(axis_name), P(axis_name)),
+        check_vma=False,
+    )(x_d)
+    labels = fetch_global(labels_d)[:z_valid]
+    n_labels = int(np.asarray(n_per_shard).sum())
+    return labels, n_labels
+
+
 def sharded_dt_watershed(
     input_,
     mesh=None,
@@ -254,40 +377,14 @@ def sharded_dt_watershed(
     from .sharded import sharded_seeded_watershed
 
     mesh = mesh if mesh is not None else get_mesh(axis_name=axis_name)
-    n = mesh.shape[axis_name]
-    pre_placed = isinstance(input_, jax.Array) and input_.sharding.is_equivalent_to(
-        NamedSharding(mesh, P(axis_name)), input_.ndim
+    x_d, z_valid = _stage_input(
+        input_, mesh, axis_name, invert_input, z_valid,
+        "sharded_dt_watershed",
     )
-    if pre_placed:
-        # streamed/padded placement: the caller owns the pad semantics
-        if z_valid is None:
-            raise ValueError(
-                "pass z_valid when handing sharded_dt_watershed a "
-                "pre-placed (possibly padded) device array"
-            )
-        if input_.dtype != jnp.float32 or input_.shape[0] % n:
-            raise ValueError(
-                "pre-placed input must be float32 with a mesh-divisible z "
-                f"extent, got {input_.dtype} {input_.shape}"
-            )
-    else:
-        if z_valid is None:
-            z_valid = int(input_.shape[0])
-        pad = (-z_valid) % n
-        input_ = np.asarray(input_, dtype=np.float32)
-        if pad:
-            # foreground side of the threshold AFTER the kernel's inversion
-            # (assumes 0 < threshold < 1, the reference's probability range)
-            pad_val = 1.0 if invert_input else 0.0
-            input_ = np.pad(
-                input_, ((0, pad), (0, 0), (0, 0)), constant_values=pad_val
-            )
     pitch = (1.0,) * 3 if pixel_pitch is None else tuple(
         float(p) for p in pixel_pitch
     )
-    from .mesh import fetch_global, put_global
-
-    x_d = put_global(input_, mesh, axis_name, dtype=np.float32)
+    from .mesh import fetch_global
 
     fg_d, maxima_d, hmap_d = _stage_a(
         x_d, threshold, pitch, sigma_seeds, sigma_weights, alpha,

@@ -570,9 +570,11 @@ class ShardedWsProblemTask(ShardedProblemTask):
     problem scratch, so every downstream consumer (costs, global solve,
     write) runs unchanged, and resume/checkpoint semantics stay store-based.
 
-    3d collective fragmentation (the ``apply_dt_2d=False`` kernel) — the
-    same partition as ``ShardedWatershedTask``; masked volumes go through
-    the block pipeline.
+    The watershed mode follows ``apply_dt_2d``/``apply_ws_2d`` in the task
+    config exactly like ``ShardedWatershedTask`` (both default False → the
+    3d collective; both True → the zero-collective per-slice kernel, the
+    block pipeline's CREMI default — ``run_sharded_ws_kernel`` dispatches).
+    Masked volumes go through the block pipeline.
     """
 
     task_name = "sharded_ws_problem"
@@ -597,9 +599,8 @@ class ShardedWsProblemTask(ShardedProblemTask):
             get_mesh, put_from_store, put_global, resolve_devices,
         )
         from ..parallel.sharded_rag import sharded_boundary_edge_features
-        from ..parallel.sharded_watershed import sharded_dt_watershed
         from ..utils import store
-        from .watershed import _normalize_host
+        from .watershed import _normalize_host, run_sharded_ws_kernel
 
         conf = {**self.global_config(), **self.get_task_config()}
         in_ds = store.file_reader(self.input_path, "r")[self.input_key]
@@ -641,17 +642,8 @@ class ShardedWsProblemTask(ShardedProblemTask):
             transform=_normalize_host,
         ))
 
-        pitch = conf.get("pixel_pitch")
-        labels, _ = timed("watershed", lambda: sharded_dt_watershed(
-            x_d, mesh=mesh,
-            threshold=float(conf["threshold"]),
-            pixel_pitch=tuple(pitch) if pitch else None,
-            sigma_seeds=float(conf.get("sigma_seeds", 2.0)),
-            sigma_weights=float(conf.get("sigma_weights", 2.0)),
-            alpha=float(conf.get("alpha", 0.8)),
-            size_filter=int(conf.get("size_filter", 25)),
-            invert_input=invert,
-            z_valid=z,
+        labels, _ = timed("watershed", lambda: run_sharded_ws_kernel(
+            x_d, conf, mesh, z_valid=z
         ))
         compact, n_labels = relabel_consecutive_np(labels.astype(np.uint64))
         compact32 = compact.astype(np.int32)

@@ -532,6 +532,44 @@ class TwoPassWatershedTask(WatershedTask):
             max_ids.write_chunk((bid,), np.array([lab.max()], dtype=np.int64))
 
 
+def run_sharded_ws_kernel(x_d, config, mesh, z_valid: int):
+    """Collective-watershed kernel dispatch shared by ShardedWatershedTask
+    and ShardedWsProblemTask: the per-slice (2d) embarrassingly-parallel
+    kernel when ``apply_dt_2d`` AND ``apply_ws_2d`` (the block pipeline's
+    CREMI default), the 3d cross-shard collective when both are False;
+    mixed settings are refused."""
+    from ..parallel.sharded_watershed import (
+        sharded_dt_watershed,
+        sharded_dt_watershed_2d,
+    )
+
+    dt_2d = bool(config.get("apply_dt_2d", False))
+    ws_2d = bool(config.get("apply_ws_2d", False))
+    if dt_2d != ws_2d:
+        raise ValueError(
+            "the collective watershed supports apply_dt_2d == apply_ws_2d "
+            "only (use the block pipeline for mixed 2d/3d modes)"
+        )
+    pitch = config.get("pixel_pitch")
+    common = dict(
+        mesh=mesh,
+        threshold=float(config["threshold"]),
+        sigma_seeds=float(config.get("sigma_seeds", 2.0)),
+        sigma_weights=float(config.get("sigma_weights", 2.0)),
+        alpha=float(config.get("alpha", 0.8)),
+        size_filter=int(config.get("size_filter", 25)),
+        invert_input=bool(config.get("invert_inputs", False)),
+        z_valid=z_valid,
+    )
+    if dt_2d:
+        if pitch:
+            raise ValueError("pixel_pitch requires the 3d collective mode")
+        return sharded_dt_watershed_2d(x_d, **common)
+    return sharded_dt_watershed(
+        x_d, pixel_pitch=tuple(pitch) if pitch else None, **common
+    )
+
+
 class ShardedWatershedTask(VolumeSimpleTask):
     """Whole-volume DT-watershed over the device mesh in collective form
     (``parallel.sharded_watershed.sharded_dt_watershed``) — the alternative
@@ -539,10 +577,15 @@ class ShardedWatershedTask(VolumeSimpleTask):
     aggregate HBM: no block offsets, no halos, no boundary inconsistencies,
     one globally-consistent fragmentation.
 
-    3d mode only (the collective kernel is the
-    ``apply_dt_2d=False, apply_ws_2d=False`` path); masks are not supported
-    yet — use the block pipeline for masked volumes.  ``collective``: under
-    a multi-process runtime every process enters the program together
+    Two collective modes, selected by the block pipeline's own knobs:
+    ``apply_dt_2d=True, apply_ws_2d=True`` (the CREMI default) runs the
+    per-slice kernel embarrassingly parallel over the z-shards — NO
+    collectives at all, bit-exact with the single-device 2d kernel; both
+    False runs the 3d collective (cross-shard EDT/flood fixpoints).  Mixed
+    2d/3d settings are refused (the block path supports them; the
+    collective formulations do not).  Masks are not supported yet — use
+    the block pipeline for masked volumes.  ``collective``: under a
+    multi-process runtime every process enters the program together
     (``devices: "global"``); process 0 owns the store writes.
     """
 
@@ -561,6 +604,10 @@ class ShardedWatershedTask(VolumeSimpleTask):
                 "size_filter": 25,
                 "alpha": 0.8,
                 "invert_inputs": False,
+                # collective kernel selection (defaults keep the round-4
+                # behavior: the 3d collective)
+                "apply_dt_2d": False,
+                "apply_ws_2d": False,
             }
         )
         return conf
@@ -570,7 +617,6 @@ class ShardedWatershedTask(VolumeSimpleTask):
 
         from ..ops.relabel import relabel_consecutive_np
         from ..parallel.mesh import get_mesh, put_from_store, resolve_devices
-        from ..parallel.sharded_watershed import sharded_dt_watershed
 
         config = {**self.global_config(), **self.get_task_config()}
         in_ds = store.file_reader(self.input_path, "r")[self.input_key]
@@ -594,18 +640,8 @@ class ShardedWatershedTask(VolumeSimpleTask):
             transform=_normalize_host,
         )
 
-        pitch = config.get("pixel_pitch")
-        labels, n_seeds = sharded_dt_watershed(
-            x_d,
-            mesh=mesh,
-            threshold=float(config["threshold"]),
-            pixel_pitch=tuple(pitch) if pitch else None,
-            sigma_seeds=float(config.get("sigma_seeds", 2.0)),
-            sigma_weights=float(config.get("sigma_weights", 2.0)),
-            alpha=float(config.get("alpha", 0.8)),
-            size_filter=int(config.get("size_filter", 25)),
-            invert_input=invert,
-            z_valid=int(in_ds.shape[0]),
+        labels, n_seeds = run_sharded_ws_kernel(
+            x_d, config, mesh, z_valid=int(in_ds.shape[0])
         )
         if _jax.process_index() != 0:
             return  # process 0 owns the writes

@@ -82,6 +82,99 @@ def test_sharded_dtws_deep_halo_smoothing(rng):
     assert _bijection(got, np.asarray(ref))
 
 
+class TestPerSlice2d:
+    """The collective per-slice mode (sharded_dt_watershed_2d): z-slices
+    are independent, so each slab runs the identical single-device kernel —
+    the partition must equal the whole-volume 2d kernel's exactly; label
+    values are slab-local + the shard plane offset (globally unique)."""
+
+    @pytest.mark.parametrize("size_filter", [0, 12])
+    def test_partition_matches_single_device(self, rng, size_filter):
+        from cluster_tools_tpu.parallel.sharded_watershed import (
+            sharded_dt_watershed_2d,
+        )
+
+        raw = _volume(rng)
+        kwargs = dict(threshold=0.6, sigma_seeds=1.0, sigma_weights=1.0,
+                      alpha=0.8, size_filter=size_filter)
+        ref, _ = dt_watershed(
+            jnp.asarray(raw), apply_dt_2d=True, apply_ws_2d=True, **kwargs
+        )
+        ref = np.asarray(ref)
+        got, n_got = sharded_dt_watershed_2d(raw, **kwargs)
+        assert ((got > 0) == (ref > 0)).all()
+        assert _bijection(got, ref)
+        # n is the summed per-slab max: exact distinct count unfiltered,
+        # an upper bound once the size filter removes ids
+        distinct = len(np.unique(got[got > 0]))
+        if size_filter == 0:
+            assert n_got == distinct == len(np.unique(ref[ref > 0]))
+        else:
+            assert n_got >= distinct > 0
+
+    def test_non_divisible_z_pad_produces_no_labels(self, rng):
+        from cluster_tools_tpu.parallel.sharded_watershed import (
+            sharded_dt_watershed_2d,
+        )
+
+        raw = _volume(rng, shape=(21, 16, 16))
+        kwargs = dict(threshold=0.6, sigma_seeds=1.0, sigma_weights=1.0,
+                      alpha=0.8, size_filter=8)
+        ref, _ = dt_watershed(
+            jnp.asarray(raw), apply_dt_2d=True, apply_ws_2d=True, **kwargs
+        )
+        got, _ = sharded_dt_watershed_2d(raw, **kwargs)
+        assert got.shape == raw.shape  # pad planes cropped, no pad labels
+        assert ((got > 0) == (np.asarray(ref) > 0)).all()
+        assert _bijection(got, np.asarray(ref))
+
+    def test_task_mode_dispatch(self, tmp_path, rng):
+        """ShardedWatershedTask with apply_dt_2d/ws_2d=True routes to the
+        per-slice kernel; mixed modes are refused."""
+        from cluster_tools_tpu.runtime import build, config as cfg
+        from cluster_tools_tpu.tasks.watershed import ShardedWatershedTask
+        from cluster_tools_tpu.utils import file_reader
+
+        raw = _volume(rng)
+        path = str(tmp_path / "d2.n5")
+        file_reader(path).create_dataset("bnd", data=raw, chunks=(12, 12, 12))
+        config_dir = str(tmp_path / "configs2")
+        cfg.write_global_config(
+            config_dir, {"block_shape": [12, 12, 12], "target": "tpu"}
+        )
+        cfg.write_config(
+            config_dir, "sharded_watershed",
+            {"threshold": 0.6, "sigma_seeds": 1.0, "size_filter": 10,
+             "apply_dt_2d": True, "apply_ws_2d": True},
+        )
+        task = ShardedWatershedTask(
+            str(tmp_path / "tmp2"), config_dir,
+            input_path=path, input_key="bnd",
+            output_path=path, output_key="ws2d",
+        )
+        assert build([task])
+        ws = file_reader(path, "r")["ws2d"][:]
+        ref, _ = dt_watershed(
+            jnp.asarray(raw), apply_dt_2d=True, apply_ws_2d=True,
+            threshold=0.6, sigma_seeds=1.0, sigma_weights=2.0, size_filter=10,
+        )
+        assert _bijection(ws, np.asarray(ref))
+        ids = np.unique(ws)
+        assert ids[0] == 0 and (np.diff(ids) == 1).all()  # consecutive
+
+        cfg.write_config(
+            config_dir, "sharded_watershed",
+            {"threshold": 0.6, "apply_dt_2d": True, "apply_ws_2d": False},
+        )
+        bad = ShardedWatershedTask(
+            str(tmp_path / "tmp3"), config_dir,
+            input_path=path, input_key="bnd",
+            output_path=path, output_key="wsbad",
+        )
+        with pytest.raises(Exception, match="apply_dt_2d == apply_ws_2d"):
+            bad.run()
+
+
 def test_sharded_watershed_workflow(tmp_path, rng):
     """WatershedWorkflow(sharded=True): one collective task, globally
     consistent fragments (no block-offset id ranges), consecutive ids."""
