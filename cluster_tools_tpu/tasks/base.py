@@ -70,6 +70,9 @@ class VolumeTask(BlockTask):
             if self.output_chunks_from_blocks
             else None
         )
+        # user-facing outputs keep the reference's gzip default (vanilla
+        # n5-java readers lack the blosc plugin); SCRATCH datasets get the
+        # fast house codec via create_dataset's "default"
         f.require_dataset(
             self.output_key,
             shape=tuple(blocking.shape),
@@ -160,8 +163,10 @@ class VolumeSimpleTask(SimpleTask):
 
     def require_output(self, shape, conf, dtype="uint64"):
         """Create/open ``output_path/output_key`` with the house convention
-        (block-shape chunks, gzip) — one recipe for every single-shot task
-        that writes a volume."""
+        (block-shape chunks, gzip — user-facing outputs stay on the
+        reference's default codec for vanilla n5-java readability; scratch
+        data rides the fast blosc default) — one recipe for every
+        single-shot task that writes a volume."""
         f = store.file_reader(self.output_path, "a")
         block_shape = conf.get("block_shape")
         return f.require_dataset(
