@@ -131,12 +131,28 @@ def _is_blosc(compression) -> bool:
     return isinstance(compression, dict) and compression.get("id") == "blosc"
 
 
+def _clamp_chunks(chunks, shape):
+    """Chunk dims never exceed the shape; zero-size dims keep the chunk
+    (h5py/zarr both reject zero chunks) — the one clamp both the directory
+    stores and the h5 façade apply."""
+    return tuple(min(c, s) if s > 0 else c for c, s in zip(chunks, shape))
+
+
 def default_compression():
     """The house codec for datasets the framework creates: blosc-lz4 when
     the system libblosc is present (6-30x faster than gzip-1 per chunk at
     equal-or-better ratios on label/boundary data — SURVEY.md §7 hard-part
     5 'blosc intermediates'), else gzip.  Explicit ``compression=`` values
-    always win; the sentinel string ``"default"`` resolves here."""
+    always win; the sentinel string ``"default"`` resolves here.
+
+    ``CTT_DEFAULT_COMPRESSION`` pins the resolution (``gzip``/``blosc``)
+    for deployments where codec availability varies across nodes — the
+    scratch store's meta records whatever the CREATING node resolved, and
+    a reading node without libblosc would fail loudly; on such mixed
+    installs pin gzip."""
+    pinned = os.environ.get("CTT_DEFAULT_COMPRESSION")
+    if pinned in ("gzip", "blosc"):
+        return pinned
     return "blosc" if _blosc_mod().available() else "gzip"
 
 
@@ -749,7 +765,7 @@ class Group:
             raise ValueError("shape and dtype (or data) are required")
         if chunks is None:
             chunks = tuple(min(s, 64) for s in shape)
-        chunks = tuple(min(c, s) if s > 0 else c for c, s in zip(chunks, shape))
+        chunks = _clamp_chunks(chunks, shape)
         # normalize/validate the compression spec BEFORE any destructive
         # step: the exist_ok overwrite below rmtree's the old array, and a
         # late failure (e.g. missing libblosc) must not have deleted data
@@ -933,17 +949,19 @@ class _CachedH5File:
 
     def create_dataset(self, key, shape=None, dtype=None, chunks=None,
                        compression="default", data=None, **kw):
-        if data is not None:
+        if data is not None and not isinstance(data, (str, bytes)):
+            # str/bytes stay raw: h5py stores them as vlen strings, and
+            # np.asarray would turn str into a U-dtype h5py rejects
             data = np.asarray(data)
             if shape is None:
                 shape = data.shape
+            elif tuple(shape) != data.shape:
+                data = data.reshape(shape)  # h5py semantics: shape wins
         if chunks is not None and shape is not None:
-            # mirror Group.create_dataset's clamp incl. the zero-size guard
-            chunks = tuple(
-                min(c, s) if s > 0 else c for c, s in zip(chunks, shape)
-            )
-        scalar = shape is not None and (
-            len(shape) == 0 or any(s == 0 for s in shape)
+            chunks = _clamp_chunks(chunks, shape)
+        scalar = (data is not None and np.ndim(data) == 0) or (
+            shape is not None
+            and (len(shape) == 0 or any(s == 0 for s in shape))
         )
         if scalar:
             # h5py: scalar/empty datasets take no chunk/filter options

@@ -156,6 +156,52 @@ def test_int_index_drops_axis(tmp_path, rng):
         ds[7]
 
 
+class TestH5FacadeDatasets:
+    """The h5 façade's create/require_dataset: our compression vocabulary
+    mapped onto h5py, scalar/empty datasets skip filters, dtype honored
+    with data, loud dtype conformance on reuse."""
+
+    def test_compression_vocabulary_and_scalars(self, tmp_path):
+        pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        f = store.file_reader(str(tmp_path / "v.h5"), "a")
+        f.create_dataset("scalar", data=np.bytes_("meta"))  # no filter crash
+        f.create_dataset("empty", shape=(0,), dtype="uint64", chunks=(64,))
+        d = f.create_dataset(
+            "blosc_req", data=np.arange(32.0), compression="blosc"
+        )
+        assert d.compression == "gzip"  # house codecs map onto h5py's gzip
+        r = f.create_dataset("raw", data=np.arange(8), compression="raw")
+        assert r.compression is None
+
+    def test_str_data_and_shape_with_data(self, tmp_path):
+        """h5py semantics preserved: str stored as vlen string; an explicit
+        shape reshapes the data."""
+        pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        f = store.file_reader(str(tmp_path / "s.h5"), "a")
+        f.create_dataset("s", data="hello")  # vlen string, no U-dtype crash
+        assert f["s"][()] in (b"hello", "hello")
+        d = f.create_dataset("r", shape=(2, 2), data=np.arange(4))
+        assert d.shape == (2, 2)
+
+    def test_dtype_with_data_and_reuse_conformance(self, tmp_path):
+        pytest.importorskip("h5py")
+        from cluster_tools_tpu.utils import store
+
+        f = store.file_reader(str(tmp_path / "d.h5"), "a")
+        d = f.create_dataset("typed", data=[1, 2, 3], dtype="uint32")
+        assert d.dtype == np.uint32
+        f.require_dataset("typed", shape=(3,), dtype="uint32")  # ok
+        f.require_dataset("typed", shape=(3,), dtype="uint16")  # safe cast ok
+        with pytest.raises(TypeError, match="dtype"):
+            f.require_dataset("typed", shape=(3,), dtype="float64")
+        with pytest.raises(ValueError, match="shape"):
+            f.require_dataset("typed", shape=(5,), dtype="uint32")
+
+
 class TestH5HandleCache:
     def test_same_file_read_then_write(self, tmp_path):
         """HDF5 refuses two opens with different modes per process; the
