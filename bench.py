@@ -754,8 +754,10 @@ def bench_e2e(x, block_shape, platform=None):
         # candidate: this process, default device (the TPU chip under the
         # driver); warm=True also reports the jit-cache-warm re-run — the
         # steady-state number a production sweep over many ROIs pays
+        dev_seg_path = os.path.join(td, "seg_dev.npy")
         t_dev, t_dev_warm = run_pipeline(
-            vol_path, x.shape, block_shape, "tpu", warm=True
+            vol_path, x.shape, block_shape, "tpu", warm=True,
+            seg_export=dev_seg_path,
         )
         log(f"[e2e] tpu target {t_dev:.2f} s (warm {t_dev_warm:.2f} s)")
 
@@ -806,6 +808,7 @@ def bench_e2e(x, block_shape, platform=None):
 
         # baseline: same framework, host XLA-CPU backend, local target
         script = os.path.join(td, "e2e_cpu.py")
+        host_seg_path = os.path.join(td, "seg_host.npy")
         with open(script, "w") as f:
             f.write(
                 "import json, os, sys\n"
@@ -815,7 +818,8 @@ def bench_e2e(x, block_shape, platform=None):
                 "jax.config.update('jax_platforms', 'cpu')\n"
                 "from bench_e2e_lib import run_pipeline\n"
                 f"t = run_pipeline({vol_path!r}, {tuple(x.shape)!r}, "
-                f"{tuple(block_shape)!r}, 'local')\n"
+                f"{tuple(block_shape)!r}, 'local', "
+                f"seg_export={host_seg_path!r})\n"
                 "print(json.dumps({'wall_s': t}))\n"
             )
         t0 = time.perf_counter()
@@ -845,6 +849,28 @@ def bench_e2e(x, block_shape, platform=None):
             f"[e2e] cpu-local baseline {t_host:.2f} s (subprocess total "
             f"{time.perf_counter()-t0:.1f} s)"
         )
+        # segmentation parity vs the local target — the BASELINE.md north
+        # star is defined at "segmentation-identical Rand/VoI", so the
+        # contract carries the measured agreement of the two cold runs
+        try:
+            from cluster_tools_tpu.ops.evaluation import (
+                evaluate_segmentation,
+            )
+
+            dev_seg = np.load(dev_seg_path)
+            host_seg = np.load(host_seg_path)
+            # ignore_gt_zero=False: this is a PARITY check, not a gt
+            # evaluation — background disagreement (flood-mask/size-filter
+            # differences) must count, and the metric must be symmetric
+            m = evaluate_segmentation(dev_seg, host_seg,
+                                      ignore_gt_zero=False)
+            warm["e2e_parity_rand_index"] = round(m["rand_index"], 6)
+            warm["e2e_parity_vi_split"] = round(m["vi_split"], 6)
+            warm["e2e_parity_vi_merge"] = round(m["vi_merge"], 6)
+            log(f"[e2e] tpu-vs-local parity: RI {m['rand_index']:.6f}, "
+                f"VoI {m['vi_split']:.4f}/{m['vi_merge']:.4f}")
+        except Exception as e:
+            log(f"[e2e] parity metrics unavailable: {e}")
     return x.size / t_dev / 1e6, t_host / t_dev, t_sharded, warm
 
 

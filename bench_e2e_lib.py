@@ -53,7 +53,7 @@ def _stage_volume(td, vol_path, shape, block_shape, warm):
 
 
 def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
-                 sharded_ws=False, warm=False):
+                 sharded_ws=False, warm=False, seg_export=None):
     """Wall-clock of the full pipeline; ``sharded_problem=True`` swaps the
     block-wise graph+features extraction for the one-program collective
     path (ShardedProblemTask + global solve); ``sharded_ws=True``
@@ -152,6 +152,13 @@ def run_pipeline(vol_path, shape, block_shape, target, sharded_problem=False,
                   file=sys.stderr, flush=True)
 
         wall, cold_breakdown = one_run("", "bnd")
+        if seg_export is not None:
+            # the cold run's final segmentation, for cross-target Rand/VoI
+            # parity (BASELINE.md: "Rand-Index / VoI parity vs 'local'")
+            from cluster_tools_tpu.utils import file_reader
+
+            with file_reader(data_path, "r") as f:
+                np.save(seg_export, f["seg"][:])
         if not warm:
             return wall
         # cold-vs-warm per task separates compile cost (cold only) from
