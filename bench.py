@@ -57,6 +57,32 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+DEFAULT_BENCH_DEADLINE_S = 2400.0
+
+
+def parse_deadline_env(env=None):
+    """CTT_BENCH_DEADLINE_S as a positive finite float, else the default.
+
+    The deadline guards the unlosable-contract machinery; a malformed value
+    from a driver/CI template must degrade to the default with a warning,
+    never crash the bench before the first JSON line."""
+    raw = (os.environ if env is None else env).get("CTT_BENCH_DEADLINE_S")
+    if raw is None:
+        return DEFAULT_BENCH_DEADLINE_S
+    try:
+        value = float(raw)
+    except (TypeError, ValueError):
+        log(f"[bench] invalid CTT_BENCH_DEADLINE_S={raw!r} (not a number); "
+            f"using default {DEFAULT_BENCH_DEADLINE_S:.0f}s")
+        return DEFAULT_BENCH_DEADLINE_S
+    if not (value > 0.0) or value != value or value == float("inf"):
+        log(f"[bench] invalid CTT_BENCH_DEADLINE_S={raw!r} (must be a "
+            f"positive finite number); using default "
+            f"{DEFAULT_BENCH_DEADLINE_S:.0f}s")
+        return DEFAULT_BENCH_DEADLINE_S
+    return value
+
+
 def make_volume(shape, seed=0, boundary_frac=0.12):
     """CREMI-like smooth boundary-probability volume.
 
@@ -921,7 +947,7 @@ def main():
         #   * configs run in priority order: the headline metric first, then
         #     the north-star workloads, then the per-kernel configs.
         t_start = time.perf_counter()
-        deadline_s = float(os.environ.get("CTT_BENCH_DEADLINE_S", "2400"))
+        deadline_s = parse_deadline_env()
         merged = {
             "metric": "dt_watershed_throughput_per_chip",
             "value": None,

@@ -1,0 +1,35 @@
+"""ctt-lint: static analysis for the TPU pipeline.
+
+Two families of checks (see COMPONENTS.md, "Static analysis"):
+
+  * AST invariant lints (CTT0xx) over ``ops/``, ``parallel/``,
+    ``runtime/``, ``tasks/``, ``workflows/``, ``utils/`` and the marker /
+    noqa hygiene rules over ``tests/`` — ``ast_rules.py``;
+  * workflow-graph validation (CTT1xx) over every workflow's task DAG,
+    built by instantiation with sentinel arguments, never executed —
+    ``graph.py``.
+
+CLI: ``python -m cluster_tools_tpu.analysis [--fail-on-findings]``.
+Suppression: ``# ctt: noqa[CTT003] reason``.
+"""
+
+from .core import Finding, REGISTRY, filter_suppressed, parse_suppressions
+from .ast_rules import lint_paths, lint_source, registered_markers
+from .graph import (
+    validate_workflow_class,
+    validate_workflow_file,
+    validate_workflows_dir,
+)
+
+__all__ = [
+    "Finding",
+    "REGISTRY",
+    "filter_suppressed",
+    "parse_suppressions",
+    "lint_paths",
+    "lint_source",
+    "registered_markers",
+    "validate_workflow_class",
+    "validate_workflow_file",
+    "validate_workflows_dir",
+]

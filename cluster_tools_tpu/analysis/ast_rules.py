@@ -1,0 +1,537 @@
+"""AST invariant lints (CTT0xx) over the accelerator/runtime source.
+
+The rules encode the invariants the TPU rebuild otherwise only enforces
+through runtime tests:
+
+  CTT001  no host-materializing calls inside ``@jax.jit``/``shard_map``
+          bodies (``np.*``, ``jax.device_get``, ``.block_until_ready()``,
+          ``.item()``, ``.tolist()``) — a host sync inside a traced body
+          either crashes on tracers or silently serializes the pipeline.
+          Trace-time-constant helpers (``np.iinfo``/``np.finfo``/dtype
+          constructors/...) are allowed.
+  CTT002  no wall-clock or host randomness inside jitted bodies
+          (``time.time()``, ``random.*``, ``np.random.*``) — they burn
+          into the compiled program as constants.
+  CTT003  collectives (``psum``/``ppermute``/``all_gather``/...) only in
+          ``parallel/`` modules, where the mesh context that gives their
+          axis names meaning lives.
+  CTT004  no wide-dtype drift into device code: ``jnp.float64``/
+          ``jnp.int64``/``jnp.uint64`` anywhere, or 64-bit dtype literals
+          inside jitted bodies / passed to ``jnp`` calls — without
+          ``jax_enable_x64`` these silently demote and mask precision bugs.
+  CTT005  no iteration over ``set`` values where the order can leak into
+          constructed state (task graphs, pin files, edge lists) — wrap in
+          ``sorted()`` or iterate a list.  Order-invariant consumers
+          (``sorted``/``min``/``max``/``sum``/``len``/``any``/``all``/set
+          algebra) are allowed.
+  CTT006  every ``pytest.mark.<name>`` used under ``tests/`` must be
+          registered in ``pyproject.toml`` (``[tool.pytest.ini_options]
+          markers``) — unregistered markers make ``-m`` selection silently
+          select nothing and spam warnings.
+  CTT007  noqa hygiene: a ``# ctt: noqa[...]`` referencing an unknown rule
+          id (or an empty bracket) suppresses nothing and hides typos.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List, Optional, Sequence, Set
+
+from .core import Finding, REGISTRY, register_rule
+
+register_rule("CTT001", "host-materializing call inside a jitted body")
+register_rule("CTT002", "wall-clock/host randomness inside a jitted body")
+register_rule("CTT003", "collective call outside parallel/ mesh context")
+register_rule("CTT004", "wide (64-bit) dtype in device code")
+register_rule("CTT005", "order-sensitive iteration over a set")
+register_rule("CTT006", "pytest marker not registered in pyproject.toml")
+register_rule("CTT007", "noqa comment references an unknown rule id")
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_SHARD_MAP_NAMES = {"shard_map", "jax.experimental.shard_map.shard_map"}
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    name = dotted_name(dec)
+    if name in _JIT_NAMES | _SHARD_MAP_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_NAMES | _SHARD_MAP_NAMES:
+            return True  # @jax.jit(static_argnums=...) / @shard_map(...)
+        if fname in {"partial", "functools.partial"} and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner in _JIT_NAMES | _SHARD_MAP_NAMES:
+                return True
+    return False
+
+
+def jitted_functions(tree: ast.Module) -> List[ast.FunctionDef]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                out.append(node)
+    return out
+
+
+# --------------------------------------------------------------------------
+# CTT001 / CTT002 / CTT004-in-jit: walk jitted bodies
+
+# np.* helpers that only produce trace-time constants — legal inside jit
+_TRACE_SAFE_NP = {
+    "iinfo", "finfo", "dtype", "promote_types", "result_type", "can_cast",
+    # scalar dtype constructors (np.float32(x) on a python scalar)
+    "float32", "float16", "bfloat16", "int32", "int16", "int8",
+    "uint32", "uint16", "uint8", "bool_",
+    # trace-time arithmetic on static shapes/sizes (np.prod(x.shape),
+    # np.ceil(np.log2(n)) for loop-bound derivation) — the codebase idiom
+    "prod", "ceil", "floor", "log2", "sqrt",
+}
+
+_HOST_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+
+_WIDE_DTYPES = {"float64", "int64", "uint64"}
+
+_TIME_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+
+def _check_jit_body(
+    fn: ast.FunctionDef, path: str, findings: List[Finding]
+) -> None:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            root = name.split(".")[0]
+            # -- CTT002: clock / host RNG ---------------------------------
+            if (
+                name in _TIME_CALLS
+                or root == "random"
+                or name.startswith(("np.random", "numpy.random"))
+            ):
+                findings.append(Finding(
+                    "CTT002", path, node.lineno,
+                    f"`{name}` inside jitted `{fn.name}` bakes host "
+                    "state into the compiled program",
+                ))
+                continue
+            # -- CTT001: host materialization -----------------------------
+            if name in {"jax.device_get", "device_get"}:
+                findings.append(Finding(
+                    "CTT001", path, node.lineno,
+                    f"`{name}` inside jitted `{fn.name}` forces a device "
+                    "sync on a tracer",
+                ))
+                continue
+            if root in {"np", "numpy"}:
+                leaf = name.split(".")[-1]
+                if leaf not in _TRACE_SAFE_NP:
+                    findings.append(Finding(
+                        "CTT001", path, node.lineno,
+                        f"`{name}` inside jitted `{fn.name}` runs on the "
+                        "host — use jnp, or hoist to trace-time constants",
+                    ))
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS
+            ):
+                findings.append(Finding(
+                    "CTT001", path, node.lineno,
+                    f"`.{node.func.attr}()` inside jitted `{fn.name}` "
+                    "forces a host sync",
+                ))
+                continue
+        # -- CTT004: wide dtype mentioned inside device code --------------
+        if isinstance(node, ast.Attribute):
+            name = dotted_name(node) or ""
+            if (
+                name.split(".")[0] in {"np", "numpy", "jnp"}
+                and name.split(".")[-1] in _WIDE_DTYPES
+            ):
+                findings.append(Finding(
+                    "CTT004", path, node.lineno,
+                    f"`{name}` inside jitted `{fn.name}` — 64-bit dtypes "
+                    "demote silently without jax_enable_x64",
+                ))
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value in _WIDE_DTYPES:
+                findings.append(Finding(
+                    "CTT004", path, node.lineno,
+                    f"dtype literal '{node.value}' inside jitted "
+                    f"`{fn.name}`",
+                ))
+
+
+# --------------------------------------------------------------------------
+# CTT004 outside jit: jnp-wide dtypes anywhere, 64-bit literals fed to jnp
+
+
+def _check_wide_dtypes_module(
+    tree: ast.Module, path: str, jit_fns: Sequence[ast.FunctionDef],
+    findings: List[Finding],
+) -> None:
+    jit_nodes = set()
+    for fn in jit_fns:
+        jit_nodes.update(id(n) for n in ast.walk(fn))
+    for node in ast.walk(tree):
+        if id(node) in jit_nodes:
+            continue  # already covered by the in-jit check
+        if isinstance(node, ast.Attribute):
+            name = dotted_name(node) or ""
+            parts = name.split(".")
+            if parts[0] in {"jnp", "jax"} and parts[-1] in _WIDE_DTYPES:
+                findings.append(Finding(
+                    "CTT004", path, node.lineno,
+                    f"`{name}` — jax arrays must stay <= 32-bit "
+                    "(no jax_enable_x64 in this codebase)",
+                ))
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func) or ""
+            if fname.split(".")[0] in {"jnp"}:
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and isinstance(kw.value, ast.Constant):
+                        if kw.value.value in _WIDE_DTYPES:
+                            findings.append(Finding(
+                                "CTT004", path, node.lineno,
+                                f"dtype='{kw.value.value}' passed to "
+                                f"`{fname}`",
+                            ))
+
+
+# --------------------------------------------------------------------------
+# CTT003: collectives outside parallel/
+
+_COLLECTIVES = {
+    "psum", "psum_scatter", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "axis_index",
+}
+
+
+def _collective_allowed(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    return "parallel" in parts
+
+
+def _check_collectives(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    if _collective_allowed(path):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        parts = name.split(".")
+        if parts[-1] not in _COLLECTIVES:
+            continue
+        # only flag jax.lax-rooted (or bare-imported) collective names;
+        # arbitrary methods that happen to collide are not collectives
+        if len(parts) == 1 or parts[0] in {"jax", "lax"}:
+            findings.append(Finding(
+                "CTT003", path, node.lineno,
+                f"collective `{name}` outside parallel/ — collectives "
+                "need the mesh context that names their axes",
+            ))
+
+
+# --------------------------------------------------------------------------
+# CTT005: order-sensitive set iteration
+
+_ORDER_INVARIANT_CONSUMERS = {
+    "sorted", "min", "max", "sum", "len", "any", "all", "set", "frozenset",
+}
+_ORDER_SENSITIVE_CONSUMERS = {"list", "tuple", "enumerate"}
+
+
+class _SetIterVisitor(ast.NodeVisitor):
+    """Track names bound to set expressions per function scope and flag
+    order-sensitive iteration over them."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+        self.set_names: List[Set[str]] = [set()]
+        self.nonset_names: List[Set[str]] = [set()]
+
+    # -- scope handling ---------------------------------------------------
+
+    def _enter(self):
+        self.set_names.append(set())
+        self.nonset_names.append(set())
+
+    def _exit(self):
+        self.set_names.pop()
+        self.nonset_names.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter()
+        self.generic_visit(node)
+        self._exit()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- binding tracking -------------------------------------------------
+
+    @staticmethod
+    def _is_set_expr(node: ast.AST) -> bool:
+        if isinstance(node, ast.Set):
+            return True
+        if isinstance(node, ast.SetComp):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in {"set", "frozenset"}:
+                return True
+            if isinstance(node.func, ast.Attribute) and node.func.attr in {
+                "intersection", "union", "difference", "symmetric_difference",
+            }:
+                return False  # could be sets, but too ambiguous to track
+        return False
+
+    def _is_tracked_set(self, node: ast.AST) -> bool:
+        if self._is_set_expr(node):
+            return True
+        if isinstance(node, ast.Name):
+            for tracked, shadowed in zip(
+                reversed(self.set_names), reversed(self.nonset_names)
+            ):
+                if node.id in shadowed:
+                    return False
+                if node.id in tracked:
+                    return True
+        return False
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if self._is_set_expr(node.value):
+                    self.set_names[-1].add(tgt.id)
+                    self.nonset_names[-1].discard(tgt.id)
+                else:
+                    self.nonset_names[-1].add(tgt.id)
+                    self.set_names[-1].discard(tgt.id)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if isinstance(node.target, ast.Name) and node.value is not None:
+            if self._is_set_expr(node.value):
+                self.set_names[-1].add(node.target.id)
+            else:
+                self.nonset_names[-1].add(node.target.id)
+        self.generic_visit(node)
+
+    # -- iteration sites --------------------------------------------------
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            "CTT005", self.path, node.lineno,
+            f"{what} iterates a set — ordering is hash-seed dependent; "
+            "wrap in sorted() or restructure",
+        ))
+
+    def visit_For(self, node):
+        if self._is_tracked_set(node.iter):
+            self._flag(node, "for-loop")
+        self.generic_visit(node)
+
+    def _check_comprehension(self, node, what: str):
+        for gen in node.generators:
+            if self._is_tracked_set(gen.iter):
+                self._flag(node, what)
+        self.generic_visit(node)
+
+    def visit_ListComp(self, node):
+        self._check_comprehension(node, "list comprehension")
+
+    def visit_DictComp(self, node):
+        self._check_comprehension(node, "dict comprehension")
+
+    def visit_Call(self, node):
+        name = dotted_name(node.func)
+        if name in _ORDER_SENSITIVE_CONSUMERS and node.args:
+            if self._is_tracked_set(node.args[0]):
+                self._flag(node, f"{name}() over a set")
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------------
+# CTT006: unregistered pytest markers
+
+# markers pytest itself (or its bundled plugins) always knows
+_BUILTIN_MARKERS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures", "filterwarnings",
+}
+
+_PYPROJECT_MARKER_RE = re.compile(
+    r"markers\s*=\s*\[(?P<body>.*?)\]", re.DOTALL
+)
+
+
+def registered_markers(pyproject_path: str) -> Set[str]:
+    """Markers declared in ``[tool.pytest.ini_options] markers``.  Parsed
+    with a regex (no tomllib on py3.10); each entry is ``"name: doc"``."""
+    try:
+        with open(pyproject_path) as f:
+            text = f.read()
+    except OSError:
+        return set()
+    m = _PYPROJECT_MARKER_RE.search(text)
+    if m is None:
+        return set()
+    out: Set[str] = set()
+    for entry in re.findall(r"[\"']([^\"']+)[\"']", m.group("body")):
+        out.add(entry.split(":")[0].strip().split("(")[0])
+    return out
+
+
+def _check_markers(
+    tree: ast.Module, path: str, registered: Set[str],
+    findings: List[Finding],
+) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        name = dotted_name(node) or ""
+        parts = name.split(".")
+        if len(parts) < 3 or parts[-3:-1] != ["pytest", "mark"]:
+            continue
+        marker = parts[-1]
+        if marker in _BUILTIN_MARKERS or marker in registered:
+            continue
+        findings.append(Finding(
+            "CTT006", path, node.lineno,
+            f"pytest marker `{marker}` is not registered in "
+            "pyproject.toml [tool.pytest.ini_options] markers",
+        ))
+
+
+# --------------------------------------------------------------------------
+# CTT007: noqa hygiene (regex over raw source; comments are not in the AST)
+
+from .core import _NOQA_RE, comment_lines  # noqa: E402  (shared grammar)
+
+
+def _check_noqa_hygiene(
+    source: str, path: str, findings: List[Finding]
+) -> None:
+    known = REGISTRY.known_ids()
+    for lineno, text in comment_lines(source).items():
+        m = _NOQA_RE.search(text)
+        if m is None:
+            continue
+        ids_raw = m.group("ids")
+        if ids_raw is None:
+            continue  # bare noqa: suppress-all is legal
+        ids = [t.strip() for t in ids_raw.split(",") if t.strip()]
+        if not ids:
+            findings.append(Finding(
+                "CTT007", path, lineno,
+                "empty `# ctt: noqa[]` suppresses nothing — name the rule "
+                "ids or drop the brackets",
+            ))
+            continue
+        for rid in ids:
+            if rid not in known:
+                findings.append(Finding(
+                    "CTT007", path, lineno,
+                    f"noqa references unknown rule id `{rid}`",
+                ))
+
+
+# --------------------------------------------------------------------------
+# driver
+
+
+def _is_test_file(path: str) -> bool:
+    base = os.path.basename(path)
+    return base.startswith("test_") or base == "conftest.py"
+
+
+def lint_source(
+    source: str,
+    path: str,
+    pyproject_path: Optional[str] = None,
+    apply_suppressions: bool = True,
+) -> List[Finding]:
+    """Run every applicable AST rule over one file's source."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("CTT000", path, e.lineno or 1, f"syntax error: {e.msg}")]
+
+    if _is_test_file(path):
+        registered = (
+            registered_markers(pyproject_path) if pyproject_path else set()
+        )
+        _check_markers(tree, path, registered, findings)
+    else:
+        jit_fns = jitted_functions(tree)
+        for fn in jit_fns:
+            _check_jit_body(fn, path, findings)
+        _check_wide_dtypes_module(tree, path, jit_fns, findings)
+        _check_collectives(tree, path, findings)
+        _SetIterVisitor(path, findings).visit(tree)
+    _check_noqa_hygiene(source, path, findings)
+
+    if apply_suppressions:
+        from .core import filter_suppressed
+
+        findings = filter_suppressed(findings, source)
+    return findings
+
+
+def lint_paths(
+    paths: Iterable[str], pyproject_path: Optional[str] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path) as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding("CTT000", path, 1, f"unreadable: {e}"))
+            continue
+        findings.extend(lint_source(source, path, pyproject_path))
+    return findings
+
+
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                # ``fixtures`` holds deliberately-malformed lint corpora —
+                # excluded from directory walks, lintable by explicit path
+                dirs[:] = sorted(
+                    d for d in dirs if d not in {"__pycache__", ".git", "fixtures"}
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out

@@ -1,0 +1,126 @@
+"""ctt-lint core: findings, the rule registry, and noqa suppression.
+
+Every rule is a small class with a stable id (``CTT001``...), a one-line
+description, and a ``check`` entry point.  Findings are reported as
+``path:line: CTTxxx message`` and can be suppressed inline with
+
+    some_code()  # ctt: noqa[CTT003] reason why this is a false positive
+
+A bare ``# ctt: noqa`` (no bracket) suppresses every rule on that line.
+Rule ids live in two families:
+
+  * ``CTT0xx`` — AST invariant lints over the accelerator/runtime source
+    (see ``ast_rules.py``);
+  * ``CTT1xx`` — workflow-graph validation over ``workflows/*.py`` task
+    DAGs (see ``graph.py``).
+
+Adding a rule: subclass :class:`AstRule` (or extend the graph validator),
+give it a unique ``rule_id`` + ``description``, and register it in the
+module-level rule list; ``python -m cluster_tools_tpu.analysis --list-rules``
+must show it, and COMPONENTS.md ("Static analysis") documents it.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Set
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule_id} {self.message}"
+
+
+# ``# ctt: noqa`` or ``# ctt: noqa[CTT001, CTT005] optional reason``
+_NOQA_RE = re.compile(r"#\s*ctt:\s*noqa(?:\[(?P<ids>[^\]]*)\])?")
+
+# sentinel for "suppress every rule on this line"
+_ALL = "*"
+
+
+def comment_lines(source: str) -> Dict[int, str]:
+    """1-based line number -> comment text, via the tokenizer — so noqa
+    grammar inside *string literals* (docs, test corpora) never counts.
+    Falls back to a raw line scan when the source does not tokenize."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            if "#" in text:
+                out[lineno] = text[text.index("#"):]
+    return out
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> set of suppressed rule ids (``*`` = all)."""
+    out: Dict[int, Set[str]] = {}
+    for lineno, text in comment_lines(source).items():
+        m = _NOQA_RE.search(text)
+        if m is None:
+            continue
+        ids = m.group("ids")
+        if ids is None:
+            out[lineno] = {_ALL}
+        else:
+            out[lineno] = {t.strip() for t in ids.split(",") if t.strip()}
+    return out
+
+
+def is_suppressed(
+    finding: Finding, suppressions: Dict[int, Set[str]]
+) -> bool:
+    ids = suppressions.get(finding.line)
+    if not ids:
+        return False
+    return _ALL in ids or finding.rule_id in ids
+
+
+def filter_suppressed(
+    findings: Sequence[Finding], source: str
+) -> List[Finding]:
+    supp = parse_suppressions(source)
+    return [f for f in findings if not is_suppressed(f, supp)]
+
+
+@dataclass
+class RuleInfo:
+    rule_id: str
+    description: str
+
+
+class Registry:
+    """The set of known rule ids — used by the CLI listing and by the
+    noqa-hygiene rule (an unknown id in a noqa comment is itself a finding)."""
+
+    def __init__(self) -> None:
+        self._rules: Dict[str, RuleInfo] = {}
+
+    def register(self, rule_id: str, description: str) -> None:
+        if rule_id in self._rules:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        self._rules[rule_id] = RuleInfo(rule_id, description)
+
+    def known_ids(self) -> Set[str]:
+        return set(self._rules)
+
+    def items(self) -> List[RuleInfo]:
+        return [self._rules[k] for k in sorted(self._rules)]
+
+
+REGISTRY = Registry()
+
+
+def register_rule(rule_id: str, description: str) -> None:
+    REGISTRY.register(rule_id, description)

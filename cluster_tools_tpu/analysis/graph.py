@@ -1,0 +1,436 @@
+"""Workflow-graph validation (CTT1xx): import each ``workflows/*.py``
+module, build every workflow's task DAG *without executing it*, and check
+structural invariants:
+
+  CTT101  dependency cycle in the task DAG
+  CTT102  a task consumes a dataset (``<x>_path``/``<x>_key`` pair) that no
+          transitive upstream task produces and that was not handed in at
+          the workflow boundary
+  CTT103  a task/workflow reads a config key (``config["k"]`` /
+          ``config.get("k")``) that is neither in the global/task config
+          schema nor in the class's ``default_task_config()`` — the static
+          shape of a config-file typo
+  CTT104  a ``slow = True`` task is reachable from a workflow that is not
+          itself marked ``slow`` — tier-1 entry points must not pull slow
+          paths in by accident
+  CTT105  the workflow could not even be instantiated / its ``requires()``
+          raised under default flags — the DAG is not statically buildable
+
+The DAG is built by instantiating each workflow with synthesized arguments:
+``*_path``/``*_key`` parameters get unique ``<param>`` sentinel strings, so
+dataset provenance can be checked by value equality (derived names like
+``output_key + "_frag"`` keep their upstream identity).  Graph findings are
+anchored at the workflow class's ``class`` line, so ``# ctt: noqa[...]``
+suppression works there like everywhere else.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import importlib.util
+import inspect
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, filter_suppressed, register_rule
+
+register_rule("CTT101", "dependency cycle in a workflow task DAG")
+register_rule("CTT102", "task input not produced upstream nor external")
+register_rule("CTT103", "config key read outside the accepted schema")
+register_rule("CTT104", "slow-marked task reachable from a tier-1 workflow")
+register_rule("CTT105", "workflow DAG not statically buildable")
+
+
+# --------------------------------------------------------------------------
+# module loading
+
+
+def load_workflow_module(path: str):
+    """Import a workflow file.  Files inside the ``cluster_tools_tpu``
+    package import as package modules (their relative imports need it);
+    anything else (test fixtures) spec-loads by path."""
+    import cluster_tools_tpu
+
+    pkg_root = os.path.dirname(os.path.abspath(cluster_tools_tpu.__file__))
+    apath = os.path.abspath(path)
+    if apath.startswith(pkg_root + os.sep):
+        rel = os.path.relpath(apath, os.path.dirname(pkg_root))
+        mod_name = rel[:-3].replace(os.sep, ".")
+        return importlib.import_module(mod_name)
+    mod_name = "_ctt_lint_fixture_" + os.path.basename(path)[:-3]
+    spec = importlib.util.spec_from_file_location(mod_name, apath)
+    mod = importlib.util.module_from_spec(spec)
+    # registered so inspect.getsourcelines can anchor findings to the file
+    sys.modules[mod_name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def discover_workflow_classes(mod) -> List[type]:
+    from ..runtime.workflow import WorkflowBase
+
+    out = []
+    for name in sorted(vars(mod)):
+        obj = vars(mod)[name]
+        if (
+            inspect.isclass(obj)
+            and issubclass(obj, WorkflowBase)
+            and obj is not WorkflowBase
+            and obj.__module__ == mod.__name__
+        ):
+            out.append(obj)
+    return out
+
+
+# --------------------------------------------------------------------------
+# instantiation with sentinel arguments
+
+
+def _named_init_params(cls) -> Dict[str, inspect.Parameter]:
+    """Named ``__init__`` parameters across the MRO.  ``*args/**kwargs``
+    forwarder inits (the ``SkeletonEvaluationWorkflow`` pattern) pull in
+    their base class's named parameters; the climb stops at the first
+    ``__init__`` without ``**kwargs`` (nothing more can be passed)."""
+    params: Dict[str, inspect.Parameter] = {}
+    for klass in cls.__mro__:
+        init = vars(klass).get("__init__")
+        if init is None:
+            continue
+        try:
+            sig = inspect.signature(init)
+        except (TypeError, ValueError):
+            break
+        has_var_kw = False
+        for name, p in sig.parameters.items():
+            if p.kind == p.VAR_KEYWORD:
+                has_var_kw = True
+            if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD) or name == "self":
+                continue
+            params.setdefault(name, p)
+        if not has_var_kw:
+            break
+    return params
+
+
+def synthesize_kwargs(cls) -> Dict[str, Any]:
+    kwargs: Dict[str, Any] = {}
+    for name, p in _named_init_params(cls).items():
+        if name == "dependencies":
+            continue
+        if name == "tmp_folder":
+            kwargs[name] = "<tmp_folder>"
+        elif name == "config_dir":
+            kwargs[name] = None
+        elif name.endswith("_path"):
+            # sentinel even when a default exists (a fully-wired DAG is
+            # what makes the provenance check meaningful); the .n5 suffix
+            # satisfies container-extension dispatch in requires() bodies
+            kwargs[name] = f"<{name}>.n5"
+        elif name.endswith("_key") or name.endswith("_prefix"):
+            kwargs[name] = f"<{name}>"
+        elif p.default is not inspect.Parameter.empty:
+            continue  # keep the class's own default behavior
+        elif p.annotation in (int, "int"):
+            kwargs[name] = 1
+        elif p.annotation in (bool, "bool"):
+            kwargs[name] = False
+        else:
+            kwargs[name] = f"<{name}>"
+    return kwargs
+
+
+def _class_anchor(cls) -> Tuple[str, int]:
+    try:
+        path = inspect.getsourcefile(cls) or "<unknown>"
+        _, line = inspect.getsourcelines(cls)
+    except (OSError, TypeError):
+        return "<unknown>", 1
+    return path, line
+
+
+# --------------------------------------------------------------------------
+# DAG walk
+
+
+class TaskGraph:
+    """The instantiated DAG of one workflow: nodes keyed by ``id()``."""
+
+    def __init__(self, roots: Sequence[Any]):
+        self.nodes: List[Any] = []
+        self.deps: Dict[int, List[Any]] = {}
+        self.cycle: Optional[List[str]] = None
+        self._seen: Set[int] = set()
+        onstack: List[int] = []
+
+        def visit(task) -> None:
+            if self.cycle is not None:
+                return
+            tid = id(task)
+            if tid in onstack:
+                names = [type(t).__name__ for t in self.nodes if id(t) in onstack]
+                self.cycle = names + [type(task).__name__]
+                return
+            if tid in self._seen:
+                return
+            self._seen.add(tid)
+            onstack.append(tid)
+            deps = list(task.requires())
+            self.deps[tid] = deps
+            self.nodes.append(task)
+            for dep in deps:
+                visit(dep)
+            onstack.pop()
+
+        for r in roots:
+            visit(r)
+
+    def transitive_deps(self, task) -> List[Any]:
+        out: List[Any] = []
+        seen: Set[int] = set()
+        stack = list(self.deps.get(id(task), []))
+        while stack:
+            t = stack.pop()
+            if id(t) in seen:
+                continue
+            seen.add(id(t))
+            out.append(t)
+            stack.extend(self.deps.get(id(t), []))
+        return out
+
+
+# --------------------------------------------------------------------------
+# dataset provenance (CTT102)
+
+
+def produced_pairs(task) -> Set[Tuple[str, str]]:
+    """(path, key) datasets a task writes.  ``output_path``/``output_key``
+    by default; tasks with additional outputs declare them via a
+    ``produced_prefixes`` class attribute."""
+    prefixes = getattr(task, "produced_prefixes", ("output",))
+    out: Set[Tuple[str, str]] = set()
+    for prefix in prefixes:
+        path = getattr(task, f"{prefix}_path", None)
+        key = getattr(task, f"{prefix}_key", None)
+        if path is not None and key is not None:
+            out.add((path, key))
+    return out
+
+
+def consumed_pairs(task) -> List[Tuple[str, Tuple[str, str]]]:
+    """(attr-prefix, (path, key)) datasets a task reads."""
+    prefixes = set(getattr(task, "produced_prefixes", ("output",)))
+    out: List[Tuple[str, Tuple[str, str]]] = []
+    for attr in sorted(vars(task)):
+        if not attr.endswith("_path"):
+            continue
+        prefix = attr[: -len("_path")]
+        if prefix in prefixes:
+            continue
+        path = getattr(task, attr)
+        key = getattr(task, f"{prefix}_key", None)
+        if path is None or key is None:
+            continue
+        out.append((prefix, (path, key)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# config-schema scan (CTT103)
+
+_CONFIG_VAR_NAMES = {"config", "conf", "tconf", "gconf", "task_config"}
+
+
+def _config_reads(cls) -> List[Tuple[str, int]]:
+    """Literal config-key reads in a class body: (key, absolute line)."""
+    try:
+        source, start = inspect.getsourcelines(cls)
+    except (OSError, TypeError):
+        return []
+    try:
+        tree = ast.parse("".join(source).strip() or "pass")
+    except (SyntaxError, IndentationError):
+        try:
+            import textwrap
+
+            tree = ast.parse(textwrap.dedent("".join(source)))
+        except SyntaxError:
+            return []
+    # ``get_config`` classmethods assemble the *collection* of per-task
+    # configs (keys are task names, not config keys) — out of scope here
+    skip_nodes: Set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "get_config"
+        ):
+            skip_nodes.update(id(n) for n in ast.walk(node))
+    reads: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if id(node) in skip_nodes:
+            continue
+        key = None
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Load)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in _CONFIG_VAR_NAMES
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+        ):
+            key = node.slice.value
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in {"get", "pop"}
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in _CONFIG_VAR_NAMES
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            key = node.args[0].value
+        if key is not None:
+            reads.append((key, start + node.lineno - 1))
+    return reads
+
+
+def accepted_config_keys(cls) -> Set[str]:
+    from ..runtime import config as cfg
+
+    accepted = set(cfg.DEFAULT_GLOBAL_CONFIG) | set(cfg.DEFAULT_TASK_CONFIG)
+    default_fn = getattr(cls, "default_task_config", None)
+    if default_fn is not None:
+        try:
+            accepted |= set(default_fn())
+        except Exception:
+            pass
+    return accepted
+
+
+# --------------------------------------------------------------------------
+# validation driver
+
+
+def validate_workflow_class(cls) -> List[Finding]:
+    findings: List[Finding] = []
+    anchor_path, anchor_line = _class_anchor(cls)
+
+    try:
+        kwargs = synthesize_kwargs(cls)
+        wf = cls(**kwargs)
+        graph = TaskGraph([wf])
+    except RecursionError:
+        findings.append(Finding(
+            "CTT101", anchor_path, anchor_line,
+            f"{cls.__name__}: dependency cycle (requires() recursion "
+            "never terminates)",
+        ))
+        return findings
+    except Exception as e:
+        findings.append(Finding(
+            "CTT105", anchor_path, anchor_line,
+            f"{cls.__name__}: DAG not statically buildable under default "
+            f"flags ({type(e).__name__}: {e})",
+        ))
+        return findings
+
+    if graph.cycle is not None:
+        findings.append(Finding(
+            "CTT101", anchor_path, anchor_line,
+            f"{cls.__name__}: dependency cycle "
+            f"{' -> '.join(graph.cycle)}",
+        ))
+        return findings
+
+    external = {v for v in kwargs.values() if isinstance(v, str)}
+
+    seen_classes: Set[type] = set()
+    for task in graph.nodes:
+        # -- CTT102: dataset provenance -----------------------------------
+        upstream: Set[Tuple[str, str]] = set()
+        for dep in graph.transitive_deps(task):
+            upstream |= produced_pairs(dep)
+        own = produced_pairs(task)
+        for prefix, (path, key) in consumed_pairs(task):
+            if (path, key) in upstream or (path, key) in own:
+                continue
+            if path in external and key in external:
+                continue  # handed in at the workflow boundary
+            findings.append(Finding(
+                "CTT102", anchor_path, anchor_line,
+                f"{cls.__name__}: {type(task).__name__} consumes "
+                f"{prefix}=({path}, {key}) which no upstream task "
+                "produces and which is not a workflow input",
+            ))
+
+        # -- CTT103: config keys (once per class) -------------------------
+        tcls = type(task)
+        if tcls in seen_classes:
+            continue
+        seen_classes.add(tcls)
+        accepted = accepted_config_keys(tcls)
+        src_path = inspect.getsourcefile(tcls) or anchor_path
+        for key, line in _config_reads(tcls):
+            if key not in accepted:
+                findings.append(Finding(
+                    "CTT103", src_path, line,
+                    f"{tcls.__name__} reads config key '{key}' which is "
+                    "not in the global schema nor its "
+                    "default_task_config()",
+                ))
+
+    # -- CTT104: slow reachability ----------------------------------------
+    if not getattr(cls, "slow", False):
+        for task in graph.nodes:
+            if getattr(type(task), "slow", False):
+                findings.append(Finding(
+                    "CTT104", anchor_path, anchor_line,
+                    f"{cls.__name__} reaches slow-marked task "
+                    f"{type(task).__name__} but is not itself marked "
+                    "slow — tier-1 entry points must stay fast",
+                ))
+    return findings
+
+
+def validate_workflow_file(path: str) -> List[Finding]:
+    try:
+        mod = load_workflow_module(path)
+    except Exception as e:
+        return [Finding(
+            "CTT105", path, 1,
+            f"workflow module failed to import: {type(e).__name__}: {e}",
+        )]
+    findings: List[Finding] = []
+    seen: Set[Finding] = set()
+    for cls in discover_workflow_classes(mod):
+        # the same task class (and thus config-read scan) appears under
+        # multiple workflow roots — dedupe identical findings
+        for f in validate_workflow_class(cls):
+            if f not in seen:
+                seen.add(f)
+                findings.append(f)
+    # graph findings are anchored in source files; apply that file's noqas
+    by_file: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_file.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for fpath, fs in sorted(by_file.items()):
+        try:
+            with open(fpath) as fh:
+                source = fh.read()
+        except OSError:
+            out.extend(fs)
+            continue
+        out.extend(filter_suppressed(fs, source))
+    return out
+
+
+def validate_workflows_dir(dirpath: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for name in sorted(os.listdir(dirpath)):
+        if not name.endswith(".py") or name == "__init__.py":
+            continue
+        findings.extend(validate_workflow_file(os.path.join(dirpath, name)))
+    return findings

@@ -197,7 +197,9 @@ def _lifted_gaec_python(
                 touched.add(w)
             m[rv].clear()
         touched.update(local[ru].keys())
-        for w in touched:
+        # sorted: heap push order must not depend on set hashing, or equal
+        # costs tie-break nondeterministically across runs (CTT005)
+        for w in sorted(touched):
             if w not in local[ru]:
                 continue  # lifted-only pairs are not contractible
             counter += 1

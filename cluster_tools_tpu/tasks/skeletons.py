@@ -188,6 +188,12 @@ class SkeletonEvaluationTask(VolumeSimpleTask):
         super().__init__(*args, skeleton_folder=skeleton_folder,
                          seg_path=seg_path, seg_key=seg_key, **kwargs)
 
+    @classmethod
+    def default_task_config(cls) -> Dict[str, Any]:
+        conf = super().default_task_config()
+        conf.update({"resolution": [1.0, 1.0, 1.0]})
+        return conf
+
     def run_impl(self) -> None:
         conf = self.get_task_config()
         resolution = np.asarray(conf.get("resolution", [1.0, 1.0, 1.0]))

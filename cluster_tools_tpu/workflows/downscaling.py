@@ -400,7 +400,7 @@ class DownscalingWorkflow(WorkflowBase):
         return conf
 
 
-class PainteraToBdvWorkflow(WorkflowBase):
+class PainteraToBdvWorkflow(WorkflowBase):  # ctt: noqa[CTT105] DAG shape depends on the input container's scale metadata (requires() enumerates s<i> levels), so it cannot be built against sentinel paths
     """Convert an existing paintera multiscale group to a bdv container
     (reference downscaling_workflow.py:272-330): copy every ``s<i>`` scale
     dataset into the bdv key layout, derive the relative scale factors from

@@ -1,0 +1,44 @@
+"""ctt-lint fixture: one violation of every AST invariant rule.  This file
+is linted, never imported/executed — the undefined names are deliberate."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def host_call_in_jit(x):
+    labels = np.unique(x)  # CTT001: host materialization of a tracer
+    return labels
+
+
+@partial(jax.jit, static_argnames=())
+def clock_in_jit(x):
+    return x + time.time()  # CTT002: wall clock baked into the program
+
+
+def collective_outside_parallel(x):
+    return jax.lax.psum(x, axis_name="data")  # CTT003: not in parallel/
+
+
+@jax.jit
+def wide_dtype_in_jit(x):
+    return x.astype(jnp.float64)  # CTT004: 64-bit dtype in device code
+
+
+def set_order_leak(edges):
+    nodes = set()
+    for u, v in edges:
+        nodes.add(u)
+        nodes.add(v)
+    order = []
+    for n in nodes:  # CTT005: hash-order iteration feeding constructed state
+        order.append(n)
+    return order
+
+
+def bad_suppression(x):
+    return x + 1  # ctt: noqa[CTT999] CTT007: unknown rule id in noqa

@@ -1,0 +1,20 @@
+"""ctt-lint fixture: a task reading a misspelled config key (CTT103)."""
+
+from cluster_tools_tpu.runtime.task import SimpleTask
+from cluster_tools_tpu.runtime.workflow import WorkflowBase
+
+
+class _FixtureTypoTask(SimpleTask):
+    task_name = "fixture_typo_task"
+
+    def run_impl(self) -> None:
+        config = self.get_task_config()
+        block_shape = config.get("block_shpae")  # typo of block_shape
+        del block_shape
+
+
+class ConfigTypoWorkflow(WorkflowBase):
+    task_name = "fixture_config_typo_workflow"
+
+    def requires(self):
+        return [_FixtureTypoTask(self.tmp_folder, self.config_dir)]

@@ -1,0 +1,25 @@
+"""ctt-lint fixture: a workflow whose task DAG contains a cycle (CTT101).
+
+Never imported by tests directly — loaded by the workflow-graph validator.
+"""
+
+from cluster_tools_tpu.runtime.task import SimpleTask
+from cluster_tools_tpu.runtime.workflow import WorkflowBase
+
+
+class _CycleTaskA(SimpleTask):
+    task_name = "fixture_cycle_a"
+
+
+class _CycleTaskB(SimpleTask):
+    task_name = "fixture_cycle_b"
+
+
+class CycleWorkflow(WorkflowBase):
+    task_name = "fixture_cycle_workflow"
+
+    def requires(self):
+        a = _CycleTaskA(self.tmp_folder, self.config_dir)
+        b = _CycleTaskB(self.tmp_folder, self.config_dir, dependencies=[a])
+        a.dependencies.append(b)  # a -> b -> a
+        return [b]

@@ -1,0 +1,63 @@
+"""ctt-lint fixture: a correctly wired workflow — zero findings expected."""
+
+from typing import Sequence
+
+from cluster_tools_tpu.runtime.task import SimpleTask
+from cluster_tools_tpu.runtime.workflow import WorkflowBase
+
+
+class _GoodProducer(SimpleTask):
+    task_name = "fixture_good_producer"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None,
+                 dependencies: Sequence = (), input_path=None, input_key=None,
+                 output_path=None, output_key=None):
+        super().__init__(tmp_folder, config_dir, max_jobs, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+    def run_impl(self) -> None:
+        config = self.get_task_config()
+        threads = config.get("threads_per_job", 1)
+        del threads
+
+
+class _GoodConsumer(SimpleTask):
+    task_name = "fixture_good_consumer"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None,
+                 dependencies: Sequence = (), input_path=None, input_key=None,
+                 output_path=None, output_key=None):
+        super().__init__(tmp_folder, config_dir, max_jobs, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+
+class GoodWorkflow(WorkflowBase):
+    task_name = "fixture_good_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None,
+                 target=None, input_path=None, input_key=None,
+                 output_path=None, output_key=None, dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+    def requires(self):
+        producer = _GoodProducer(
+            self.tmp_folder, self.config_dir,
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key="fragments",
+        )
+        consumer = _GoodConsumer(
+            self.tmp_folder, self.config_dir, dependencies=[producer],
+            input_path=self.output_path, input_key="fragments",
+            output_path=self.output_path, output_key=self.output_key,
+        )
+        return [consumer]

@@ -1,0 +1,60 @@
+"""ctt-lint fixture: a task consuming a dataset that no upstream task
+produces and that is not a workflow input (CTT102)."""
+
+from typing import Optional, Sequence
+
+from cluster_tools_tpu.runtime.task import SimpleTask
+from cluster_tools_tpu.runtime.workflow import WorkflowBase
+
+
+class _FixtureProducer(SimpleTask):
+    task_name = "fixture_producer"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None,
+                 dependencies: Sequence = (), output_path=None,
+                 output_key=None):
+        super().__init__(tmp_folder, config_dir, max_jobs, dependencies)
+        self.output_path = output_path
+        self.output_key = output_key
+
+
+class _FixtureConsumer(SimpleTask):
+    task_name = "fixture_consumer"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None,
+                 dependencies: Sequence = (), input_path=None, input_key=None,
+                 output_path=None, output_key=None):
+        super().__init__(tmp_folder, config_dir, max_jobs, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+
+class MissingInputWorkflow(WorkflowBase):
+    """The consumer reads ``fragments_interm`` which the producer never
+    writes (its output key is ``fragments``) — the wiring typo CTT102
+    exists to catch."""
+
+    task_name = "fixture_missing_input_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None,
+                 target=None, input_path=None, input_key=None,
+                 output_path=None, output_key=None, dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target, dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+    def requires(self):
+        producer = _FixtureProducer(
+            self.tmp_folder, self.config_dir,
+            output_path=self.output_path, output_key="fragments",
+        )
+        consumer = _FixtureConsumer(
+            self.tmp_folder, self.config_dir, dependencies=[producer],
+            input_path=self.output_path, input_key="fragments_interm",
+            output_path=self.output_path, output_key=self.output_key,
+        )
+        return [consumer]

@@ -1,0 +1,25 @@
+"""ctt-lint fixture: a ``slow = True`` task reachable from a workflow not
+itself marked slow (CTT104) — plus the acknowledged negative case."""
+
+from cluster_tools_tpu.runtime.task import SimpleTask
+from cluster_tools_tpu.runtime.workflow import WorkflowBase
+
+
+class _FixtureSlowTask(SimpleTask):
+    task_name = "fixture_slow_task"
+    slow = True
+
+
+class UnmarkedSlowWorkflow(WorkflowBase):
+    task_name = "fixture_unmarked_slow_workflow"
+
+    def requires(self):
+        return [_FixtureSlowTask(self.tmp_folder, self.config_dir)]
+
+
+class MarkedSlowWorkflow(WorkflowBase):
+    task_name = "fixture_marked_slow_workflow"
+    slow = True
+
+    def requires(self):
+        return [_FixtureSlowTask(self.tmp_folder, self.config_dir)]

@@ -1,0 +1,435 @@
+"""ctt-lint: positive + negative unit coverage for every rule id, noqa
+suppression semantics, the workflow-graph fixtures, and the CLI contract
+(exit 0 on the real tree, non-zero on the malformed fixtures)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cluster_tools_tpu.analysis import (
+    REGISTRY,
+    lint_source,
+    parse_suppressions,
+    registered_markers,
+    validate_workflow_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "ctt_lint")
+PYPROJECT = os.path.join(REPO, "pyproject.toml")
+
+
+def ids(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+def lint(src, path="cluster_tools_tpu/ops/fake.py", **kw):
+    return lint_source(src, path, **kw)
+
+
+def line_of(path, needle):
+    with open(path) as f:
+        for lineno, text in enumerate(f, start=1):
+            if needle in text:
+                return lineno
+    raise AssertionError(f"{needle!r} not found in {path}")
+
+
+# --------------------------------------------------------------------------
+# registry / meta
+
+
+class TestRegistry:
+    def test_all_shipped_rules_registered(self):
+        expect = {
+            "CTT001", "CTT002", "CTT003", "CTT004", "CTT005", "CTT006",
+            "CTT007", "CTT101", "CTT102", "CTT103", "CTT104", "CTT105",
+        }
+        assert expect <= REGISTRY.known_ids()
+        assert len(expect) >= 8
+
+    def test_report_format_is_path_line_rule(self):
+        (f,) = lint("import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
+        text = f.format()
+        assert text.startswith("cluster_tools_tpu/ops/fake.py:4: CTT001 ")
+
+
+# --------------------------------------------------------------------------
+# CTT001 host calls in jit
+
+
+class TestCTT001:
+    def test_np_call_in_jit(self):
+        src = (
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.unique(x)\n"
+        )
+        (f,) = lint(src)
+        assert f.rule_id == "CTT001"
+        assert f.line == 4
+
+    def test_partial_jit_and_block_until_ready(self):
+        src = (
+            "import jax\nfrom functools import partial\n"
+            "@partial(jax.jit, static_argnames=())\n"
+            "def f(x):\n"
+            "    return (x + 1).block_until_ready()\n"
+        )
+        assert ids(lint(src)) == ["CTT001"]
+
+    def test_device_get_in_shard_map(self):
+        src = (
+            "import jax\nfrom jax.experimental.shard_map import shard_map\n"
+            "from functools import partial\n"
+            "@partial(shard_map, mesh=None, in_specs=None, out_specs=None)\n"
+            "def f(x):\n"
+            "    return jax.device_get(x)\n"
+        )
+        assert ids(lint(src)) == ["CTT001"]
+
+    def test_negative_outside_jit_and_trace_time_np(self):
+        src = (
+            "import jax, numpy as np\n"
+            "def host(x):\n"
+            "    return np.unique(x)\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    big = np.iinfo(np.int32).max\n"
+            "    n = int(np.prod(x.shape))\n"
+            "    return x + big + n\n"
+        )
+        assert lint(src) == []
+
+
+# --------------------------------------------------------------------------
+# CTT002 clock / randomness in jit
+
+
+class TestCTT002:
+    def test_time_in_jit(self):
+        src = (
+            "import jax, time\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x + time.time()\n"
+        )
+        (f,) = lint(src)
+        assert (f.rule_id, f.line) == ("CTT002", 4)
+
+    def test_np_random_in_jit(self):
+        src = (
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x + np.random.rand()\n"
+        )
+        assert ids(lint(src)) == ["CTT002"]
+
+    def test_negative_time_outside_jit(self):
+        src = "import time\ndef f():\n    return time.time()\n"
+        assert lint(src) == []
+
+
+# --------------------------------------------------------------------------
+# CTT003 collectives outside parallel/
+
+
+class TestCTT003:
+    SRC = (
+        "import jax\n"
+        "def merge(x):\n"
+        "    return jax.lax.psum(x, axis_name='data')\n"
+    )
+
+    def test_collective_in_ops(self):
+        (f,) = lint(self.SRC, path="cluster_tools_tpu/ops/merge.py")
+        assert (f.rule_id, f.line) == ("CTT003", 3)
+
+    def test_negative_in_parallel(self):
+        assert lint(self.SRC, path="cluster_tools_tpu/parallel/merge.py") == []
+
+    def test_negative_unrelated_method_name(self):
+        src = "def f(obj, x):\n    return obj.all_gather(x)\n"
+        assert lint(src) == []
+
+
+# --------------------------------------------------------------------------
+# CTT004 wide dtypes
+
+
+class TestCTT004:
+    def test_jnp_wide_dtype_anywhere(self):
+        src = "import jax.numpy as jnp\ndef f(x):\n    return x.astype(jnp.float64)\n"
+        (f,) = lint(src)
+        assert (f.rule_id, f.line) == ("CTT004", 3)
+
+    def test_dtype_literal_kwarg_to_jnp(self):
+        src = "import jax.numpy as jnp\ndef f():\n    return jnp.zeros(4, dtype='int64')\n"
+        assert ids(lint(src)) == ["CTT004"]
+
+    def test_np_wide_dtype_inside_jit(self):
+        src = (
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.astype(np.float64)\n"
+        )
+        assert ids(lint(src)) == ["CTT004"]
+
+    def test_negative_host_numpy_and_plain_strings(self):
+        src = (
+            "import numpy as np\n"
+            "SUPPORTED = ('float32', 'float64')\n"
+            "def host(x):\n"
+            "    return x.astype(np.float64)\n"
+        )
+        assert lint(src) == []
+
+
+# --------------------------------------------------------------------------
+# CTT005 set iteration
+
+
+class TestCTT005:
+    def test_for_over_set_variable(self):
+        src = (
+            "def f(edges):\n"
+            "    nodes = set()\n"
+            "    out = []\n"
+            "    for n in nodes:\n"
+            "        out.append(n)\n"
+            "    return out\n"
+        )
+        (f,) = lint(src)
+        assert (f.rule_id, f.line) == ("CTT005", 4)
+
+    def test_list_over_set_call(self):
+        src = "def f(xs):\n    return list(set(xs))\n"
+        assert ids(lint(src)) == ["CTT005"]
+
+    def test_comprehension_over_set(self):
+        src = "def f(xs):\n    return [x for x in set(xs)]\n"
+        assert ids(lint(src)) == ["CTT005"]
+
+    def test_negative_sorted_membership_and_reassignment(self):
+        src = (
+            "def f(xs, d):\n"
+            "    s = set(xs)\n"
+            "    a = sorted(s)\n"
+            "    b = [x for x in xs if x in s]\n"
+            "    s = list(xs)\n"
+            "    c = [x for x in s]\n"
+            "    for k in d:\n"
+            "        pass\n"
+            "    return a, b, c\n"
+        )
+        assert lint(src) == []
+
+
+# --------------------------------------------------------------------------
+# CTT006 unregistered pytest markers
+
+
+class TestCTT006:
+    def test_unregistered_marker(self, tmp_path):
+        pyproject = tmp_path / "pyproject.toml"
+        pyproject.write_text(
+            "[tool.pytest.ini_options]\nmarkers = [\n  'slow: slow tests',\n]\n"
+        )
+        src = (
+            "import pytest\n"
+            "@pytest.mark.gpu_only\n"
+            "def test_x():\n"
+            "    pass\n"
+        )
+        (f,) = lint_source(src, "tests/test_fake.py", str(pyproject))
+        assert (f.rule_id, f.line) == ("CTT006", 2)
+        assert "gpu_only" in f.message
+
+    def test_negative_registered_and_builtin(self, tmp_path):
+        pyproject = tmp_path / "pyproject.toml"
+        pyproject.write_text(
+            "[tool.pytest.ini_options]\nmarkers = [\n  'slow: slow tests',\n]\n"
+        )
+        src = (
+            "import pytest\n"
+            "@pytest.mark.slow\n"
+            "@pytest.mark.parametrize('x', [1])\n"
+            "def test_x(x):\n"
+            "    pass\n"
+        )
+        assert lint_source(src, "tests/test_fake.py", str(pyproject)) == []
+
+    def test_repo_pyproject_registers_used_markers(self):
+        markers = registered_markers(PYPROJECT)
+        assert "slow" in markers
+        assert "timeout" in markers
+
+
+# --------------------------------------------------------------------------
+# CTT007 noqa hygiene + suppression semantics
+
+
+class TestCTT007AndSuppression:
+    def test_unknown_rule_id_in_noqa(self):
+        src = "x = 1  # ctt: noqa[CTT999]\n"
+        (f,) = lint(src)
+        assert (f.rule_id, f.line) == ("CTT007", 1)
+
+    def test_empty_noqa_brackets(self):
+        src = "x = 1  # ctt: noqa[]\n"
+        assert ids(lint(src)) == ["CTT007"]
+
+    def test_negative_known_id(self):
+        src = "x = 1  # ctt: noqa[CTT005] documented reason\n"
+        assert lint(src) == []
+
+    def test_suppression_by_id_and_bare(self):
+        base = "def f(xs):\n    return list(set(xs)){}\n"
+        assert ids(lint(base.format(""))) == ["CTT005"]
+        assert lint(base.format("  # ctt: noqa[CTT005] stable enough")) == []
+        assert lint(base.format("  # ctt: noqa")) == []
+
+    def test_suppression_is_per_rule(self):
+        src = "def f(xs):\n    return list(set(xs))  # ctt: noqa[CTT001]\n"
+        assert ids(lint(src)) == ["CTT005"]
+
+    def test_parse_suppressions(self):
+        supp = parse_suppressions(
+        "a = 1\nb = 2  # ctt: noqa[CTT001, CTT005]\nc = 3  # ctt: noqa\n"
+        )
+        assert supp == {2: {"CTT001", "CTT005"}, 3: {"*"}}
+
+
+# --------------------------------------------------------------------------
+# workflow-graph fixtures (CTT101..CTT105)
+
+
+class TestGraphValidator:
+    def test_cycle_fixture(self):
+        path = os.path.join(FIXTURES, "wf_cycle.py")
+        (f,) = validate_workflow_file(path)
+        assert f.rule_id == "CTT101"
+        assert f.path == path
+        assert f.line == line_of(path, "class CycleWorkflow")
+        assert "cycle" in f.message.lower()
+
+    def test_missing_input_fixture(self):
+        path = os.path.join(FIXTURES, "wf_missing_input.py")
+        (f,) = validate_workflow_file(path)
+        assert f.rule_id == "CTT102"
+        assert f.path == path
+        assert f.line == line_of(path, "class MissingInputWorkflow")
+        assert "fragments_interm" in f.message
+
+    def test_config_typo_fixture(self):
+        path = os.path.join(FIXTURES, "wf_config_typo.py")
+        (f,) = validate_workflow_file(path)
+        assert f.rule_id == "CTT103"
+        assert f.path == path
+        assert f.line == line_of(path, "block_shpae")
+        assert "block_shpae" in f.message
+
+    def test_slow_fixture_flags_only_unmarked(self):
+        path = os.path.join(FIXTURES, "wf_slow.py")
+        (f,) = validate_workflow_file(path)
+        assert f.rule_id == "CTT104"
+        assert f.line == line_of(path, "class UnmarkedSlowWorkflow")
+        assert "MarkedSlowWorkflow" not in f.message
+
+    def test_unbuildable_workflow(self, tmp_path):
+        path = tmp_path / "wf_broken.py"
+        path.write_text(
+            "from cluster_tools_tpu.runtime.workflow import WorkflowBase\n"
+            "class BrokenWorkflow(WorkflowBase):\n"
+            "    task_name = 'fixture_broken'\n"
+            "    def requires(self):\n"
+            "        raise RuntimeError('cannot wire')\n"
+        )
+        (f,) = validate_workflow_file(str(path))
+        assert f.rule_id == "CTT105"
+        assert "cannot wire" in f.message
+
+    def test_good_fixture_is_clean(self):
+        assert validate_workflow_file(os.path.join(FIXTURES, "wf_good.py")) == []
+
+    def test_shipped_workflows_are_clean(self):
+        # the whole point: the real tree stays lint-clean
+        from cluster_tools_tpu.analysis import validate_workflows_dir
+
+        wf_dir = os.path.join(REPO, "cluster_tools_tpu", "workflows")
+        assert validate_workflows_dir(wf_dir) == []
+
+
+# --------------------------------------------------------------------------
+# CLI contract
+
+
+def run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "cluster_tools_tpu.analysis", *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+
+
+class TestCli:
+    def test_list_rules(self):
+        proc = run_cli("--list-rules")
+        assert proc.returncode == 0
+        for rid in ("CTT001", "CTT005", "CTT101", "CTT105"):
+            assert rid in proc.stdout
+
+    def test_real_tree_is_clean(self):
+        proc = run_cli("--fail-on-findings")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_bad_ast_fixture_fails(self):
+        proc = run_cli(
+            "--fail-on-findings", "--no-graph",
+            "--paths", os.path.join(FIXTURES, "bad_ast.py"),
+        )
+        assert proc.returncode == 1
+        for rid in ("CTT001", "CTT002", "CTT003", "CTT004", "CTT005", "CTT007"):
+            assert rid in proc.stdout, rid
+
+    def test_workflow_fixtures_fail(self):
+        proc = run_cli(
+            "--fail-on-findings", "--paths", "--workflows", FIXTURES,
+        )
+        assert proc.returncode == 1
+        for rid in ("CTT101", "CTT102", "CTT103", "CTT104"):
+            assert rid in proc.stdout, rid
+
+
+# --------------------------------------------------------------------------
+# bench env hardening (satellite)
+
+
+class TestBenchDeadlineEnv:
+    @pytest.fixture()
+    def bench(self):
+        sys.path.insert(0, REPO)
+        try:
+            import bench
+
+            yield bench
+        finally:
+            sys.path.remove(REPO)
+
+    def test_default_when_unset(self, bench):
+        assert bench.parse_deadline_env({}) == bench.DEFAULT_BENCH_DEADLINE_S
+
+    def test_valid_value(self, bench):
+        assert bench.parse_deadline_env({"CTT_BENCH_DEADLINE_S": "120.5"}) == 120.5
+
+    @pytest.mark.parametrize(
+        "raw", ["abc", "", "-5", "0", "nan", "inf", "1e999"]
+    )
+    def test_invalid_falls_back_with_warning(self, bench, raw, capsys):
+        got = bench.parse_deadline_env({"CTT_BENCH_DEADLINE_S": raw})
+        assert got == bench.DEFAULT_BENCH_DEADLINE_S
+        assert "invalid CTT_BENCH_DEADLINE_S" in capsys.readouterr().err
