@@ -920,9 +920,17 @@ def main():
 
     # persistent XLA executable cache — cold kernel configs and the e2e
     # subprocesses all profit across runs (CTT_COMPILE_CACHE=0 disables)
+    from cluster_tools_tpu.obs import trace as obs_trace
     from cluster_tools_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
+    # ctt-obs: when CTT_TRACE_DIR is set, every bench (sub)process joins
+    # ONE traced run — enable() exported CTT_RUN_ID at bootstrap, so the
+    # per-config subprocesses below inherit it and the run id rides the
+    # contract, making bench runs diffable (obs diff <run_a> <run_b>)
+    obs_run_id = obs_trace.current_run_id()
+    if obs_run_id is not None:
+        log(f"[bench] ctt-obs tracing on: run {obs_run_id}")
     if args.platform:
         import jax
 
@@ -955,6 +963,8 @@ def main():
             "vs_baseline": None,
             "extra": {},
         }
+        if obs_run_id is not None:
+            merged["extra"]["obs_run_id"] = obs_run_id
 
         def emit():
             print(json.dumps(merged), flush=True)
@@ -1051,6 +1061,8 @@ def main():
     batch = 4 if args.quick else 8
 
     extra = {}
+    if obs_run_id is not None:
+        extra["obs_run_id"] = obs_run_id
     value, vs = None, None
 
     if want("dtws"):

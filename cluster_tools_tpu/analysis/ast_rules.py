@@ -30,6 +30,11 @@ through runtime tests:
           select nothing and spam warnings.
   CTT007  noqa hygiene: a ``# ctt: noqa[...]`` referencing an unknown rule
           id (or an empty bracket) suppresses nothing and hides typos.
+  CTT008  raw ``time.time()`` used in duration/deadline math (arithmetic
+          or comparison) outside ``obs/`` — a host clock jump (NTP step,
+          VM migration) fires or stalls such timeouts.  Wall clock is for
+          *timestamps* only; durations and deadlines go through the obs
+          monotonic helpers (``obs.trace.monotonic()``).
 """
 
 from __future__ import annotations
@@ -48,6 +53,7 @@ register_rule("CTT004", "wide (64-bit) dtype in device code")
 register_rule("CTT005", "order-sensitive iteration over a set")
 register_rule("CTT006", "pytest marker not registered in pyproject.toml")
 register_rule("CTT007", "noqa comment references an unknown rule id")
+register_rule("CTT008", "wall-clock time.time() in duration/deadline math")
 
 
 # --------------------------------------------------------------------------
@@ -378,6 +384,53 @@ class _SetIterVisitor(ast.NodeVisitor):
 
 
 # --------------------------------------------------------------------------
+# CTT008: wall clock in duration/deadline math
+
+_WALL_CLOCK_CALLS = {"time.time"}
+
+
+def _wall_clock_exempt(path: str) -> bool:
+    # obs/ IS the clock vocabulary: it records wall-clock anchors next to
+    # monotonic ones by design (trace shard headers, export alignment)
+    return "obs" in os.path.normpath(path).split(os.sep)
+
+
+def _check_wall_clock_math(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    """Flag ``time.time()`` participating in arithmetic or comparisons —
+    that is duration/deadline math, where a clock jump corrupts the
+    result.  A bare ``time.time()`` stored or serialized as a timestamp
+    stays legal.  Jitted bodies are excluded: any clock there is already a
+    CTT002 finding (host state baked into the program) — one report per
+    defect."""
+    if _wall_clock_exempt(path):
+        return
+    in_jit: Set[int] = set()
+    for fn in jitted_functions(tree):
+        in_jit.update(id(n) for n in ast.walk(fn))
+    flagged: Set[int] = set()
+    for node in ast.walk(tree):
+        if id(node) in in_jit:
+            continue
+        if not isinstance(node, (ast.BinOp, ast.Compare, ast.AugAssign)):
+            continue
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and dotted_name(sub.func) in _WALL_CLOCK_CALLS
+                and id(sub) not in flagged
+            ):
+                flagged.add(id(sub))
+                findings.append(Finding(
+                    "CTT008", path, sub.lineno,
+                    "`time.time()` in duration/deadline math — wall clock "
+                    "jumps corrupt intervals; use obs.trace.monotonic() "
+                    "(time.time() is for timestamps only)",
+                ))
+
+
+# --------------------------------------------------------------------------
 # CTT006: unregistered pytest markers
 
 # markers pytest itself (or its bundled plugins) always knows
@@ -494,6 +547,7 @@ def lint_source(
             _check_jit_body(fn, path, findings)
         _check_wide_dtypes_module(tree, path, jit_fns, findings)
         _check_collectives(tree, path, findings)
+        _check_wall_clock_math(tree, path, findings)
         _SetIterVisitor(path, findings).visit(tree)
     _check_noqa_hygiene(source, path, findings)
 

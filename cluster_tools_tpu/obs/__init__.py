@@ -1,0 +1,25 @@
+"""ctt-obs: structured tracing, metrics, and run-diff observability.
+
+Three pieces (see each module's docstring):
+
+  * :mod:`.trace`   — process-safe span recorder (JSONL shards per
+    pid+thread, monotonic clocks, no-op fast path when disabled);
+  * :mod:`.metrics` — counters/gauges for hot paths (store IO bytes,
+    compile-cache hits, retry/failure counts, pipeline overlap);
+  * :mod:`.export`  — cross-process shard merge, per-task summaries,
+    Chrome ``trace_event`` export, and run-vs-run regression diff
+    (CLI: ``python -m cluster_tools_tpu.obs``).
+
+Enable by exporting ``CTT_TRACE_DIR=/some/dir`` before the run (child
+processes — scheduler workers, bench subprocesses, multi-host peers —
+inherit it and join the same run via ``CTT_RUN_ID``), or call
+``obs.trace.enable(trace_dir)`` programmatically.
+"""
+
+from . import metrics, trace
+from .trace import enable, enabled, event, flush, monotonic, span
+
+__all__ = [
+    "metrics", "trace",
+    "enable", "enabled", "event", "flush", "monotonic", "span",
+]

@@ -1,0 +1,118 @@
+"""CLI: ``python -m cluster_tools_tpu.obs`` — summarize / trace / diff.
+
+    python -m cluster_tools_tpu.obs summarize <run_dir> [--json]
+    python -m cluster_tools_tpu.obs trace <run_dir> [-o trace.json]
+    python -m cluster_tools_tpu.obs diff <base_run> <cand_run> \
+        [--threshold 0.2] [--min-s 0.01] [--json]
+
+``<run_dir>`` is either ``<CTT_TRACE_DIR>/<run_id>`` or a trace dir
+containing exactly one run.  Exit codes:
+
+  0  success (summarize: at least one task span; diff: no regression)
+  1  summarize found no task spans (a run that recorded nothing is a CI
+     failure, not a silent pass)
+  2  malformed trace (truncated/corrupt shard, mixed runs, bad metrics)
+  3  diff found at least one task regressed beyond the threshold
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .export import (
+    TraceFormatError,
+    diff,
+    format_diff,
+    format_summary,
+    load_run,
+    summarize,
+    to_chrome_trace,
+)
+
+EXIT_OK = 0
+EXIT_NO_TASKS = 1
+EXIT_MALFORMED = 2
+EXIT_REGRESSED = 3
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m cluster_tools_tpu.obs",
+        description="ctt-obs: merge, summarize, export, and diff "
+        "structured run traces",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_sum = sub.add_parser(
+        "summarize", help="per-task host-IO/device/collective breakdown"
+    )
+    p_sum.add_argument("run")
+    p_sum.add_argument("--json", action="store_true",
+                       help="machine-readable output")
+
+    p_trace = sub.add_parser(
+        "trace", help="export Chrome trace_event JSON (Perfetto-loadable)"
+    )
+    p_trace.add_argument("run")
+    p_trace.add_argument("-o", "--output", default=None,
+                         help="output path (default: stdout)")
+
+    p_diff = sub.add_parser(
+        "diff", help="compare two runs; nonzero exit on regression"
+    )
+    p_diff.add_argument("base")
+    p_diff.add_argument("candidate")
+    p_diff.add_argument("--threshold", type=float, default=0.2,
+                        help="fractional wall-clock growth that counts as "
+                        "a regression (default 0.2 = 20%%)")
+    p_diff.add_argument("--min-s", type=float, default=0.01,
+                        help="absolute floor in seconds below which growth "
+                        "is jitter, not regression (default 0.01)")
+    p_diff.add_argument("--json", action="store_true")
+
+    args = parser.parse_args(argv)
+    try:
+        if args.cmd == "summarize":
+            summary = summarize(load_run(args.run))
+            if args.json:
+                print(json.dumps(summary, indent=2, sort_keys=True))
+            else:
+                print(format_summary(summary))
+            if summary["n_task_spans"] < 1:
+                print("obs: no task spans recorded", file=sys.stderr)
+                return EXIT_NO_TASKS
+            return EXIT_OK
+        if args.cmd == "trace":
+            chrome = to_chrome_trace(load_run(args.run))
+            payload = json.dumps(chrome)
+            if args.output:
+                with open(args.output, "w") as f:
+                    f.write(payload)
+                print(f"wrote {len(chrome['traceEvents'])} events to "
+                      f"{args.output}", file=sys.stderr)
+            else:
+                print(payload)
+            return EXIT_OK
+        if args.cmd == "diff":
+            result = diff(
+                load_run(args.base), load_run(args.candidate),
+                threshold=args.threshold, min_seconds=args.min_s,
+            )
+            if args.json:
+                print(json.dumps(result, indent=2, sort_keys=True))
+            else:
+                print(format_diff(result))
+            return EXIT_REGRESSED if result["n_regressed"] else EXIT_OK
+    except TraceFormatError as e:
+        print(f"obs: malformed trace: {e}", file=sys.stderr)
+        return EXIT_MALFORMED
+    except OSError as e:
+        print(f"obs: {e}", file=sys.stderr)
+        return EXIT_MALFORMED
+    raise AssertionError(f"unhandled command {args.cmd}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

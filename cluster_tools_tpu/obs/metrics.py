@@ -1,0 +1,128 @@
+"""ctt-obs counters and gauges: cheap aggregates for hot paths.
+
+Spans (obs.trace) are the right tool for intervals; per-chunk store IO is
+far too hot for a JSONL line per operation.  These process-local counters
+cost one enabled-check + one dict update per call and flush as ONE
+``metrics.p<pid>.json`` snapshot per process into the active run's
+directory (atomic tmp+replace, the store convention), where
+``obs.export`` sums them across processes.
+
+Wired in:
+
+  * ``utils/store.py`` — ``store.bytes_read`` / ``store.bytes_written`` /
+    ``store.chunks_read`` / ``store.chunks_written`` (chunk payload sizes
+    at the codec boundary: what actually crossed the filesystem);
+  * ``utils/compile_cache.py`` — ``compile_cache.cache_hits`` /
+    ``compile_cache.cache_misses`` via a ``jax.monitoring`` event
+    listener, plus an ``entries_at_enable`` gauge;
+  * ``runtime/task.py`` — ``task.blocks_failed`` / ``task.blocks_retried``;
+  * ``runtime/executor.py`` — ``executor.batches`` /
+    ``executor.batch_s`` (summed in-flight batch seconds) /
+    ``executor.dispatch_wall_s`` (wall of the whole dispatch round):
+    ``batch_s - dispatch_wall_s > 0`` is host IO hidden behind device
+    execution by the pipeline (depth > 1).
+
+Enabled exactly when tracing is enabled (one switch: CTT_TRACE_DIR).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict
+
+from . import trace
+
+__all__ = [
+    "inc", "set_gauge", "snapshot", "flush",
+    "install_compile_cache_listener", "reset",
+]
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, float] = {}
+_GAUGES: Dict[str, Any] = {}
+
+METRICS_FILE_PREFIX = "metrics.p"
+
+
+def inc(name: str, value: float = 1.0) -> None:
+    if not trace.enabled():
+        return
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0.0) + value
+
+
+def set_gauge(name: str, value: Any) -> None:
+    if not trace.enabled():
+        return
+    with _LOCK:
+        _GAUGES[name] = value
+
+
+def snapshot() -> Dict[str, Any]:
+    with _LOCK:
+        return {"counters": dict(_COUNTERS), "gauges": dict(_GAUGES)}
+
+
+def reset() -> None:
+    """Drop all accumulated values (test isolation helper)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+
+
+def flush() -> None:
+    """Write this process's snapshot into the active run directory.
+    Atomic (tmp + os.replace); repeated flushes overwrite with the latest
+    totals, so the last write per process wins."""
+    rdir = trace.run_dir()
+    if rdir is None:
+        return
+    snap = snapshot()
+    if not snap["counters"] and not snap["gauges"]:
+        return
+    os.makedirs(rdir, exist_ok=True)
+    path = os.path.join(rdir, f"{METRICS_FILE_PREFIX}{os.getpid()}.json")
+    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(snap, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# jax compile-cache hit/miss listener
+
+_CACHE_LISTENER_INSTALLED = False
+
+# jax.monitoring event names emitted by the persistent compilation cache
+_CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "compile_cache.cache_hits",
+    "/jax/compilation_cache/cache_misses": "compile_cache.cache_misses",
+    "/jax/compilation_cache/tasks_using_cache": "compile_cache.tasks_using_cache",
+}
+
+
+def install_compile_cache_listener() -> bool:
+    """Count persistent-compile-cache hits/misses via ``jax.monitoring``
+    (idempotent).  Returns False when the monitoring API is unavailable —
+    the cache keeps working, only the metric is absent."""
+    global _CACHE_LISTENER_INSTALLED
+    if _CACHE_LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:  # pragma: no cover - jax is baked into the image
+        return False
+
+    def _listener(event: str, **kwargs) -> None:
+        name = _CACHE_EVENTS.get(event)
+        if name is not None:
+            inc(name)
+
+    try:
+        monitoring.register_event_listener(_listener)
+    except Exception:  # pragma: no cover - API drift must not break callers
+        return False
+    _CACHE_LISTENER_INSTALLED = True
+    return True

@@ -1,0 +1,371 @@
+"""ctt-obs span recorder: low-overhead, process-safe structured tracing.
+
+Where a workflow's wall-clock goes was previously invisible: the only
+telemetry was per-dispatch ``time.time()`` deltas buried in status JSON
+(`Task.record_timing`).  This module records *spans* — named, nested
+intervals on the monotonic clock — into per-(pid, thread) JSONL shards
+that `obs.export` merges across processes into one run:
+
+  run (``build``) → task → dispatch → block-batch / block → host-IO,
+  plus collective spans from ``parallel/sharded*.py``.
+
+Design constraints (the reasons it looks the way it does):
+
+  * **No-op fast path.**  Tracing is off unless ``CTT_TRACE_DIR`` is set
+    (or `enable()` is called): ``span()`` then returns a shared singleton
+    context manager — no allocation, no clock read, no lock.  Hot paths
+    (per-chunk store IO) use `obs.metrics` counters instead of spans.
+  * **One writer per shard.**  Every (pid, thread) pair appends to its own
+    ``spans.p<pid>.t<tid>.jsonl`` — the same pid+thread-uniqueness
+    convention as the store's atomic tmp files (utils/store.py
+    ``_atomic_write_bytes``) — so concurrent block threads never interleave
+    partial lines and no cross-process lock exists.
+  * **Monotonic durations, wall-clock anchors.**  Span endpoints are
+    ``time.monotonic()`` (immune to clock jumps — the same fix applied to
+    the task deadlines, see CTT008); each shard's header records one
+    (wall, mono) anchor pair so the exporter can place spans on a shared
+    wall-clock axis across processes.
+  * **Cross-process-unique span ids**: ``pid << 24 | counter`` — shards
+    from any number of single-host processes merge without collisions.
+  * **Parents are best-effort.**  Nesting is tracked per thread; spans
+    opened in worker threads (executor pipelining) carry an explicit
+    ``task=...`` attribute instead, and the exporter resolves task
+    attribution through either route.
+
+Clock vocabulary for the rest of the codebase (enforced by lint rule
+CTT008): ``time.time()`` is for *timestamps* only; durations and deadlines
+use ``obs.trace.monotonic()`` (= ``time.monotonic()``) so a host clock
+jump can never fire or stall a timeout.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import itertools
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, IO, Optional, Tuple
+
+__all__ = [
+    "enabled", "enable", "disable", "flush", "span", "event", "traced",
+    "current_run_id", "run_dir", "monotonic", "new_run_id",
+]
+
+ENV_DIR = "CTT_TRACE_DIR"
+ENV_RUN = "CTT_RUN_ID"
+
+# duration clock for the whole codebase (CTT008: wall clock is for
+# timestamps only) — a named alias so call sites read as intent
+monotonic = time.monotonic
+
+_SPAN_ID_PID_SHIFT = 24  # pid << 24 | counter: unique across processes
+
+
+def new_run_id() -> str:
+    """Human-sortable, collision-safe run id (wall stamp + pid + nonce)."""
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    nonce = os.urandom(2).hex()
+    return f"run_{stamp}_p{os.getpid()}_{nonce}"
+
+
+class _RunState:
+    """Open shard handles + per-thread span stacks for one enabled run."""
+
+    def __init__(self, trace_dir: str, run_id: str):
+        self.trace_dir = trace_dir
+        self.run_id = run_id
+        self.dir = os.path.join(trace_dir, run_id)
+        self.lock = threading.Lock()  # guards the shard-handle dict only
+        self.shards: Dict[Tuple[int, int], IO[str]] = {}
+        self.local = threading.local()
+        self.counter = itertools.count(1)
+
+    # -- per-thread span stack (parent tracking) --------------------------
+
+    def stack(self):
+        st = getattr(self.local, "stack", None)
+        if st is None:
+            st = []
+            self.local.stack = st
+        return st
+
+    # -- shard IO ----------------------------------------------------------
+
+    def _shard(self) -> IO[str]:
+        key = (os.getpid(), threading.get_ident())
+        f = self.shards.get(key)
+        if f is None or f.closed:
+            with self.lock:
+                f = self.shards.get(key)
+                if f is None or f.closed:
+                    os.makedirs(self.dir, exist_ok=True)
+                    path = os.path.join(
+                        self.dir, f"spans.p{key[0]}.t{key[1]}.jsonl"
+                    )
+                    f = open(path, "a", buffering=1)
+                    # anchor pair: the exporter maps mono -> wall with it.
+                    # time.time() here is a timestamp, not duration math.
+                    f.write(json.dumps({
+                        "type": "header",
+                        "run": self.run_id,
+                        "pid": key[0],
+                        "tid": key[1],
+                        "host": socket.gethostname(),
+                        "wall": time.time(),
+                        "mono": monotonic(),
+                    }) + "\n")
+                    self.shards[key] = f
+        return f
+
+    def write(self, record: Dict[str, Any]) -> None:
+        self._shard().write(json.dumps(record) + "\n")
+
+    def next_span_id(self) -> int:
+        return (os.getpid() << _SPAN_ID_PID_SHIFT) | (
+            next(self.counter) & ((1 << _SPAN_ID_PID_SHIFT) - 1)
+        )
+
+    def flush(self) -> None:
+        with self.lock:
+            for f in list(self.shards.values()):
+                try:
+                    if not f.closed:
+                        f.flush()
+                except OSError:  # pragma: no cover - flush is best-effort
+                    pass
+
+    def close(self) -> None:
+        with self.lock:
+            for f in list(self.shards.values()):
+                try:
+                    if not f.closed:
+                        f.close()
+                except OSError:  # pragma: no cover
+                    pass
+            self.shards.clear()
+
+
+_RUN: Optional[_RunState] = None
+_ATEXIT_REGISTERED = False
+
+
+def enabled() -> bool:
+    return _RUN is not None
+
+
+def current_run_id() -> Optional[str]:
+    return _RUN.run_id if _RUN is not None else None
+
+
+def run_dir() -> Optional[str]:
+    """Directory holding this run's shards (``<trace_dir>/<run_id>``)."""
+    return _RUN.dir if _RUN is not None else None
+
+
+def enable(
+    trace_dir: Optional[str] = None,
+    run_id: Optional[str] = None,
+    export_env: bool = True,
+) -> str:
+    """Turn tracing on (idempotent for an identical dir+run).
+
+    ``export_env=True`` publishes CTT_TRACE_DIR / CTT_RUN_ID so child
+    processes (bench subprocesses, scheduler workers, multi-host peers
+    launched from here) join the SAME run — the cross-process contract.
+    Returns the run id.
+    """
+    global _RUN, _ATEXIT_REGISTERED
+    if trace_dir is None:
+        trace_dir = os.environ.get(ENV_DIR)
+        if not trace_dir:
+            raise ValueError(
+                "enable() needs a trace_dir (argument or CTT_TRACE_DIR)"
+            )
+    if run_id is None:
+        run_id = os.environ.get(ENV_RUN) or new_run_id()
+    if _RUN is not None:
+        if _RUN.trace_dir == trace_dir and _RUN.run_id == run_id:
+            return run_id
+        disable()
+    _RUN = _RunState(trace_dir, run_id)
+    if export_env:
+        os.environ[ENV_DIR] = trace_dir
+        os.environ[ENV_RUN] = run_id
+    if not _ATEXIT_REGISTERED:
+        atexit.register(flush)
+        _ATEXIT_REGISTERED = True
+    return run_id
+
+
+def disable() -> None:
+    """Flush and stop recording (the env vars are left untouched so an
+    explicit disable() sticks for this process only)."""
+    global _RUN
+    if _RUN is not None:
+        try:
+            from . import metrics as _metrics
+
+            _metrics.flush()
+        except Exception:  # pragma: no cover - teardown is best-effort
+            pass
+        _RUN.flush()
+        _RUN.close()
+        _RUN = None
+
+
+def flush() -> None:
+    """Flush every open shard (and the metrics snapshot) to disk — called
+    at the end of ``runtime.build`` and atexit, so short-lived processes
+    (scheduler workers, bench subprocesses) never lose buffered spans."""
+    if _RUN is not None:
+        try:
+            from . import metrics as _metrics
+
+            _metrics.flush()
+        except Exception:  # pragma: no cover
+            pass
+        _RUN.flush()
+
+
+def _bootstrap_from_env() -> None:
+    trace_dir = os.environ.get(ENV_DIR)
+    if trace_dir:
+        enable(trace_dir)
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled fast path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "kind", "attrs", "sid", "parent", "t0", "_st")
+
+    def __init__(self, st: _RunState, name: str, kind: str, attrs):
+        self._st = st
+        self.name = name
+        self.kind = kind
+        self.attrs = attrs
+        self.sid = st.next_span_id()
+        self.parent = None
+        self.t0 = 0.0
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "_Span":
+        stack = self._st.stack()
+        if stack:
+            self.parent = stack[-1].sid
+        stack.append(self)
+        self.t0 = monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = monotonic()
+        stack = self._st.stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        record = {
+            "type": "span",
+            "id": self.sid,
+            "parent": self.parent,
+            "name": self.name,
+            "kind": self.kind,
+            "t0": self.t0,
+            "t1": t1,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if self.attrs:
+            record["attrs"] = self.attrs
+        self._st.write(record)
+        return False
+
+
+def span(name: str, kind: str = "host", **attrs):
+    """Context manager recording one interval.
+
+    ``kind`` buckets the summarize table: ``host_io`` (chunk reads/writes),
+    ``device`` (batched device dispatch), ``collective`` (mesh programs in
+    parallel/), ``task``/``dispatch``/``run`` (structural), ``barrier``
+    (peer waits), ``host`` (everything else), ``timing`` (retroactive
+    record_timing bridge events — excluded from bucket sums).  Pass
+    ``task=<identifier>`` when the span may open in a worker thread, where
+    the per-thread parent stack cannot see the task span.
+    """
+    st = _RUN
+    if st is None:
+        return _NOOP
+    return _Span(st, name, kind, attrs)
+
+
+def traced(name: Optional[str] = None, kind: str = "host", **attrs):
+    """Decorator form of :func:`span` for whole functions (e.g. the
+    collective entry points in ``parallel/sharded*.py``).  When tracing is
+    disabled the only overhead is one module-global None check."""
+
+    def deco(fn):
+        label = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if _RUN is None:
+                return fn(*args, **kwargs)
+            with span(label, kind=kind, **attrs):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def event(name: str, kind: str, seconds: float, **attrs) -> None:
+    """Record a retroactive, already-measured interval ending now (the
+    bridge for `Task.record_timing`'s after-the-fact durations).  The
+    placement on the time axis is approximate (ends at 'now'); the
+    duration is exact."""
+    st = _RUN
+    if st is None:
+        return
+    t1 = monotonic()
+    record = {
+        "type": "span",
+        "id": st.next_span_id(),
+        "parent": None,
+        "name": name,
+        "kind": kind,
+        "t0": t1 - float(seconds),
+        "t1": t1,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+    }
+    if attrs:
+        record["attrs"] = attrs
+    st.write(record)
+
+
+_bootstrap_from_env()

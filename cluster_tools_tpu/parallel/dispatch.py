@@ -14,6 +14,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from ..utils.blocking import Blocking, BlockWithHalo
 
 
@@ -69,13 +70,16 @@ def read_block_batch(
             arr = np.pad(arr, pad_width)
         return arr
 
-    if n_threads > 1 and len(blocks) > 1:
-        from concurrent.futures import ThreadPoolExecutor
+    with obs_trace.span(
+        "read_block_batch", kind="host_io", blocks=len(blocks)
+    ):
+        if n_threads > 1 and len(blocks) > 1:
+            from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(min(n_threads, len(blocks))) as pool:
-            datas = list(pool.map(_read, blocks))
-    else:
-        datas = [_read(bh) for bh in blocks]
+            with ThreadPoolExecutor(min(n_threads, len(blocks))) as pool:
+                datas = list(pool.map(_read, blocks))
+        else:
+            datas = [_read(bh) for bh in blocks]
     valids = [
         [[0, e - b] for b, e in zip(bh.outer.begin, bh.outer.end)]
         for bh in blocks
@@ -107,10 +111,13 @@ def write_block_batch(
     Only the inner box is written — overlap is re-read, never written, the
     reference's no-write-race construction (SURVEY.md §2.8.2).
     """
-    for i, bh in enumerate(batch.blocks):
-        arr = results[i]
-        local = bh.inner_local
-        arr = np.asarray(arr[local.slicing])
-        if cast is not None:
-            arr = arr.astype(cast)
-        ds[bh.inner.slicing] = arr
+    with obs_trace.span(
+        "write_block_batch", kind="host_io", blocks=len(batch.blocks)
+    ):
+        for i, bh in enumerate(batch.blocks):
+            arr = results[i]
+            local = bh.inner_local
+            arr = np.asarray(arr[local.slicing])
+            if cast is not None:
+                arr = arr.astype(cast)
+            ds[bh.inner.slicing] = arr

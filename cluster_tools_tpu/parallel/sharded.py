@@ -41,6 +41,7 @@ try:  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
+from ..obs import trace as obs_trace
 from ..ops.cc import _min_sweep, _shift, neighbor_offsets
 from .mesh import get_mesh, put_global
 
@@ -365,6 +366,7 @@ def _sharded_flood(hmap, seeds, mask, axis_name, mesh):
     return fn(hmap, seeds, mask)
 
 
+@obs_trace.traced(kind="collective")
 def sharded_seeded_watershed(
     hmap,
     seeds,
@@ -399,6 +401,7 @@ def sharded_seeded_watershed(
     return _sharded_flood(hmap, seeds, mask, axis_name, mesh)
 
 
+@obs_trace.traced(kind="collective")
 def sharded_connected_components(
     mask,
     mesh=None,

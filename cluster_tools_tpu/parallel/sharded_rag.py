@@ -32,6 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..obs import trace as obs_trace
 from ..ops.rag import HIST_BINS, QUANTILES
 from .mesh import get_mesh, put_global
 from .sharded import _neighbor_planes, shard_map
@@ -264,6 +265,7 @@ def shard_sample_cap(labels_host: np.ndarray, n_shards: int) -> int:
     return sample_capacity(worst)
 
 
+@obs_trace.traced(kind="collective")
 def sharded_boundary_edge_features(
     labels,
     values,

@@ -41,6 +41,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..obs import trace as obs_trace
 from ..ops.dt import _BIG as _DT_BIG
 from ..ops.dt import _parabola_pass
 from ..ops.filters import _gauss_kernel
@@ -257,6 +258,7 @@ def _stage_input(input_, mesh, axis_name, invert_input, z_valid, who):
     return put_global(arr, mesh, axis_name, dtype=np.float32), int(z_valid)
 
 
+@obs_trace.traced(kind="collective")
 def sharded_dt_watershed_2d(
     input_,
     mesh=None,
@@ -342,6 +344,7 @@ def sharded_dt_watershed_2d(
     return labels, n_labels
 
 
+@obs_trace.traced(kind="collective")
 def sharded_dt_watershed(
     input_,
     mesh=None,

@@ -33,6 +33,10 @@ def run_job(job_dir: str, job_id: int) -> int:
     with open(config_path) as f:
         job = json.load(f)
 
+    # ctt-obs: a scheduler job inherits CTT_TRACE_DIR/CTT_RUN_ID from the
+    # submitting process's environment (worker_env), so its spans land in
+    # the same run as the driver's — bootstrap happened at obs import
+    from ..obs import trace as obs_trace
     from ..utils.blocking import Blocking
     from .executor import LocalExecutor
 
@@ -42,9 +46,14 @@ def run_job(job_dir: str, job_id: int) -> int:
     config["target"] = "local"
     executor = LocalExecutor(config)
     try:
-        done, failed, errors = executor.run_blocks(
-            task, blocking, job["block_ids"], config
-        )
+        with obs_trace.span(
+            f"job_{job_id}", kind="host",
+            task=getattr(task, "identifier", "unknown"),
+            blocks=len(job["block_ids"]),
+        ):
+            done, failed, errors = executor.run_blocks(
+                task, blocking, job["block_ids"], config
+            )
         status = {
             "done": [int(b) for b in done],
             "failed": [int(b) for b in failed],
@@ -60,6 +69,7 @@ def run_job(job_dir: str, job_id: int) -> int:
     with open(tmp, "w") as f:
         json.dump(status, f)
     os.replace(tmp, status_path)
+    obs_trace.flush()  # short-lived process: don't rely on atexit ordering
     return 0 if not status["failed"] else 1
 
 

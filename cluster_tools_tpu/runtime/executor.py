@@ -24,6 +24,8 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Sequence, Tuple
 
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..utils.blocking import Blocking
 
 RunResult = Tuple[List[int], List[int], Dict[int, str]]  # done, failed, errors
@@ -81,7 +83,13 @@ class LocalExecutor(BaseExecutor):
         def _one(bid: int):
             try:
                 t0 = time.perf_counter()
-                task.process_block(bid, blocking, config)
+                # explicit task= attribute: under a thread pool the span
+                # opens in a worker thread where the per-thread parent
+                # stack cannot see the enclosing task span
+                with obs_trace.span(
+                    "block", kind="host", task=task.identifier, block=bid
+                ):
+                    task.process_block(bid, blocking, config)
                 durations.append(time.perf_counter() - t0)
                 return bid, None
             except Exception:
@@ -159,15 +167,23 @@ class TpuExecutor(BaseExecutor):
             ids[i : i + batch_size] for i in range(0, len(ids), batch_size)
         ]
 
+        batch_seconds: List[float] = []  # list.append: safe from pool threads
+
         def _one_batch(chunk):
             try:
                 t0 = time.perf_counter()
-                batch_fn(chunk, blocking, config)
+                with obs_trace.span(
+                    "block_batch", kind="device", task=task.identifier,
+                    blocks=len(chunk),
+                ):
+                    batch_fn(chunk, blocking, config)
+                dt = time.perf_counter() - t0
+                batch_seconds.append(dt)
                 _record(
                     task,
                     f"batch_{chunk[0]}_{chunk[-1]}",
                     len(chunk),
-                    time.perf_counter() - t0,
+                    dt,
                 )
                 done.extend(chunk)
             except Exception:
@@ -176,7 +192,11 @@ class TpuExecutor(BaseExecutor):
                 # doesn't fail the whole batch
                 for bid in chunk:
                     try:
-                        task.process_block(bid, blocking, config)
+                        with obs_trace.span(
+                            "block_fallback", kind="host",
+                            task=task.identifier, block=bid,
+                        ):
+                            task.process_block(bid, blocking, config)
                         done.append(bid)
                     except Exception:
                         failed.append(bid)
@@ -204,12 +224,21 @@ class TpuExecutor(BaseExecutor):
         depth = max(int(config.get("pipeline_depth", 2)), 1)
         if not getattr(task, "pipeline_safe", True):
             depth = 1
+        t_wall0 = time.perf_counter()
         if depth == 1 or len(chunks) == 1:
             for chunk in chunks:
                 _one_batch(chunk)
         else:
             with ThreadPoolExecutor(depth) as pool:
                 list(pool.map(_one_batch, chunks))
+        # pipeline overlap efficiency: with depth > 1, summed in-flight
+        # batch seconds exceeding the dispatch wall is exactly the host-IO
+        # time hidden behind device execution
+        obs_metrics.inc("executor.batches", len(chunks))
+        obs_metrics.inc("executor.batch_s", sum(batch_seconds))
+        obs_metrics.inc(
+            "executor.dispatch_wall_s", time.perf_counter() - t_wall0
+        )
 
     @staticmethod
     def _n_devices(config) -> int:

@@ -26,6 +26,8 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from . import config as cfg
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..utils.blocking import Blocking, blocks_in_volume
 
 
@@ -89,9 +91,17 @@ class Task:
         """Per-dispatch timing record (one batch on the tpu executor, one
         block on the local executor, one phase in a single-shot collective
         task) — surfaced in the status file so perf work is data-driven
-        (SURVEY.md §5 'strictly additive' tracing)."""
+        (SURVEY.md §5 'strictly additive' tracing).  Also bridged into the
+        ctt-obs span stream (kind ``timing``: retroactive, excluded from
+        the bucket sums — executor spans measure the same intervals live)
+        WITHOUT changing the status-file schema: resume/retry keep reading
+        the old keys."""
         self._timings.append(
             {"label": label, "blocks": int(n_blocks), "seconds": float(seconds)}
+        )
+        obs_trace.event(
+            label, "timing", seconds, task=self.identifier,
+            blocks=int(n_blocks),
         )
 
     # -- identity ------------------------------------------------------------
@@ -128,26 +138,32 @@ class Task:
         (the cross-process barrier of the shared-filesystem control plane).
         A peer that recorded an abort fails the waiter immediately instead of
         letting it spin to the timeout."""
-        deadline = time.time() + timeout_s
-        while True:
-            missing = []
-            for t in targets:
-                status = t.read()
-                if status.get("aborted"):
+        # monotonic deadline: a host clock jump (NTP step, VM migration)
+        # must neither fire the timeout early nor stall it forever
+        deadline = obs_trace.monotonic() + timeout_s
+        with obs_trace.span(
+            f"peer_wait:{stage}", kind="barrier", task=self.identifier,
+            what=what,
+        ):
+            while True:
+                missing = []
+                for t in targets:
+                    status = t.read()
+                    if status.get("aborted"):
+                        raise FailedBlocksError(
+                            f"{self.identifier}: peer process aborted "
+                            f"({t.path}): {status.get('error', 'unknown error')}"
+                        )
+                    if not status.get(stage, False):
+                        missing.append(t.path)
+                if not missing:
+                    return
+                if obs_trace.monotonic() > deadline:
                     raise FailedBlocksError(
-                        f"{self.identifier}: peer process aborted "
-                        f"({t.path}): {status.get('error', 'unknown error')}"
+                        f"{self.identifier}: timed out after {timeout_s:.0f}s "
+                        f"waiting for {what}: {missing[:3]}"
                     )
-                if not status.get(stage, False):
-                    missing.append(t.path)
-            if not missing:
-                return
-            if time.time() > deadline:
-                raise FailedBlocksError(
-                    f"{self.identifier}: timed out after {timeout_s:.0f}s "
-                    f"waiting for {what}: {missing[:3]}"
-                )
-            time.sleep(1.0)
+                time.sleep(1.0)
 
     def _write_abort(self, error: str) -> None:
         """Record this process's failure so peers at a barrier fail fast."""
@@ -281,10 +297,11 @@ class SimpleTask(Task):
                      f"{self.identifier}")
             self._peer_wait([self.output()], timeout, f"{self.identifier} on p0")
             return
-        t0 = time.time()
+        t0 = obs_trace.monotonic()
         try:
             self.log(f"start {self.identifier}")
-            self.run_impl()
+            with obs_trace.span(self.identifier, kind="task"):
+                self.run_impl()
         except Exception as e:
             if num > 1:
                 self._write_abort(f"{type(e).__name__}: {e}")
@@ -302,7 +319,7 @@ class SimpleTask(Task):
         status = {
             "task": self.identifier,
             "complete": True,
-            "runtime_s": time.time() - t0,
+            "runtime_s": obs_trace.monotonic() - t0,
             "timings": list(self._timings),
         }
         self.output().write(status)
@@ -386,7 +403,11 @@ class BlockTask(Task):
     # -- main lifecycle ------------------------------------------------------
 
     def run(self) -> None:
-        t_start = time.time()
+        with obs_trace.span(self.identifier, kind="task"):
+            self._run_traced()
+
+    def _run_traced(self) -> None:
+        t_start = obs_trace.monotonic()
         gconf = self.global_config()
         pid, num = cfg.process_topology(gconf)
         try:
@@ -404,7 +425,8 @@ class BlockTask(Task):
         if num <= 1:
             self.finalize(blocking, config, block_ids)
             self._write_status(target, block_ids, done, [], runtimes, True)
-            self.log(f"done {self.identifier} in {time.time() - t_start:.2f}s")
+            self.log(f"done {self.identifier} in "
+                     f"{obs_trace.monotonic() - t_start:.2f}s")
             return
 
         # multi-host completion protocol: blocks_done → all-process barrier →
@@ -433,7 +455,8 @@ class BlockTask(Task):
         self._write_status(
             target, block_ids, done, [], runtimes, True, blocks_done=True
         )
-        self.log(f"done {self.identifier} in {time.time() - t_start:.2f}s")
+        self.log(f"done {self.identifier} in "
+                 f"{obs_trace.monotonic() - t_start:.2f}s")
 
     def _run_blocks_phase(self, gconf, pid: int, num: int):
         """Setup + block execution (incl. retries) for this process's shard."""
@@ -478,15 +501,21 @@ class BlockTask(Task):
     ) -> None:
         attempt = 0
         while todo:
-            t0 = time.time()
-            newly_done, failed, errors = executor.run_blocks(
-                self, blocking, todo, config
-            )
-            runtimes.append(time.time() - t0)
+            t0 = obs_trace.monotonic()
+            with obs_trace.span(
+                "dispatch", kind="dispatch", task=self.identifier,
+                attempt=attempt, blocks=len(todo),
+            ):
+                newly_done, failed, errors = executor.run_blocks(
+                    self, blocking, todo, config
+                )
+            runtimes.append(obs_trace.monotonic() - t0)
             done.update(newly_done)
             self._write_status(target, block_ids, done, failed, runtimes, False)
             for bid, err in errors.items():
                 self.log(f"block {bid} failed: {err}")
+            if failed:
+                obs_metrics.inc("task.blocks_failed", len(failed))
             if not failed:
                 break
             frac = len(failed) / max(len(block_ids), 1)
@@ -508,6 +537,7 @@ class BlockTask(Task):
                     f"(≥{failure_fraction:.0%}) — refusing retry"
                 )
             attempt += 1
+            obs_metrics.inc("task.blocks_retried", len(failed))
             self.log(f"retry {attempt}/{max_retries}: {len(failed)} failed blocks")
             todo = failed
 

@@ -80,6 +80,7 @@ def build(tasks: Sequence[Task], raise_on_failure: bool = True) -> bool:
     # persistent XLA executable cache: fresh worker processes skip the
     # multi-second jit compiles of the big fused programs (CTT_COMPILE_CACHE
     # relocates/disables — see utils/compile_cache.py)
+    from ..obs import trace as obs_trace
     from ..utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -88,24 +89,30 @@ def build(tasks: Sequence[Task], raise_on_failure: bool = True) -> bool:
         # resume after a multi-host failure: stale aborted flags from the
         # prior run would otherwise fail peers' barriers immediately
         task.clear_stale_abort()
-    for task in order:
-        if task.complete():
-            continue
-        try:
-            task.run()
-        except Exception:
-            if raise_on_failure:
-                raise
-            import traceback
+    try:
+        with obs_trace.span("build", kind="run", n_tasks=len(order)):
+            for task in order:
+                if task.complete():
+                    continue
+                try:
+                    task.run()
+                except Exception:
+                    if raise_on_failure:
+                        raise
+                    import traceback
 
-            traceback.print_exc()
-            return False
-        if isinstance(task, WorkflowBase):
-            continue
-        if not task.complete():
-            msg = f"task {task!r} ran but did not reach completion"
-            if raise_on_failure:
-                raise RuntimeError(msg)
-            print(msg)
-            return False
-    return True
+                    traceback.print_exc()
+                    return False
+                if isinstance(task, WorkflowBase):
+                    continue
+                if not task.complete():
+                    msg = f"task {task!r} ran but did not reach completion"
+                    if raise_on_failure:
+                        raise RuntimeError(msg)
+                    print(msg)
+                    return False
+        return True
+    finally:
+        # in-process callers (tests, notebooks) see complete shards without
+        # waiting for interpreter exit
+        obs_trace.flush()

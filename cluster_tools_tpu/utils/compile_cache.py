@@ -62,4 +62,14 @@ def enable_compile_cache(path: str | None = None) -> str | None:
         print(f"[compile_cache] disabled ({e})", flush=True)
         return None
     _ACTIVE_DIR = path
+    # ctt-obs: count cache hits/misses via jax.monitoring (no-op when
+    # tracing is off) and record how warm the cache was at enable time
+    from ..obs import metrics as obs_metrics
+
+    obs_metrics.install_compile_cache_listener()
+    try:
+        n_entries = sum(1 for n in os.listdir(path) if not n.startswith("."))
+    except OSError:  # pragma: no cover - dir vanished between calls
+        n_entries = 0
+    obs_metrics.set_gauge("compile_cache.entries_at_enable", n_entries)
     return path

@@ -36,6 +36,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from .blocking import _ceil_div
 
 try:  # h5py is available in the image, but keep it optional
@@ -473,6 +474,10 @@ class Dataset:
             return None
         with open(p, "rb") as f:
             payload = f.read()
+        # obs counters at the codec boundary: what actually crossed the
+        # filesystem (compressed payload bytes), not the decoded size
+        obs_metrics.inc("store.chunks_read")
+        obs_metrics.inc("store.bytes_read", len(payload))
         flat = self._fmt.decode_chunk(payload, self.chunks, self.dtype, self.compression)
         full = flat.reshape(self.chunks)
         extent = self._chunk_extent(grid_pos)
@@ -493,6 +498,8 @@ class Dataset:
         payload = self._fmt.encode_chunk(
             np.asarray(data, dtype=self.dtype), self.chunks, self.compression
         )
+        obs_metrics.inc("store.chunks_written")
+        obs_metrics.inc("store.bytes_written", len(payload))
         _atomic_write_bytes(p, payload)
 
     def write_chunk_varlen(self, grid_pos: Sequence[int], data: np.ndarray) -> None:
@@ -509,6 +516,8 @@ class Dataset:
         )
         p = self._chunk_path(grid_pos)
         os.makedirs(os.path.dirname(p), exist_ok=True)
+        obs_metrics.inc("store.chunks_written")
+        obs_metrics.inc("store.bytes_written", len(payload))
         _atomic_write_bytes(p, payload)
 
     def read_chunk_varlen(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
@@ -520,6 +529,8 @@ class Dataset:
             return None
         with open(p, "rb") as f:
             payload = f.read()
+        obs_metrics.inc("store.chunks_read")
+        obs_metrics.inc("store.bytes_read", len(payload))
         mode, ndim = struct.unpack(">HH", payload[:4])
         if mode != 1:
             raise ValueError(f"chunk {tuple(grid_pos)} is not varlength")

@@ -45,7 +45,8 @@ class TestRegistry:
     def test_all_shipped_rules_registered(self):
         expect = {
             "CTT001", "CTT002", "CTT003", "CTT004", "CTT005", "CTT006",
-            "CTT007", "CTT101", "CTT102", "CTT103", "CTT104", "CTT105",
+            "CTT007", "CTT008", "CTT101", "CTT102", "CTT103", "CTT104",
+            "CTT105",
         }
         assert expect <= REGISTRY.known_ids()
         assert len(expect) >= 8
@@ -268,6 +269,80 @@ class TestCTT006:
         markers = registered_markers(PYPROJECT)
         assert "slow" in markers
         assert "timeout" in markers
+
+
+# --------------------------------------------------------------------------
+# CTT008 wall clock in duration/deadline math
+
+
+class TestCTT008:
+    def test_deadline_addition(self):
+        src = (
+            "import time\n"
+            "def f(timeout):\n"
+            "    deadline = time.time() + timeout\n"
+            "    return deadline\n"
+        )
+        (f,) = lint(src, path="cluster_tools_tpu/runtime/fake.py")
+        assert (f.rule_id, f.line) == ("CTT008", 3)
+        assert "monotonic" in f.message
+
+    def test_duration_subtraction_and_comparison(self):
+        src = (
+            "import time\n"
+            "def f(t0, deadline):\n"
+            "    if time.time() > deadline:\n"
+            "        raise TimeoutError\n"
+            "    return time.time() - t0\n"
+        )
+        fs = lint(src, path="cluster_tools_tpu/runtime/fake.py")
+        assert [(f.rule_id, f.line) for f in fs] == [
+            ("CTT008", 3), ("CTT008", 5),
+        ]
+
+    def test_negative_timestamp_only(self):
+        src = (
+            "import time\n"
+            "def f(status):\n"
+            "    status['recorded_at'] = time.time()\n"
+            "    stamp = time.strftime('%H:%M:%S')\n"
+            "    return status, stamp\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/runtime/fake.py") == []
+
+    def test_negative_monotonic_math_is_fine(self):
+        src = (
+            "import time\n"
+            "def f(timeout):\n"
+            "    deadline = time.monotonic() + timeout\n"
+            "    return time.monotonic() > deadline\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/runtime/fake.py") == []
+
+    def test_obs_is_exempt(self):
+        src = (
+            "import time\n"
+            "def align(wall_anchor, mono_anchor, t):\n"
+            "    return wall_anchor + (t - mono_anchor) - time.time()\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/obs/fake.py") == []
+
+    def test_tests_are_exempt(self):
+        src = (
+            "import time\n"
+            "def test_x():\n"
+            "    t0 = time.time()\n"
+            "    assert time.time() - t0 < 5.0\n"
+        )
+        assert lint_source(src, "tests/test_fake.py") == []
+
+    def test_suppressible(self):
+        src = (
+            "import time\n"
+            "def f(t0):\n"
+            "    return time.time() - t0  # ctt: noqa[CTT008] wall on purpose\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/runtime/fake.py") == []
 
 
 # --------------------------------------------------------------------------

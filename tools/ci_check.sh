@@ -17,6 +17,54 @@ if [ "$lint_rc" -ne 0 ]; then
     exit "$lint_rc"
 fi
 
+echo "== ctt-obs smoke (traced workflow -> summarize; malformed -> nonzero) =="
+obs_tmp="$(mktemp -d)"
+trap 'rm -rf "$obs_tmp"' EXIT
+JAX_PLATFORMS=cpu CTT_TRACE_DIR="$obs_tmp/trace" CTT_RUN_ID=ci_smoke \
+    python - <<'PY'
+import numpy as np
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import UniqueWorkflow
+import os, tempfile
+td = tempfile.mkdtemp()
+path = os.path.join(td, "d.n5")
+rng = np.random.default_rng(0)
+file_reader(path).create_dataset(
+    "seg", data=rng.integers(0, 50, (8, 16, 16)).astype(np.uint64),
+    chunks=(4, 8, 8),
+)
+config_dir = os.path.join(td, "configs")
+cfg.write_global_config(config_dir, {"block_shape": [4, 8, 8]})
+wf = UniqueWorkflow(os.path.join(td, "tmp"), config_dir,
+                    input_path=path, input_key="seg",
+                    output_path=path, output_key="u")
+assert build([wf])
+PY
+smoke_rc=$?
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "obs smoke workflow failed (rc=$smoke_rc)" >&2
+    exit "$smoke_rc"
+fi
+# summarize exits 0 only when the run holds >= 1 task span
+JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs summarize \
+    "$obs_tmp/trace/ci_smoke"
+sum_rc=$?
+if [ "$sum_rc" -ne 0 ]; then
+    echo "obs summarize failed (rc=$sum_rc): traced run has no task spans" \
+         "or is malformed" >&2
+    exit "$sum_rc"
+fi
+# a malformed event file must exit nonzero (truncated/corrupt traces fail
+# loudly instead of summarizing garbage)
+echo "not json" >> "$obs_tmp/trace/ci_smoke/$(ls "$obs_tmp/trace/ci_smoke" \
+    | grep '^spans\.' | head -1)"
+if JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs summarize \
+    "$obs_tmp/trace/ci_smoke" >/dev/null 2>&1; then
+    echo "obs summarize accepted a malformed event file" >&2
+    exit 1
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
