@@ -34,6 +34,38 @@ SHARDED_WS_CONFIG = {
 }
 
 
+def stage_breakdown(tmp_folder):
+    """Per-stage pipeline seconds summed over a run's status files — the
+    three-stage executor's ``stage_{read,compute,write}_total`` records
+    (one aggregate per dispatch round).  Empty dict when no staged dispatch
+    ran (local target, sharded single-shot tasks, pipeline_depth 1)."""
+    import json
+
+    totals = {"read": 0.0, "compute": 0.0, "write": 0.0}
+    found = False
+    sdir = os.path.join(tmp_folder, "status")
+    if not os.path.isdir(sdir):
+        return {}
+    for name in sorted(os.listdir(sdir)):
+        if not name.endswith(".status.json"):
+            continue
+        try:
+            with open(os.path.join(sdir, name)) as fh:
+                st = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        for rec in st.get("timings", []):
+            label = str(rec.get("label", ""))
+            if label.startswith("stage_") and label.endswith("_total"):
+                key = label[len("stage_"):-len("_total")]
+                if key in totals:
+                    totals[key] += float(rec.get("seconds", 0.0))
+                    found = True
+    if not found:
+        return {}
+    return {f"stage_{k}_s": round(v, 3) for k, v in totals.items()}
+
+
 def _stage_volume(td, vol_path, shape, block_shape, warm):
     """Load the benchmark volume into a fresh n5 container; with ``warm``
     also stage a DISTINCT (z-rolled) copy for the jit-cache-warm rerun."""
@@ -184,7 +216,11 @@ def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False,
     SHARDED_WS_CONFIG selects the per-slice (2d) collective kernel — the
     SAME algorithm the block pipeline and the cpu-local baseline run
     (apples-to-apples), zero cross-shard collectives; rounds before that
-    measured the 3d collective."""
+    measured the 3d collective.
+
+    With ``warm=True`` returns ``(cold_wall, warm_wall, stages)`` where
+    ``stages`` carries the warm run's three-stage pipeline breakdown
+    (``stage_breakdown``; empty when no staged dispatch ran)."""
     from cluster_tools_tpu.runtime import build, config as cfg
     from cluster_tools_tpu.workflows import WatershedWorkflow
 
@@ -218,4 +254,5 @@ def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False,
         if not warm:
             return wall
         warm_wall = one_run("_warm", "bnd_warm")
-    return wall, warm_wall
+        stages = stage_breakdown(os.path.join(td, "tmp_warm"))
+    return wall, warm_wall, stages

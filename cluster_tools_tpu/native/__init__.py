@@ -48,7 +48,22 @@ def _load() -> Optional[ctypes.CDLL]:
             if not _build():
                 _build_failed = True
                 return None
-        lib = ctypes.CDLL(_LIB)
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            # a prebuilt .so from another toolchain (GLIBCXX/arch mismatch)
+            # must trigger a local rebuild, not crash every caller
+            print(f"[native] prebuilt library unusable ({e}); rebuilding")
+            if not _build():
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError as e2:
+                print(f"[native] rebuilt library failed to load ({e2}); "
+                      "falling back to python solvers")
+                _build_failed = True
+                return None
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")

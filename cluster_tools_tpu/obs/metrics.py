@@ -12,6 +12,10 @@ Wired in:
   * ``utils/store.py`` — ``store.bytes_read`` / ``store.bytes_written`` /
     ``store.chunks_read`` / ``store.chunks_written`` (chunk payload sizes
     at the codec boundary: what actually crossed the filesystem);
+    ``store.chunk_cache_hits`` / ``store.chunk_cache_misses`` (the decoded-
+    chunk LRU: hits are decodes the cache absorbed, e.g. overlapping halo
+    reads) and ``store.aligned_chunk_writes`` (region writes that took the
+    chunk-aligned encode fast path instead of read-modify-write);
   * ``utils/compile_cache.py`` — ``compile_cache.cache_hits`` /
     ``compile_cache.cache_misses`` via a ``jax.monitoring`` event
     listener, plus an ``entries_at_enable`` gauge;
@@ -20,7 +24,12 @@ Wired in:
     ``executor.batch_s`` (summed in-flight batch seconds) /
     ``executor.dispatch_wall_s`` (wall of the whole dispatch round):
     ``batch_s - dispatch_wall_s > 0`` is host IO hidden behind device
-    execution by the pipeline (depth > 1).
+    execution by the pipeline (depth > 1).  The three-stage pipeline
+    (split-protocol tasks at depth > 1) additionally reports per-stage
+    occupancy — ``executor.stage_read_s`` / ``executor.stage_compute_s`` /
+    ``executor.stage_write_s`` / ``executor.stage_batches`` — and
+    ``executor.stage_hidden_io_s``, the read+write seconds hidden behind
+    the serialized compute stage.
 
 Enabled exactly when tracing is enabled (one switch: CTT_TRACE_DIR).
 """

@@ -58,7 +58,19 @@ discarded immediately.  Correctness: mutexes persist and follow merges
 (clusters only grow; the (min, max) cluster key re-roots with ``comp``),
 so at that edge's sequential turn the mutex still exists — an attractive
 edge would be skipped, a repulsive one would record a redundant mutex for
-the same pair; neither has any other side effect.  Without this rule the
+the same pair; neither has any other side effect.  The load-bearing
+invariant behind "still exists at its sequential turn" is a WEIGHT BOUND,
+not mere persistence: the mutex edge must PRECEDE the discarded edge in
+the sequential (weight desc, index asc) order.  That holds because every
+merge edge joining a cluster grown from the mutexed pair was mutual-best
+at its round (or mutex-immune, which is strictly stronger), so along any
+merge chain the joining weights are bounded by the mutex edge's weight —
+hence every ACTIVE edge now incident to the mutexed cluster pair,
+including the discarded one, is no heavier than the mutex edge and
+sequentially comes after it.  Kernel edits that relax the mutual-best /
+immunity admission (e.g. admitting locally-best-only merges) would break
+this bound and with it the discard rule, even though mutex persistence
+itself would still hold.  Without this rule the
 near-boundary regime drained one mutexed mutual pair per round (measured
 on the bench's bimodal affinity problems: 2k nodes/6.8k edges 1164 -> 33
 rounds; 8k nodes/28k edges 3344 -> 70 rounds, 160 s -> 1.8 s warm on the

@@ -100,24 +100,65 @@ def read_block_batch(
     )
 
 
+def _chunk_aligned_region(ds, bh: BlockWithHalo) -> bool:
+    """True when the block's inner write region covers whole chunks of
+    ``ds`` — begin on a chunk boundary, end on one or at the volume edge.
+    Aligned regions of distinct blocks can never share a chunk, so their
+    writes are free of read-modify-write races."""
+    chunks = getattr(ds, "chunks", None)
+    shape = getattr(ds, "shape", None)
+    begin, end = bh.inner.begin, bh.inner.end
+    if chunks is None or shape is None or len(chunks) != len(begin):
+        return False
+    for b, e, c, s in zip(begin, end, chunks, shape):
+        if b % c or (e % c and e != s):
+            return False
+    return True
+
+
 def write_block_batch(
     ds,
     batch: BlockBatch,
     results: np.ndarray,
     cast=None,
+    n_threads: int = 4,
 ) -> None:
     """Write each block's *inner* region back (halo cropped, padding dropped).
 
     Only the inner box is written — overlap is re-read, never written, the
     reference's no-write-race construction (SURVEY.md §2.8.2).
-    """
+
+    Writes fan out over ``n_threads`` (mirroring ``read_block_batch``: chunk
+    encode is codec-bound and releases the GIL) — but ONLY when every
+    block's inner region is chunk-aligned in ``ds``, so no two blocks
+    read-modify-write the same chunk concurrently; misaligned layouts and
+    hdf5 (global lock) keep the serial loop."""
+    if (
+        getattr(ds, "_is_hdf5", False)
+        or type(ds).__module__.split(".")[0] == "h5py"
+        or not all(_chunk_aligned_region(ds, bh) for bh in batch.blocks)
+    ):
+        n_threads = 1
+
+    def _write(i_bh) -> None:
+        i, bh = i_bh
+        arr = results[i]
+        local = bh.inner_local
+        arr = np.asarray(arr[local.slicing])
+        if cast is not None:
+            arr = arr.astype(cast)
+        ds[bh.inner.slicing] = arr
+
     with obs_trace.span(
         "write_block_batch", kind="host_io", blocks=len(batch.blocks)
     ):
-        for i, bh in enumerate(batch.blocks):
-            arr = results[i]
-            local = bh.inner_local
-            arr = np.asarray(arr[local.slicing])
-            if cast is not None:
-                arr = arr.astype(cast)
-            ds[bh.inner.slicing] = arr
+        if n_threads > 1 and len(batch.blocks) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                min(n_threads, len(batch.blocks))
+            ) as pool:
+                list(pool.map(_write, enumerate(batch.blocks)))
+        else:
+            for i_bh in enumerate(batch.blocks):
+                _write(i_bh)

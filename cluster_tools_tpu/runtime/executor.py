@@ -10,7 +10,12 @@ process, so the backends here are:
   * ``tpu``   — prefers a task's ``process_block_batch``: blocks are grouped into
     fixed-size batches (static shapes for XLA), padded, and executed as one jit
     dispatch, vmapped over the batch and — when several devices are visible —
-    sharded over a ``jax.sharding.Mesh`` by the task's kernels.
+    sharded over a ``jax.sharding.Mesh`` by the task's kernels.  Tasks that
+    additionally implement the split ``read_batch`` / ``compute_batch`` /
+    ``write_batch`` protocol run under an explicit three-stage pipeline
+    (read pool → serialized compute → write pool, bounded to
+    ``pipeline_depth`` batches per stage), so chunk reads of batch i+1 and
+    chunk writes of batch i−1 both hide behind batch i's device program.
 
 Both report per-block success/failure so the task layer can retry exactly the
 failed blocks.
@@ -19,8 +24,10 @@ failed blocks.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Sequence, Tuple
 
@@ -159,6 +166,42 @@ class TpuExecutor(BaseExecutor):
             )
         return done, failed, errors
 
+    @staticmethod
+    def _staged_fns(task):
+        """The split batch protocol: a task that implements all of
+        ``read_batch`` / ``compute_batch`` / ``write_batch`` opts into the
+        three-stage pipeline; ``process_block_batch`` stays the monolithic
+        composition (used at depth 1 and by the per-block fallback)."""
+        fns = tuple(
+            getattr(task, name, None)
+            for name in ("read_batch", "compute_batch", "write_batch")
+        )
+        return fns if all(fns) else None
+
+    def _per_block_fallback(
+        self, task, blocking, config, chunk, done, failed, errors, tb
+    ) -> None:
+        """Re-run a failed batch block by block so a single poisoned block
+        doesn't fail the whole batch."""
+        for bid in chunk:
+            try:
+                with obs_trace.span(
+                    "block_fallback", kind="host",
+                    task=task.identifier, block=bid,
+                ):
+                    task.process_block(bid, blocking, config)
+                done.append(bid)
+            except Exception:
+                failed.append(bid)
+                errors[bid] = traceback.format_exc()
+        if not any(b in errors for b in chunk):
+            # batch path is broken but every block succeeded per-block;
+            # surface why without mislabeling a done block as failed
+            print(
+                f"[{self.name}] batch dispatch failed, per-block fallback "
+                f"succeeded for blocks {chunk[0]}..{chunk[-1]}:\n{tb}"
+            )
+
     def _run_batches(
         self, task, blocking, config, ids, batch_size, batch_fn,
         done, failed, errors,
@@ -187,47 +230,42 @@ class TpuExecutor(BaseExecutor):
                 )
                 done.extend(chunk)
             except Exception:
-                tb = traceback.format_exc()
-                # fall back to per-block execution so a single poisoned block
-                # doesn't fail the whole batch
-                for bid in chunk:
-                    try:
-                        with obs_trace.span(
-                            "block_fallback", kind="host",
-                            task=task.identifier, block=bid,
-                        ):
-                            task.process_block(bid, blocking, config)
-                        done.append(bid)
-                    except Exception:
-                        failed.append(bid)
-                        errors[bid] = traceback.format_exc()
-                if not any(b in errors for b in chunk):
-                    # batch path is broken but every block succeeded per-block;
-                    # surface why without mislabeling a done block as failed
-                    print(
-                        f"[{self.name}] batch dispatch failed, per-block fallback "
-                        f"succeeded for blocks {chunk[0]}..{chunk[-1]}:\n{tb}"
-                    )
+                self._per_block_fallback(
+                    task, blocking, config, chunk, done, failed, errors,
+                    traceback.format_exc(),
+                )
 
         # Batch pipelining (the reference's dask IO/compute overlap,
-        # inference.py:319-327, moved into the executor): with depth d, up to d
-        # batches are in flight on a small thread pool, so batch i+1's host
-        # chunk reads/decodes run while batch i's device program executes
-        # (XLA releases the GIL during execution).  Depth 1 restores the
-        # serial loop.  A task whose blocks read regions other blocks of the
-        # SAME dispatch write (e.g. two-pass pass 2: the halo'd read overlaps
-        # a same-color *diagonal* neighbor's inner box) declares
-        # ``pipeline_safe = False`` — chunk writes are atomic (os.replace),
-        # so concurrency would not tear data, but it would make which
-        # neighbor labels a batch sees timing-dependent; serial batches keep
-        # the output deterministic.
+        # inference.py:319-327, moved into the executor).  A task whose
+        # blocks read regions other blocks of the SAME dispatch write (e.g.
+        # two-pass pass 2: the halo'd read overlaps a same-color *diagonal*
+        # neighbor's inner box) declares ``pipeline_safe = False`` — chunk
+        # writes are atomic (os.replace), so concurrency would not tear
+        # data, but it would make which neighbor labels a batch sees
+        # timing-dependent; depth 1 (the strictly serial loop) keeps the
+        # output deterministic.
+        #
+        # Two pipelined forms, best first:
+        #   * tasks implementing the split protocol (``_staged_fns``) run a
+        #     true three-stage pipeline: a read pool prefetches batch i+1's
+        #     chunks, the dispatching thread runs every device program IN
+        #     ORDER (deterministic dispatch), and a write pool drains batch
+        #     i−1's chunk encodes — reads AND writes both overlap compute;
+        #   * monolithic ``process_block_batch`` tasks keep the depth-d
+        #     thread pool (whole batches overlap).
         depth = max(int(config.get("pipeline_depth", 2)), 1)
         if not getattr(task, "pipeline_safe", True):
             depth = 1
+        staged = self._staged_fns(task)
         t_wall0 = time.perf_counter()
         if depth == 1 or len(chunks) == 1:
             for chunk in chunks:
                 _one_batch(chunk)
+        elif staged is not None:
+            self._run_staged(
+                task, blocking, config, chunks, depth, staged,
+                done, failed, errors, batch_seconds,
+            )
         else:
             with ThreadPoolExecutor(depth) as pool:
                 list(pool.map(_one_batch, chunks))
@@ -238,6 +276,131 @@ class TpuExecutor(BaseExecutor):
         obs_metrics.inc("executor.batch_s", sum(batch_seconds))
         obs_metrics.inc(
             "executor.dispatch_wall_s", time.perf_counter() - t_wall0
+        )
+
+    def _run_staged(
+        self, task, blocking, config, chunks, depth, staged,
+        done, failed, errors, batch_seconds,
+    ) -> None:
+        """Three-stage pipeline: read → device compute → write over bounded
+        in-flight deques (the explicit-stage successor of the depth-N
+        read→compute→write pool).
+
+        Up to ``depth`` reads and ``depth`` writes ride small thread pools
+        while the calling thread is the ONE compute stage, consuming read
+        results in submission order — so the device sees the exact dispatch
+        sequence of the serial loop while batch i+1's chunk decodes and
+        batch i−1's chunk encodes both happen under batch i's program (XLA
+        releases the GIL during execution).  A stage failure for a batch
+        degrades that batch to the per-block fallback; other batches are
+        unaffected."""
+        read_fn, compute_fn, write_fn = staged
+        stage_s = {"read": 0.0, "compute": 0.0, "write": 0.0}
+        acc_lock = threading.Lock()
+
+        def _acc(stage: str, dt: float) -> None:
+            with acc_lock:
+                stage_s[stage] += dt
+
+        def _read(chunk):
+            t0 = time.perf_counter()
+            with obs_trace.span(
+                "stage_read", kind="host_io", task=task.identifier,
+                blocks=len(chunk),
+            ):
+                payload = read_fn(chunk, blocking, config)
+            _acc("read", time.perf_counter() - t0)
+            return payload
+
+        def _write(chunk, result):
+            t0 = time.perf_counter()
+            with obs_trace.span(
+                "stage_write", kind="host_io", task=task.identifier,
+                blocks=len(chunk),
+            ):
+                write_fn(result, blocking, config)
+            _acc("write", time.perf_counter() - t0)
+
+        n_blocks = sum(len(c) for c in chunks)
+        reads: deque = deque()   # (chunk, Future[payload])
+        writes: deque = deque()  # (chunk, Future[None], t_batch0)
+        with ThreadPoolExecutor(
+            depth, thread_name_prefix="ctt-read"
+        ) as read_pool, ThreadPoolExecutor(
+            depth, thread_name_prefix="ctt-write"
+        ) as write_pool:
+
+            def _drain_write():
+                chunk, fut, t_batch0 = writes.popleft()
+                try:
+                    fut.result()
+                except Exception:
+                    self._per_block_fallback(
+                        task, blocking, config, chunk, done, failed,
+                        errors, traceback.format_exc(),
+                    )
+                    return
+                batch_seconds.append(time.perf_counter() - t_batch0)
+                done.extend(chunk)
+
+            def _drain_read():
+                chunk, fut = reads.popleft()
+                t_batch0 = time.perf_counter()
+                try:
+                    payload = fut.result()
+                    t0 = time.perf_counter()
+                    with obs_trace.span(
+                        "stage_compute", kind="device",
+                        task=task.identifier, blocks=len(chunk),
+                    ):
+                        result = compute_fn(payload, blocking, config)
+                    dt = time.perf_counter() - t0
+                    _acc("compute", dt)
+                    _record(task, f"batch_{chunk[0]}_{chunk[-1]}",
+                            len(chunk), dt)
+                except Exception:
+                    self._per_block_fallback(
+                        task, blocking, config, chunk, done, failed,
+                        errors, traceback.format_exc(),
+                    )
+                    return
+                writes.append(
+                    (chunk, write_pool.submit(_write, chunk, result),
+                     t_batch0)
+                )
+                while len(writes) > depth:
+                    _drain_write()
+
+            t_wall0 = time.perf_counter()
+            for chunk in chunks:
+                reads.append((chunk, read_pool.submit(_read, chunk)))
+                while len(reads) >= depth:
+                    _drain_read()
+            while reads:
+                _drain_read()
+            while writes:
+                _drain_write()
+        wall = time.perf_counter() - t_wall0
+
+        # one aggregate record per stage per dispatch round (per-batch
+        # stage records would make the status JSON O(n_batches) × 3); the
+        # per-batch compute walls above keep the task_breakdown contract
+        _record(task, "stage_read_total", n_blocks, stage_s["read"])
+        _record(task, "stage_compute_total", n_blocks, stage_s["compute"])
+        _record(task, "stage_write_total", n_blocks, stage_s["write"])
+        obs_metrics.inc("executor.stage_batches", len(chunks))
+        obs_metrics.inc("executor.stage_read_s", stage_s["read"])
+        obs_metrics.inc("executor.stage_compute_s", stage_s["compute"])
+        obs_metrics.inc("executor.stage_write_s", stage_s["write"])
+        # IO seconds the pipeline hid behind (serialized) compute: summed
+        # read+write stage time minus the wall the compute stage left open
+        obs_metrics.inc(
+            "executor.stage_hidden_io_s",
+            max(
+                0.0,
+                stage_s["read"] + stage_s["write"]
+                - max(0.0, wall - stage_s["compute"]),
+            ),
         )
 
     @staticmethod

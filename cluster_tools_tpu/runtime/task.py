@@ -338,6 +338,15 @@ class BlockTask(Task):
       * optionally ``process_block_batch(block_ids, blocking, config)`` — a
         device-batched path the ``tpu`` executor prefers (blocks padded to a static
         shape, vmapped/sharded over the mesh);
+      * optionally the SPLIT batch protocol — ``read_batch(block_ids,
+        blocking, config) -> payload``, ``compute_batch(payload, blocking,
+        config) -> result`` (the device program; the executor serializes
+        this stage in batch order), ``write_batch(result, blocking,
+        config)`` — which lets the ``tpu`` executor run a true three-stage
+        pipeline: batch i+1's chunk reads and batch i−1's chunk writes both
+        overlap batch i's device program.  Tasks defining it keep
+        ``process_block_batch`` as the read→compute→write composition (used
+        at ``pipeline_depth`` 1 and by the per-block fallback);
       * optionally ``prepare(blocking, config)`` / ``finalize(blocking, config,
         block_ids)`` — host-side setup (e.g. output dataset creation) and reduction.
 

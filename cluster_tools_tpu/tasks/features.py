@@ -666,6 +666,8 @@ class ShardedWsProblemTask(ShardedProblemTask):
         if _jax.process_index() != 0:
             return  # process 0 owns the store writes
         ds = self.require_output(in_ds.shape, conf)
+        # threaded chunk-aligned whole-volume write (store fast path)
+        store.set_read_threads(ds, read_threads(conf))
         timed("write", lambda: ds.__setitem__(slice(None), compact))
         # ws ids ARE 1..n_labels consecutive — the node table is implied
         nodes = np.arange(1, n_labels + 1, dtype=np.uint64)

@@ -112,12 +112,12 @@ class MinfilterTask(VolumeTask):
         # halo = half the filter extent, rounded up (reference minfilter.py:83)
         return [fs // 2 + 1 for fs in config["filter_shape"]]
 
-    def _run_batch(self, block_ids, blocking: Blocking, config):
+    # -- split batch protocol (three-stage executor pipeline) ---------------
+
+    def read_batch(self, block_ids, blocking: Blocking, config):
         halo = self._halo(config)
-        in_ds = self.input_ds()
-        out_ds = self.output_ds()
-        batch = read_block_batch(in_ds, blocking, block_ids, halo=halo,
-                                 n_threads=read_threads(config),
+        batch = read_block_batch(self.input_ds(), blocking, block_ids,
+                                 halo=halo, n_threads=read_threads(config),
                                  dtype="float32")
         # replicate-pad the static-shape padding: zero fill would leak
         # "masked out" into border blocks through the min window
@@ -131,13 +131,31 @@ class MinfilterTask(VolumeTask):
                     [(0, f - s) for f, s in zip(full_shape, true_shape)],
                     mode="edge",
                 )
+        return batch
+
+    def compute_batch(self, batch, blocking: Blocking, config):
         from ..parallel.mesh import put_sharded
 
         xb, n = put_sharded(batch.data, config)
         out = _minfilter_batch(
             xb, tuple(int(f) for f in config["filter_shape"])
         )
-        write_block_batch(out_ds, batch, np.asarray(out)[:n], cast="uint8")
+        return batch, np.asarray(out)[:n]
+
+    def write_batch(self, result, blocking: Blocking, config):
+        batch, out = result
+        write_block_batch(
+            self.output_ds(), batch, out, cast="uint8",
+            n_threads=read_threads(config),
+        )
+
+    def _run_batch(self, block_ids, blocking: Blocking, config):
+        self.write_batch(
+            self.compute_batch(
+                self.read_batch(block_ids, blocking, config), blocking, config
+            ),
+            blocking, config,
+        )
 
     def process_block(self, block_id, blocking, config):
         self._run_batch([block_id], blocking, config)

@@ -44,24 +44,42 @@ class ThresholdTask(VolumeTask):
         conf.update({"threshold": 0.5, "threshold_mode": "greater", "sigma": 0.0})
         return conf
 
-    def _run_batch(self, block_ids: List[int], blocking: Blocking, config):
+    # -- split batch protocol (three-stage executor pipeline) ---------------
+
+    def read_batch(self, block_ids: List[int], blocking: Blocking, config):
         mode = config.get("threshold_mode", "greater")
         if mode not in _MODES:
             raise ValueError(f"unsupported threshold_mode {mode!r}")
+        return read_block_batch(
+            self.input_ds(), blocking, block_ids, dtype="float32",
+            n_threads=read_threads(config),
+        )
+
+    def compute_batch(self, batch, blocking: Blocking, config):
         sigma = config.get("sigma", 0.0) or 0.0
         if isinstance(sigma, list):
             sigma = tuple(sigma)
-        in_ds = self.input_ds()
-        out_ds = self.output_ds()
-        batch = read_block_batch(
-            in_ds, blocking, block_ids, dtype="float32",
-            n_threads=read_threads(config),
-        )
         xb, n = put_sharded(batch.data, config)
         result = _threshold_batch(
-            xb, float(config.get("threshold", 0.5)), mode, sigma
+            xb, float(config.get("threshold", 0.5)),
+            config.get("threshold_mode", "greater"), sigma,
         )
-        write_block_batch(out_ds, batch, np.asarray(result)[:n], cast="uint8")
+        return batch, np.asarray(result)[:n]
+
+    def write_batch(self, result, blocking: Blocking, config):
+        batch, labels = result
+        write_block_batch(
+            self.output_ds(), batch, labels, cast="uint8",
+            n_threads=read_threads(config),
+        )
+
+    def _run_batch(self, block_ids: List[int], blocking: Blocking, config):
+        self.write_batch(
+            self.compute_batch(
+                self.read_batch(block_ids, blocking, config), blocking, config
+            ),
+            blocking, config,
+        )
 
     def process_block(self, block_id, blocking, config):
         self._run_batch([block_id], blocking, config)

@@ -77,16 +77,29 @@ class BlockComponentsTask(VolumeTask):
         )
         return conf
 
-    def _run_batch(self, block_ids: List[int], blocking: Blocking, config):
+    # -- split batch protocol (three-stage executor pipeline) ---------------
+
+    def read_batch(self, block_ids: List[int], blocking: Blocking, config):
+        batch = read_block_batch(
+            self.input_ds(), blocking, block_ids, dtype="float32",
+            n_threads=read_threads(config),
+        )
+        if self.mask_path:
+            from ..utils import store as _store
+
+            mask_ds = _store.file_reader(self.mask_path, "r")[self.mask_key]
+            masks = [
+                mask_ds[bh.outer.slicing].astype(bool) for bh in batch.blocks
+            ]
+        else:
+            masks = None
+        return batch, masks
+
+    def compute_batch(self, payload, blocking: Blocking, config):
+        batch, masks = payload
         sigma = config.get("sigma", 0.0) or 0.0
         if isinstance(sigma, list):
             sigma = tuple(sigma)
-        in_ds = self.input_ds()
-        out_ds = self.output_ds()
-        batch = read_block_batch(
-            in_ds, blocking, block_ids, dtype="float32",
-            n_threads=read_threads(config),
-        )
         xb, n = put_sharded(batch.data, config)
         labels, _ = _components_batch(
             xb,
@@ -96,20 +109,31 @@ class BlockComponentsTask(VolumeTask):
             int(config.get("connectivity", 1)),
         )
         labels = np.array(labels[:n])  # writable host copy (mask edit below)
-        if self.mask_path:
-            from ..utils import store as _store
-
-            mask_ds = _store.file_reader(self.mask_path, "r")[self.mask_key]
-            for i, bh in enumerate(batch.blocks):
-                m = mask_ds[bh.outer.slicing].astype(bool)
+        if masks is not None:
+            for i, m in enumerate(masks):
                 sl = tuple(slice(0, s) for s in m.shape)
                 labels[i][sl] = np.where(m, labels[i][sl], 0)
-        write_block_batch(out_ds, batch, labels, cast="uint64")
+        return batch, labels
+
+    def write_batch(self, result, blocking: Blocking, config):
+        batch, labels = result
+        write_block_batch(
+            self.output_ds(), batch, labels, cast="uint64",
+            n_threads=read_threads(config),
+        )
         max_ids = self.tmp_ragged(MAX_IDS_KEY, blocking.n_blocks, np.int64)
         for i, bid in enumerate(batch.block_ids):
             bh = batch.blocks[i]
             inner = labels[i][bh.inner_local.slicing]
             max_ids.write_chunk((bid,), np.array([inner.max()], dtype=np.int64))
+
+    def _run_batch(self, block_ids: List[int], blocking: Blocking, config):
+        self.write_batch(
+            self.compute_batch(
+                self.read_batch(block_ids, blocking, config), blocking, config
+            ),
+            blocking, config,
+        )
 
     def process_block(self, block_id, blocking, config):
         self._run_batch([block_id], blocking, config)
@@ -363,6 +387,8 @@ class ShardedComponentsTask(VolumeSimpleTask):
         out, n_labels = relabel_consecutive_np(shifted.astype(np.uint64))
 
         ds = self.require_output(out.shape, conf)
+        # threaded chunk-aligned whole-volume write (store fast path)
+        store_mod.set_read_threads(ds, read_threads(conf))
         ds[:] = out
         ds.attrs["n_labels"] = int(n_labels)
         self.log(

@@ -97,11 +97,11 @@ class LinearTransformationTask(VolumeTask):
                     b[i, dz] = entry["b"]
         return a, b
 
-    def _run_batch(self, block_ids, blocking: Blocking, config):
-        in_ds = self.input_ds()
-        out_ds = self.output_ds()
+    # -- split batch protocol (three-stage executor pipeline) ---------------
+
+    def read_batch(self, block_ids, blocking: Blocking, config):
         batch = read_block_batch(
-            in_ds, blocking, block_ids, dtype="float32",
+            self.input_ds(), blocking, block_ids, dtype="float32",
             n_threads=read_threads(config),
         )
         a, b = self._coefficients(blocking, block_ids)
@@ -114,7 +114,10 @@ class LinearTransformationTask(VolumeTask):
                 mask[i][tuple(slice(0, s) for s in m.shape)] = m
         else:
             mask = np.ones(batch.data.shape, dtype=bool)
+        return batch, a, b, mask
 
+    def compute_batch(self, payload, blocking: Blocking, config):
+        batch, a, b, mask = payload
         from ..parallel.mesh import put_sharded
 
         xb, n = put_sharded(batch.data, config)
@@ -122,7 +125,23 @@ class LinearTransformationTask(VolumeTask):
         bb, _ = put_sharded(np.asarray(b), config)
         mb, _ = put_sharded(mask, config)
         out = _linear_batch(xb, ab, bb, mb)
-        write_block_batch(out_ds, batch, np.asarray(out)[:n], cast=out_ds.dtype)
+        return batch, np.asarray(out)[:n]
+
+    def write_batch(self, result, blocking: Blocking, config):
+        batch, out = result
+        out_ds = self.output_ds()
+        write_block_batch(
+            out_ds, batch, out, cast=out_ds.dtype,
+            n_threads=read_threads(config),
+        )
+
+    def _run_batch(self, block_ids, blocking: Blocking, config):
+        self.write_batch(
+            self.compute_batch(
+                self.read_batch(block_ids, blocking, config), blocking, config
+            ),
+            blocking, config,
+        )
 
     def process_block(self, block_id, blocking, config):
         self._run_batch([block_id], blocking, config)

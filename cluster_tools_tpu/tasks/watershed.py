@@ -169,13 +169,12 @@ class WatershedTask(VolumeTask):
             for bh in batch.blocks
         ])
 
-    def _run_batch(self, block_ids: List[int], blocking: Blocking, config):
-        in_ds = self.input_ds()
-        out_ds = self.output_ds()
-        halo = config.get("halo") or [0, 0, 0]
-        params = self._kernel_params(config)
+    # -- split batch protocol (three-stage executor pipeline) ---------------
 
-        # read (channel-agglomerated) halo'd blocks
+    def read_batch(self, block_ids: List[int], blocking: Blocking, config):
+        """Stage 1: read (channel-agglomerated) halo'd blocks + masks."""
+        in_ds = self.input_ds()
+        halo = config.get("halo") or [0, 0, 0]
         datas, blocks = [], []
         full_shape = tuple(
             bs + 2 * h for bs, h in zip(blocking.block_shape, halo)
@@ -196,11 +195,16 @@ class WatershedTask(VolumeTask):
         batch = BlockBatch(
             data=batch_arr, valid=None, blocks=blocks, block_ids=list(block_ids)
         )
-        mask = self._load_mask_batch(batch)
+        return batch, valid_arr, self._load_mask_batch(batch)
 
+    def compute_batch(self, payload, blocking: Blocking, config):
+        """Stage 2: ONE fused dispatch — flood → inner-box crop → CC
+        re-close (the former three-dispatch sequence with host round-trips
+        in between) — materialized back to host."""
+        batch, valid_arr, mask = payload
+        halo = config.get("halo") or [0, 0, 0]
+        params = self._kernel_params(config)
         has_halo = any(h > 0 for h in halo)
-        # one fused dispatch: flood → inner-box crop → CC re-close (the
-        # former three-dispatch sequence with host round-trips in between)
         fused = _fused_ws_kernel(
             tuple(sorted(params.items())),
             tuple(blocking.block_shape),
@@ -208,9 +212,9 @@ class WatershedTask(VolumeTask):
             has_halo,
         )
         starts = np.array(
-            [bh.inner_local.begin for bh in blocks], dtype=np.int32
+            [bh.inner_local.begin for bh in batch.blocks], dtype=np.int32
         )
-        xb, n_real = put_sharded(batch_arr, config)
+        xb, n_real = put_sharded(batch.data, config)
         vb, _ = put_sharded(valid_arr, config)
         sb, _ = put_sharded(starts, config)
         if mask is None:
@@ -218,11 +222,18 @@ class WatershedTask(VolumeTask):
         else:
             mb, _ = put_sharded(mask, config)
             labels = fused(xb, vb, sb, mb)
-        labels = np.asarray(labels)[:n_real].astype(np.uint64)
+        return batch, np.asarray(labels)[:n_real].astype(np.uint64)
 
+    def write_batch(self, result, blocking: Blocking, config):
+        """Stage 3: apply block-id offsets, record per-block max ids, write
+        the inner boxes."""
+        batch, labels = result
+        out_ds = self.output_ds()
+        halo = config.get("halo") or [0, 0, 0]
+        has_halo = any(h > 0 for h in halo)
         offset_unit = int(np.prod(blocking.block_shape))
         max_ids = self.tmp_ragged(MAX_IDS_KEY, blocking.n_blocks, np.int64)
-        for i, (bid, bh) in enumerate(zip(batch.block_ids, blocks)):
+        for i, (bid, bh) in enumerate(zip(batch.block_ids, batch.blocks)):
             lab = labels[i]
             if has_halo:
                 # fused output is inner-origin at the static block shape;
@@ -235,6 +246,14 @@ class WatershedTask(VolumeTask):
             lab = np.where(lab > 0, lab + off, 0).astype(np.uint64)
             max_ids.write_chunk((bid,), np.array([lab.max()], dtype=np.int64))
             out_ds[bh.inner.slicing] = lab
+
+    def _run_batch(self, block_ids: List[int], blocking: Blocking, config):
+        self.write_batch(
+            self.compute_batch(
+                self.read_batch(block_ids, blocking, config), blocking, config
+            ),
+            blocking, config,
+        )
 
     def process_block(self, block_id, blocking, config):
         self._run_batch([block_id], blocking, config)
@@ -647,6 +666,9 @@ class ShardedWatershedTask(VolumeSimpleTask):
             return  # process 0 owns the writes
         out, n_labels = relabel_consecutive_np(labels.astype(np.uint64))
         ds = self.require_output(in_ds.shape, config)
+        # threaded chunk-aligned whole-volume write (store fast path):
+        # every chunk encodes straight from the label array, in parallel
+        store.set_read_threads(ds, read_threads(config))
         ds[:] = out
         self.log(
             f"sharded DT-watershed over {n_dev} devices: {n_labels} fragments"

@@ -95,8 +95,16 @@ def available() -> bool:
     return _load() is not None
 
 
-def decompress(payload: bytes) -> bytes:
-    """Decompress one blosc frame (any cname/shuffle the lib supports)."""
+def decompress(payload: bytes, expected_nbytes: Optional[int] = None) -> bytes:
+    """Decompress one blosc frame (any cname/shuffle the lib supports).
+
+    ``expected_nbytes`` bounds the output allocation: chunk callers know the
+    decoded size a frame may legitimately claim (chunk_shape × itemsize), and
+    a corrupt/hostile chunk from an externally-produced store must fail
+    loudly instead of triggering a multi-GB allocation from a forged header
+    (ADVICE r5 — the pre-1.16 fallback path read the header-claimed nbytes
+    unbounded; the clamp applies to the validate path too, since
+    ``blosc_cbuffer_validate`` checks consistency, not plausibility)."""
     lib = _load()
     if lib is None:
         raise RuntimeError(
@@ -124,6 +132,11 @@ def decompress(payload: bytes) -> bytes:
         )
         if cbytes.value != len(payload):
             raise ValueError("corrupt blosc chunk (size header mismatch)")
+    if expected_nbytes is not None and nbytes.value > int(expected_nbytes):
+        raise ValueError(
+            f"corrupt blosc chunk: header claims {nbytes.value} decompressed "
+            f"bytes, expected at most {int(expected_nbytes)}"
+        )
     out = ctypes.create_string_buffer(max(nbytes.value, 1))
     n = lib.blosc_decompress_ctx(payload, out, nbytes.value, 1)
     if n < 0 or n != nbytes.value:

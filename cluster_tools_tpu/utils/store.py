@@ -21,6 +21,18 @@ Datasets support numpy-style region read/write (``ds[bb]`` / ``ds[bb] = x``) wit
 read-modify-write on partially covered chunks.  Parallel writers must write disjoint
 chunk-aligned regions — the same contract the reference relies on (SURVEY.md §5
 "race detection": disjoint inner-block writes by construction).
+
+Host hot-path fast paths (ctt-io):
+
+  * region writes that exactly cover a chunk encode straight from the region
+    view (no intermediate chunk buffer, no RMW read+decode);
+  * region reads AND writes fan their per-chunk work over ``ds.n_threads``
+    (the z5py idiom, ``set_read_threads``) — codec work releases the GIL;
+  * a process-global decoded-chunk LRU (``CTT_CHUNK_CACHE_MB``, default 64,
+    0 disables) so overlapping halo'd reads of neighboring blocks decode
+    each shared chunk once.  Entries are validated against the chunk file's
+    ``(inode, mtime_ns, size)`` and invalidated by in-process writes, so
+    cross-process writers are picked up on the next read.
 """
 
 from __future__ import annotations
@@ -31,6 +43,8 @@ import os
 import struct
 import threading
 import zlib
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 from itertools import product
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -64,6 +78,73 @@ def _write_json(path: str, obj: Any) -> None:
 def _read_json(path: str) -> Any:
     with open(path) as f:
         return json.load(f)
+
+
+class _DecodedChunkCache:
+    """Process-global LRU of decoded (uncompressed, full-shape) chunks.
+
+    Halo'd block reads decode every shared chunk up to 2^ndim times per
+    batch; the cache makes each decode happen once.  Entries are keyed by
+    the chunk file path and carry the file's ``(inode, mtime_ns, size)``
+    signature: a mismatch (another process rewrote the chunk — os.replace
+    changes the inode) is a miss, so cross-process freshness degrades to a
+    re-decode, never to stale data.  In-process writers invalidate
+    explicitly (``write_chunk``).  Cached arrays are read-only views shared
+    across readers; callers that hand out writable data copy on exit
+    (``Dataset.read_chunk``).
+    """
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, Tuple[Any, np.ndarray]]" = OrderedDict()
+        self._bytes = 0
+
+    def get(self, path: str, sig) -> Optional[np.ndarray]:
+        with self._lock:
+            entry = self._entries.get(path)
+            if entry is None or entry[0] != sig:
+                return None
+            self._entries.move_to_end(path)
+            return entry[1]
+
+    def put(self, path: str, sig, arr: np.ndarray) -> None:
+        if arr.nbytes > self.max_bytes:
+            return
+        with self._lock:
+            old = self._entries.pop(path, None)
+            if old is not None:
+                self._bytes -= old[1].nbytes
+            self._entries[path] = (sig, arr)
+            self._bytes += arr.nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, evicted) = self._entries.popitem(last=False)
+                self._bytes -= evicted.nbytes
+
+    def invalidate(self, path: str) -> None:
+        with self._lock:
+            old = self._entries.pop(path, None)
+            if old is not None:
+                self._bytes -= old[1].nbytes
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+def _chunk_cache_budget_bytes() -> int:
+    """CTT_CHUNK_CACHE_MB (default 64, 0 disables); malformed values degrade
+    to the default like every other CTT_* switch.  Read once at import."""
+    raw = os.environ.get("CTT_CHUNK_CACHE_MB")
+    try:
+        mb = float(raw) if raw is not None else 64.0
+    except (TypeError, ValueError):
+        mb = 64.0
+    return max(int(mb * 1024 * 1024), 0)
+
+
+_CHUNK_CACHE = _DecodedChunkCache(_chunk_cache_budget_bytes())
 
 
 class Attributes:
@@ -157,16 +238,32 @@ def default_compression():
     return "blosc" if _blosc_mod().available() else "gzip"
 
 
-def _normalize_blosc(spec) -> dict:
+def _normalize_blosc(spec, itemsize: Optional[int] = None) -> dict:
     """Blosc spec with the ecosystem defaults (zarr-python: lz4, clevel 5,
     byte shuffle, auto blocksize) filled in; ``spec`` may be the string
-    'blosc', a zarr compressor dict, or an n5 compression dict."""
+    'blosc', a zarr compressor dict, or an n5 compression dict.
+
+    ``shuffle`` from external metadata is validated into {0, 1, 2} here —
+    at ``read_meta`` time — because numcodecs writes −1 (AUTOSHUFFLE),
+    which READS fine (the frame header governs decompression) but would
+    make any later write into such a dataset fail inside
+    ``blosc_compress_ctx`` with a generic rc error (ADVICE r5).  −1 maps
+    to what numcodecs' auto resolves to: byte shuffle for ``itemsize`` > 1,
+    no shuffle for single-byte types."""
     src = spec if isinstance(spec, dict) else {}
+    shuffle = int(src.get("shuffle", 1))
+    if shuffle == -1:
+        shuffle = 1 if (itemsize or 0) > 1 else 0
+    if shuffle not in (0, 1, 2):
+        raise ValueError(
+            f"unsupported blosc shuffle {src.get('shuffle')!r} "
+            "(supported: 0=none, 1=byte, 2=bit, -1=auto)"
+        )
     return {
         "id": "blosc",
         "cname": src.get("cname", "lz4"),
         "clevel": int(src.get("clevel", 5)),
-        "shuffle": int(src.get("shuffle", 1)),
+        "shuffle": shuffle,
         "blocksize": int(src.get("blocksize", 0)),
     }
 
@@ -219,7 +316,9 @@ class _ZarrFormat:
         elif comp.get("id") in ("zlib", "gzip"):
             compression = comp["id"]
         elif comp.get("id") == "blosc":
-            compression = _normalize_blosc(comp)
+            compression = _normalize_blosc(
+                comp, itemsize=np.dtype(meta["dtype"]).itemsize
+            )
         else:
             raise ValueError(
                 f"unsupported zarr compressor {comp.get('id')!r} in {path} "
@@ -261,7 +360,12 @@ class _ZarrFormat:
     @staticmethod
     def decode_chunk(payload: bytes, chunk_shape, dtype: np.dtype, compression):
         if _is_blosc(compression):
-            payload = _blosc_mod().decompress(payload)
+            # bound the decode allocation by what the chunk may legitimately
+            # hold — a forged header cannot trigger a multi-GB buffer
+            payload = _blosc_mod().decompress(
+                payload,
+                expected_nbytes=int(np.prod(chunk_shape)) * dtype.itemsize,
+            )
         elif compression == "gzip":
             payload = gzip.decompress(payload)
         elif compression:
@@ -334,7 +438,9 @@ class _N5Format:
         if ctype == "raw":
             compression = None
         elif ctype == "blosc":
-            compression = _normalize_blosc(n5_comp)
+            compression = _normalize_blosc(
+                n5_comp, itemsize=np.dtype(meta["dataType"]).itemsize
+            )
         else:
             compression = "gzip"
         return {
@@ -383,7 +489,12 @@ class _N5Format:
             offset += 4
         raw = payload[offset:]
         if _is_blosc(compression):
-            raw = _blosc_mod().decompress(raw)
+            # n5 stores clipped edge chunks, so the full chunk size is an
+            # upper bound on any legitimate decode (see _ZarrFormat)
+            raw = _blosc_mod().decompress(
+                raw,
+                expected_nbytes=int(np.prod(chunk_shape)) * dtype.itemsize,
+            )
         elif compression:
             raw = gzip.decompress(raw)
         be_dtype = np.dtype(_N5Format._DTYPES[dtype.name])
@@ -467,22 +578,49 @@ class Dataset:
             for g, c, s in zip(grid_pos, self.chunks, self.shape)
         )
 
-    def read_chunk(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
-        """Read one chunk (cropped to the volume at edges), or None if unwritten."""
+    def _decoded_chunk(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
+        """One chunk decoded at FULL chunk shape (edge chunks zero-padded),
+        read-only, through the process-global decoded-chunk LRU.  Returns
+        None if the chunk is unwritten.  The stat → read window is benign:
+        a concurrent rewrite can at worst cache fresh content under the old
+        signature, which the next reader's stat turns into a miss."""
         p = self._chunk_path(grid_pos)
-        if not os.path.exists(p):
+        sig = None
+        if _CHUNK_CACHE.max_bytes > 0:
+            try:
+                st = os.stat(p)
+            except OSError:
+                return None
+            sig = (st.st_ino, st.st_mtime_ns, st.st_size)
+            hit = _CHUNK_CACHE.get(p, sig)
+            if hit is not None:
+                obs_metrics.inc("store.chunk_cache_hits")
+                return hit
+        try:
+            with open(p, "rb") as f:
+                payload = f.read()
+        except FileNotFoundError:
             return None
-        with open(p, "rb") as f:
-            payload = f.read()
         # obs counters at the codec boundary: what actually crossed the
         # filesystem (compressed payload bytes), not the decoded size
         obs_metrics.inc("store.chunks_read")
         obs_metrics.inc("store.bytes_read", len(payload))
         flat = self._fmt.decode_chunk(payload, self.chunks, self.dtype, self.compression)
         full = flat.reshape(self.chunks)
+        full.setflags(write=False)  # shared across cache readers
+        if sig is not None:
+            obs_metrics.inc("store.chunk_cache_misses")
+            _CHUNK_CACHE.put(p, sig, full)
+        return full
+
+    def read_chunk(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
+        """Read one chunk (cropped to the volume at edges), or None if unwritten."""
+        full = self._decoded_chunk(grid_pos)
+        if full is None:
+            return None
         extent = self._chunk_extent(grid_pos)
         crop = tuple(slice(0, e - b) for b, e in extent)
-        return full[crop].copy()  # frombuffer views are read-only
+        return full[crop].copy()  # cached/frombuffer arrays are read-only
 
     def write_chunk(self, grid_pos: Sequence[int], data: np.ndarray) -> None:
         if self._readonly:
@@ -501,6 +639,7 @@ class Dataset:
         obs_metrics.inc("store.chunks_written")
         obs_metrics.inc("store.bytes_written", len(payload))
         _atomic_write_bytes(p, payload)
+        _CHUNK_CACHE.invalidate(p)
 
     def write_chunk_varlen(self, grid_pos: Sequence[int], data: np.ndarray) -> None:
         """Write an arbitrary-length 1d payload as an n5 mode-1 (varlength)
@@ -519,6 +658,7 @@ class Dataset:
         obs_metrics.inc("store.chunks_written")
         obs_metrics.inc("store.bytes_written", len(payload))
         _atomic_write_bytes(p, payload)
+        _CHUNK_CACHE.invalidate(p)
 
     def read_chunk_varlen(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
         """Read a mode-1 (varlength) chunk as a flat array, or None."""
@@ -585,7 +725,10 @@ class Dataset:
         out = np.full(out_shape, self.fill_value, dtype=self.dtype)
 
         def _assemble(grid_pos):
-            chunk = self.read_chunk(grid_pos)
+            # full decoded chunk via the LRU: overlapping halo reads of
+            # neighboring blocks decode each shared chunk once, and no
+            # per-chunk crop copy is made on the assembly path
+            chunk = self._decoded_chunk(grid_pos)
             if chunk is None:
                 return
             extent = self._chunk_extent(grid_pos)
@@ -606,8 +749,6 @@ class Dataset:
             # the reference's ``ds.n_threads = n`` idiom (z5py datasets):
             # file IO and zlib/gzip decompression release the GIL, so the
             # fan-out overlaps chunk decode even on few cores
-            from concurrent.futures import ThreadPoolExecutor
-
             with ThreadPoolExecutor(min(n_threads, len(positions))) as pool:
                 list(pool.map(_assemble, positions))
         else:
@@ -626,27 +767,44 @@ class Dataset:
         region_shape = tuple(e - b for b, e in bb)
         value = np.asarray(value, dtype=self.dtype)
         value = np.broadcast_to(value, region_shape)
-        for grid_pos in self._chunks_overlapping(bb):
+
+        def _write_one(grid_pos):
             extent = self._chunk_extent(grid_pos)
             lo = [max(cb, rb) for (cb, _), (rb, _) in zip(extent, bb)]
             hi = [min(ce, re) for (_, ce), (_, re) in zip(extent, bb)]
             if any(l >= h for l, h in zip(lo, hi)):
-                continue
-            chunk_shape = tuple(ce - cb for cb, ce in extent)
+                return
+            src = tuple(slice(l - rb, h - rb) for l, h, (rb, _) in zip(lo, hi, bb))
             covers_fully = all(
                 l == cb and h == ce
                 for l, h, (cb, ce) in zip(lo, hi, extent)
             )
             if covers_fully:
-                chunk = np.empty(chunk_shape, dtype=self.dtype)
-            else:  # read-modify-write for partially covered chunks
-                chunk = self.read_chunk(grid_pos)
-                if chunk is None:
-                    chunk = np.zeros(chunk_shape, dtype=self.dtype)
+                # chunk-aligned fast path: encode straight from the region
+                # view — no intermediate chunk buffer and, for partially
+                # written datasets, no RMW read+decode
+                obs_metrics.inc("store.aligned_chunk_writes")
+                self.write_chunk(grid_pos, value[src])
+                return
+            chunk_shape = tuple(ce - cb for cb, ce in extent)
+            # read-modify-write for partially covered chunks
+            chunk = self.read_chunk(grid_pos)
+            if chunk is None:
+                chunk = np.zeros(chunk_shape, dtype=self.dtype)
             dst = tuple(slice(l - cb, h - cb) for l, h, (cb, _) in zip(lo, hi, extent))
-            src = tuple(slice(l - rb, h - rb) for l, h, (rb, _) in zip(lo, hi, bb))
             chunk[dst] = value[src]
             self.write_chunk(grid_pos, chunk)
+
+        positions = list(self._chunks_overlapping(bb))
+        n_threads = int(getattr(self, "n_threads", 1) or 1)
+        if n_threads > 1 and len(positions) > 1:
+            # mirror of the read fan-out: each grid position is a distinct
+            # chunk file, so the per-chunk encode+replace jobs are disjoint
+            with ThreadPoolExecutor(min(n_threads, len(positions))) as pool:
+                list(pool.map(_write_one, positions))
+        else:
+            for grid_pos in positions:
+                _write_one(grid_pos)
 
     def __repr__(self) -> str:
         return f"Dataset({self.path!r}, shape={self.shape}, chunks={self.chunks}, dtype={self.dtype})"
@@ -783,7 +941,9 @@ class Group:
         if compression == "default":
             compression = default_compression()
         if compression == "blosc" or _is_blosc(compression):
-            compression = _normalize_blosc(compression)
+            compression = _normalize_blosc(
+                compression, itemsize=np.dtype(dtype).itemsize
+            )
             if not _blosc_mod().available():
                 raise RuntimeError(
                     "compression='blosc' requires the system libblosc"

@@ -1,0 +1,475 @@
+"""ctt-io tests: store fast paths + the three-stage executor pipeline.
+
+Covers the PR-3 acceptance contract:
+  * chunk-aligned region writes round-trip byte-identically vs the RMW
+    slow path (zarr + n5, across available codecs);
+  * the decoded-chunk LRU absorbs repeated decodes under overlapping
+    halo'd reads (hit counter asserted) and is invalidated by writes;
+  * pipeline determinism — depth 1 vs depth 3 produce identical outputs
+    for a staged task and for the halo'd two-pass watershed (whose pass 2
+    is ``pipeline_safe = False``);
+  * stage occupancy counters are populated by a staged depth-3 dispatch;
+  * blosc hardening (decode-size clamp, shuffle validation at read_meta).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.obs import metrics as obs_metrics
+from cluster_tools_tpu.obs import trace as obs_trace
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.tasks.threshold import ThresholdTask
+from cluster_tools_tpu.utils import blosc as blosc_mod
+from cluster_tools_tpu.utils import store
+
+
+COMPRESSIONS = [None, "gzip"] + (["blosc"] if blosc_mod.available() else [])
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Enable tracing (metrics on) for one test, process-locally."""
+    obs_metrics.reset()
+    obs_trace.enable(str(tmp_path / "trace"), "io_test", export_env=False)
+    yield
+    obs_trace.disable()
+    obs_metrics.reset()
+
+
+def _chunk_files(ds_path):
+    """{relpath: bytes} of every chunk file under a dataset directory."""
+    out = {}
+    for dp, _, fs in os.walk(ds_path):
+        for f in fs:
+            if f.startswith(".") or f == "attributes.json":
+                continue
+            p = os.path.join(dp, f)
+            with open(p, "rb") as fh:
+                out[os.path.relpath(p, ds_path)] = fh.read()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# chunk-aligned write fast path
+
+
+@pytest.mark.parametrize("ext", [".zarr", ".n5"])
+@pytest.mark.parametrize("compression", COMPRESSIONS)
+def test_aligned_write_byte_identical_vs_rmw(tmp_path, ext, compression):
+    """The same data written through the chunk-aligned fast path (one
+    aligned region write) and through the RMW slow path (two misaligned
+    partial writes) must produce byte-identical chunk files."""
+    shape, chunks = (8, 16, 16), (4, 8, 8)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 1000, shape).astype("uint64")
+
+    f_fast = store.file_reader(str(tmp_path / ("fast" + ext)))
+    ds_fast = f_fast.create_dataset(
+        "x", shape=shape, dtype="uint64", chunks=chunks,
+        compression=compression,
+    )
+    ds_fast[:] = data  # every chunk fully covered -> aligned fast path
+
+    f_slow = store.file_reader(str(tmp_path / ("slow" + ext)))
+    ds_slow = f_slow.create_dataset(
+        "x", shape=shape, dtype="uint64", chunks=chunks,
+        compression=compression,
+    )
+    ds_slow[0:3] = data[0:3]  # partial cover -> RMW
+    ds_slow[3:8] = data[3:8]  # partial cover over the same chunks -> RMW
+
+    np.testing.assert_array_equal(ds_fast[:], data)
+    np.testing.assert_array_equal(ds_slow[:], data)
+    fast_files = _chunk_files(os.path.join(str(tmp_path / ("fast" + ext)), "x"))
+    slow_files = _chunk_files(os.path.join(str(tmp_path / ("slow" + ext)), "x"))
+    assert fast_files and fast_files.keys() == slow_files.keys()
+    assert fast_files == slow_files
+
+
+def test_aligned_write_counter_and_rmw_preserves_content(tmp_path, traced):
+    ds = store.file_reader(str(tmp_path / "d.zarr")).create_dataset(
+        "x", shape=(8, 16, 16), dtype="uint16", chunks=(4, 8, 8),
+        compression="gzip",
+    )
+    base = np.arange(8 * 16 * 16, dtype="uint16").reshape(8, 16, 16)
+    ds[:] = base
+    aligned = obs_metrics.snapshot()["counters"].get(
+        "store.aligned_chunk_writes", 0
+    )
+    assert aligned == 8  # (8,16,16)/(4,8,8) -> every chunk took the fast path
+    # a misaligned write goes through RMW and must preserve the rest
+    ds[2:5, 3:9, 3:9] = 7
+    expect = base.copy()
+    expect[2:5, 3:9, 3:9] = 7
+    np.testing.assert_array_equal(ds[:], expect)
+    after = obs_metrics.snapshot()["counters"].get(
+        "store.aligned_chunk_writes", 0
+    )
+    assert after == aligned  # no chunk of the partial write was aligned
+
+
+def test_threaded_region_write_matches_serial(tmp_path):
+    data = np.random.default_rng(1).random((8, 16, 16)).astype("float32")
+    for n_threads, name in ((1, "serial"), (4, "threaded")):
+        ds = store.file_reader(str(tmp_path / f"{name}.n5")).create_dataset(
+            "x", shape=data.shape, dtype="float32", chunks=(4, 8, 8),
+            compression="gzip",
+        )
+        store.set_read_threads(ds, n_threads)
+        ds[:] = data
+    s = _chunk_files(str(tmp_path / "serial.n5" / "x"))
+    t = _chunk_files(str(tmp_path / "threaded.n5" / "x"))
+    assert s == t
+
+
+# ---------------------------------------------------------------------------
+# decoded-chunk LRU
+
+
+def test_chunk_cache_hits_under_overlapping_halo_reads(tmp_path, traced):
+    store._CHUNK_CACHE.clear()
+    ds = store.file_reader(str(tmp_path / "d.n5")).create_dataset(
+        "x", shape=(8, 16, 16), dtype="uint32", chunks=(4, 8, 8),
+        compression="gzip",
+    )
+    data = np.arange(8 * 16 * 16, dtype="uint32").reshape(8, 16, 16)
+    ds[:] = data
+    obs_metrics.reset()
+    # two halo'd reads of neighboring blocks: the four chunks their outer
+    # boxes share must decode once and hit the cache on the second read
+    a = ds[0:6, 0:12, 0:16]
+    b = ds[2:8, 4:16, 0:16]
+    np.testing.assert_array_equal(a, data[0:6, 0:12, 0:16])
+    np.testing.assert_array_equal(b, data[2:8, 4:16, 0:16])
+    counters = obs_metrics.snapshot()["counters"]
+    assert counters.get("store.chunk_cache_hits", 0) >= 4
+    # a second identical read is served fully from cache
+    before = counters.get("store.chunks_read", 0)
+    np.testing.assert_array_equal(ds[0:6, 0:12, 0:16], a)
+    counters = obs_metrics.snapshot()["counters"]
+    assert counters.get("store.chunks_read", 0) == before
+
+
+def test_chunk_cache_invalidated_by_write(tmp_path, traced):
+    store._CHUNK_CACHE.clear()
+    ds = store.file_reader(str(tmp_path / "d.zarr")).create_dataset(
+        "x", shape=(4, 8, 8), dtype="uint8", chunks=(4, 8, 8),
+        compression="gzip",
+    )
+    ds[:] = np.ones((4, 8, 8), dtype="uint8")
+    assert int(ds[:].sum()) == 4 * 8 * 8  # populates the cache
+    ds[:] = np.full((4, 8, 8), 3, dtype="uint8")
+    np.testing.assert_array_equal(ds[:], np.full((4, 8, 8), 3, "uint8"))
+
+
+def test_chunk_cache_cross_instance_freshness(tmp_path):
+    """A second Dataset handle over the same path (or another process —
+    same mechanism: the stat signature changes on os.replace) must never
+    see stale cached content."""
+    store._CHUNK_CACHE.clear()
+    path = str(tmp_path / "d.n5")
+    ds1 = store.file_reader(path).create_dataset(
+        "x", shape=(4, 8, 8), dtype="int32", chunks=(4, 8, 8),
+        compression=None,
+    )
+    ds1[:] = np.full((4, 8, 8), 1, "int32")
+    assert int(ds1[0, 0, 0]) == 1
+    ds2 = store.file_reader(path)["x"]
+    ds2[:] = np.full((4, 8, 8), 2, "int32")
+    np.testing.assert_array_equal(ds1[:], np.full((4, 8, 8), 2, "int32"))
+
+
+# ---------------------------------------------------------------------------
+# blosc hardening (satellites)
+
+
+def test_normalize_blosc_shuffle_validation():
+    # numcodecs AUTOSHUFFLE (-1) resolves like numcodecs does: byte shuffle
+    # for multi-byte types, none for single-byte
+    assert store._normalize_blosc({"shuffle": -1}, itemsize=8)["shuffle"] == 1
+    assert store._normalize_blosc({"shuffle": -1}, itemsize=1)["shuffle"] == 0
+    for ok in (0, 1, 2):
+        assert store._normalize_blosc({"shuffle": ok})["shuffle"] == ok
+    with pytest.raises(ValueError):
+        store._normalize_blosc({"shuffle": 5})
+
+
+@pytest.mark.parametrize("itemsize,expect", [(8, 1), (1, 0)])
+def test_read_meta_maps_autoshuffle(tmp_path, itemsize, expect):
+    """A zarr array written by numcodecs with shuffle=-1 must read back
+    with a writable ({0,1,2}) shuffle value."""
+    import json
+
+    path = str(tmp_path / "ext.zarr")
+    os.makedirs(path)
+    dtype = "<u8" if itemsize == 8 else "|u1"
+    with open(os.path.join(path, ".zarray"), "w") as f:
+        json.dump({
+            "zarr_format": 2, "shape": [4, 4], "chunks": [4, 4],
+            "dtype": dtype, "fill_value": 0, "order": "C", "filters": None,
+            "compressor": {"id": "blosc", "cname": "lz4", "clevel": 5,
+                           "shuffle": -1, "blocksize": 0},
+        }, f)
+    spec = store._ZarrFormat.read_meta(path)
+    assert spec["compression"]["shuffle"] == expect
+
+
+@pytest.mark.skipif(not blosc_mod.available(), reason="no system libblosc")
+def test_blosc_decompress_expected_nbytes_clamp():
+    raw = bytes(range(256)) * 64  # 16 KiB
+    frame = blosc_mod.compress(raw, typesize=1)
+    assert blosc_mod.decompress(frame, expected_nbytes=len(raw)) == raw
+    with pytest.raises(ValueError, match="expected at most"):
+        blosc_mod.decompress(frame, expected_nbytes=len(raw) // 2)
+
+
+@pytest.mark.skipif(not blosc_mod.available(), reason="no system libblosc")
+def test_blosc_pre116_fallback_clamps(monkeypatch):
+    """Force the no-validate (pre-1.16) branch: the header-claimed nbytes
+    must still be bounded by expected_nbytes."""
+    real = blosc_mod._load()
+
+    class _NoValidate:
+        def __getattr__(self, name):
+            if name == "blosc_cbuffer_validate":
+                raise AttributeError(name)
+            return getattr(real, name)
+
+    monkeypatch.setattr(blosc_mod, "_lib", _NoValidate())
+    monkeypatch.setattr(blosc_mod, "_lib_checked", True)
+    raw = b"x" * 4096
+    frame = blosc_mod.compress(raw, typesize=1)
+    assert blosc_mod.decompress(frame, expected_nbytes=4096) == raw
+    with pytest.raises(ValueError, match="expected at most"):
+        blosc_mod.decompress(frame, expected_nbytes=100)
+
+
+# ---------------------------------------------------------------------------
+# three-stage executor pipeline
+
+
+def _run_threshold(tmp_path, key, depth):
+    path = str(tmp_path / "data.n5")
+    if not os.path.exists(path):
+        rng = np.random.default_rng(3)
+        store.file_reader(path).create_dataset(
+            "x", data=rng.random((16, 32, 32)).astype("float32"),
+            chunks=(4, 8, 8),
+        )
+    config_dir = str(tmp_path / f"configs_{key}")
+    cfg.write_global_config(
+        config_dir,
+        {"block_shape": [4, 8, 8], "target": "tpu", "device_batch_size": 2,
+         "devices": [0], "pipeline_depth": depth},
+    )
+    t = ThresholdTask(
+        str(tmp_path / f"tmp_{key}"), config_dir,
+        input_path=path, input_key="x",
+        output_path=path, output_key=key,
+    )
+    assert build([t])
+    return store.file_reader(path, "r")[key][:], t
+
+
+def test_staged_pipeline_depth_determinism(tmp_path):
+    """depth 1 (serial loop) and depth 3 (three-stage pipeline) must write
+    identical outputs, and the depth-3 run must populate the per-stage
+    records."""
+    out1, _ = _run_threshold(tmp_path, "d1", 1)
+    out3, t3 = _run_threshold(tmp_path, "d3", 3)
+    np.testing.assert_array_equal(out1, out3)
+    labels = {r["label"] for r in t3.output().read()["timings"]}
+    assert {"stage_read_total", "stage_compute_total",
+            "stage_write_total"} <= labels
+
+
+def test_staged_pipeline_stage_counters(tmp_path, traced):
+    _run_threshold(tmp_path, "ctr", 3)
+    counters = obs_metrics.snapshot()["counters"]
+    for key in ("executor.stage_batches", "executor.stage_read_s",
+                "executor.stage_compute_s", "executor.stage_write_s"):
+        assert counters.get(key, 0) > 0, (key, counters)
+
+
+def test_staged_pipeline_overlaps_stages(tmp_env):
+    """Read/write stages really run off the compute thread at depth > 1."""
+    from cluster_tools_tpu.runtime.task import BlockTask
+
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(
+        config_dir,
+        {"block_shape": [4, 32, 32], "target": "tpu",
+         "device_batch_size": 1, "devices": [0], "pipeline_depth": 3},
+    )
+    seen = {"read": set(), "compute": set(), "write": set()}
+
+    class StagedTask(BlockTask):
+        task_name = "staged_probe"
+
+        def get_shape(self):
+            return (32, 32, 32)
+
+        def read_batch(self, block_ids, blocking, config):
+            seen["read"].add(threading.get_ident())
+            return list(block_ids)
+
+        def compute_batch(self, payload, blocking, config):
+            seen["compute"].add(threading.get_ident())
+            return payload
+
+        def write_batch(self, result, blocking, config):
+            seen["write"].add(threading.get_ident())
+
+        def process_block_batch(self, block_ids, blocking, config):
+            self.write_batch(
+                self.compute_batch(
+                    self.read_batch(block_ids, blocking, config),
+                    blocking, config),
+                blocking, config)
+
+        def process_block(self, block_id, blocking, config):
+            self.process_block_batch([block_id], blocking, config)
+
+    t = StagedTask(tmp_folder, config_dir)
+    assert build([t])
+    assert len(t.output().read()["done"]) == 8
+    assert len(seen["compute"]) == 1  # serialized compute stage
+    assert not (seen["read"] & seen["compute"])
+    assert not (seen["write"] & seen["compute"])
+
+
+def test_staged_poisoned_batch_falls_back_per_block(tmp_env):
+    from cluster_tools_tpu.runtime.task import BlockTask
+
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(
+        config_dir,
+        {"block_shape": [4, 32, 32], "target": "tpu",
+         "device_batch_size": 2, "devices": [0], "pipeline_depth": 2},
+    )
+
+    class PoisonStagedTask(BlockTask):
+        task_name = "poison_staged"
+
+        def __init__(self, *args, out=None, **kw):
+            super().__init__(*args, **kw)
+            self.out = out if out is not None else {}
+
+        def get_shape(self):
+            return (32, 32, 32)
+
+        def read_batch(self, block_ids, blocking, config):
+            return list(block_ids)
+
+        def compute_batch(self, payload, blocking, config):
+            if 2 in payload:
+                raise RuntimeError("poisoned staged batch")
+            return payload
+
+        def write_batch(self, result, blocking, config):
+            self.out.setdefault("written", []).extend(result)
+
+        def process_block(self, block_id, blocking, config):
+            # per-block fallback path (also poisoned for block 3)
+            if block_id == 3:
+                raise RuntimeError("block 3 is truly broken")
+            self.out.setdefault("written", []).append(block_id)
+
+    out = {}
+    t = PoisonStagedTask(tmp_folder, config_dir, out=out)
+    from cluster_tools_tpu.runtime.task import FailedBlocksError
+
+    with pytest.raises(FailedBlocksError):
+        build([t])
+    status = t.output().read()
+    assert status["failed"] == [3]
+    assert sorted(set(out["written"])) == [b for b in range(8) if b != 3]
+
+
+def test_unsafe_task_serializes_on_tpu_executor(tmp_env):
+    """pipeline_safe=False forces the strictly serial loop even when the
+    task implements the split protocol and depth > 1."""
+    from cluster_tools_tpu.runtime.task import BlockTask
+
+    tmp_folder, config_dir = tmp_env
+    cfg.write_global_config(
+        config_dir,
+        {"block_shape": [4, 32, 32], "target": "tpu",
+         "device_batch_size": 1, "devices": [0], "pipeline_depth": 3},
+    )
+    threads = set()
+
+    class UnsafeStagedTask(BlockTask):
+        task_name = "unsafe_staged"
+        pipeline_safe = False
+
+        def get_shape(self):
+            return (32, 32, 32)
+
+        def read_batch(self, block_ids, blocking, config):
+            threads.add(threading.get_ident())
+            return list(block_ids)
+
+        def compute_batch(self, payload, blocking, config):
+            threads.add(threading.get_ident())
+            return payload
+
+        def write_batch(self, result, blocking, config):
+            threads.add(threading.get_ident())
+
+        def process_block_batch(self, block_ids, blocking, config):
+            self.write_batch(
+                self.compute_batch(
+                    self.read_batch(block_ids, blocking, config),
+                    blocking, config),
+                blocking, config)
+
+        def process_block(self, block_id, blocking, config):
+            self.process_block_batch([block_id], blocking, config)
+
+    t = UnsafeStagedTask(tmp_folder, config_dir)
+    assert build([t])
+    assert len(t.output().read()["done"]) == 8
+    assert len(threads) == 1  # everything on the dispatching thread
+
+
+@pytest.mark.timeout(600)
+def test_two_pass_watershed_depth_determinism(tmp_path, rng):
+    """The halo'd two-pass watershed — pass 2 reads what same-dispatch
+    neighbors wrote (``pipeline_safe = False``) — must produce identical
+    outputs at pipeline_depth 1 and 3."""
+    from scipy import ndimage
+
+    from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+    raw = ndimage.gaussian_filter(rng.random((24, 48, 48)), (1.0, 2.0, 2.0))
+    raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+    path = str(tmp_path / "d.n5")
+    store.file_reader(path).create_dataset(
+        "bnd", data=raw, chunks=(12, 24, 24)
+    )
+    conf = {"threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+            "halo": [4, 8, 8], "apply_dt_2d": False, "apply_ws_2d": False}
+
+    def run(depth):
+        config_dir = str(tmp_path / f"configs_{depth}")
+        cfg.write_global_config(
+            config_dir,
+            {"block_shape": [12, 24, 24], "target": "tpu",
+             "device_batch_size": 1, "devices": [0],
+             "pipeline_depth": depth},
+        )
+        cfg.write_config(config_dir, "two_pass_watershed", conf)
+        wf = WatershedWorkflow(
+            str(tmp_path / f"tmp_{depth}"), config_dir,
+            input_path=path, input_key="bnd",
+            output_path=path, output_key=f"ws_{depth}",
+            two_pass=True,
+        )
+        assert build([wf])
+        return store.file_reader(path, "r")[f"ws_{depth}"][:]
+
+    np.testing.assert_array_equal(run(1), run(3))

@@ -65,6 +65,58 @@ if JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs summarize \
     exit 1
 fi
 
+echo "== ctt-io pipeline smoke (depth-3 staged dispatch -> stage counters) =="
+JAX_PLATFORMS=cpu CTT_TRACE_DIR="$obs_tmp/trace" CTT_RUN_ID=ci_pipeline \
+    python - <<'PY'
+import json, os, tempfile
+import numpy as np
+from cluster_tools_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.tasks.threshold import ThresholdTask
+from cluster_tools_tpu.utils import file_reader
+
+td = tempfile.mkdtemp()
+path = os.path.join(td, "d.n5")
+rng = np.random.default_rng(0)
+file_reader(path).create_dataset(
+    "x", data=rng.random((16, 16, 16)).astype("float32"), chunks=(4, 8, 8)
+)
+config_dir = os.path.join(td, "configs")
+cfg.write_global_config(
+    config_dir,
+    {"block_shape": [4, 8, 8], "target": "tpu", "device_batch_size": 1,
+     "devices": [0], "pipeline_depth": 3},
+)
+t = ThresholdTask(os.path.join(td, "tmp"), config_dir,
+                  input_path=path, input_key="x",
+                  output_path=path, output_key="y")
+assert build([t])
+snap = obs_metrics.snapshot()["counters"]
+stage_keys = [k for k in snap if k.startswith("executor.stage_")]
+missing = [k for k in (
+    "executor.stage_batches", "executor.stage_read_s",
+    "executor.stage_compute_s", "executor.stage_write_s",
+) if snap.get(k, 0) <= 0]
+assert not missing, f"stage counters absent/zero: {missing} (have {stage_keys})"
+obs_trace.flush()
+print("pipeline smoke ok:",
+      json.dumps({k: round(snap[k], 4) for k in sorted(stage_keys)}))
+PY
+pipe_rc=$?
+if [ "$pipe_rc" -ne 0 ]; then
+    echo "pipeline smoke failed (rc=$pipe_rc): depth-3 staged dispatch did" \
+         "not run or stage counters missing" >&2
+    exit "$pipe_rc"
+fi
+# the traced pipeline run must summarize cleanly too
+JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs summarize \
+    "$obs_tmp/trace/ci_pipeline"
+pipe_sum_rc=$?
+if [ "$pipe_sum_rc" -ne 0 ]; then
+    echo "obs summarize failed on the pipeline smoke run (rc=$pipe_sum_rc)" >&2
+    exit "$pipe_sum_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
