@@ -73,7 +73,7 @@ def main(argv=None) -> int:
     if args.paths is None:
         paths = [
             os.path.join(pkg_root, d)
-            for d in ("obs", "ops", "parallel", "runtime", "tasks",
+            for d in ("faults", "obs", "ops", "parallel", "runtime", "tasks",
                       "workflows", "utils")
         ]
         tests_dir = os.path.join(repo_root, "tests")

@@ -35,6 +35,14 @@ through runtime tests:
           VM migration) fires or stalls such timeouts.  Wall clock is for
           *timestamps* only; durations and deadlines go through the obs
           monotonic helpers (``obs.trace.monotonic()``).
+  CTT009  resilience hygiene: (a) ad-hoc retry loops — a ``while``/``for``
+          containing both a ``try``/``except`` and a ``time.sleep`` —
+          outside the shared backoff helper (``utils/retry.py``): hand-
+          rolled retries skip the exponential backoff, the jitter that
+          prevents retry storms, and the ``store.io_retries`` counter;
+          (b) ``except Exception: pass`` (or a bare except) whose body is
+          only ``pass`` — swallowing a block error without recording any
+          status hides failures from the retry machinery and the operator.
 """
 
 from __future__ import annotations
@@ -54,6 +62,9 @@ register_rule("CTT005", "order-sensitive iteration over a set")
 register_rule("CTT006", "pytest marker not registered in pyproject.toml")
 register_rule("CTT007", "noqa comment references an unknown rule id")
 register_rule("CTT008", "wall-clock time.time() in duration/deadline math")
+register_rule(
+    "CTT009", "ad-hoc sleep-retry loop / error-swallowing `except: pass`"
+)
 
 
 # --------------------------------------------------------------------------
@@ -431,6 +442,59 @@ def _check_wall_clock_math(
 
 
 # --------------------------------------------------------------------------
+# CTT009: ad-hoc retry loops and swallowed exceptions
+
+
+def _retry_helper_exempt(path: str) -> bool:
+    # utils/retry.py IS the sanctioned backoff loop the rule points at
+    parts = os.path.normpath(path).split(os.sep)
+    return parts[-2:] == ["utils", "retry.py"]
+
+
+def _check_resilience_hygiene(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    # (a) ad-hoc sleep-retry loops: a loop whose body holds both an
+    # exception handler and a time.sleep — hand-rolled backoff
+    if not _retry_helper_exempt(path):
+        flagged: Set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            if not any(isinstance(n, ast.Try) for n in ast.walk(node)):
+                continue
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and dotted_name(sub.func) == "time.sleep"
+                    and id(sub) not in flagged
+                ):
+                    flagged.add(id(sub))
+                    findings.append(Finding(
+                        "CTT009", path, sub.lineno,
+                        "ad-hoc sleep-retry loop — route transient-IO "
+                        "retries through utils.retry.io_retry (exponential "
+                        "backoff + jitter + the store.io_retries counter)",
+                    ))
+    # (b) `except Exception: pass` / bare `except: pass`: the error is
+    # swallowed without recording any status
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
+            continue
+        tname = dotted_name(node.type) if node.type is not None else None
+        if node.type is None or tname in ("Exception", "BaseException"):
+            findings.append(Finding(
+                "CTT009", path, node.lineno,
+                "`except"
+                + (f" {tname}" if tname else "")
+                + ": pass` swallows errors without recording status — "
+                "narrow the exception or record/log the failure",
+            ))
+
+
+# --------------------------------------------------------------------------
 # CTT006: unregistered pytest markers
 
 # markers pytest itself (or its bundled plugins) always knows
@@ -548,6 +612,7 @@ def lint_source(
         _check_wide_dtypes_module(tree, path, jit_fns, findings)
         _check_collectives(tree, path, findings)
         _check_wall_clock_math(tree, path, findings)
+        _check_resilience_hygiene(tree, path, findings)
         _SetIterVisitor(path, findings).visit(tree)
     _check_noqa_hygiene(source, path, findings)
 

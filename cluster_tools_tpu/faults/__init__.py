@@ -1,0 +1,398 @@
+"""ctt-fault: deterministic, seeded fault injection for the block pipeline.
+
+The runtime's whole reliability story is "blocks are idempotent, rerun the
+failed ones" (runtime/task.py retry loop, peer-wait timeouts, abort flags) —
+this package makes those paths *exercisable*: named injection sites threaded
+through the storage, executor, cluster, task, and collective layers fire
+deterministic faults according to a seeded spec, so chaos runs are
+reproducible and diffable (every injected event lands in obs metrics and the
+span stream).
+
+Spec grammar (``CTT_FAULTS`` environment variable)::
+
+    CTT_FAULTS = entry (";" entry)*
+    entry      = "seed=" int
+               | site ":" action [":" param ("," param)*]
+    param      = "p=" float        probability per matching check (seeded RNG)
+               | "ids=" int("|"int)*   only fire for these ctx ids (job/block)
+               | "once"            fire at most once (== times=1)
+               | "times=" int      fire at most N times
+               | "after=" int      skip the first N matching checks
+               | "s=" float        stall duration seconds (stall action)
+               | "bytes=" int      torn-payload keep-bytes (torn action)
+
+Example::
+
+    CTT_FAULTS="store.write:io_error:p=0.05;worker.job:kill:ids=1;collective.init:fail:once;seed=42"
+
+Sites (each named where the corresponding code path lives):
+
+  ``store.read`` / ``store.write`` / ``store.decode``  — utils/store.py chunk
+      IO; ``store.write`` additionally supports the ``torn`` action, which
+      truncates the chunk payload on disk (torn-write simulation) and raises
+      ``CorruptChunk`` so the shared retry / block-retry machinery rewrites it.
+  ``executor.block`` (ctx ``id``: block id) / ``executor.batch`` /
+      ``executor.stage_read`` / ``executor.stage_compute`` /
+      ``executor.stage_write``  — runtime/executor.py dispatch paths.
+  ``worker.job`` (ctx ``id``: job id; before the status write — ``kill``
+      simulates a job dying with no status) / ``worker.exit`` (after the
+      status write)  — runtime/cluster_worker.py.
+  ``task.barrier``  — runtime/task.py peer-wait loop (``stall`` simulates a
+      slow peer; ``fail`` a poisoned barrier).
+  ``collective.init`` / ``collective.execute``  — parallel/sharded.py entry
+      kernels (init failures trigger the graceful sharded→local fallback).
+
+Actions: ``io_error`` (OSError EIO), ``fail`` (FaultInjected), ``kill``
+(``os._exit(KILL_EXIT_CODE)`` — a hard crash, no cleanup), ``stall``
+(sleep ``s`` seconds), ``torn`` (payload truncation, write sites only).
+
+Determinism: every entry owns a ``random.Random`` seeded from the spec seed
+and the entry's (site, index), and its stream advances once per *matching*
+check — the same spec + seed + call sequence produces the same injection
+sequence in any process (tested in tests/test_faults.py).  For faults that
+must fire once *across* processes (a killed scheduler job must stay dead
+after its resubmission), set ``CTT_FAULT_STATE_DIR``: ``once``/``times``
+entries then latch through O_CREAT|O_EXCL files in that directory.
+
+Zero-overhead no-op fast path: with ``CTT_FAULTS`` unset, ``_PLAN`` is None
+and every ``check()``/``mangle()`` call is one global load + compare —
+nothing is parsed, allocated, or locked (tested by the disabled-overhead
+smoke).  A malformed spec raises ``FaultSpecError`` loudly at configure time:
+a chaos harness that silently disarms would certify nothing.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "FaultInjected", "FaultSpecError", "check", "mangle", "enabled",
+    "configure", "reset", "decision_log", "KILL_EXIT_CODE",
+    "ENV_SPEC", "ENV_STATE",
+]
+
+ENV_SPEC = "CTT_FAULTS"
+ENV_STATE = "CTT_FAULT_STATE_DIR"
+
+# hard-crash exit code for the ``kill`` action: distinct from 0/1 so a
+# submitter / test can tell an injected kill from an ordinary failure
+KILL_EXIT_CODE = 17
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the ``fail`` action (and wrapped by site-local classifiers,
+    e.g. the store turns injected decode faults into ``CorruptChunk``)."""
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``CTT_FAULTS`` spec — always loud, never silently disarmed."""
+
+
+KNOWN_SITES = frozenset({
+    "store.read", "store.write", "store.decode",
+    "executor.block", "executor.batch",
+    "executor.stage_read", "executor.stage_compute", "executor.stage_write",
+    "worker.job", "worker.exit",
+    "task.barrier",
+    "collective.init", "collective.execute",
+})
+
+KNOWN_ACTIONS = frozenset({"io_error", "fail", "kill", "stall", "torn"})
+
+
+class _Entry:
+    """One parsed spec entry plus its runtime state (RNG stream, counters)."""
+
+    __slots__ = (
+        "site", "action", "p", "ids", "times", "after", "stall_s",
+        "keep_bytes", "index", "rng", "seen", "fired",
+    )
+
+    def __init__(self, site: str, action: str, index: int):
+        self.site = site
+        self.action = action
+        self.index = index
+        self.p: Optional[float] = None
+        self.ids: Optional[frozenset] = None
+        self.times: Optional[int] = None
+        self.after = 0
+        self.stall_s = 5.0
+        self.keep_bytes: Optional[int] = None
+        self.rng: Optional[random.Random] = None
+        self.seen = 0
+        self.fired = 0
+
+    def describe(self) -> str:
+        return f"{self.site}:{self.action}#{self.index}"
+
+
+def _parse_entry(raw: str, index: int) -> _Entry:
+    segs = raw.split(":")
+    if len(segs) < 2 or len(segs) > 3:
+        raise FaultSpecError(
+            f"fault entry {raw!r} is not site:action[:params]"
+        )
+    site, action = segs[0].strip(), segs[1].strip()
+    if site not in KNOWN_SITES:
+        raise FaultSpecError(
+            f"unknown fault site {site!r} (known: {sorted(KNOWN_SITES)})"
+        )
+    if action not in KNOWN_ACTIONS:
+        raise FaultSpecError(
+            f"unknown fault action {action!r} (known: {sorted(KNOWN_ACTIONS)})"
+        )
+    if action == "torn" and not site.endswith(".write"):
+        raise FaultSpecError(
+            f"action 'torn' only applies to write sites, not {site!r}"
+        )
+    entry = _Entry(site, action, index)
+    if len(segs) == 3:
+        for param in segs[2].split(","):
+            param = param.strip()
+            if not param:
+                continue
+            try:
+                if param == "once":
+                    entry.times = 1
+                elif param.startswith("p="):
+                    entry.p = float(param[2:])
+                    if not 0.0 <= entry.p <= 1.0:
+                        raise ValueError
+                elif param.startswith("ids="):
+                    entry.ids = frozenset(
+                        int(t) for t in param[4:].split("|") if t
+                    )
+                elif param.startswith("times="):
+                    entry.times = int(param[6:])
+                elif param.startswith("after="):
+                    entry.after = int(param[6:])
+                elif param.startswith("s="):
+                    entry.stall_s = float(param[2:])
+                elif param.startswith("bytes="):
+                    entry.keep_bytes = int(param[6:])
+                else:
+                    raise ValueError
+            except ValueError:
+                raise FaultSpecError(
+                    f"bad fault param {param!r} in entry {raw!r}"
+                ) from None
+    return entry
+
+
+def parse_spec(spec: str) -> Tuple[List[_Entry], int]:
+    """``(entries, seed)`` for a spec string; raises FaultSpecError."""
+    entries: List[_Entry] = []
+    seed = 0
+    index = 0
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if raw.startswith("seed="):
+            try:
+                seed = int(raw[5:])
+            except ValueError:
+                raise FaultSpecError(f"bad seed in {raw!r}") from None
+            continue
+        entries.append(_parse_entry(raw, index))
+        index += 1
+    if not entries:
+        raise FaultSpecError(
+            f"{ENV_SPEC} is set but contains no fault entries: {spec!r}"
+        )
+    return entries, seed
+
+
+class _Plan:
+    """Parsed spec + per-entry state.  One instance per process; ``check``
+    is locked so concurrent block threads keep counters coherent (thread
+    interleavings are inherently non-deterministic anyway — determinism
+    holds for deterministic call sequences)."""
+
+    def __init__(self, entries: List[_Entry], seed: int,
+                 state_dir: Optional[str]):
+        self.seed = seed
+        self.state_dir = state_dir
+        self.entries = entries
+        self.by_site: Dict[str, List[_Entry]] = {}
+        self.log: List[Tuple[str, str, int]] = []  # (site, action, seen#)
+        self.lock = threading.Lock()
+        for e in entries:
+            # per-entry stream: decisions of one entry never shift another's
+            stream_id = zlib.crc32(f"{e.site}#{e.index}".encode())
+            e.rng = random.Random((seed << 32) ^ stream_id)
+            self.by_site.setdefault(e.site, []).append(e)
+
+    # -- cross-process once/times latch -----------------------------------
+
+    def _claim(self, e: _Entry) -> bool:
+        """True if this firing slot is ours.  With a state dir, slots are
+        O_CREAT|O_EXCL latch files shared by every process reading the same
+        spec; without, a process-local counter."""
+        if e.times is None:
+            return True
+        if self.state_dir is None:
+            if e.fired >= e.times:
+                return False
+            return True
+        os.makedirs(self.state_dir, exist_ok=True)
+        for slot in range(e.times):
+            path = os.path.join(
+                self.state_dir, f"{e.site}.{e.index}.fired{slot}"
+            )
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                continue
+            os.write(fd, f"pid={os.getpid()}\n".encode())
+            os.close(fd)
+            return True
+        return False
+
+    # -- matching ----------------------------------------------------------
+
+    def _matches(self, e: _Entry, ctx: Dict[str, Any]) -> bool:
+        """Advances ``seen`` and the RNG stream; claims a latch slot last so
+        an exhausted entry still keeps its stream deterministic."""
+        if e.ids is not None and ctx.get("id") not in e.ids:
+            return False
+        e.seen += 1
+        if e.seen <= e.after:
+            return False
+        if e.p is not None and e.rng.random() >= e.p:
+            return False
+        if not self._claim(e):
+            return False
+        e.fired += 1
+        return True
+
+    def _note(self, e: _Entry, ctx: Dict[str, Any]) -> None:
+        self.log.append((e.site, e.action, e.seen))
+        try:
+            from ..obs import metrics as obs_metrics
+            from ..obs import trace as obs_trace
+
+            obs_metrics.inc("faults.injected")
+            obs_metrics.inc(f"faults.injected.{e.site}")
+            obs_trace.event(
+                f"fault:{e.site}", "fault", 0.0,
+                action=e.action, entry=e.index, seen=e.seen,
+                **{k: v for k, v in ctx.items() if isinstance(v, (int, str))},
+            )
+        except Exception:  # ctt: noqa[CTT009] telemetry about an injected fault must never mask the fault itself
+            pass  # pragma: no cover
+
+    # -- public ------------------------------------------------------------
+
+    def check(self, site: str, ctx: Dict[str, Any]) -> None:
+        entries = self.by_site.get(site)
+        if not entries:
+            return
+        fired: Optional[_Entry] = None
+        with self.lock:
+            for e in entries:
+                if e.action == "torn":
+                    continue  # torn fires through mangle() only
+                if self._matches(e, ctx):
+                    fired = e
+                    self._note(e, ctx)
+                    break
+        if fired is None:
+            return
+        if fired.action == "kill":
+            os._exit(KILL_EXIT_CODE)
+        if fired.action == "stall":
+            time.sleep(fired.stall_s)
+            return
+        if fired.action == "io_error":
+            raise OSError(
+                errno.EIO, f"injected io_error at {site} ({fired.describe()})"
+            )
+        raise FaultInjected(
+            f"injected failure at {site} ({fired.describe()})"
+        )
+
+    def mangle(self, site: str, payload: bytes,
+               ctx: Dict[str, Any]) -> Optional[bytes]:
+        entries = self.by_site.get(site)
+        if not entries:
+            return None
+        with self.lock:
+            for e in entries:
+                if e.action != "torn":
+                    continue
+                if self._matches(e, ctx):
+                    self._note(e, ctx)
+                    keep = (
+                        e.keep_bytes if e.keep_bytes is not None
+                        else max(1, len(payload) // 2)
+                    )
+                    return payload[:keep]
+        return None
+
+
+_PLAN: Optional[_Plan] = None
+
+
+def configure(spec: Optional[str] = None, seed: Optional[int] = None,
+              state_dir: Optional[str] = None) -> bool:
+    """(Re)build the process fault plan.  With no arguments, re-reads
+    ``CTT_FAULTS`` / ``CTT_FAULT_STATE_DIR`` — unset/empty disables.
+    Returns True when a plan is armed."""
+    global _PLAN
+    if spec is None:
+        spec = os.environ.get(ENV_SPEC)
+    if not spec:
+        _PLAN = None
+        return False
+    entries, spec_seed = parse_spec(spec)
+    if seed is not None:
+        spec_seed = seed
+    if state_dir is None:
+        state_dir = os.environ.get(ENV_STATE) or None
+    _PLAN = _Plan(entries, spec_seed, state_dir)
+    return True
+
+
+def reset() -> None:
+    """Disarm the harness (test isolation helper)."""
+    global _PLAN
+    _PLAN = None
+
+
+def enabled() -> bool:
+    return _PLAN is not None
+
+
+def check(site: str, **ctx: Any) -> None:
+    """Injection site: no-op unless a plan is armed and an entry fires.
+    May raise OSError/FaultInjected, sleep (stall), or hard-exit (kill)."""
+    plan = _PLAN
+    if plan is None:
+        return
+    plan.check(site, ctx)
+
+
+def mangle(site: str, payload: bytes, **ctx: Any) -> Optional[bytes]:
+    """Torn-write site: returns the truncated payload when a ``torn`` entry
+    fires, else None (caller writes the original)."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.mangle(site, payload, ctx)
+
+
+def decision_log() -> List[Tuple[str, str, int]]:
+    """Fired faults so far: ``(site, action, matching-check ordinal)`` —
+    the sequence the determinism test compares across processes."""
+    plan = _PLAN
+    return list(plan.log) if plan is not None else []
+
+
+configure()

@@ -20,6 +20,14 @@ Wired in:
     ``compile_cache.cache_misses`` via a ``jax.monitoring`` event
     listener, plus an ``entries_at_enable`` gauge;
   * ``runtime/task.py`` — ``task.blocks_failed`` / ``task.blocks_retried``;
+  * ``faults/`` + the resilience paths it validates (ctt-fault) —
+    ``faults.injected`` / ``faults.injected.<site>`` (every fired
+    injection), ``store.io_retries`` (backoff sleeps absorbed by
+    ``utils/retry.py`` on transient chunk IO), ``executor.blocks_timed_out``
+    (blocks the soft-deadline watchdog converted into failures), and
+    ``sharded.fallback_local`` (collective→local kernel degradations) —
+    so a chaos run's injections AND recoveries are diffable with
+    ``obs diff``;
   * ``runtime/executor.py`` — ``executor.batches`` /
     ``executor.batch_s`` (summed in-flight batch seconds) /
     ``executor.dispatch_wall_s`` (wall of the whole dispatch round):

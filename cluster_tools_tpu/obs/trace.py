@@ -210,7 +210,7 @@ def disable() -> None:
             from . import metrics as _metrics
 
             _metrics.flush()
-        except Exception:  # pragma: no cover - teardown is best-effort
+        except Exception:  # pragma: no cover  # ctt: noqa[CTT009] teardown is best-effort: a metrics flush failure must not block disable()
             pass
         _RUN.flush()
         _RUN.close()
@@ -226,7 +226,7 @@ def flush() -> None:
             from . import metrics as _metrics
 
             _metrics.flush()
-        except Exception:  # pragma: no cover
+        except Exception:  # pragma: no cover  # ctt: noqa[CTT009] flush is best-effort by contract (atexit path)
             pass
         _RUN.flush()
 

@@ -74,9 +74,46 @@ def axis_size(axis_name):
     return lax.psum(1, axis_name)
 
 
+import warnings
+
+from .. import faults
+from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..ops.cc import _min_sweep, _shift, neighbor_offsets
 from .mesh import get_mesh, put_global
+
+
+class CollectiveInitError(RuntimeError):
+    """Collective setup (mesh/device resolution) failed — the entry kernels
+    degrade to the single-device local kernel instead of failing the run
+    (``sharded.fallback_local`` obs counter + warning, never silent)."""
+
+
+def _collective_mesh(mesh, axis_name: str):
+    """Resolve the mesh for a collective entry kernel; every failure —
+    injected (``collective.init`` fault site) or real (driver/device init)
+    — surfaces as :class:`CollectiveInitError` so callers can fall back."""
+    try:
+        faults.check("collective.init")
+        return mesh if mesh is not None else get_mesh(axis_name=axis_name)
+    except Exception as e:
+        raise CollectiveInitError(f"collective init failed: {e}") from e
+
+
+def _note_local_fallback(what: str, err: Exception) -> None:
+    """Record a sharded→local degradation — loud (warning + obs counter),
+    and refused outright on a multi-process runtime, where one host
+    computing locally while peers enter the collective would deadlock the
+    program or silently split the answer."""
+    if jax.process_count() > 1:
+        raise err
+    obs_metrics.inc("sharded.fallback_local")
+    warnings.warn(
+        f"{what}: {err} — falling back to the single-device local kernel "
+        "(same result, no ICI parallelism)",
+        RuntimeWarning,
+        stacklevel=3,
+    )
 
 
 def _neighbor_planes(plane, axis_name, direction):
@@ -417,8 +454,28 @@ def sharded_seeded_watershed(
     equality (tested) — for volumes whose z-extent is divisible by the mesh
     size.  Seeds are global int32 ids (0 = unlabeled); voxels outside
     ``mask`` stay 0.
+
+    When collective setup fails (``CollectiveInitError`` — a wedged device
+    runtime, or the ``collective.init`` fault site), the kernel degrades to
+    the single-device ``ops.watershed.seeded_watershed`` fixpoint, which
+    computes the SAME labels (the equality claimed above); the degradation
+    is recorded (``sharded.fallback_local`` counter + warning), and refused
+    under a multi-process runtime.
     """
-    mesh = mesh if mesh is not None else get_mesh(axis_name=axis_name)
+    try:
+        mesh = _collective_mesh(mesh, axis_name)
+    except CollectiveInitError as e:
+        _note_local_fallback("sharded_seeded_watershed", e)
+        from ..ops.watershed import seeded_watershed
+
+        return seeded_watershed(
+            jnp.asarray(np.asarray(hmap, dtype=np.float32)),
+            jnp.asarray(np.asarray(seeds, dtype=np.int32)),
+            mask=None if mask is None else jnp.asarray(
+                np.asarray(mask, dtype=bool)
+            ),
+            per_slice=False,
+        )
     n = mesh.shape[axis_name]
     if hmap.shape[0] % n:
         raise ValueError(
@@ -431,6 +488,7 @@ def sharded_seeded_watershed(
     hmap = put_global(hmap, mesh, axis_name, dtype=np.float32)
     seeds = put_global(seeds, mesh, axis_name, dtype=np.int32)
     mask = put_global(mask, mesh, axis_name, dtype=bool)
+    faults.check("collective.execute")
     return _sharded_flood(hmap, seeds, mask, axis_name, mesh)
 
 
@@ -452,12 +510,30 @@ def sharded_connected_components(
 
     One jit program: per-shard sweeps + pointer jumping, ppermute'd boundary
     planes, psum'd convergence — no host round-trips between rounds.
+
+    When collective setup fails (``CollectiveInitError`` — a wedged device
+    runtime, or the ``collective.init`` fault site), the kernel degrades to
+    the single-device ``ops.cc.connected_components_raw``, which carries the
+    IDENTICAL label contract (min global flat index per component,
+    background -1) — same values, no ICI parallelism; the degradation is
+    recorded (``sharded.fallback_local`` counter + warning), and refused
+    under a multi-process runtime.
     """
-    mesh = mesh if mesh is not None else get_mesh(axis_name=axis_name)
+    try:
+        mesh = _collective_mesh(mesh, axis_name)
+    except CollectiveInitError as e:
+        _note_local_fallback("sharded_connected_components", e)
+        from ..ops.cc import connected_components_raw
+
+        return connected_components_raw(
+            jnp.asarray(np.asarray(mask, dtype=bool)),
+            connectivity=connectivity,
+        )
     n = mesh.shape[axis_name]
     if mask.shape[0] % n:
         raise ValueError(
             f"z extent {mask.shape[0]} not divisible by mesh size {n}"
         )
     mask = put_global(mask, mesh, axis_name, dtype=bool)
+    faults.check("collective.execute")
     return _sharded_cc(mask, connectivity, axis_name, mesh)

@@ -143,19 +143,30 @@ class ClusterExecutor(BaseExecutor):
         errors: Dict[int, str] = {}
         for job_id in range(n_jobs):
             _, _, status_path = job_paths(job_dir, job_id)
+            job_blocks = ids[job_id::n_jobs]
+            anchor = job_blocks[0] if job_blocks else -1
             if not os.path.exists(status_path):
-                # job died before writing status — its blocks stay failed
-                errors[ids[job_id::n_jobs][0] if ids[job_id::n_jobs] else -1] = (
-                    f"job {job_id} wrote no status file"
-                )
+                # job died before writing status (crash/kill/preemption) —
+                # its blocks stay failed
+                errors[anchor] = f"job {job_id} wrote no status file"
                 continue
-            with open(status_path) as f:
-                status = json.load(f)
+            try:
+                with open(status_path) as f:
+                    status = json.load(f)
+            except (json.JSONDecodeError, OSError) as e:
+                # a torn/unreadable status is a failed job, not a crashed
+                # submitter — the retry loop resubmits its blocks
+                errors[anchor] = f"job {job_id} status unreadable: {e}"
+                continue
             done.extend(status["done"])
             failed_set.difference_update(status["done"])
             for k, v in status.get("errors", {}).items():
                 if k.isdigit():
                     errors[int(k)] = v
+                else:
+                    # job-scope errors (setup failure, whole-job crash):
+                    # surface the diagnostic on the job's first block
+                    errors.setdefault(anchor, f"job {job_id} {k}: {v}")
         failed = sorted(failed_set)
         return done, failed, errors
 

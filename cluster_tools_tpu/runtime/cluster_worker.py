@@ -6,6 +6,21 @@ machine-readable per-job status JSON (the positive-success analog of the
 reference's ``processed job/block`` log lines, function_utils.py:11-16 —
 parsed back by the submitting process without log-grepping).
 
+Failure surfaces (ctt-fault):
+
+  * a corrupt ``task.pkl`` / ``job_N.json`` (torn write, version skew,
+    truncated ship) no longer dies with only a traceback on stderr — the
+    setup phase writes a failed status JSON with ``"setup_failed": true``
+    and the traceback under ``errors["setup"]``, so the submitter
+    aggregates a real diagnostic instead of inferring "job died before
+    writing status";
+  * fault sites ``worker.job`` (before the status write — ``kill``
+    simulates a job dying statusless, the case the submitter's
+    no-status-file branch covers) and ``worker.exit`` (after the status
+    write) make both crash windows testable;
+  * the status write is durable (tmp + fsync + atomic replace via the
+    store helper).
+
     python -m cluster_tools_tpu.runtime.cluster_worker <job_dir> <job_id>
 """
 
@@ -26,16 +41,35 @@ def job_paths(job_dir: str, job_id: int):
     )
 
 
+def _write_status(status_path: str, status: dict) -> None:
+    from ..utils.store import atomic_write_bytes
+
+    atomic_write_bytes(status_path, json.dumps(status).encode())
+
+
 def run_job(job_dir: str, job_id: int) -> int:
     task_path, config_path, status_path = job_paths(job_dir, job_id)
-    with open(task_path, "rb") as f:
-        task = pickle.load(f)
-    with open(config_path) as f:
-        job = json.load(f)
+    try:
+        with open(task_path, "rb") as f:
+            task = pickle.load(f)
+        with open(config_path) as f:
+            job = json.load(f)
+    except Exception:
+        # machine-readable setup failure: the submitter keeps this job's
+        # blocks failed (done is empty) AND gets the traceback, instead of
+        # a bare "job wrote no status file"
+        _write_status(status_path, {
+            "done": [],
+            "failed": [],
+            "errors": {"setup": traceback.format_exc()},
+            "setup_failed": True,
+        })
+        return 1
 
     # ctt-obs: a scheduler job inherits CTT_TRACE_DIR/CTT_RUN_ID from the
     # submitting process's environment (worker_env), so its spans land in
     # the same run as the driver's — bootstrap happened at obs import
+    from .. import faults
     from ..obs import trace as obs_trace
     from ..utils.blocking import Blocking
     from .executor import LocalExecutor
@@ -65,10 +99,13 @@ def run_job(job_dir: str, job_id: int) -> int:
             "failed": [int(b) for b in job["block_ids"]],
             "errors": {"job": traceback.format_exc()},
         }
-    tmp = status_path + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(status, f)
-    os.replace(tmp, status_path)
+    # chaos seam: `kill` here dies WITHOUT a status file (the submitter's
+    # no-status branch + task retry must recover the job's blocks)
+    faults.check("worker.job", id=job_id)
+    _write_status(status_path, status)
+    # ... and here dies AFTER the status landed (crash on the way out —
+    # recorded work must survive, the submitter sees a normal status)
+    faults.check("worker.exit", id=job_id)
     obs_trace.flush()  # short-lived process: don't rely on atexit ordering
     return 0 if not status["failed"] else 1
 

@@ -24,18 +24,41 @@ failed blocks.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 import traceback
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Any, Dict, List, Sequence, Tuple
 
+from .. import faults
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..utils.blocking import Blocking
 
 RunResult = Tuple[List[int], List[int], Dict[int, str]]  # done, failed, errors
+
+
+def block_deadline_s(config: Dict[str, Any]) -> float:
+    """Per-block soft deadline in seconds (0 = watchdog off): the
+    ``block_deadline_s`` config key, else ``CTT_BLOCK_DEADLINE_S``;
+    malformed values degrade to off like every other CTT_* switch.
+
+    "Soft" because Python cannot kill a thread: a block that exceeds the
+    deadline is *recorded failed* (``executor.blocks_timed_out``) and fed
+    to the task retry loop, while the hung call is left to finish in the
+    background — idempotent blocks make the possible late completion
+    harmless (the same contract block retry already relies on)."""
+    raw = config.get("block_deadline_s")
+    if raw is None:
+        raw = os.environ.get("CTT_BLOCK_DEADLINE_S")
+    try:
+        deadline = float(raw) if raw is not None else 0.0
+    except (TypeError, ValueError):
+        deadline = 0.0
+    return max(deadline, 0.0)
 
 
 def _record(task, label: str, n_blocks: int, seconds: float) -> None:
@@ -89,6 +112,7 @@ class LocalExecutor(BaseExecutor):
 
         def _one(bid: int):
             try:
+                faults.check("executor.block", id=bid)
                 t0 = time.perf_counter()
                 # explicit task= attribute: under a thread pool the span
                 # opens in a worker thread where the per-thread parent
@@ -102,8 +126,13 @@ class LocalExecutor(BaseExecutor):
             except Exception:
                 return bid, traceback.format_exc()
 
+        deadline = block_deadline_s(config)
         with profiler_trace(config):
-            if n_workers == 1:
+            if deadline > 0:
+                results = self._run_with_watchdog(
+                    _one, block_ids, n_workers, deadline
+                )
+            elif n_workers == 1:
                 results = [_one(b) for b in block_ids]
             else:
                 with ThreadPoolExecutor(n_workers) as pool:
@@ -120,6 +149,43 @@ class LocalExecutor(BaseExecutor):
                 failed.append(bid)
                 errors[bid] = err
         return done, failed, errors
+
+    @staticmethod
+    def _run_with_watchdog(fn, block_ids, n_workers: int, deadline: float):
+        """Run ``fn(bid) -> (bid, err)`` per block under the soft-deadline
+        watchdog: a block that doesn't resolve within ``deadline`` seconds
+        is converted into a failed block (the task retry loop re-runs it)
+        instead of hanging the dispatch.  Always pool-based (even at one
+        worker) so the waiter can abandon a hung call; the pool is shut
+        down without joining — hung threads are left to finish in the
+        background (see :func:`block_deadline_s`)."""
+        pool = ThreadPoolExecutor(
+            max(n_workers, 1), thread_name_prefix="ctt-watchdog"
+        )
+        results = []
+        try:
+            futures = [(bid, pool.submit(fn, bid)) for bid in block_ids]
+            for bid, fut in futures:
+                try:
+                    results.append(fut.result(timeout=deadline))
+                except FutureTimeout:
+                    # not-yet-started blocks behind a hung worker cancel
+                    # cleanly; running ones are abandoned to the background
+                    fut.cancel()
+                    obs_metrics.inc("executor.blocks_timed_out")
+                    results.append((
+                        bid,
+                        f"block {bid} exceeded the soft deadline "
+                        f"({deadline:.1f}s) — recorded failed for retry; "
+                        "the hung call is left to finish in the background",
+                    ))
+                except Exception:
+                    # fn reports its own errors; this only guards cancelled
+                    # futures racing the result() call
+                    results.append((bid, traceback.format_exc()))
+        finally:
+            pool.shutdown(wait=False)
+        return results
 
 
 class TpuExecutor(BaseExecutor):
@@ -214,6 +280,7 @@ class TpuExecutor(BaseExecutor):
 
         def _one_batch(chunk):
             try:
+                faults.check("executor.batch", id=chunk[0])
                 t0 = time.perf_counter()
                 with obs_trace.span(
                     "block_batch", kind="device", task=task.identifier,
@@ -303,6 +370,7 @@ class TpuExecutor(BaseExecutor):
                 stage_s[stage] += dt
 
         def _read(chunk):
+            faults.check("executor.stage_read", id=chunk[0])
             t0 = time.perf_counter()
             with obs_trace.span(
                 "stage_read", kind="host_io", task=task.identifier,
@@ -313,6 +381,7 @@ class TpuExecutor(BaseExecutor):
             return payload
 
         def _write(chunk, result):
+            faults.check("executor.stage_write", id=chunk[0])
             t0 = time.perf_counter()
             with obs_trace.span(
                 "stage_write", kind="host_io", task=task.identifier,
@@ -348,6 +417,7 @@ class TpuExecutor(BaseExecutor):
                 t_batch0 = time.perf_counter()
                 try:
                     payload = fut.result()
+                    faults.check("executor.stage_compute", id=chunk[0])
                     t0 = time.perf_counter()
                     with obs_trace.span(
                         "stage_compute", kind="device",

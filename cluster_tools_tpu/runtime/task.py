@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 import traceback
 from typing import Any, Dict, List, Optional, Sequence
@@ -26,9 +25,11 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from . import config as cfg
+from .. import faults
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..utils.blocking import Blocking, blocks_in_volume
+from ..utils.store import atomic_write_bytes
 
 
 class FailedBlocksError(RuntimeError):
@@ -62,11 +63,13 @@ class Target:
             return json.load(f)
 
     def write(self, status: Dict[str, Any]) -> None:
+        # the store's durable atomic write (tmp + fsync + replace, tmp
+        # unlinked on failure): a status file is the ONE record peers and
+        # resumes trust — it must never surface empty after a power cut
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        tmp = self.path + f".tmp{os.getpid()}.{threading.get_ident()}"
-        with open(tmp, "w") as f:
-            json.dump(status, f, indent=2)
-        os.replace(tmp, self.path)
+        atomic_write_bytes(
+            self.path, json.dumps(status, indent=2).encode()
+        )
 
 
 class Task:
@@ -146,6 +149,9 @@ class Task:
             what=what,
         ):
             while True:
+                # chaos seam: `stall` models a slow peer/filesystem (the
+                # deadline above must still fire), `fail` a poisoned barrier
+                faults.check("task.barrier", what=what)
                 missing = []
                 for t in targets:
                     status = t.read()

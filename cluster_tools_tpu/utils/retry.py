@@ -1,0 +1,91 @@
+"""Shared exponential-backoff-with-jitter retry for transient store IO.
+
+The chunked store is the control AND data plane of the whole runtime: a
+transient filesystem error (NFS hiccup, overloaded object-store gateway, a
+torn chunk mid-rewrite by a crashed peer) on one chunk read must not fail a
+block — and a failed block must not fail the run (that is what block retry
+is for).  This helper is the ONE sanctioned retry loop for such errors; lint
+rule CTT009 flags ad-hoc ``time.sleep`` retry loops elsewhere.
+
+Classification contract (utils/store.py):
+
+  * transient ``OSError`` (EIO and friends)          → retryable;
+  * decode of a torn/truncated chunk → ``CorruptChunk`` (an OSError
+    subclass) → retryable — a concurrent writer's rewrite lands between
+    attempts; if it never does, the error propagates, the *block* fails,
+    and the task retry loop rewrites the chunk;
+  * ``FileNotFoundError``                            → NOT retryable
+    (unwritten chunks are normal: they mean fill_value, not failure).
+
+Knobs (read per call so tests and chaos runs can tune them):
+
+  ``CTT_IO_RETRIES``        max retry count after the first attempt (default 3)
+  ``CTT_IO_BACKOFF_BASE_S`` first backoff delay (default 0.01)
+  ``CTT_IO_BACKOFF_MAX_S``  backoff cap (default 1.0)
+
+Each retry sleeps ``min(base * 2**attempt, max) * uniform(0.5, 1.0)`` —
+full-jitter-style decorrelation so a fleet of workers hitting the same
+flaky mount does not resynchronize into retry storms.  Every sleep
+increments the caller's obs counter (default ``store.io_retries``) so
+recovered transients stay visible in ``obs diff``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+from ..obs import metrics as obs_metrics
+
+__all__ = ["io_retry", "retry_attempts"]
+
+T = TypeVar("T")
+
+_DEF_RETRIES = 3
+_DEF_BASE_S = 0.01
+_DEF_MAX_S = 1.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        val = float(raw) if raw is not None else default
+    except (TypeError, ValueError):
+        val = default  # malformed degrades to default, the CTT_* convention
+    return max(val, 0.0)
+
+
+def retry_attempts() -> int:
+    return int(_env_float("CTT_IO_RETRIES", _DEF_RETRIES))
+
+
+def io_retry(
+    fn: Callable[[], T],
+    what: str = "store io",
+    retryable: Tuple[Type[BaseException], ...] = (OSError,),
+    non_retryable: Tuple[Type[BaseException], ...] = (FileNotFoundError,),
+    counter: str = "store.io_retries",
+) -> T:
+    """Run ``fn`` with exponential-backoff retries on transient errors.
+
+    The first attempt is a plain call — the success path adds one function
+    call and zero allocations over calling ``fn()`` directly."""
+    retries = retry_attempts()
+    base_s = _env_float("CTT_IO_BACKOFF_BASE_S", _DEF_BASE_S)
+    max_s = _env_float("CTT_IO_BACKOFF_MAX_S", _DEF_MAX_S)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except non_retryable:
+            raise
+        except retryable:
+            if attempt >= retries:
+                raise
+            delay = min(base_s * (2.0 ** attempt), max_s)
+            delay *= 0.5 + random.random() * 0.5
+            obs_metrics.inc(counter)
+            time.sleep(delay)
+            attempt += 1

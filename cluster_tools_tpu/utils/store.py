@@ -33,6 +33,27 @@ Host hot-path fast paths (ctt-io):
     each shared chunk once.  Entries are validated against the chunk file's
     ``(inode, mtime_ns, size)`` and invalidated by in-process writes, so
     cross-process writers are picked up on the next read.
+
+Transient-failure resilience (ctt-fault):
+
+  * chunk reads/writes run under the shared backoff helper
+    (``utils/retry.py``): transient ``OSError`` retries with exponential
+    backoff + jitter (``store.io_retries`` obs counter) instead of failing
+    the block outright; ``FileNotFoundError`` stays non-retryable (an
+    unwritten chunk means fill_value, not failure);
+  * a chunk that reads but fails to *decode* (truncated/garbled payload —
+    a torn write by a crashed peer) raises :class:`CorruptChunk`, an
+    OSError subclass: retryable at the IO level (a concurrent rewrite may
+    land between attempts) and, if it never heals, a clean block failure
+    that the task retry loop repairs by rerunning the writing block;
+  * atomic writes fsync the tmp file before ``os.replace`` (an unsynced
+    rename can surface as an empty/truncated file after power loss —
+    ``CTT_STORE_FSYNC=0`` opts out for throwaway scratch) and unlink the
+    tmp file when the write fails, so failed writes don't litter
+    ``.tmpPID.TID`` files in shared stores;
+  * fault-injection sites ``store.read`` / ``store.write`` /
+    ``store.decode`` (see ``cluster_tools_tpu/faults``) exercise all of the
+    above deterministically, including torn-write simulation.
 """
 
 from __future__ import annotations
@@ -50,29 +71,75 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import faults
 from ..obs import metrics as obs_metrics
 from .blocking import _ceil_div
+from .retry import io_retry
 
 try:  # h5py is available in the image, but keep it optional
     import h5py
 except ImportError:  # pragma: no cover
     h5py = None
 
-__all__ = ["file_reader", "File", "Dataset", "RaggedDataset"]
+__all__ = [
+    "file_reader", "File", "Dataset", "RaggedDataset", "CorruptChunk",
+    "atomic_write_bytes",
+]
 
 
-def _atomic_write_bytes(path: str, payload: bytes) -> None:
+class CorruptChunk(OSError):
+    """A chunk read back but failed to decode — truncated or garbled
+    payload, i.e. a torn write.  OSError subclass so the shared IO retry
+    treats it as transient (a concurrent rewrite may land between
+    attempts); if it never heals it fails the reading block cleanly and
+    block retry repairs the store by rerunning the writer."""
+
+
+# fsync before rename is the durability half of atomicity: without it a
+# power failure can surface the renamed file EMPTY (metadata reached the
+# journal, data didn't).  Chunk scratch on tmpfs doesn't care; status/meta
+# JSON does.  CTT_STORE_FSYNC=0 opts out for throwaway stores.
+_FSYNC = os.environ.get("CTT_STORE_FSYNC", "1").lower() not in (
+    "0", "false", "off", ""
+)
+
+
+def atomic_write_bytes(path: str, payload: bytes) -> None:
     # tmp name must be unique per pid AND thread: concurrent block threads
     # writing the same meta file (e.g. two workers group-initializing the
     # shared scratch store) would otherwise replace each other's tmp away
     tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            if _FSYNC:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # failed writes must not litter .tmpPID.TID files in shared stores
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+# original (pre-ctt-fault) internal name, kept for callers/tests
+_atomic_write_bytes = atomic_write_bytes
 
 
 def _write_json(path: str, obj: Any) -> None:
     _atomic_write_bytes(path, json.dumps(obj, indent=2).encode())
+
+
+def _gzip_compress(raw: bytes) -> bytes:
+    """Deterministic gzip (level 1, mtime pinned to 0): by default
+    ``gzip.compress`` stamps the wall clock into every member header, so
+    two runs writing identical arrays produce different chunk *bytes* —
+    which breaks byte-identity checks (chaos-vs-clean runs, content-
+    addressed dedup) for no benefit.  Readers ignore the field."""
+    return gzip.compress(raw, 1, mtime=0)
 
 
 def _read_json(path: str) -> Any:
@@ -354,7 +421,7 @@ class _ZarrFormat:
                 blocksize=compression["blocksize"],
             )
         if compression == "gzip":
-            return gzip.compress(raw, 1)
+            return _gzip_compress(raw)
         return zlib.compress(raw, 1) if compression else raw
 
     @staticmethod
@@ -471,7 +538,7 @@ class _N5Format:
                 blocksize=compression["blocksize"],
             )
         elif compression:
-            raw = gzip.compress(raw, 1)
+            raw = _gzip_compress(raw)
         return header + raw
 
     @staticmethod
@@ -578,6 +645,26 @@ class Dataset:
             for g, c, s in zip(grid_pos, self.chunks, self.shape)
         )
 
+    def _decode_classified(self, p: str, payload: bytes) -> np.ndarray:
+        """Decode one chunk payload at full chunk shape, classifying every
+        decode failure as :class:`CorruptChunk` (retryable torn-write
+        evidence) — codec errors on bytes that DID read are corruption,
+        not programming errors."""
+        try:
+            faults.check("store.decode", path=p)
+            flat = self._fmt.decode_chunk(
+                payload, self.chunks, self.dtype, self.compression
+            )
+            return flat.reshape(self.chunks)
+        except FileNotFoundError:
+            raise
+        except (ValueError, struct.error, zlib.error, EOFError,
+                RuntimeError, OSError) as e:
+            raise CorruptChunk(
+                f"chunk {p} failed to decode "
+                f"({len(payload)} payload bytes): {e}"
+            ) from e
+
     def _decoded_chunk(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
         """One chunk decoded at FULL chunk shape (edge chunks zero-padded),
         read-only, through the process-global decoded-chunk LRU.  Returns
@@ -596,17 +683,22 @@ class Dataset:
             if hit is not None:
                 obs_metrics.inc("store.chunk_cache_hits")
                 return hit
-        try:
+        def _load() -> np.ndarray:
+            faults.check("store.read", path=p)
             with open(p, "rb") as f:
                 payload = f.read()
+            # obs counters at the codec boundary: what actually crossed the
+            # filesystem (compressed payload bytes), not the decoded size
+            obs_metrics.inc("store.chunks_read")
+            obs_metrics.inc("store.bytes_read", len(payload))
+            return self._decode_classified(p, payload)
+
+        try:
+            # transient OSError / torn-chunk decode retries with backoff;
+            # a missing chunk (FileNotFoundError) is normal and final
+            full = io_retry(_load, what=f"read chunk {p}")
         except FileNotFoundError:
             return None
-        # obs counters at the codec boundary: what actually crossed the
-        # filesystem (compressed payload bytes), not the decoded size
-        obs_metrics.inc("store.chunks_read")
-        obs_metrics.inc("store.bytes_read", len(payload))
-        flat = self._fmt.decode_chunk(payload, self.chunks, self.dtype, self.compression)
-        full = flat.reshape(self.chunks)
         full.setflags(write=False)  # shared across cache readers
         if sig is not None:
             obs_metrics.inc("store.chunk_cache_misses")
@@ -636,10 +728,32 @@ class Dataset:
         payload = self._fmt.encode_chunk(
             np.asarray(data, dtype=self.dtype), self.chunks, self.compression
         )
-        obs_metrics.inc("store.chunks_written")
-        obs_metrics.inc("store.bytes_written", len(payload))
-        _atomic_write_bytes(p, payload)
-        _CHUNK_CACHE.invalidate(p)
+        self._commit_chunk_payload(p, payload)
+
+    def _commit_chunk_payload(self, p: str, payload: bytes) -> None:
+        """Write one encoded chunk payload under the shared IO retry.
+        The ``store.write`` fault site raises transient errors here; the
+        ``torn`` action truncates the payload on disk and raises
+        CorruptChunk, so the retry (or, once exhausted, block retry)
+        rewrites the full payload — a tear heals instead of poisoning
+        later reads."""
+
+        def _commit() -> None:
+            faults.check("store.write", path=p)
+            torn = faults.mangle("store.write", payload, path=p)
+            obs_metrics.inc("store.chunks_written")
+            obs_metrics.inc("store.bytes_written", len(payload))
+            atomic_write_bytes(p, payload if torn is None else torn)
+            if torn is not None:
+                raise CorruptChunk(
+                    f"torn write injected for {p} "
+                    f"({len(torn)}/{len(payload)} bytes)"
+                )
+
+        try:
+            io_retry(_commit, what=f"write chunk {p}")
+        finally:
+            _CHUNK_CACHE.invalidate(p)
 
     def write_chunk_varlen(self, grid_pos: Sequence[int], data: np.ndarray) -> None:
         """Write an arbitrary-length 1d payload as an n5 mode-1 (varlength)
@@ -655,34 +769,57 @@ class Dataset:
         )
         p = self._chunk_path(grid_pos)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        obs_metrics.inc("store.chunks_written")
-        obs_metrics.inc("store.bytes_written", len(payload))
-        _atomic_write_bytes(p, payload)
-        _CHUNK_CACHE.invalidate(p)
+        self._commit_chunk_payload(p, payload)
 
     def read_chunk_varlen(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
         """Read a mode-1 (varlength) chunk as a flat array, or None."""
         if self._fmt is not _N5Format:
             raise NotImplementedError("varlength chunks are n5-only")
         p = self._chunk_path(grid_pos)
-        if not os.path.exists(p):
+
+        def _load() -> np.ndarray:
+            faults.check("store.read", path=p)
+            with open(p, "rb") as f:
+                payload = f.read()
+            obs_metrics.inc("store.chunks_read")
+            obs_metrics.inc("store.bytes_read", len(payload))
+            try:
+                faults.check("store.decode", path=p)
+                mode, ndim = struct.unpack(">HH", payload[:4])
+                if mode != 1:
+                    raise ValueError(
+                        f"chunk {tuple(grid_pos)} is not varlength"
+                    )
+                offset = 4 + 4 * ndim
+                (n_elements,) = struct.unpack(
+                    ">I", payload[offset : offset + 4]
+                )
+                raw = payload[offset + 4 :]
+                if _is_blosc(self.compression):
+                    raw = _blosc_mod().decompress(raw)
+                elif self.compression:
+                    raw = gzip.decompress(raw)
+                be_dtype = np.dtype(_N5Format._DTYPES[self.dtype.name])
+                out = np.frombuffer(raw, dtype=be_dtype)
+                if out.size < n_elements:
+                    raise ValueError(
+                        f"payload holds {out.size} elements, "
+                        f"header promises {n_elements}"
+                    )
+                return out[:n_elements].astype(self.dtype)
+            except FileNotFoundError:
+                raise
+            except (ValueError, struct.error, zlib.error, EOFError,
+                    RuntimeError, OSError) as e:
+                raise CorruptChunk(
+                    f"varlen chunk {p} failed to decode "
+                    f"({len(payload)} payload bytes): {e}"
+                ) from e
+
+        try:
+            return io_retry(_load, what=f"read varlen chunk {p}")
+        except FileNotFoundError:
             return None
-        with open(p, "rb") as f:
-            payload = f.read()
-        obs_metrics.inc("store.chunks_read")
-        obs_metrics.inc("store.bytes_read", len(payload))
-        mode, ndim = struct.unpack(">HH", payload[:4])
-        if mode != 1:
-            raise ValueError(f"chunk {tuple(grid_pos)} is not varlength")
-        offset = 4 + 4 * ndim
-        (n_elements,) = struct.unpack(">I", payload[offset : offset + 4])
-        raw = payload[offset + 4 :]
-        if _is_blosc(self.compression):
-            raw = _blosc_mod().decompress(raw)
-        elif self.compression:
-            raw = gzip.decompress(raw)
-        be_dtype = np.dtype(_N5Format._DTYPES[self.dtype.name])
-        return np.frombuffer(raw, dtype=be_dtype)[:n_elements].astype(self.dtype)
 
     # -- region level --------------------------------------------------------
 
@@ -854,8 +991,18 @@ class RaggedDataset:
     def write_chunk(self, grid_pos, data: np.ndarray) -> None:
         p = self._chunk_path(grid_pos)
         tmp = p + f".tmp{os.getpid()}.npy"
-        np.save(tmp, np.asarray(data, dtype=self.dtype))
-        os.replace(tmp, p)
+        try:
+            np.save(tmp, np.asarray(data, dtype=self.dtype))
+            if _FSYNC:
+                with open(tmp, "rb+") as f:
+                    os.fsync(f.fileno())
+            os.replace(tmp, p)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
 
 class Group:

@@ -45,8 +45,8 @@ class TestRegistry:
     def test_all_shipped_rules_registered(self):
         expect = {
             "CTT001", "CTT002", "CTT003", "CTT004", "CTT005", "CTT006",
-            "CTT007", "CTT008", "CTT101", "CTT102", "CTT103", "CTT104",
-            "CTT105",
+            "CTT007", "CTT008", "CTT009", "CTT101", "CTT102", "CTT103",
+            "CTT104", "CTT105",
         }
         assert expect <= REGISTRY.known_ids()
         assert len(expect) >= 8
@@ -341,6 +341,128 @@ class TestCTT008:
             "import time\n"
             "def f(t0):\n"
             "    return time.time() - t0  # ctt: noqa[CTT008] wall on purpose\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/runtime/fake.py") == []
+
+
+# --------------------------------------------------------------------------
+# CTT009 resilience hygiene: ad-hoc retry loops, swallowed exceptions
+
+
+class TestCTT009:
+    def test_adhoc_sleep_retry_loop(self):
+        src = (
+            "import time\n"
+            "def fetch(path):\n"
+            "    for attempt in range(5):\n"
+            "        try:\n"
+            "            return open(path).read()\n"
+            "        except OSError:\n"
+            "            time.sleep(2 ** attempt)\n"
+        )
+        (f,) = lint(src, path="cluster_tools_tpu/tasks/fake.py")
+        assert (f.rule_id, f.line) == ("CTT009", 7)
+        assert "io_retry" in f.message
+
+    def test_while_retry_loop(self):
+        src = (
+            "import time\n"
+            "def fetch(path):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return open(path).read()\n"
+            "        except OSError:\n"
+            "            pass\n"
+            "        time.sleep(1.0)\n"
+        )
+        fs = lint(src, path="cluster_tools_tpu/tasks/fake.py")
+        assert ("CTT009", 8) in [(f.rule_id, f.line) for f in fs]
+
+    def test_negative_poll_loop_without_try(self):
+        # a plain poll loop (no exception handling) is not a retry loop
+        src = (
+            "import time\n"
+            "def wait(done):\n"
+            "    while not done():\n"
+            "        time.sleep(1.0)\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/runtime/fake.py") == []
+
+    def test_negative_shared_helper_is_exempt(self):
+        src = (
+            "import time\n"
+            "def io_retry(fn):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return fn()\n"
+            "        except OSError:\n"
+            "            time.sleep(0.01)\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/utils/retry.py") == []
+
+    def test_swallowed_exception(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        risky()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        (f,) = lint(src, path="cluster_tools_tpu/runtime/fake.py")
+        assert (f.rule_id, f.line) == ("CTT009", 4)
+        assert "swallows" in f.message
+
+    def test_bare_except_pass(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        risky()\n"
+            "    except:\n"
+            "        pass\n"
+        )
+        assert ids(lint(src, path="cluster_tools_tpu/runtime/fake.py")) == [
+            "CTT009"
+        ]
+
+    def test_negative_narrow_except_pass_is_fine(self):
+        src = (
+            "def f(ds, n):\n"
+            "    try:\n"
+            "        ds.n_threads = n\n"
+            "    except (AttributeError, TypeError):\n"
+            "        pass\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/utils/fake.py") == []
+
+    def test_negative_except_with_recording_body_is_fine(self):
+        src = (
+            "def f(status):\n"
+            "    try:\n"
+            "        risky()\n"
+            "    except Exception:\n"
+            "        status['failed'] = True\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/runtime/fake.py") == []
+
+    def test_tests_are_exempt(self):
+        src = (
+            "import time\n"
+            "def test_retry():\n"
+            "    while True:\n"
+            "        try:\n"
+            "            break\n"
+            "        except OSError:\n"
+            "            time.sleep(0.1)\n"
+        )
+        assert lint_source(src, "tests/test_fake.py") == []
+
+    def test_suppressible(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        risky()\n"
+            "    except Exception:  # ctt: noqa[CTT009] best-effort teardown\n"
+            "        pass\n"
         )
         assert lint(src, path="cluster_tools_tpu/runtime/fake.py") == []
 

@@ -117,6 +117,126 @@ if [ "$pipe_sum_rc" -ne 0 ]; then
     exit "$pipe_sum_rc"
 fi
 
+echo "== ctt-fault chaos smoke (seeded store faults + killed worker job) =="
+chaos_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu CTT_TRACE_DIR="$obs_tmp/trace" CTT_RUN_ID=ci_chaos \
+CTT_FAULTS="store.write:io_error:p=0.15;store.read:io_error:p=0.05;store.write:torn:once;worker.job:kill:ids=0,once;seed=42" \
+CTT_FAULT_STATE_DIR="$chaos_tmp/fault_state" \
+    python - "$chaos_tmp" <<'PY'
+import hashlib, json, os, stat, sys
+
+# the baseline run must be fault-free INCLUDING its worker subprocesses,
+# which inherit this process's environment — pop the spec, re-arm later
+CHAOS_SPEC = os.environ.pop("CTT_FAULTS")
+
+import numpy as np
+from scipy import ndimage
+
+from cluster_tools_tpu import faults
+from cluster_tools_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+td = sys.argv[1]
+
+# stub scheduler (the fake-sbatch seam from tests/test_cluster_executor.py)
+sched = os.path.join(td, "sched")
+os.makedirs(sched, exist_ok=True)
+submit, queue = os.path.join(sched, "submit"), os.path.join(sched, "queue")
+with open(submit, "w") as f:
+    f.write('#!/bin/bash\nscript="${@: -1}"\nbash "$script" >/dev/null 2>&1\n'
+            'echo "Submitted batch job 1"\n')
+with open(queue, "w") as f:
+    f.write("#!/bin/bash\nexit 0\n")
+for p in (submit, queue):
+    os.chmod(p, os.stat(p).st_mode | stat.S_IEXEC)
+
+rng = np.random.default_rng(0)
+raw = ndimage.gaussian_filter(rng.random((24, 48, 48)), (1.0, 2.0, 2.0))
+raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+
+
+def run_ws(key, spec=None):
+    if spec is None:
+        os.environ.pop("CTT_FAULTS", None)
+    else:
+        os.environ["CTT_FAULTS"] = spec
+    faults.configure()
+    path = os.path.join(td, f"{key}.n5")
+    file_reader(path).create_dataset("bnd", data=raw, chunks=(12, 24, 24))
+    config_dir = os.path.join(td, f"configs_{key}")
+    cfg.write_global_config(config_dir, {
+        "block_shape": [12, 24, 24], "target": "slurm", "max_jobs": 3,
+        "max_num_retries": 3, "retry_failure_fraction": 0.7,
+        "poll_interval_s": 0.05, "sbatch_cmd": submit, "squeue_cmd": queue,
+        "worker_env": {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+    })
+    cfg.write_config(config_dir, "watershed", {
+        "threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+        "halo": [2, 6, 6],
+    })
+    wf = WatershedWorkflow(
+        os.path.join(td, f"tmp_{key}"), config_dir, max_jobs=3,
+        input_path=path, input_key="bnd",
+        output_path=path, output_key="ws",
+    )
+    try:
+        assert build([wf]), f"{key} watershed build failed"
+    finally:
+        faults.reset()
+        os.environ.pop("CTT_FAULTS", None)
+    return path
+
+
+def digest(root):
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+ref = run_ws("ref")
+chaos = run_ws("chaos", CHAOS_SPEC)
+
+np.testing.assert_array_equal(
+    file_reader(chaos, "r")["ws"][:], file_reader(ref, "r")["ws"][:]
+)
+assert digest(os.path.join(chaos, "ws")) == digest(os.path.join(ref, "ws")), \
+    "chaos output not byte-identical to the fault-free run"
+
+# recovery must be VISIBLE: sum counters over the driver + every worker
+obs_metrics.flush()
+totals = {}
+run_dir = obs_trace.run_dir()
+for name in os.listdir(run_dir):
+    if name.startswith("metrics.p"):
+        with open(os.path.join(run_dir, name)) as f:
+            for k, v in json.load(f)["counters"].items():
+                totals[k] = totals.get(k, 0) + v
+assert totals.get("faults.injected", 0) > 0, f"no faults injected: {totals}"
+assert totals.get("store.io_retries", 0) > 0, f"no IO retries: {totals}"
+# the killed worker job really died (latched once across resubmissions)
+latches = os.listdir(os.environ["CTT_FAULT_STATE_DIR"])
+assert any(l.startswith("worker.job.") for l in latches), latches
+print("chaos smoke ok:", json.dumps({
+    k: round(v, 2) for k, v in sorted(totals.items())
+    if k.startswith(("faults.", "store.io_retries"))
+}))
+PY
+chaos_rc=$?
+rm -rf "$chaos_tmp"
+if [ "$chaos_rc" -ne 0 ]; then
+    echo "chaos smoke failed (rc=$chaos_rc): fault-injected watershed run" \
+         "did not recover to a byte-identical output" >&2
+    exit "$chaos_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
