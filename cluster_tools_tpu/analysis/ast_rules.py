@@ -43,6 +43,12 @@ through runtime tests:
           (b) ``except Exception: pass`` (or a bare except) whose body is
           only ``pass`` — swallowing a block error without recording any
           status hides failures from the retry machinery and the operator.
+  CTT010  metric-name hygiene: a string literal passed to
+          ``metrics.inc``/``metrics.set_gauge`` that is not listed in
+          ``obs/registry.py`` (and matches no allowed dynamic prefix,
+          e.g. ``faults.injected.<site>``) — a typo silently creates a
+          fresh series nothing ever reads.  Non-literal names (f-strings,
+          variables) are the sanctioned dynamic path and are skipped.
 """
 
 from __future__ import annotations
@@ -64,6 +70,9 @@ register_rule("CTT007", "noqa comment references an unknown rule id")
 register_rule("CTT008", "wall-clock time.time() in duration/deadline math")
 register_rule(
     "CTT009", "ad-hoc sleep-retry loop / error-swallowing `except: pass`"
+)
+register_rule(
+    "CTT010", "metric name literal not in the obs/registry.py registry"
 )
 
 
@@ -495,6 +504,51 @@ def _check_resilience_hygiene(
 
 
 # --------------------------------------------------------------------------
+# CTT010: metric-name literals must come from obs/registry.py
+
+_METRIC_CALL_ATTRS = {"inc": "counter", "set_gauge": "gauge"}
+
+
+def _check_metric_names(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    """Flag ``<...>metrics.inc("name")`` / ``set_gauge("name")`` literals
+    absent from the registry.  Only literal first arguments are checked —
+    computed names (``f"faults.injected.{site}"``) are the dynamic path,
+    covered by the registry's prefix list."""
+    from ..obs import registry as metric_registry
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        name = dotted_name(node.func) or ""
+        parts = name.split(".")
+        if len(parts) < 2 or parts[-1] not in _METRIC_CALL_ATTRS:
+            continue
+        # the receiver must be a metrics module alias (`metrics`,
+        # `obs_metrics`); arbitrary objects with .inc() are not metrics
+        if "metrics" not in parts[-2]:
+            continue
+        arg = node.args[0]
+        if not isinstance(arg, ast.Constant) or not isinstance(arg.value, str):
+            continue
+        mname = arg.value
+        kind = _METRIC_CALL_ATTRS[parts[-1]]
+        known = (
+            metric_registry.is_known_counter(mname)
+            if kind == "counter"
+            else metric_registry.is_known_gauge(mname)
+        )
+        if not known:
+            findings.append(Finding(
+                "CTT010", path, node.lineno,
+                f"{kind} name '{mname}' is not in obs/registry.py — a "
+                "typo silently creates a series nothing reads; add it to "
+                "the registry (or a DYNAMIC_PREFIXES family)",
+            ))
+
+
+# --------------------------------------------------------------------------
 # CTT006: unregistered pytest markers
 
 # markers pytest itself (or its bundled plugins) always knows
@@ -613,6 +667,7 @@ def lint_source(
         _check_collectives(tree, path, findings)
         _check_wall_clock_math(tree, path, findings)
         _check_resilience_hygiene(tree, path, findings)
+        _check_metric_names(tree, path, findings)
         _SetIterVisitor(path, findings).visit(tree)
     _check_noqa_hygiene(source, path, findings)
 

@@ -1,14 +1,22 @@
-"""ctt-obs: structured tracing, metrics, and run-diff observability.
+"""ctt-obs: structured tracing, metrics, live telemetry, run-diff.
 
-Three pieces (see each module's docstring):
+Pieces (see each module's docstring):
 
-  * :mod:`.trace`   — process-safe span recorder (JSONL shards per
+  * :mod:`.trace`     — process-safe span recorder (JSONL shards per
     pid+thread, monotonic clocks, no-op fast path when disabled);
-  * :mod:`.metrics` — counters/gauges for hot paths (store IO bytes,
+  * :mod:`.metrics`   — counters/gauges for hot paths (store IO bytes,
     compile-cache hits, retry/failure counts, pipeline overlap);
-  * :mod:`.export`  — cross-process shard merge, per-task summaries,
-    Chrome ``trace_event`` export, and run-vs-run regression diff
-    (CLI: ``python -m cluster_tools_tpu.obs``).
+  * :mod:`.registry`  — the canonical list of counter/gauge names
+    (lint rule CTT010 keeps call sites honest);
+  * :mod:`.heartbeat` — ctt-watch liveness beats per executing process
+    (``hb.p<pid>.json`` every ``CTT_HEARTBEAT_S``) + the SIGTERM
+    preemption flush;
+  * :mod:`.live`      — incremental tailer over shards + heartbeats:
+    progress/ETA, stragglers, suspected-dead workers, block-duration
+    heatmap, OpenMetrics exposition (``watch``/``heatmap``/``prom``);
+  * :mod:`.export`    — post-mortem cross-process shard merge, per-task
+    summaries, Chrome ``trace_event`` export, and run-vs-run regression
+    diff (CLI: ``python -m cluster_tools_tpu.obs``).
 
 Enable by exporting ``CTT_TRACE_DIR=/some/dir`` before the run (child
 processes — scheduler workers, bench subprocesses, multi-host peers —

@@ -1,18 +1,30 @@
-"""CLI: ``python -m cluster_tools_tpu.obs`` — summarize / trace / diff.
+"""CLI: ``python -m cluster_tools_tpu.obs`` — post-mortem and live verbs.
+
+Post-mortem (strict: malformed traces fail loudly):
 
     python -m cluster_tools_tpu.obs summarize <run_dir> [--json]
     python -m cluster_tools_tpu.obs trace <run_dir> [-o trace.json]
     python -m cluster_tools_tpu.obs diff <base_run> <cand_run> \
         [--threshold 0.2] [--min-s 0.01] [--json]
 
+Live (ctt-watch: incremental, tolerant of in-flight writes):
+
+    python -m cluster_tools_tpu.obs watch <run_dir> [--once]
+        [--interval S] [--fail-on-stall] [--straggler-k K] [--json]
+    python -m cluster_tools_tpu.obs heatmap <run_dir> [--task NAME]
+    python -m cluster_tools_tpu.obs prom <run_dir>
+
 ``<run_dir>`` is either ``<CTT_TRACE_DIR>/<run_id>`` or a trace dir
 containing exactly one run.  Exit codes:
 
-  0  success (summarize: at least one task span; diff: no regression)
-  1  summarize found no task spans (a run that recorded nothing is a CI
-     failure, not a silent pass)
+  0  success (summarize: at least one task span; diff: no regression;
+     watch: block/task progress observed and no stall flagged)
+  1  nothing recorded (summarize: no task spans; watch --once: no
+     progress; heatmap: no finished blocks; prom: no run directory)
   2  malformed trace (truncated/corrupt shard, mixed runs, bad metrics)
   3  diff found at least one task regressed beyond the threshold
+  4  watch --fail-on-stall flagged a stale worker (heartbeat older than
+     3x its cadence: suspected dead before the deadline watchdog fires)
 """
 
 from __future__ import annotations
@@ -20,6 +32,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from .export import (
     TraceFormatError,
@@ -35,13 +48,14 @@ EXIT_OK = 0
 EXIT_NO_TASKS = 1
 EXIT_MALFORMED = 2
 EXIT_REGRESSED = 3
+EXIT_STALLED = 4
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m cluster_tools_tpu.obs",
         description="ctt-obs: merge, summarize, export, and diff "
-        "structured run traces",
+        "structured run traces; ctt-watch: live watch/heatmap/prom",
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -72,7 +86,37 @@ def main(argv=None) -> int:
                         "is jitter, not regression (default 0.01)")
     p_diff.add_argument("--json", action="store_true")
 
+    p_watch = sub.add_parser(
+        "watch", help="live progress/ETA/straggler report (ctt-watch)"
+    )
+    p_watch.add_argument("run")
+    p_watch.add_argument("--once", action="store_true",
+                         help="one poll + report, then exit (CI mode)")
+    p_watch.add_argument("--interval", type=float, default=5.0,
+                         help="poll cadence in seconds (default 5)")
+    p_watch.add_argument("--fail-on-stall", action="store_true",
+                         help="exit 4 as soon as a stale worker is flagged")
+    p_watch.add_argument("--straggler-k", type=float, default=4.0,
+                         help="flag in-flight blocks older than K x the "
+                         "median completed block duration (default 4)")
+    p_watch.add_argument("--json", action="store_true",
+                         help="one JSON snapshot object per poll")
+
+    p_heat = sub.add_parser(
+        "heatmap", help="z-slab text heatmap of per-block durations"
+    )
+    p_heat.add_argument("run")
+    p_heat.add_argument("--task", default=None,
+                        help="task identifier (default: most blocks done)")
+
+    p_prom = sub.add_parser(
+        "prom", help="OpenMetrics/Prometheus text exposition of the run"
+    )
+    p_prom.add_argument("run")
+
     args = parser.parse_args(argv)
+    if args.cmd in ("watch", "heatmap", "prom"):
+        return _live_main(args)
     try:
         if args.cmd == "summarize":
             summary = summarize(load_run(args.run))
@@ -112,6 +156,83 @@ def main(argv=None) -> int:
         print(f"obs: {e}", file=sys.stderr)
         return EXIT_MALFORMED
     raise AssertionError(f"unhandled command {args.cmd}")
+
+
+def _watch_exit_code(snap, fail_on_stall: bool) -> int:
+    if fail_on_stall and snap["n_stale"] > 0:
+        return EXIT_STALLED
+    return EXIT_OK if snap["progress"] else EXIT_NO_TASKS
+
+
+def _live_main(args) -> int:
+    from .live import (
+        LiveRun,
+        format_heatmap,
+        format_watch,
+        render_openmetrics,
+        resolve_live_dir,
+    )
+
+    run_dir = resolve_live_dir(args.run)
+    if args.cmd == "prom":
+        if run_dir is None:
+            print(f"obs: no run telemetry under {args.run}", file=sys.stderr)
+            return EXIT_NO_TASKS
+        print(render_openmetrics(LiveRun(run_dir).poll()), end="")
+        return EXIT_OK
+
+    if args.cmd == "heatmap":
+        if run_dir is None:
+            print(f"obs: no run telemetry under {args.run}", file=sys.stderr)
+            return EXIT_NO_TASKS
+        live = LiveRun(run_dir)
+        live.poll()
+        hm = live.heatmap(task=args.task)
+        if hm is None:
+            print("obs: no finished blocks to map yet", file=sys.stderr)
+            return EXIT_NO_TASKS
+        print(format_heatmap(hm))
+        return EXIT_OK
+
+    # watch: poll until progress settles (or forever without --once)
+    live = None
+    while True:
+        if run_dir is None:
+            run_dir = resolve_live_dir(args.run)
+        if run_dir is not None and live is None:
+            live = LiveRun(run_dir, straggler_k=args.straggler_k)
+        if live is None:
+            if args.once:
+                print(f"obs: no run telemetry under {args.run}",
+                      file=sys.stderr)
+                return EXIT_NO_TASKS
+            print(f"waiting for telemetry under {args.run} ...",
+                  file=sys.stderr)
+        else:
+            snap = live.poll()
+            if args.json:
+                print(json.dumps(snap, sort_keys=True))
+            else:
+                print(format_watch(snap))
+            sys.stdout.flush()
+            rc = _watch_exit_code(snap, args.fail_on_stall)
+            if args.once:
+                return rc
+            if rc == EXIT_STALLED:
+                return rc
+            # a finished run: every heartbeat says exiting and >= 1 task
+            # completed — stop polling a corpse
+            workers = snap["workers"]
+            if (
+                workers
+                and all(w["exiting"] for w in workers)
+                and any(r["complete"] for r in snap["tasks"].values())
+            ):
+                return EXIT_OK
+        try:
+            time.sleep(max(args.interval, 0.05))  # ctt: noqa[CTT009] poll cadence, not an IO retry — nothing here is retried
+        except KeyboardInterrupt:  # pragma: no cover - interactive only
+            return EXIT_OK
 
 
 if __name__ == "__main__":

@@ -40,6 +40,11 @@ Wired in:
     the serialized compute stage.
 
 Enabled exactly when tracing is enabled (one switch: CTT_TRACE_DIR).
+
+Naming: every counter/gauge name is listed in :mod:`obs.registry`
+(dynamic families like ``faults.injected.<site>`` by prefix) and lint
+rule CTT010 flags literals absent from it — a typo'd name would
+otherwise silently create a series nothing reads.
 """
 
 from __future__ import annotations

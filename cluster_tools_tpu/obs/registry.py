@@ -1,0 +1,84 @@
+"""ctt-obs metric-name registry: the canonical list of series names.
+
+Counters and gauges are stringly-typed at the call site
+(``metrics.inc("store.bytes_read")``) — a typo there does not fail, it
+silently creates a fresh series that no dashboard, bench contract, or
+``obs diff`` ever looks at.  This module is the single source of truth:
+
+  * every known counter/gauge name, grouped by owning subsystem;
+  * the allowed *dynamic* prefixes (``faults.injected.<site>`` is one
+    series per injection site by design);
+  * lint rule CTT010 (analysis/ast_rules.py) flags any
+    ``metrics.inc``/``set_gauge`` call whose literal name is not listed
+    here, so adding a metric means adding it to this registry — which is
+    exactly where README/COMPONENTS readers go looking for it.
+
+The live exporter (obs.live ``prom``) exposes whatever a run actually
+recorded; this registry is a *lint* namespace, not a runtime filter —
+dynamic names and future names degrade to "unknown series", never to
+dropped data.
+"""
+
+from __future__ import annotations
+
+__all__ = ["COUNTERS", "GAUGES", "DYNAMIC_PREFIXES", "is_known_counter",
+           "is_known_gauge"]
+
+# -- counters (metrics.inc) -------------------------------------------------
+
+COUNTERS = frozenset({
+    # utils/store.py — chunk IO at the codec boundary
+    "store.bytes_read",
+    "store.bytes_written",
+    "store.chunks_read",
+    "store.chunks_written",
+    "store.chunk_cache_hits",
+    "store.chunk_cache_misses",
+    "store.aligned_chunk_writes",
+    # utils/retry.py — backoff sleeps absorbed on transient chunk IO
+    "store.io_retries",
+    # utils/compile_cache.py — jax.monitoring persistent-cache events
+    "compile_cache.cache_hits",
+    "compile_cache.cache_misses",
+    "compile_cache.tasks_using_cache",
+    # runtime/task.py — retry machinery
+    "task.blocks_failed",
+    "task.blocks_retried",
+    # runtime/executor.py — dispatch + pipeline occupancy
+    "executor.batches",
+    "executor.batch_s",
+    "executor.dispatch_wall_s",
+    "executor.blocks_timed_out",
+    "executor.stage_batches",
+    "executor.stage_read_s",
+    "executor.stage_compute_s",
+    "executor.stage_write_s",
+    "executor.stage_hidden_io_s",
+    # faults/ — every fired injection (per-site series via prefix below)
+    "faults.injected",
+    # parallel/sharded.py — collective→local degradations
+    "sharded.fallback_local",
+})
+
+# -- gauges (metrics.set_gauge) ---------------------------------------------
+
+GAUGES = frozenset({
+    "compile_cache.entries_at_enable",
+})
+
+# dynamic name families: one series per <suffix>, allowed by prefix
+DYNAMIC_PREFIXES = (
+    "faults.injected.",  # per injection site (faults/__init__.py)
+)
+
+
+def _matches_prefix(name: str) -> bool:
+    return any(name.startswith(p) for p in DYNAMIC_PREFIXES)
+
+
+def is_known_counter(name: str) -> bool:
+    return name in COUNTERS or _matches_prefix(name)
+
+
+def is_known_gauge(name: str) -> bool:
+    return name in GAUGES or _matches_prefix(name)

@@ -36,6 +36,25 @@ Clock vocabulary for the rest of the codebase (enforced by lint rule
 CTT008): ``time.time()`` is for *timestamps* only; durations and deadlines
 use ``obs.trace.monotonic()`` (= ``time.monotonic()``) so a host clock
 jump can never fire or stall a timeout.
+
+Run-directory file formats (everything ``obs.live`` tails)::
+
+    spans.p<pid>.t<tid>.jsonl   append-only; line 1 a header record
+                                {"type": "header", "run", "pid", "tid",
+                                 "host", "wall", "mono"}  (the (wall, mono)
+                                anchor pair), then span records
+                                {"type": "span", "id", "parent", "name",
+                                 "kind", "t0", "t1", "pid", "tid",
+                                 "attrs"?}  with monotonic endpoints.
+    metrics.p<pid>.json         one snapshot per process, atomically
+                                replaced on flush: {"counters", "gauges"}.
+    hb.p<pid>.json              ctt-watch heartbeat, atomically replaced
+                                every CTT_HEARTBEAT_S while the process
+                                executes blocks: liveness + role/job id +
+                                progress counters + in-flight block ids +
+                                device-memory high-water + an (wall, mono)
+                                anchor and the promised cadence — full
+                                field list in obs/heartbeat.py.
 """
 
 from __future__ import annotations

@@ -70,8 +70,11 @@ def read_block_batch(
             arr = np.pad(arr, pad_width)
         return arr
 
+    # block_ids tag (ctt-watch): lets the live reader / Perfetto tie this
+    # host-IO interval to the specific volume regions it touched
     with obs_trace.span(
-        "read_block_batch", kind="host_io", blocks=len(blocks)
+        "read_block_batch", kind="host_io", blocks=len(blocks),
+        block_ids=[int(b) for b in block_ids],
     ):
         if n_threads > 1 and len(blocks) > 1:
             from concurrent.futures import ThreadPoolExecutor
@@ -150,7 +153,8 @@ def write_block_batch(
         ds[bh.inner.slicing] = arr
 
     with obs_trace.span(
-        "write_block_batch", kind="host_io", blocks=len(batch.blocks)
+        "write_block_batch", kind="host_io", blocks=len(batch.blocks),
+        block_ids=[int(b) for b in batch.block_ids],
     ):
         if n_threads > 1 and len(batch.blocks) > 1:
             from concurrent.futures import ThreadPoolExecutor

@@ -6,6 +6,13 @@ machine-readable per-job status JSON (the positive-success analog of the
 reference's ``processed job/block`` log lines, function_utils.py:11-16 —
 parsed back by the submitting process without log-grepping).
 
+Live telemetry (ctt-watch): when tracing is enabled the worker heartbeats
+(``obs/heartbeat.py`` — role ``worker`` + its scheduler job id) so the
+driver-side ``obs watch`` sees its progress and flags it stale if it hangs
+or dies; a scheduler SIGTERM (the common preemption path) flushes metrics
++ trace shards + one final ``exiting`` heartbeat before the process dies
+(``install_sigterm_flush``), so preempted work is visible, not lost.
+
 Failure surfaces (ctt-fault):
 
   * a corrupt ``task.pkl`` / ``job_N.json`` (torn write, version skew,
@@ -49,6 +56,12 @@ def _write_status(status_path: str, status: dict) -> None:
 
 def run_job(job_dir: str, job_id: int) -> int:
     task_path, config_path, status_path = job_paths(job_dir, job_id)
+    # preemption hook first: a SIGTERM during setup must already flush
+    # whatever telemetry exists (no-ops when tracing is disabled)
+    from ..obs import heartbeat as obs_heartbeat
+
+    obs_heartbeat.install_sigterm_flush()
+    obs_heartbeat.ensure_started(role="worker", job_id=job_id)
     try:
         with open(task_path, "rb") as f:
             task = pickle.load(f)
@@ -76,6 +89,13 @@ def run_job(job_dir: str, job_id: int) -> int:
 
     blocking = Blocking(job["shape"], job["block_shape"])
     config = dict(job["config"])
+    # this job's share in the heartbeat stream: run_blocks is driven
+    # directly here (no Task.run), so the task/total fields need setting
+    obs_heartbeat.note_task(
+        getattr(task, "identifier", "unknown"),
+        len(job["block_ids"]),
+        grid=blocking.grid_shape,
+    )
     # inside one scheduler job, blocks run through the plain local path
     config["target"] = "local"
     executor = LocalExecutor(config)
@@ -106,6 +126,9 @@ def run_job(job_dir: str, job_id: int) -> int:
     # ... and here dies AFTER the status landed (crash on the way out —
     # recorded work must survive, the submitter sees a normal status)
     faults.check("worker.exit", id=job_id)
+    # final exiting heartbeat: obs watch distinguishes this clean exit
+    # from a kill (whose last heartbeat goes stale instead)
+    obs_heartbeat.stop(final=True)
     obs_trace.flush()  # short-lived process: don't rely on atexit ordering
     return 0 if not status["failed"] else 1
 

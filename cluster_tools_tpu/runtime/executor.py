@@ -34,6 +34,7 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Any, Dict, List, Sequence, Tuple
 
 from .. import faults
+from ..obs import heartbeat as obs_heartbeat
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..utils.blocking import Blocking
@@ -85,6 +86,10 @@ class BaseExecutor:
 
     def __init__(self, config: Dict[str, Any]):
         self.config = config
+        # ctt-watch: every executing process (driver dispatch loop, or the
+        # LocalExecutor inside a scheduler worker) heartbeats while it
+        # owns blocks; one global check + no thread when tracing is off
+        obs_heartbeat.ensure_started()
 
     def run_blocks(
         self, task, blocking: Blocking, block_ids: Sequence[int], config: Dict[str, Any]
@@ -111,6 +116,7 @@ class LocalExecutor(BaseExecutor):
         durations: List[float] = []
 
         def _one(bid: int):
+            obs_heartbeat.note_block_start(bid)
             try:
                 faults.check("executor.block", id=bid)
                 t0 = time.perf_counter()
@@ -122,9 +128,13 @@ class LocalExecutor(BaseExecutor):
                 ):
                     task.process_block(bid, blocking, config)
                 durations.append(time.perf_counter() - t0)
+                obs_heartbeat.note_blocks_done()
                 return bid, None
             except Exception:
+                obs_heartbeat.note_blocks_failed()
                 return bid, traceback.format_exc()
+            finally:
+                obs_heartbeat.note_block_end(bid)
 
         deadline = block_deadline_s(config)
         with profiler_trace(config):
@@ -257,9 +267,11 @@ class TpuExecutor(BaseExecutor):
                 ):
                     task.process_block(bid, blocking, config)
                 done.append(bid)
+                obs_heartbeat.note_blocks_done()
             except Exception:
                 failed.append(bid)
                 errors[bid] = traceback.format_exc()
+                obs_heartbeat.note_blocks_failed()
         if not any(b in errors for b in chunk):
             # batch path is broken but every block succeeded per-block;
             # surface why without mislabeling a done block as failed
@@ -279,12 +291,17 @@ class TpuExecutor(BaseExecutor):
         batch_seconds: List[float] = []  # list.append: safe from pool threads
 
         def _one_batch(chunk):
+            # the batch's first block stands in for the whole batch in the
+            # heartbeat's in-flight list (straggler age tracking)
+            obs_heartbeat.note_block_start(chunk[0])
             try:
                 faults.check("executor.batch", id=chunk[0])
                 t0 = time.perf_counter()
+                # block_ids lets the live reader attribute the batch wall
+                # to each block (the spatial latency heatmap)
                 with obs_trace.span(
                     "block_batch", kind="device", task=task.identifier,
-                    blocks=len(chunk),
+                    blocks=len(chunk), block_ids=list(chunk),
                 ):
                     batch_fn(chunk, blocking, config)
                 dt = time.perf_counter() - t0
@@ -296,11 +313,14 @@ class TpuExecutor(BaseExecutor):
                     dt,
                 )
                 done.extend(chunk)
+                obs_heartbeat.note_blocks_done(len(chunk))
             except Exception:
                 self._per_block_fallback(
                     task, blocking, config, chunk, done, failed, errors,
                     traceback.format_exc(),
                 )
+            finally:
+                obs_heartbeat.note_block_end(chunk[0])
 
         # Batch pipelining (the reference's dask IO/compute overlap,
         # inference.py:319-327, moved into the executor).  A task whose
@@ -370,11 +390,12 @@ class TpuExecutor(BaseExecutor):
                 stage_s[stage] += dt
 
         def _read(chunk):
+            obs_heartbeat.note_block_start(chunk[0])
             faults.check("executor.stage_read", id=chunk[0])
             t0 = time.perf_counter()
             with obs_trace.span(
                 "stage_read", kind="host_io", task=task.identifier,
-                blocks=len(chunk),
+                blocks=len(chunk), block_ids=list(chunk),
             ):
                 payload = read_fn(chunk, blocking, config)
             _acc("read", time.perf_counter() - t0)
@@ -385,7 +406,7 @@ class TpuExecutor(BaseExecutor):
             t0 = time.perf_counter()
             with obs_trace.span(
                 "stage_write", kind="host_io", task=task.identifier,
-                blocks=len(chunk),
+                blocks=len(chunk), block_ids=list(chunk),
             ):
                 write_fn(result, blocking, config)
             _acc("write", time.perf_counter() - t0)
@@ -408,9 +429,12 @@ class TpuExecutor(BaseExecutor):
                         task, blocking, config, chunk, done, failed,
                         errors, traceback.format_exc(),
                     )
+                    obs_heartbeat.note_block_end(chunk[0])
                     return
                 batch_seconds.append(time.perf_counter() - t_batch0)
                 done.extend(chunk)
+                obs_heartbeat.note_blocks_done(len(chunk))
+                obs_heartbeat.note_block_end(chunk[0])
 
             def _drain_read():
                 chunk, fut = reads.popleft()
@@ -422,6 +446,7 @@ class TpuExecutor(BaseExecutor):
                     with obs_trace.span(
                         "stage_compute", kind="device",
                         task=task.identifier, blocks=len(chunk),
+                        block_ids=list(chunk),
                     ):
                         result = compute_fn(payload, blocking, config)
                     dt = time.perf_counter() - t0
@@ -433,6 +458,7 @@ class TpuExecutor(BaseExecutor):
                         task, blocking, config, chunk, done, failed,
                         errors, traceback.format_exc(),
                     )
+                    obs_heartbeat.note_block_end(chunk[0])
                     return
                 writes.append(
                     (chunk, write_pool.submit(_write, chunk, result),

@@ -26,6 +26,7 @@ import numpy as np
 
 from . import config as cfg
 from .. import faults
+from ..obs import heartbeat as obs_heartbeat
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..utils.blocking import Blocking, blocks_in_volume
@@ -501,6 +502,15 @@ class BlockTask(Task):
         self.prepare(blocking, config)
         executor = get_executor(config["target"], config)
 
+        # ctt-watch: publish this process's share + the blocking geometry
+        # to the heartbeat stream (live progress and the heatmap's grid);
+        # a resumed run starts from the already-done count
+        obs_heartbeat.note_task(
+            self.identifier, len(block_ids), grid=blocking.grid_shape
+        )
+        if done:
+            obs_heartbeat.note_blocks_done(len(done))
+
         max_retries = int(config.get("max_num_retries", 0))
         failure_fraction = float(config.get("retry_failure_fraction", 0.5))
         runtimes: List[float] = list(status.get("block_runtimes", []))
@@ -520,6 +530,7 @@ class BlockTask(Task):
             with obs_trace.span(
                 "dispatch", kind="dispatch", task=self.identifier,
                 attempt=attempt, blocks=len(todo),
+                grid=list(blocking.grid_shape),
             ):
                 newly_done, failed, errors = executor.run_blocks(
                     self, blocking, todo, config
@@ -553,6 +564,7 @@ class BlockTask(Task):
                 )
             attempt += 1
             obs_metrics.inc("task.blocks_retried", len(failed))
+            obs_heartbeat.note_blocks_retried(len(failed))
             self.log(f"retry {attempt}/{max_retries}: {len(failed)} failed blocks")
             todo = failed
 

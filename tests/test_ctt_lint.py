@@ -45,8 +45,8 @@ class TestRegistry:
     def test_all_shipped_rules_registered(self):
         expect = {
             "CTT001", "CTT002", "CTT003", "CTT004", "CTT005", "CTT006",
-            "CTT007", "CTT008", "CTT009", "CTT101", "CTT102", "CTT103",
-            "CTT104", "CTT105",
+            "CTT007", "CTT008", "CTT009", "CTT010", "CTT101", "CTT102",
+            "CTT103", "CTT104", "CTT105",
         }
         assert expect <= REGISTRY.known_ids()
         assert len(expect) >= 8
@@ -465,6 +465,102 @@ class TestCTT009:
             "        pass\n"
         )
         assert lint(src, path="cluster_tools_tpu/runtime/fake.py") == []
+
+
+# --------------------------------------------------------------------------
+# CTT010 metric-name registry hygiene
+
+
+class TestCTT010:
+    def test_unknown_counter_literal(self):
+        src = (
+            "from cluster_tools_tpu.obs import metrics as obs_metrics\n"
+            "def f():\n"
+            "    obs_metrics.inc('store.bytes_raed', 10)\n"
+        )
+        (f,) = lint(src, path="cluster_tools_tpu/utils/fake.py")
+        assert (f.rule_id, f.line) == ("CTT010", 3)
+        assert "store.bytes_raed" in f.message
+        assert "registry" in f.message
+
+    def test_unknown_gauge_literal(self):
+        src = (
+            "from cluster_tools_tpu.obs import metrics\n"
+            "def f():\n"
+            "    metrics.set_gauge('compile_cache.entries', 3)\n"
+        )
+        (f,) = lint(src, path="cluster_tools_tpu/utils/fake.py")
+        assert (f.rule_id, f.line) == ("CTT010", 3)
+
+    def test_counter_name_used_as_gauge_is_flagged(self):
+        # the registry is per-kind: inc'ing a gauge name is a typo too
+        src = (
+            "from cluster_tools_tpu.obs import metrics\n"
+            "def f():\n"
+            "    metrics.set_gauge('store.bytes_read', 1)\n"
+        )
+        (f,) = lint(src, path="cluster_tools_tpu/utils/fake.py")
+        assert f.rule_id == "CTT010"
+
+    def test_negative_registered_names(self):
+        src = (
+            "from cluster_tools_tpu.obs import metrics as obs_metrics\n"
+            "def f(n):\n"
+            "    obs_metrics.inc('store.bytes_read', n)\n"
+            "    obs_metrics.inc('executor.stage_hidden_io_s', 0.5)\n"
+            "    obs_metrics.set_gauge('compile_cache.entries_at_enable', n)\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/utils/fake.py") == []
+
+    def test_negative_dynamic_prefix_literal(self):
+        src = (
+            "from cluster_tools_tpu.obs import metrics\n"
+            "def f():\n"
+            "    metrics.inc('faults.injected.store.write')\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/faults/fake.py") == []
+
+    def test_negative_computed_names_are_the_dynamic_path(self):
+        src = (
+            "from cluster_tools_tpu.obs import metrics\n"
+            "def f(site, counter):\n"
+            "    metrics.inc(f'faults.injected.{site}')\n"
+            "    metrics.inc(counter)\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/faults/fake.py") == []
+
+    def test_negative_non_metrics_receiver(self):
+        # arbitrary objects with .inc()/.set_gauge() are not metric calls
+        src = (
+            "def f(counter_obj):\n"
+            "    counter_obj.inc('anything.goes')\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/utils/fake.py") == []
+
+    def test_real_tree_call_sites_are_all_registered(self):
+        # every literal inc/set_gauge in the shipped source must pass —
+        # the registry and the call sites cannot drift apart
+        import glob as _glob
+
+        pkg = os.path.join(REPO, "cluster_tools_tpu")
+        bad = []
+        for path in _glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True):
+            with open(path) as fh:
+                src = fh.read()
+            bad.extend(
+                f for f in lint_source(src, path, PYPROJECT)
+                if f.rule_id == "CTT010"
+            )
+        assert bad == [], [f.format() for f in bad]
+
+    def test_suppressible(self):
+        src = (
+            "from cluster_tools_tpu.obs import metrics\n"
+            "def f():\n"
+            "    metrics.inc('exp.series')  # ctt: noqa[CTT010] experiment-only series\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/utils/fake.py") == []
 
 
 # --------------------------------------------------------------------------

@@ -237,6 +237,161 @@ if [ "$chaos_rc" -ne 0 ]; then
     exit "$chaos_rc"
 fi
 
+echo "== ctt-watch smoke (live watch during a stub-scheduler run; kill -> stall) =="
+watch_tmp="$obs_tmp/watch"
+mkdir -p "$watch_tmp"
+cat > "$obs_tmp/watch_driver.py" <<'PY'
+import os, stat, sys
+import numpy as np
+from scipy import ndimage
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+td = sys.argv[1]
+sched = os.path.join(td, "sched")
+os.makedirs(sched, exist_ok=True)
+submit, queue = os.path.join(sched, "submit"), os.path.join(sched, "queue")
+with open(submit, "w") as f:
+    f.write('#!/bin/bash\nscript="${@: -1}"\nbash "$script" >/dev/null 2>&1\n'
+            'echo "Submitted batch job 1"\n')
+with open(queue, "w") as f:
+    f.write("#!/bin/bash\nexit 0\n")
+for p in (submit, queue):
+    os.chmod(p, os.stat(p).st_mode | stat.S_IEXEC)
+
+rng = np.random.default_rng(0)
+raw = ndimage.gaussian_filter(rng.random((16, 32, 32)), (1.0, 2.0, 2.0))
+raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+path = os.path.join(td, "ws.n5")
+file_reader(path).create_dataset("bnd", data=raw, chunks=(8, 16, 16))
+config_dir = os.path.join(td, "configs")
+cfg.write_global_config(config_dir, {
+    "block_shape": [8, 16, 16], "target": "slurm", "max_jobs": 2,
+    "max_num_retries": 3, "retry_failure_fraction": 0.9,
+    "poll_interval_s": 0.05, "sbatch_cmd": submit, "squeue_cmd": queue,
+    "worker_env": {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+})
+cfg.write_config(config_dir, "watershed", {
+    "threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+    "halo": [2, 4, 4],
+})
+wf = WatershedWorkflow(
+    os.path.join(td, "tmp"), config_dir, max_jobs=2,
+    input_path=path, input_key="bnd",
+    output_path=path, output_key="ws",
+)
+assert build([wf]), "watch smoke watershed build failed"
+PY
+
+# 1) healthy run in the background; `watch --once` must observe nonzero
+#    progress (exit 0) while/after it runs — the live contract
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+CTT_TRACE_DIR="$obs_tmp/trace" CTT_RUN_ID=ci_watch CTT_HEARTBEAT_S=0.2 \
+    python "$obs_tmp/watch_driver.py" "$watch_tmp/healthy" \
+    > "$watch_tmp/driver.log" 2>&1 &
+watch_driver_pid=$!
+watch_ok=1
+for _ in $(seq 1 240); do
+    if JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs watch --once \
+        "$obs_tmp/trace/ci_watch" >/dev/null 2>&1; then
+        watch_ok=0
+        break
+    fi
+    sleep 0.5
+done
+wait "$watch_driver_pid"
+watch_run_rc=$?
+if [ "$watch_run_rc" -ne 0 ]; then
+    cat "$watch_tmp/driver.log" >&2
+    echo "watch smoke watershed run failed (rc=$watch_run_rc)" >&2
+    exit "$watch_run_rc"
+fi
+if [ "$watch_ok" -ne 0 ]; then
+    echo "obs watch --once never observed progress during the run" >&2
+    exit 1
+fi
+JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs watch --once \
+    "$obs_tmp/trace/ci_watch"
+# the OpenMetrics exposition must parse (prometheus_client if available,
+# grammar check otherwise) — via a file: a heredoc would steal the
+# validator's stdin from the pipe
+JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs prom \
+    "$obs_tmp/trace/ci_watch" > "$watch_tmp/exposition.txt"
+prom_gen_rc=$?
+if [ "$prom_gen_rc" -ne 0 ]; then
+    echo "obs prom failed (rc=$prom_gen_rc)" >&2
+    exit "$prom_gen_rc"
+fi
+python - "$watch_tmp/exposition.txt" <<'PY'
+import re, sys
+with open(sys.argv[1]) as f:
+    text = f.read()
+lines = text.splitlines()
+assert lines and lines[-1] == "# EOF", "exposition must end with # EOF"
+try:
+    from prometheus_client.openmetrics.parser import (
+        text_string_to_metric_families,
+    )
+    families = list(text_string_to_metric_families(text))
+    assert families, "no metric families in exposition"
+except ImportError:
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.+eEinfa]+$")
+    meta = re.compile(r"^# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* \w+|HELP .+|EOF)$")
+    for line in lines:
+        assert sample.match(line) or meta.match(line), f"bad line: {line}"
+print("prom exposition ok")
+PY
+prom_rc=$?
+if [ "$prom_rc" -ne 0 ]; then
+    echo "obs prom output is not valid OpenMetrics (rc=$prom_rc)" >&2
+    exit "$prom_rc"
+fi
+
+# 2) worker-kill run (ctt-fault and ctt-watch validating each other): the
+#    killed job's heartbeat goes stale and `--fail-on-stall` must exit 4 —
+#    polled DURING the run (the flag should land before task completion;
+#    the stale file persists, so a post-run check is the deterministic
+#    fallback if the run finishes between polls)
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+CTT_TRACE_DIR="$obs_tmp/trace" CTT_RUN_ID=ci_watch_kill CTT_HEARTBEAT_S=0.2 \
+CTT_FAULTS="worker.job:kill:ids=0,once;seed=7" \
+CTT_FAULT_STATE_DIR="$watch_tmp/fault_state" \
+    python "$obs_tmp/watch_driver.py" "$watch_tmp/kill" \
+    > "$watch_tmp/kill_driver.log" 2>&1 &
+kill_driver_pid=$!
+stall_seen=1
+while kill -0 "$kill_driver_pid" 2>/dev/null; do
+    JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs watch --once \
+        --fail-on-stall "$obs_tmp/trace/ci_watch_kill" >/dev/null 2>&1
+    if [ $? -eq 4 ]; then
+        stall_seen=0
+        echo "stale worker flagged while the run was still in flight"
+        break
+    fi
+    sleep 0.5
+done
+wait "$kill_driver_pid"
+kill_rc=$?
+if [ "$kill_rc" -ne 0 ]; then
+    cat "$watch_tmp/kill_driver.log" >&2
+    echo "worker-kill watershed run did not recover (rc=$kill_rc)" >&2
+    exit "$kill_rc"
+fi
+JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs watch --once \
+    --fail-on-stall "$obs_tmp/trace/ci_watch_kill"
+stall_rc=$?
+if [ "$stall_rc" -ne 4 ]; then
+    echo "obs watch --fail-on-stall exited $stall_rc (wanted 4): the" \
+         "killed worker's stale heartbeat was not flagged" >&2
+    exit 1
+fi
+if [ "$stall_seen" -ne 0 ]; then
+    echo "note: stall only flagged post-run (run finished between polls)"
+fi
+echo "watch smoke ok: progress seen live, prom parsed, stale worker -> rc 4"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
