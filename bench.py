@@ -413,10 +413,25 @@ def bench_dtws_batched(x, batch, repeats):
 
 
 def bench_cc(x, repeats):
-    """Thresholded connected components: XLA CC vs scipy.ndimage.label."""
+    """Thresholded connected components: XLA CC vs scipy.ndimage.label.
+
+    ctt-cc contract: the headline follows the DEFAULT dispatch
+    (``_backend.use_coarse_cc()`` — flat seq-sweep on the CPU fallback,
+    coarse-to-fine on TPU), and ``extra`` records BOTH paths on the same
+    fixture (``cc_flat_*`` / ``cc_coarse_*`` + the winning tile of a small
+    tile sweep) plus the fixpoint round counts on the bench fixture and the
+    serpentine worst case, so the r06+ trajectory shows the flat/coarse
+    before/after regardless of which one a backend defaults to."""
     import jax.numpy as jnp
 
-    from cluster_tools_tpu.ops.cc import connected_components
+    from cluster_tools_tpu.ops import _backend as ctt_backend
+    from cluster_tools_tpu.ops.cc import (
+        connected_components,
+        connected_components_coarse_raw,
+        connected_components_raw_with_iters,
+        resolve_coarse_tile,
+        serpentine_mask,
+    )
 
     mask_np = x < 0.5
     t_dev, mode, times = _sweep_then_headline(
@@ -432,6 +447,89 @@ def bench_cc(x, repeats):
     )
     extra = {}
     import jax
+
+    # -- flat vs coarse on the same fixture (+ tile sweep) -------------------
+    m_dev = jnp.asarray(mask_np)
+    extra["cc_default_mode"] = (
+        "coarse" if ctt_backend.use_coarse_cc() else "flat"
+    )
+    reps = max(repeats // 2, 1)
+    span = reps + 1
+    # distinct-input variants per timing (the _rolled result-cache idiom);
+    # roll indices start past the headline's and the pallas block's budgets
+    base = 2 * repeats + 12
+
+    def _variants(start, call):
+        return [
+            (lambda m: lambda: call(m))(jnp.asarray(v < 0.5))
+            for v in _rolled(x, span, start=start)
+        ]
+
+    sync = lambda r: r[0].block_until_ready()  # noqa: E731
+    with ctt_backend.force_cc_mode("flat"):
+        t_flat = timeit(
+            None, reps, sync=sync,
+            variants=_variants(base, connected_components),
+        )
+        _, it_flat = jax.block_until_ready(
+            connected_components_raw_with_iters(m_dev)
+        )
+    extra["cc_flat_mvox_s"] = round(x.size / t_flat / 1e6, 3)
+    extra["cc_flat_vs_baseline"] = round(t_host / t_flat, 3)
+    extra["cc_fixpoint_iters_flat"] = int(it_flat)
+
+    sweep_tiles = {resolve_coarse_tile(x.shape, None)}
+    sweep_tiles.update(
+        resolve_coarse_tile(x.shape, t)
+        for t in ((8, 64, 64), (16, 128, 128), (32, 256, 256))
+    )
+    best = None
+    tile_sweep = {}
+    for i, tile in enumerate(sorted(sweep_tiles)):
+        t_c = timeit(
+            None, reps, sync=sync,
+            variants=_variants(
+                base + span * (i + 1),
+                lambda m, t=tile: connected_components(m, coarse_tile=t),
+            ),
+        )
+        tile_sweep[",".join(map(str, tile))] = round(x.size / t_c / 1e6, 3)
+        if best is None or t_c < best[1]:
+            best = (tile, t_c)
+    tile, t_coarse = best
+    _, stats = jax.block_until_ready(
+        connected_components_coarse_raw(m_dev, 1, None, False, tile)
+    )
+    extra["cc_coarse_mvox_s"] = round(x.size / t_coarse / 1e6, 3)
+    extra["cc_coarse_vs_baseline"] = round(t_host / t_coarse, 3)
+    extra["cc_coarse_tile"] = list(tile)
+    extra["cc_tile_sweep"] = tile_sweep
+    extra["cc_fixpoint_iters_coarse"] = int(stats["fixpoint_iters"])
+    extra["cc_live_tile_rounds"] = int(stats["live_tile_rounds"])
+    extra["cc_merge_pairs"] = int(stats["merge_pairs"])
+    log(
+        f"[cc] flat {t_flat*1e3:.1f} ms ({it_flat} rounds)  "
+        f"coarse {t_coarse*1e3:.1f} ms (tile {tile}, "
+        f"{int(stats['fixpoint_iters'])} rounds)  default="
+        f"{extra['cc_default_mode']}"
+    )
+
+    # serpentine worst case: the structural round-count win (tile-bounded
+    # vs diameter-bounded) that the random fixture cannot show
+    serp = jnp.asarray(serpentine_mask((4, 128, 128)))
+    _, it_s_flat = jax.block_until_ready(
+        connected_components_raw_with_iters(serp)
+    )
+    s_tile = resolve_coarse_tile(serp.shape, None)
+    _, s_stats = jax.block_until_ready(
+        connected_components_coarse_raw(serp, 1, None, False, s_tile)
+    )
+    extra["cc_serpentine_iters_flat"] = int(it_s_flat)
+    extra["cc_serpentine_iters_coarse"] = int(s_stats["fixpoint_iters"])
+    log(
+        f"[cc] serpentine rounds: flat {int(it_s_flat)} -> coarse "
+        f"{int(s_stats['fixpoint_iters'])}"
+    )
 
     if jax.default_backend() == "tpu" and not (
         x.shape[1] % 8 or x.shape[2] % 128
@@ -740,6 +838,19 @@ def bench_ws_e2e(x, block_shape):
             "ws_e2e_wall_s": round(t_dev, 2),
             "ws_e2e_warm_wall_s": round(t_dev_warm, 2),
         }
+        try:
+            from bench_e2e_lib import flood_rounds_probe
+
+            res.update(flood_rounds_probe(x))
+            log(
+                "[ws-e2e] flood rounds (alt+assign): flat "
+                f"{res['ws_flood_alt_iters_flat']}"
+                f"+{res['ws_flood_assign_iters_flat']} -> tiled "
+                f"{res['ws_flood_alt_iters_tiled']}"
+                f"+{res['ws_flood_assign_iters_tiled']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] flood rounds probe failed: {e}")
         # the warm run's three-stage pipeline breakdown: where the host
         # pipeline spent its stage seconds (read/compute/write occupancy),
         # so the IO-hiding claim is measurable in the contract, not asserted

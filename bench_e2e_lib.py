@@ -34,6 +34,44 @@ SHARDED_WS_CONFIG = {
 }
 
 
+def flood_rounds_probe(x, tile=(8, 64, 64)):
+    """Flood fixpoint round counts — flat vs ctt-cc tile-warm-started — on
+    the bench fixture's own DT-WS fields (threshold/sigma from
+    WS_TASK_CONFIG, per-slice production mode).  Rounds, not walls: the
+    crop is small and the point is the hierarchical-flood structural
+    contract (ops.watershed._flood_scan_impl), recorded alongside the ws
+    e2e walls in bench.py's extras."""
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.ops import watershed as ws_ops
+    from cluster_tools_tpu.ops.cc import resolve_coarse_tile
+    from cluster_tools_tpu.ops.dt import distance_transform_2d_stack
+
+    xv = jnp.asarray(np.asarray(x)[:8], jnp.float32)
+    fg = xv < WS_TASK_CONFIG["threshold"]
+    dt = distance_transform_2d_stack(fg, pixel_pitch=None)
+    seeds, _ = ws_ops.dt_seeds(
+        dt, WS_TASK_CONFIG["sigma_seeds"], per_slice=True
+    )
+    hmap = ws_ops.make_hmap(
+        xv, dt, 0.8, WS_TASK_CONFIG["sigma_seeds"], per_slice=True
+    )
+    out = {}
+    for tag, t in (
+        ("flat", None), ("tiled", resolve_coarse_tile(xv.shape, tile))
+    ):
+        _, _, stats = ws_ops.flood_with_stats(
+            hmap, seeds, fg, per_slice=True, tile=t
+        )
+        out[f"ws_flood_alt_iters_{tag}"] = int(stats["flood_alt_iters"])
+        out[f"ws_flood_assign_iters_{tag}"] = int(
+            stats["flood_assign_iters"]
+        )
+        if t is not None:
+            out["ws_flood_tile_iters"] = int(stats["flood_tile_iters"])
+    return out
+
+
 def stage_breakdown(tmp_folder):
     """Per-stage pipeline seconds summed over a run's status files — the
     three-stage executor's ``stage_{read,compute,write}_total`` records

@@ -54,6 +54,11 @@ COUNTERS = frozenset({
     "executor.stage_compute_s",
     "executor.stage_write_s",
     "executor.stage_hidden_io_s",
+    # ops/cc.py — ctt-cc coarse-to-fine kernel stats (host-side emission
+    # from the connected_components_coarse wrapper, never inside jit)
+    "cc.fixpoint_iters",
+    "cc.live_tiles",
+    "cc.merge_pairs",
     # faults/ — every fired injection (per-site series via prefix below)
     "faults.injected",
     # parallel/sharded.py — collective→local degradations

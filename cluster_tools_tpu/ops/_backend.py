@@ -145,6 +145,25 @@ def use_slices_cc() -> bool:
     return _mode("cc") == "slices"
 
 
+def use_coarse_cc() -> bool:
+    """Whether CC uses the coarse-to-fine tiled kernel (ops/cc.py ctt-cc:
+    tile-local fixpoints + compact boundary union-find) instead of the flat
+    whole-volume fixpoint.  ``CTT_CC_MODE=coarse|flat`` pins it; the default
+    follows the sweep-mode economics (the bench records both paths): on
+    TPU the tile-bounded round count + vmapped VMEM-friendly tiles win, on
+    the work-bound CPU mesh the seq-sweep flat kernel already converges in
+    a handful of rounds and the O(volume·log boundary) relabel gather of
+    the merge table costs more than the saved rounds (bench.py
+    ``cc_flat_vs_baseline`` / ``cc_coarse_vs_baseline``).  Both paths are
+    bit-exact on every input (tests/test_cc_coarse.py)."""
+    mode = _mode("cc")
+    if mode in ("coarse", "flat"):
+        return mode == "coarse"
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 def use_pallas_dtws() -> bool:
     """Whether the per-slice DT-watershed uses the fused Pallas kernel
     (ops/pallas_dtws.py, CTT_DTWS_MODE=pallas)."""
@@ -168,7 +187,7 @@ def force_flood_mode(mode):
 
 
 def force_cc_mode(mode):
-    """Scoped CC-mode override ('pallas' | 'slices' | 'xla')."""
+    """Scoped CC-mode override ('coarse' | 'flat' | 'pallas' | 'slices')."""
     return _force("cc", mode)
 
 

@@ -77,26 +77,11 @@ def _cc_slice_kernel(m_ref, o_ref):
     row = lax.broadcasted_iota(jnp.int32, (h_dim, w_dim), 0)
     col = lax.broadcasted_iota(jnp.int32, (h_dim, w_dim), 1)
     flat = (z * h_dim + row) * w_dim + col
-    label0 = jnp.where(mask, flat, _SENT)
-
     # true fixpoint loop: a capped fori_loop is NOT safe here — banded
     # serpentine corridors need Θ(H·W) rounds, far beyond any H+W-style
     # bound (each round resolves one directional segment of the
     # min-label propagation path, and a corridor can turn at every band)
-    def cond(carry):
-        _, changed = carry
-        return changed
-
-    def body(carry):
-        lab, _ = carry
-        new = lab
-        for axis in (0, 1):
-            for rev in (False, True):
-                new = _sweep_min(new, mask_i, axis, rev)
-        # reduce over int32, not i1 (same Mosaic i1 limitation)
-        return new, jnp.max((new != lab).astype(jnp.int32)) > 0
-
-    lab, _ = lax.while_loop(cond, body, (label0, jnp.bool_(True)))
+    lab = _cc_tile_fixpoint(mask_i, jnp.where(mask, flat, _SENT))
     o_ref[0] = jnp.where(mask, lab, jnp.int32(-1))
 
 
@@ -129,6 +114,119 @@ def pallas_connected_components(mask, interpret: bool = False):
     mask = mask.astype(bool)
     sliced = cc_slices(mask, interpret=interpret)
     return merge_slice_labels(mask, sliced)
+
+
+def _cc_tile_fixpoint(mask_i, label0):
+    """Min-label fixpoint of one in-VMEM 2d block: directional log-depth
+    sweeps iterated until stable (shared by the whole-slice and tiled
+    kernels; see ``_cc_slice_kernel`` for why the loop must be a true
+    fixpoint, not a capped fori_loop)."""
+
+    def cond(carry):
+        _, changed = carry
+        return changed
+
+    def body(carry):
+        lab, _ = carry
+        new = lab
+        for axis in (0, 1):
+            for rev in (False, True):
+                new = _sweep_min(new, mask_i, axis, rev)
+        # reduce over int32, not i1 (Mosaic i1 vreg bitcast limitation)
+        return new, jnp.max((new != lab).astype(jnp.int32)) > 0
+
+    lab, _ = lax.while_loop(cond, body, (label0, jnp.bool_(True)))
+    return lab
+
+
+@functools.partial(jax.jit, static_argnames=("tile_hw", "interpret"))
+def cc_tiles(mask, tile_hw, interpret: bool = False):
+    """Tile-local CC of a (N, H, W) bool volume: grid = (slices, tile rows,
+    tile cols), each (th, tw) tile labeled entirely in VMEM with the minimal
+    *volume* flat index of its in-tile component (background −1).  The
+    coarse-to-fine analog of ``cc_slices`` for slices too large to hold
+    whole in VMEM; fuse with ``ops.cc.merge_tiled_labels``."""
+    n, h, w = mask.shape
+    th, tw = tile_hw
+
+    def kernel(m_ref, o_ref):
+        mask_i = m_ref[0]
+        msk = mask_i != 0
+        z = pl.program_id(0)
+        row = lax.broadcasted_iota(jnp.int32, (th, tw), 0) + pl.program_id(1) * th
+        col = lax.broadcasted_iota(jnp.int32, (th, tw), 1) + pl.program_id(2) * tw
+        flat = (z * h + row) * w + col
+        lab = _cc_tile_fixpoint(mask_i, jnp.where(msk, flat, _SENT))
+        o_ref[0] = jnp.where(msk, lab, _NEG)
+
+    spec = lambda: pl.BlockSpec((1, th, tw), lambda i, j, k: (i, j, k))  # noqa: E731
+    return pl.pallas_call(
+        kernel,
+        grid=(n, h // th, w // tw),
+        in_specs=[spec()],
+        out_specs=spec(),
+        out_shape=jax.ShapeDtypeStruct((n, h, w), jnp.int32),
+        interpret=interpret,
+    )(mask.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("tile_hw", "interpret"))
+def pallas_connected_components_tiled(mask, tile_hw, interpret: bool = False):
+    """3d connectivity-1 CC via the tiled Pallas kernel + ONE compact
+    value-table merge over every tile face (z faces included — tile depth is
+    1, so the slice merge rides the same table).  Same ``(labels, n)``
+    contract as ``pallas_connected_components``/``ops.cc.connected_components``.
+    """
+    from .cc import merge_tiled_labels
+
+    mask = mask.astype(bool)
+    tiled = cc_tiles(mask, tile_hw, interpret=interpret)
+    return merge_tiled_labels(mask, tiled, (1,) + tuple(tile_hw))
+
+
+def pallas_cc_tile(shape):
+    """Tile shape for the tiled kernel: the largest lane-aligned divisors of
+    (H, W) — W tile a multiple of 128 up to 512, H tile a multiple of 8 up
+    to 256 — fitting the ~8-buffer VMEM budget; None when no aligned divisor
+    exists."""
+    _, h, w = shape
+    budget = 12 * 1024 * 1024 // (4 * 8)  # i32 elements per tile
+    tw = max(
+        (t for t in range(128, min(w, 512) + 1, 128) if w % t == 0),
+        default=None,
+    )
+    if tw is None:
+        return None
+    th = max(
+        (
+            t
+            for t in range(8, min(h, 256) + 1, 8)
+            if h % t == 0 and t * tw <= budget
+        ),
+        default=None,
+    )
+    if th is None:
+        return None
+    return (th, tw)
+
+
+def pallas_cc_tiled_available(shape, connectivity: int, per_slice: bool) -> bool:
+    """True when the TILED Pallas CC applies: the same opt-in and volume
+    conditions as ``pallas_cc_available`` but without the whole-slice VMEM
+    bound — slices of any size qualify as long as an aligned tile divisor
+    exists.  The dispatch in ``ops.cc.connected_components`` prefers the
+    whole-slice kernel when it fits."""
+    from . import _backend
+
+    if not _backend.use_pallas_cc():
+        return False
+    if per_slice or connectivity != 1 or len(shape) != 3:
+        return False
+    if shape[1] % 8 or shape[2] % 128:
+        return False
+    if pallas_cc_tile(shape) is None:
+        return False
+    return jax.default_backend() == "tpu"
 
 
 def pallas_cc_available(shape, connectivity: int, per_slice: bool) -> bool:

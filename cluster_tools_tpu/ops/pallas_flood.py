@@ -208,6 +208,78 @@ def flood_slices(hmap, seeds, mask, interpret: bool = False):
     )
 
 
+def _flood_tile_alt_kernel(h_ref, s_ref, m_ref, o_ref):
+    """Phase-1 (altitude) fixpoint of one in-VMEM tile — the ctt-cc
+    hierarchy warm start: tile-local altitudes are min-max passes of real
+    in-tile paths, a valid phase-1 over-approximation for the XLA global
+    loops (ops.watershed._flood_scan_impl's ``warm``).  Phase 2 is NOT
+    warm-started here on purpose: tile-local (hops, label) states against
+    tile-local altitudes can undercut the global fixpoint (see the
+    _flood_scan_impl docstring)."""
+    hmap = h_ref[0]
+    mask = m_ref[0] != 0
+    is_seed = (s_ref[0] > 0) & mask
+
+    def cond(carry):
+        _, changed = carry
+        return changed
+
+    def body(carry):
+        alt, _ = carry
+        new = alt
+        for axis in (0, 1):
+            for rev in (False, True):
+                new = _sweep_altitude(new, hmap, is_seed, mask, axis, rev)
+        # reduce over int32, not i1 (Mosaic i1 vreg bitcast limitation)
+        return new, jnp.max((new != alt).astype(jnp.int32)) > 0
+
+    alt0 = jnp.where(is_seed, hmap, _BIG)
+    alt, _ = lax.while_loop(cond, body, (alt0, jnp.bool_(True)))
+    o_ref[0] = alt
+
+
+@functools.partial(jax.jit, static_argnames=("tile_hw", "interpret"))
+def flood_tiles_warm(hmap, seeds, mask, tile_hw, interpret: bool = False):
+    """Tile-local flood-altitude fixpoints of a (N, H, W) volume: grid =
+    (slices, tile rows, tile cols), each (th, tw) tile relaxed entirely in
+    VMEM.  Returns the f32 warm altitude field (``_BIG`` outside mask) for
+    ``ops.watershed`` to finish globally — the Pallas leg of the
+    hierarchical flood."""
+    n, h, w = hmap.shape
+    th, tw = tile_hw
+    spec = lambda: pl.BlockSpec((1, th, tw), lambda i, j, k: (i, j, k))  # noqa: E731
+    return pl.pallas_call(
+        _flood_tile_alt_kernel,
+        grid=(n, h // th, w // tw),
+        in_specs=[spec(), spec(), spec()],
+        out_specs=spec(),
+        out_shape=jax.ShapeDtypeStruct((n, h, w), jnp.float32),
+        interpret=interpret,
+    )(
+        hmap.astype(jnp.float32),
+        seeds.astype(jnp.int32),
+        mask.astype(jnp.int32),
+    )
+
+
+def pallas_flood_tiled_available(shape, per_slice: bool, tile) -> bool:
+    """True when the tiled Pallas warm start applies: opted in
+    (CTT_FLOOD_MODE=pallas), 3d volume, TPU backend, and the flood tile's
+    in-plane extent exactly tiles a lane-aligned slice.  Valid for both 2d
+    and 3d floods (in-tile paths are real paths either way); the whole-slice
+    kernel is preferred when it applies (``pallas_flood_available``)."""
+    from . import _backend
+
+    if not _backend.use_pallas_flood():
+        return False
+    if len(shape) != 3 or len(tile) != 3:
+        return False
+    th, tw = int(tile[1]), int(tile[2])
+    if th % 8 or tw % 128 or shape[1] % th or shape[2] % tw:
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def pallas_flood_available(shape, per_slice: bool) -> bool:
     """True when the Pallas flood applies: opted in (CTT_FLOOD_MODE=pallas or
     a ``force_flood_mode('pallas')`` scope), per-slice mode, 3d volume, TPU

@@ -117,6 +117,49 @@ def merge_assignments_device(
     return _finalize_roots(roots, consecutive)
 
 
+def merge_value_table(
+    a_vals: jnp.ndarray, b_vals: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Union-find over the *values* appearing in equivalence pairs
+    ``(a_vals[i], b_vals[i])`` — the compact form of ``merge_labels_device``
+    for sparse id spaces (ctt-cc tile-face merging, parallel/sharded.py
+    shard-face merging): the parent table covers only the values that occur
+    in the pairs (O(#pairs) entries), not the dense id range they are drawn
+    from, so resolving tile-boundary equivalences of a volume costs
+    O(boundary), not O(volume).
+
+    Padding slots must carry the same value on both sides (self-loops merge
+    nothing).  Returns ``(vals, root_vals)``: ``vals`` is the sorted multiset
+    of all pair values; ``root_vals[i]`` is the minimum value of the
+    equivalence class of ``vals[i]``.  Apply with :func:`apply_value_roots`.
+
+    Min semantics ride the sort: compacted ids (positions in ``vals``) are
+    order-isomorphic to the values, so ``merge_labels_device``'s link-to-min
+    over ids resolves each class to its minimal *value*.  Duplicate values
+    share their leftmost slot (``searchsorted`` side='left'); the orphaned
+    right slots stay self-rooted and are never referenced.
+    """
+    vals = jnp.sort(jnp.concatenate([a_vals, b_vals]))
+    n = vals.shape[0]
+    ca = jnp.searchsorted(vals, a_vals).astype(jnp.int32)
+    cb = jnp.searchsorted(vals, b_vals).astype(jnp.int32)
+    edges = jnp.stack([ca, cb], axis=1)
+    roots = merge_labels_device(jnp.arange(n, dtype=jnp.int32), edges)
+    return vals, vals[roots]
+
+
+def apply_value_roots(
+    x: jnp.ndarray, vals: jnp.ndarray, root_vals: jnp.ndarray
+) -> jnp.ndarray:
+    """Map every element of ``x`` through a resolved value table from
+    :func:`merge_value_table`; values absent from ``vals`` pass through
+    unchanged (components never touching a boundary keep their label)."""
+    n = vals.shape[0]
+    idx = jnp.clip(jnp.searchsorted(vals, x), 0, n - 1).astype(jnp.int32)
+    hit = vals[idx] == x
+    return jnp.where(hit, root_vals[idx], x)
+
+
 @partial(jax.jit)
 def merge_labels_device(parent: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     """Device merge: ``parent`` is a dense [n] parent array, ``edges`` [m,2]

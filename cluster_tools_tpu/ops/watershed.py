@@ -37,7 +37,18 @@ import numpy as np
 from jax import lax
 
 from . import _backend
-from .cc import connected_components, neighbor_offsets, _shift
+from .cc import (
+    _canonical_offsets,
+    _shift,
+    _tile_grid,
+    connected_components,
+    neighbor_offsets,
+    parse_tile_spec,
+    resolve_coarse_tile,
+    tile_crossing_take,
+    tile_stack,
+    tile_unstack,
+)
 from .filters import gaussian, maximum_filter, normalize
 
 # numpy scalar, NOT jnp: a module-level jnp constant would initialize the
@@ -235,14 +246,9 @@ def _sweep_assign_assoc(dist, label, alt, hmap, is_seed, mask, axis, reverse):
     )
 
 
-@partial(jax.jit, static_argnames=("max_iter", "per_slice"))
-def _seeded_watershed_scan(
-    hmap: jnp.ndarray,
-    seeds: jnp.ndarray,
-    mask: jnp.ndarray,
-    max_iter: int = 0,
-    per_slice: bool = False,
-) -> jnp.ndarray:
+def _flood_scan_impl(
+    hmap, seeds, mask, max_iter, per_slice, tile, warm=None
+):
     """Directional-sweep flood (6-connectivity), two monotone phases:
 
       1. flood altitude A(p) = min over paths of (max h along path) by ±axis
@@ -256,12 +262,42 @@ def _seeded_watershed_scan(
     which is why the neighbor-sweep kernel recomputes states from scratch.
     Each phase alone is monotone, so every fixpoint state has an exact witness
     chain → regions are connected, labels reach their seeds.
+
+    ``tile`` (ctt-cc hierarchy reuse) warm-starts each phase from a
+    tile-local fixpoint on independent ``tile_stack``-ed tiles, so the
+    global loops only resolve cross-tile structure and their round count
+    drops to O(#cross-tile bends) while the fixpoint stays bit-identical
+    (tests/test_cc_coarse.py asserts both).  Exactness is an
+    over-approximation argument per phase: a warm state below the fixpoint
+    could never be corrected upward (relaxation only decreases), so each
+    warm state must be witnessed by a REAL feasible path —
+
+      * phase 1: in-tile relaxations are a subset of the global ones, so
+        tile-local altitudes are min-max passes of real paths (≥ fixpoint),
+        and a sweep-stable over-approximation with pinned seeds IS the
+        fixpoint (induction along an optimal path);
+      * phase 2 MUST warm-start against the GLOBAL altitude field, after
+        global phase 1: any path of globally-feasible edges
+        (A(p) == max(A(q), h(p))) is prefix-optimal, so in-tile (hops,
+        label) states over those edges are ≥ the fixpoint.  Running tile
+        phase 2 against the TILE-local altitudes instead would be wrong:
+        a tile path can be pass-optimal without being prefix-optimal, and
+        its smaller hop count would survive to a different label
+        tie-break.
+
+    ``warm`` injects an externally computed altitude warm state under the
+    same phase-1 witness contract (the tiled Pallas flood,
+    ops/pallas_flood.py — alt only, for exactly the phase-2 reason above).
+
+    Returns ``(label, alt, stats)`` with int32 round counters
+    ``flood_tile_iters`` / ``flood_alt_iters`` / ``flood_assign_iters``.
     """
     hmap = hmap.astype(jnp.float32)
     seeds = jnp.where(mask, seeds.astype(jnp.int32), 0)
     is_seed = seeds > 0
     big_dist = jnp.int32(np.iinfo(np.int32).max - 1)
-    axes = tuple(range(hmap.ndim))
+    ndim = hmap.ndim
+    axes = tuple(range(ndim))
     if per_slice:
         axes = axes[1:]  # z-slices independent: never sweep across axis 0
 
@@ -275,9 +311,39 @@ def _seeded_watershed_scan(
     def cond(state):
         return state[-2] if max_iter == 0 else state[-2] & (state[-1] < max_iter)
 
-    # -- phase 1: altitude ---------------------------------------------------
+    tile_iters = jnp.int32(0)
     alt0 = jnp.where(is_seed, hmap, _BIG)
+    label0 = seeds
+    dist0 = jnp.where(is_seed, 0, big_dist)
 
+    if warm is not None:
+        alt0 = jnp.minimum(alt0, warm)  # injected phase-1 warm altitudes
+
+    shape = hmap.shape
+    h_t = m_t = sd_t = None
+    t_axes = tuple(a + 1 for a in axes)
+    if tile is not None:
+        h_t = tile_stack(hmap, tile, _BIG)
+        m_t = tile_stack(mask, tile, False)
+        sd_t = tile_stack(is_seed, tile, False)
+
+        # -- tile-local phase-1 warm start ---------------------------------
+        def t_alt_body(state):
+            alt, _, it = state
+            prev = alt
+            for axis in t_axes:
+                for reverse in (False, True):
+                    alt = _sweep_altitude(alt, h_t, sd_t, m_t, axis, reverse)
+            return alt, jnp.any(alt != prev), it + 1
+
+        alt_t, _, it_a = lax.while_loop(
+            cond, t_alt_body,
+            (tile_stack(alt0, tile, _BIG), jnp.bool_(True), jnp.int32(0)),
+        )
+        alt0 = tile_unstack(alt_t, shape, tile)
+        tile_iters = tile_iters + it_a
+
+    # -- phase 1: altitude ---------------------------------------------------
     def alt_body(state):
         alt, _, it = state
         prev = alt
@@ -286,14 +352,40 @@ def _seeded_watershed_scan(
                 alt = _sweep_altitude(alt, hmap, is_seed, mask, axis, reverse)
         return alt, jnp.any(alt != prev), it + 1
 
-    alt, _, _ = lax.while_loop(
-        lambda s: cond(s), alt_body, (alt0, jnp.bool_(True), jnp.int32(0))
+    alt, _, alt_iters = lax.while_loop(
+        cond, alt_body, (alt0, jnp.bool_(True), jnp.int32(0))
     )
 
-    # -- phase 2: assignment -------------------------------------------------
-    label0 = seeds
-    dist0 = jnp.where(is_seed, 0, big_dist)
+    if tile is not None:
+        # -- tile-local phase-2 warm start against the GLOBAL altitude -----
+        # (see the docstring: tile-local altitudes would break exactness)
+        a_t = tile_stack(alt, tile, _BIG)
 
+        def t_asg_body(state):
+            dist, label, _, it = state
+            prev_d, prev_l = dist, label
+            for axis in t_axes:
+                for reverse in (False, True):
+                    dist, label = _sweep_assign(
+                        dist, label, a_t, h_t, sd_t, m_t, axis, reverse
+                    )
+            changed = jnp.any((dist != prev_d) | (label != prev_l))
+            return dist, label, changed, it + 1
+
+        dist_t, label_t, _, it_s = lax.while_loop(
+            cond, t_asg_body,
+            (
+                tile_stack(dist0, tile, big_dist),
+                tile_stack(label0, tile, 0),
+                jnp.bool_(True),
+                jnp.int32(0),
+            ),
+        )
+        dist0 = tile_unstack(dist_t, shape, tile)
+        label0 = tile_unstack(label_t, shape, tile)
+        tile_iters = tile_iters + it_s
+
+    # -- phase 2: assignment -------------------------------------------------
     def assign_body(state):
         dist, label, _, it = state
         prev_d, prev_l = dist, label
@@ -305,15 +397,154 @@ def _seeded_watershed_scan(
         changed = jnp.any((dist != prev_d) | (label != prev_l))
         return dist, label, changed, it + 1
 
-    _, label, _, _ = lax.while_loop(
-        lambda s: cond(s),
+    _, label, _, asg_iters = lax.while_loop(
+        cond,
         assign_body,
         (dist0, label0, jnp.bool_(True), jnp.int32(0)),
     )
-    return jnp.where(mask, label, 0)
+    stats = {
+        "flood_tile_iters": tile_iters,
+        "flood_alt_iters": alt_iters,
+        "flood_assign_iters": asg_iters,
+    }
+    return jnp.where(mask, label, 0), alt, stats
 
 
-@partial(jax.jit, static_argnames=("connectivity", "max_iter", "per_slice"))
+@partial(jax.jit, static_argnames=("max_iter", "per_slice", "tile"))
+def _seeded_watershed_scan(
+    hmap: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: jnp.ndarray,
+    max_iter: int = 0,
+    per_slice: bool = False,
+    tile: Optional[Tuple[int, ...]] = None,
+) -> jnp.ndarray:
+    """Flood labels of ``_flood_scan_impl`` (the documented kernel)."""
+    return _flood_scan_impl(hmap, seeds, mask, max_iter, per_slice, tile)[0]
+
+
+@partial(jax.jit, static_argnames=("per_slice", "tile"))
+def flood_with_stats(
+    hmap: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: jnp.ndarray,
+    per_slice: bool = False,
+    tile: Optional[Tuple[int, ...]] = None,
+):
+    """``(labels, alt, stats)`` of the sweep flood — the bench/CI hook for
+    the hierarchical-flood round contract (stats carries the tile/global
+    fixpoint round counters; ops/cc.py is the CC analog)."""
+    return _flood_scan_impl(hmap, seeds, mask, 0, per_slice, tile)
+
+
+_FLOOD_TILE_ENV = "CTT_FLOOD_TILE"
+
+
+def resolve_flood_tile(shape, coarse_tile=None):
+    """Flood warm-start tile precedence: explicit ``coarse_tile`` >
+    CTT_FLOOD_TILE env / chip_modes.json pin > None (= no tile warm start —
+    unlike CC the flood default stays flat, because the production floods
+    converge in <10 global rounds and the warm start pays off only where a
+    global round is expensive relative to tile rounds; the ws e2e bench
+    records both round counts so a chip pin can opt in)."""
+    if coarse_tile is None:
+        pin = _backend.pinned_value(_FLOOD_TILE_ENV)
+        if pin is None:
+            return None
+        tile = parse_tile_spec(pin, len(shape))
+        if tile is None:
+            import warnings
+
+            warnings.warn(
+                f"invalid {_FLOOD_TILE_ENV}={pin!r}; tile warm start off",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return None
+        return tuple(max(1, min(int(t), int(s))) for t, s in zip(tile, shape))
+    return resolve_coarse_tile(shape, coarse_tile)
+
+
+@partial(jax.jit, static_argnames=("connectivity", "per_slice", "tile"))
+def flood_merge_table(
+    labels: jnp.ndarray,
+    heights: jnp.ndarray,
+    tile: Tuple[int, ...],
+    connectivity: int = 1,
+    per_slice: bool = False,
+):
+    """Tile-face region-merge table of a flooded labeling: for every
+    adjacency (p, p+off) crossing a tile face, the label pair and the edge's
+    saddle height max(heights[p], heights[p+off]).  Returns static-shape
+    ``(a, b, saddle)`` flat arrays; slots that are not a real inter-region
+    edge (background, same label, non-crossing) carry ``(0, 0, _BIG)``.
+
+    This is the ctt-cc hierarchy hook for multi-threshold hierarchical
+    segmentation (arXiv:2410.08946's merge-tree shape): thresholding
+    ``saddle`` and resolving ``(a, b)`` with ops.unionfind.merge_value_table
+    yields the segmentation at any coarser level WITHOUT re-flooding —
+    deliberately returned raw (min-reduction per pair is the later PR's
+    job).  Pass the flood's height map for basin saddles, or its altitude
+    field (``flood_with_stats``) for seed-relative pass heights."""
+    shape = labels.shape
+    grid = _tile_grid(shape, tile)
+    a_parts, b_parts, s_parts = [], [], []
+    for off in _canonical_offsets(len(shape), connectivity, per_slice):
+        if all(o == 0 or grid[ax] == 1 for ax, o in enumerate(off)):
+            continue
+        nei_l = _shift(labels, off, jnp.int32(0))
+        nei_h = _shift(heights, off, _BIG)
+        for slabs in tile_crossing_take(
+            (labels, nei_l, heights, nei_h), off, tile, grid
+        ):
+            a_v, b_v, h_a, h_b = slabs
+            ok = (a_v > 0) & (b_v > 0) & (a_v != b_v)
+            a_parts.append(jnp.where(ok, a_v, 0))
+            b_parts.append(jnp.where(ok, b_v, 0))
+            s_parts.append(jnp.where(ok, jnp.maximum(h_a, h_b), _BIG))
+    if not a_parts:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z, jnp.zeros((0,), jnp.float32)
+    return (
+        jnp.concatenate(a_parts),
+        jnp.concatenate(b_parts),
+        jnp.concatenate(s_parts),
+    )
+
+
+def seeded_watershed_hier(
+    hmap: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    coarse_tile=None,
+    per_slice: bool = False,
+):
+    """Hierarchical seeded flood: tile-warm-started sweep flood (labels are
+    bit-identical to ``seeded_watershed``) plus the tile-face merge table of
+    the result over the height map — ``(labels, (a, b, saddle), stats)``.
+    The merge table + stats are the multi-threshold-segmentation and bench
+    hooks; ``coarse_tile`` defaults through CTT_FLOOD_TILE then the CC
+    default tile (this entry point always tiles — it IS the hierarchy)."""
+    mask_arr = (
+        jnp.ones(hmap.shape, dtype=bool) if mask is None
+        else mask.astype(bool)
+    )
+    tile = resolve_flood_tile(hmap.shape, coarse_tile)
+    if tile is None:
+        tile = resolve_coarse_tile(hmap.shape, None)
+    labels, _, stats = flood_with_stats(
+        hmap, seeds, mask_arr, per_slice=per_slice, tile=tile
+    )
+    table = flood_merge_table(
+        labels, hmap.astype(jnp.float32), tile, per_slice=per_slice
+    )
+    return labels, table, stats
+
+
+@partial(
+    jax.jit,
+    static_argnames=("connectivity", "max_iter", "per_slice", "coarse_tile"),
+)
 def seeded_watershed(
     hmap: jnp.ndarray,
     seeds: jnp.ndarray,
@@ -321,24 +552,47 @@ def seeded_watershed(
     connectivity: int = 1,
     max_iter: int = 0,
     per_slice: bool = False,
+    coarse_tile: Optional[Tuple[int, ...]] = None,
 ) -> jnp.ndarray:
     """Flood ``seeds`` (int32, 0 = unlabeled) over height map ``hmap``.
 
     Voxels outside ``mask`` stay 0 and do not conduct floods.  ``max_iter=0``
     iterates to the fixpoint.  ``per_slice`` floods each z-slice independently
     (the reference's 2d watershed mode, watershed.py:120-137).
+    ``coarse_tile`` (or a CTT_FLOOD_TILE pin) warm-starts the sweep flood
+    from tile-local fixpoints — identical labels, fewer global rounds (see
+    ``_flood_scan_impl``); only the fixpoint scan path tiles (``max_iter``
+    caps count global rounds, so a warm start would change their meaning).
     """
     if mask is None:
         mask_arr = jnp.ones(hmap.shape, dtype=bool)
     else:
         mask_arr = mask.astype(bool)
     if connectivity == 1:
+        tile = resolve_flood_tile(hmap.shape, coarse_tile)
         if max_iter == 0:
-            from .pallas_flood import flood_slices, pallas_flood_available
+            from .pallas_flood import (
+                flood_slices,
+                flood_tiles_warm,
+                pallas_flood_available,
+                pallas_flood_tiled_available,
+            )
 
             if pallas_flood_available(hmap.shape, per_slice):
                 # whole-slice flood in VMEM (opt-in, CTT_FLOOD_MODE=pallas)
                 return flood_slices(hmap, seeds, mask_arr)
+            if tile is not None and pallas_flood_tiled_available(
+                hmap.shape, per_slice, tile
+            ):
+                # tile-local altitude fixpoints in VMEM as the phase-1 warm
+                # state; the XLA loops finish the cross-tile structure
+                warm = flood_tiles_warm(hmap, seeds, mask_arr, tile[1:])
+                return _flood_scan_impl(
+                    hmap, seeds, mask_arr, 0, per_slice, tile, warm=warm
+                )[0]
+            return _seeded_watershed_scan(
+                hmap, seeds, mask_arr, per_slice=per_slice, tile=tile
+            )
         return _seeded_watershed_scan(
             hmap, seeds, mask_arr, max_iter=max_iter, per_slice=per_slice
         )

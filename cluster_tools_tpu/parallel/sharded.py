@@ -79,7 +79,15 @@ import warnings
 from .. import faults
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
-from ..ops.cc import _min_sweep, _shift, neighbor_offsets
+from ..ops.cc import (
+    _coarse_cc_core,
+    _min_sweep,
+    _min_sweep_seq,
+    _shift,
+    boundary_cross_offsets,
+    neighbor_offsets,
+    resolve_coarse_tile,
+)
 from .mesh import get_mesh, put_global
 
 
@@ -207,23 +215,21 @@ def _update_boundary(state, combine, lo, hi, z_local):
 
 
 def _local_relax(label, mask, offsets, axes, size, shard_offset, local_size):
-    """One round of per-shard relaxation: min-label propagation (log-depth
-    axis sweeps on the assoc path — the same CTT_SWEEP_MODE switch every
-    sweep kernel honors — shift-propagation otherwise), then two pointer
-    jumps (only labels rooted inside this shard can be jumped locally)."""
+    """One round of per-shard relaxation: min-label propagation (directional
+    axis sweeps — log-depth ``_min_sweep`` on the assoc path, the ctt-cc
+    sequential-carry ``_min_sweep_seq`` otherwise, the same CTT_SWEEP_MODE
+    switch every sweep kernel honors; diagonal offsets keep one-voxel
+    shifts), then two pointer jumps (only labels rooted inside this shard
+    can be jumped locally)."""
     from ..ops import _backend
 
     sentinel = jnp.int32(size)
     new = label
-    sweep = _backend.use_assoc()
-    prop = (
-        [o for o in offsets if sum(c != 0 for c in o) > 1] if sweep
-        else list(offsets)
-    )
-    if sweep:
-        for axis in axes:
-            for reverse in (False, True):
-                new = _min_sweep(new, mask, None, axis, reverse, sentinel)
+    sweep_fn = _min_sweep if _backend.use_assoc() else _min_sweep_seq
+    prop = [o for o in offsets if sum(c != 0 for c in o) > 1]
+    for axis in axes:
+        for reverse in (False, True):
+            new = sweep_fn(new, mask, None, axis, reverse, sentinel)
     if prop:
         best = new
         for off in prop:
@@ -244,6 +250,15 @@ def _local_relax(label, mask, offsets, axes, size, shard_offset, local_size):
 
 @partial(jax.jit, static_argnames=("connectivity", "axis_name", "mesh"))
 def _sharded_cc(mask, connectivity, axis_name, mesh):
+    """Coarse-to-fine CC at shard granularity (ctt-cc, the shard-level
+    instance of ops/cc.py's tile scheme): each shard labels its slab to its
+    LOCAL fixpoint in global-id space (no collectives — the rounds are
+    bounded by in-shard structure), then ONE plane exchange + all-gather
+    builds the complete cross-shard equivalence table, resolved by the
+    compact value union-find replicated on every shard and applied with one
+    gather.  Replaces the pre-ctt-cc global fixpoint loop, whose label
+    information crawled one shard per round (local relax + plane merge +
+    psum vote, O(n_shards · local rounds) collective rounds)."""
     shape = mask.shape
     size = int(np.prod(shape))
     if size >= np.iinfo(np.int32).max:
@@ -253,57 +268,65 @@ def _sharded_cc(mask, connectivity, axis_name, mesh):
     local_size = z_local * int(np.prod(shape[1:]))
     offsets = neighbor_offsets(3, connectivity)
     # cross-boundary offsets, expressed as in-plane shifts of the received
-    # neighbor plane (dz = ±1 face/diagonal connections); deduped — both dz
-    # signs map to the same in-plane shift
-    cross = sorted({tuple(int(c) for c in o[1:]) for o in offsets if o[0] != 0})
+    # neighbor plane (dz = ±1 face/diagonal connections) — the ONE shared
+    # derivation in ops/cc.py, so connectivity semantics cannot drift
+    cross = boundary_cross_offsets(3, connectivity)
+    from ..ops import _backend
+    from ..ops.unionfind import apply_value_roots, merge_value_table
+
+    local_shape = (z_local,) + shape[1:]
+    coarse = _backend.use_coarse_cc()
+    tile = resolve_coarse_tile(local_shape, None) if coarse else None
 
     def local_fn(m):
         shard = lax.axis_index(axis_name)
         offset = shard * local_size
-        flat = (
-            jnp.arange(local_size, dtype=jnp.int32).reshape((z_local,) + shape[1:])
+        gids = (
+            jnp.arange(local_size, dtype=jnp.int32).reshape(local_shape)
             + offset
         )
         sentinel = jnp.int32(size)
-        init = jnp.where(m, flat, sentinel)
 
-        def boundary_merge(label):
-            # exchange boundary label+mask planes with both z-neighbors and
-            # min-combine over every cross-boundary connection
-            lo, hi = _exchange_planes((label, m), axis_name)
-
-            def combine(own, got, plane_idx):
-                (own_lab,) = own
-                got_lab, got_msk = got
-                own_msk = m[plane_idx]
-                best = own_lab
-                for off in cross:
-                    g_lab = _shift(got_lab, off, sentinel)
-                    g_msk = _shift(got_msk, off, False)
-                    best = jnp.minimum(
-                        best, jnp.where(own_msk & g_msk, g_lab, sentinel)
-                    )
-                return (best,)
-
-            (out,) = _update_boundary((label,), combine, lo, hi, z_local)
-            return out
-
-        def cond(state):
-            _, changed = state
-            return changed
-
-        def body(state):
-            label, _ = state
-            new = _local_relax(
-                label, m, offsets, (0, 1, 2), size, offset, local_size
+        # -- stage 1: shard-local fixpoint, global-id labels ---------------
+        if coarse:
+            label, _ = _coarse_cc_core(
+                m, gids, size, connectivity, None, False, tile
             )
-            new = boundary_merge(new)
-            changed = jnp.any(new != label)
-            # every shard must agree on termination: global OR via psum
-            changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
-            return new, changed
+        else:
+            init = jnp.where(m, gids, sentinel)
 
-        label, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
+            def body(state):
+                lab, _ = state
+                new = _local_relax(
+                    lab, m, offsets, (0, 1, 2), size, offset, local_size
+                )
+                return new, jnp.any(new != lab)
+
+            label, _ = lax.while_loop(
+                lambda s: s[1], body, (init, jnp.bool_(True))
+            )
+
+        if n_shards == 1:
+            return jnp.where(m, label, jnp.int32(-1))
+
+        # -- stage 2: one all-gathered boundary table ----------------------
+        # each shard contributes its +z face: own last plane against the +z
+        # neighbor's first plane (zero-filled mask past the mesh edge, so
+        # the last shard contributes only self-loop padding)
+        _, hi = _exchange_planes((label, m), axis_name)
+        hi_lab, hi_msk = hi
+        own_lab, own_msk = label[-1], m[-1]
+        a_parts, b_parts = [], []
+        for off in cross:
+            g_lab = _shift(hi_lab, off, sentinel)
+            g_msk = _shift(hi_msk, off, False)
+            ok = own_msk & g_msk & (g_lab < sentinel)
+            a_parts.append(jnp.where(ok, own_lab, sentinel).reshape(-1))
+            b_parts.append(jnp.where(ok, g_lab, sentinel).reshape(-1))
+        a = lax.all_gather(jnp.concatenate(a_parts), axis_name).reshape(-1)
+        b = lax.all_gather(jnp.concatenate(b_parts), axis_name).reshape(-1)
+        vals, root_vals = merge_value_table(a, b)
+        label = apply_value_roots(label, vals, root_vals)
         return jnp.where(m, label, jnp.int32(-1))
 
     fn = shard_map(

@@ -37,8 +37,11 @@ OFFSETS_NAME = "thresholded_components_offsets.npz"
 ASSIGNMENTS_NAME = "thresholded_components_assignments.npy"
 
 
-@partial(jax.jit, static_argnames=("mode", "sigma", "connectivity"))
-def _components_batch(batch, threshold, mode, sigma, connectivity):
+@partial(
+    jax.jit, static_argnames=("mode", "sigma", "connectivity", "coarse_tile")
+)
+def _components_batch(batch, threshold, mode, sigma, connectivity,
+                      coarse_tile=None):
     x = batch
     if sigma:
         x = jax.vmap(lambda b: filters.gaussian(b, sigma))(x)
@@ -48,7 +51,11 @@ def _components_batch(batch, threshold, mode, sigma, connectivity):
         mask = x < threshold
     else:
         mask = x == threshold
-    labels, n = jax.vmap(lambda m: cc_ops.connected_components(m, connectivity))(mask)
+    labels, n = jax.vmap(
+        lambda m: cc_ops.connected_components(
+            m, connectivity, coarse_tile=coarse_tile
+        )
+    )(mask)
     return labels, n
 
 
@@ -73,6 +80,9 @@ class BlockComponentsTask(VolumeTask):
                 "threshold_mode": "greater",
                 "sigma": 0.0,
                 "connectivity": 1,
+                # ctt-cc coarse-to-fine tile (None = CTT_CC_TILE env pin /
+                # backend default — see ops/cc.resolve_coarse_tile)
+                "coarse_tile": None,
             }
         )
         return conf
@@ -101,12 +111,16 @@ class BlockComponentsTask(VolumeTask):
         if isinstance(sigma, list):
             sigma = tuple(sigma)
         xb, n = put_sharded(batch.data, config)
+        coarse_tile = config.get("coarse_tile", None)
+        if coarse_tile is not None and not isinstance(coarse_tile, int):
+            coarse_tile = tuple(coarse_tile)
         labels, _ = _components_batch(
             xb,
             float(config.get("threshold", 0.5)),
             config.get("threshold_mode", "greater"),
             sigma,
             int(config.get("connectivity", 1)),
+            coarse_tile,
         )
         labels = np.array(labels[:n])  # writable host copy (mask edit below)
         if masks is not None:

@@ -32,7 +32,8 @@ MAX_IDS_KEY = "watershed/max_ids"
 
 
 @lru_cache(maxsize=32)
-def _fused_ws_kernel(params_key, block_shape, with_mask: bool, crop_cc: bool):
+def _fused_ws_kernel(params_key, block_shape, with_mask: bool, crop_cc: bool,
+                     coarse_tile=None):
     """One jitted program per config: flood → per-block dynamic-slice crop to
     the inner box → CC re-close (reference watershed.py:329-333), vmapped
     over the stacked block batch.
@@ -56,7 +57,10 @@ def _fused_ws_kernel(params_key, block_shape, with_mask: bool, crop_cc: bool):
             lab, _ = kernel(x, valid=v)
         if crop_cc:
             lab = lax.dynamic_slice(lab, (start[0], start[1], start[2]), bs)
-            lab, _ = connected_components_labels(lab)
+            # re-close through the ctt-cc kernel: the same
+            # connected_components() dispatch as every other CC call site
+            # (coarse_tile config knob > CTT_CC_TILE pin > backend default)
+            lab, _ = connected_components_labels(lab, coarse_tile=coarse_tile)
         return lab
 
     if with_mask:
@@ -137,6 +141,9 @@ class WatershedTask(VolumeTask):
                 "channel_end": None,
                 "agglomerate_channels": "mean",
                 "non_maximum_suppression": False,
+                # ctt-cc tile for the halo-crop CC re-close (None =
+                # CTT_CC_TILE env pin / backend default)
+                "coarse_tile": None,
             }
         )
         return conf
@@ -205,11 +212,15 @@ class WatershedTask(VolumeTask):
         halo = config.get("halo") or [0, 0, 0]
         params = self._kernel_params(config)
         has_halo = any(h > 0 for h in halo)
+        coarse_tile = config.get("coarse_tile", None)
+        if coarse_tile is not None and not isinstance(coarse_tile, int):
+            coarse_tile = tuple(coarse_tile)
         fused = _fused_ws_kernel(
             tuple(sorted(params.items())),
             tuple(blocking.block_shape),
             mask is not None,
             has_halo,
+            coarse_tile,
         )
         starts = np.array(
             [bh.inner_local.begin for bh in batch.blocks], dtype=np.int32

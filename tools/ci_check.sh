@@ -392,6 +392,42 @@ if [ "$stall_seen" -ne 0 ]; then
 fi
 echo "watch smoke ok: progress seen live, prom parsed, stale worker -> rc 4"
 
+echo "== ctt-cc smoke (coarse kernel parity + tile-bounded rounds) =="
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from cluster_tools_tpu.ops import cc
+
+# parity: the coarse kernel must be BIT-exact with the numpy oracle on the
+# serpentine worst case and a random fixture
+for mask in (
+    cc.serpentine_mask((4, 64, 64)),
+    np.random.default_rng(0).random((12, 24, 24)) < 0.5,
+):
+    ref, n_ref = cc.connected_components_np(mask)
+    got, n = cc.connected_components(jnp.asarray(mask), coarse_tile=(4, 16, 16))
+    assert int(n) == n_ref, (int(n), n_ref)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+
+# iteration contract: tile-bounded rounds strictly below the flat kernel's
+# diameter-bounded count on the serpentine corridor
+serp = jnp.asarray(cc.serpentine_mask((4, 64, 64)))
+_, it_flat = cc.connected_components_raw_with_iters(serp)
+_, stats = cc.connected_components_coarse_raw(serp, 1, None, False, (4, 16, 16))
+it_coarse = int(stats["fixpoint_iters"])
+assert it_coarse < int(it_flat), (it_coarse, int(it_flat))
+print(f"cc smoke ok: parity exact, serpentine rounds {int(it_flat)} -> {it_coarse}")
+PY
+cc_rc=$?
+if [ "$cc_rc" -ne 0 ]; then
+    echo "ctt-cc smoke failed (rc=$cc_rc): coarse kernel parity or the" \
+         "round contract regressed" >&2
+    exit "$cc_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
