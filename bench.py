@@ -860,6 +860,27 @@ def bench_ws_e2e(x, block_shape):
             res["ws_e2e_sharded_wall_s"] = round(t_sh, 2)
             res["ws_e2e_sharded_warm_wall_s"] = round(t_sh_warm, 2)
         try:
+            # ctt-stream: fused threshold→CC→watershed chain vs the same
+            # workflow task-at-a-time — store-byte traffic for both, so
+            # the scratch round-trip reduction is a recorded number
+            from bench_e2e_lib import run_stream_pipeline
+
+            stream_res = run_stream_pipeline(
+                vol_path, x.shape, block_shape, "tpu"
+            )
+            res.update(stream_res)
+            log(
+                "[ws-e2e] ctt-stream fused chain: bytes_read "
+                f"{stream_res['ws_e2e_store_bytes_read']} -> "
+                f"{stream_res['ws_e2e_stream_store_bytes_read']} "
+                f"({stream_res['ws_e2e_stream_read_reduction']}x), warm "
+                f"wall {stream_res['ws_e2e_stream_warm_wall_s']} s vs "
+                f"unfused {stream_res['ws_e2e_stream_unfused_warm_wall_s']}"
+                f" s, parity {stream_res['ws_e2e_stream_parity']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] ctt-stream bench failed: {e}")
+        try:
             # below the driver's 450 s ws budget so a slow baseline can
             # never take the already-measured device numbers down with it
             out = subprocess.run(

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from .core import Finding, filter_suppressed, register_rule
 
+register_rule("CTT011", "fused streaming chain contract violation")
 register_rule("CTT101", "dependency cycle in a workflow task DAG")
 register_rule("CTT102", "task input not produced upstream nor external")
 register_rule("CTT103", "config key read outside the accepted schema")
@@ -310,6 +311,112 @@ def accepted_config_keys(cls) -> Set[str]:
 
 
 # --------------------------------------------------------------------------
+# fused-chain declarations (CTT011, ctt-stream)
+
+
+def _task_graph_key(task) -> str:
+    try:
+        return (
+            f"{type(task).__module__}.{type(task).__qualname__}:"
+            f"{task.output().path}"
+        )
+    except Exception:
+        return f"{type(task).__module__}.{type(task).__qualname__}:<?>"
+
+
+def validate_fused_chains(cls, wf, graph) -> List[Finding]:
+    """Statically verify a workflow's declared fused chains over the
+    sentinel-built DAG: every member a fusable split-protocol block task
+    with declared halo/carry contracts, in-chain consumers implementing
+    ``fused_read_batch``, and no out-of-chain consumer of an elided
+    intermediate (eliding it would hand that consumer a dataset that never
+    exists)."""
+    anchor_path, anchor_line = _class_anchor(cls)
+
+    def finding(msg: str) -> Finding:
+        return Finding("CTT011", anchor_path, anchor_line,
+                       f"{cls.__name__}: {msg}")
+
+    get = getattr(wf, "fused_chains", None)
+    if get is None:
+        return []
+    try:
+        chains = list(get())
+    except Exception as e:
+        return [finding(
+            f"fused_chains() raised under sentinel args "
+            f"({type(e).__name__}: {e})"
+        )]
+    if not chains:
+        return []
+
+    from ..runtime import config as rcfg
+    from ..runtime.task import BlockTask
+
+    out: List[Finding] = []
+    for chain in chains:
+        members = list(chain.members)
+        produced: Dict[Tuple[str, str], Any] = {}
+        elided_pairs: Set[Tuple[str, str]] = set()
+        for m in members:
+            name = type(m).__name__
+            if not isinstance(m, BlockTask) or not all(
+                callable(getattr(m, attr, None))
+                for attr in ("read_batch", "compute_batch", "write_batch")
+            ) or not getattr(m, "fusable", False):
+                out.append(finding(
+                    f"chain '{chain.name}' member {name} is not a fusable "
+                    "split-protocol block task"
+                ))
+                continue
+            try:
+                conf = dict(rcfg.DEFAULT_GLOBAL_CONFIG)
+                conf.update(type(m).default_task_config())
+                halo = m.fusion_halo(conf)
+                if halo is not None:
+                    tuple(int(h) for h in halo)
+                inputs = list(m.fusion_inputs(conf) or [])
+            except Exception as e:
+                out.append(finding(
+                    f"chain '{chain.name}' member {name} halo/carry "
+                    f"contract undeclared ({type(e).__name__}: {e})"
+                ))
+                continue
+            for pair in inputs:
+                if pair in produced and (
+                    type(m).fused_read_batch is BlockTask.fused_read_batch
+                ):
+                    out.append(finding(
+                        f"chain '{chain.name}' member {name} consumes "
+                        f"in-chain product {pair} but does not implement "
+                        "fused_read_batch"
+                    ))
+            opath = getattr(m, "output_path", None)
+            okey = getattr(m, "output_key", None)
+            if opath is not None and okey is not None:
+                produced[(opath, okey)] = m
+                if m.identifier in chain.elide:
+                    elided_pairs.add((opath, okey))
+
+        if not elided_pairs:
+            continue
+        skip_keys = {_task_graph_key(t) for t in members}
+        skip_keys |= {_task_graph_key(t) for t in chain.covers}
+        for node in graph.nodes:
+            if _task_graph_key(node) in skip_keys:
+                continue
+            for prefix, pair in consumed_pairs(node):
+                if pair in elided_pairs:
+                    out.append(finding(
+                        f"{type(node).__name__} consumes elided "
+                        f"intermediate {prefix}={pair} from outside chain "
+                        f"'{chain.name}' — that dataset never exists when "
+                        "the chain fuses"
+                    ))
+    return out
+
+
+# --------------------------------------------------------------------------
 # validation driver
 
 
@@ -380,6 +487,9 @@ def validate_workflow_class(cls) -> List[Finding]:
                     "not in the global schema nor its "
                     "default_task_config()",
                 ))
+
+    # -- CTT011: fused-chain declarations (ctt-stream) ----------------------
+    findings.extend(validate_fused_chains(cls, wf, graph))
 
     # -- CTT104: slow reachability ----------------------------------------
     if not getattr(cls, "slow", False):

@@ -39,6 +39,12 @@ Wired in:
     ``executor.stage_hidden_io_s``, the read+write seconds hidden behind
     the serialized compute stage.
 
+  * ``runtime/stream.py`` (ctt-stream) — ``stream.chains`` /
+    ``stream.slabs`` / ``stream.elided_bytes`` (intermediate bytes that
+    never reached the store) / ``stream.fallbacks`` plus the
+    ``stream.carry_bytes`` peak gauge: how much a fused chain streamed,
+    elided, and carried.
+
 Enabled exactly when tracing is enabled (one switch: CTT_TRACE_DIR).
 
 Naming: every counter/gauge name is listed in :mod:`obs.registry`

@@ -63,12 +63,19 @@ COUNTERS = frozenset({
     "faults.injected",
     # parallel/sharded.py — collective→local degradations
     "sharded.fallback_local",
+    # runtime/stream.py — ctt-stream fused-chain execution
+    "stream.chains",        # fused chains executed to completion
+    "stream.slabs",         # block batches (z-slabs) streamed through a chain
+    "stream.elided_bytes",  # intermediate bytes neither written nor re-read
+    "stream.fallbacks",     # declared chains that declined/failed to fuse
 })
 
 # -- gauges (metrics.set_gauge) ---------------------------------------------
 
 GAUGES = frozenset({
     "compile_cache.entries_at_enable",
+    # runtime/stream.py — peak carried merge-state bytes of a fused chain
+    "stream.carry_bytes",
 })
 
 # dynamic name families: one series per <suffix>, allowed by prefix

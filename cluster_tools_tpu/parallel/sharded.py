@@ -560,3 +560,34 @@ def sharded_connected_components(
     mask = put_global(mask, mesh, axis_name, dtype=bool)
     faults.check("collective.execute")
     return _sharded_cc(mask, connectivity, axis_name, mesh)
+
+
+def fused_threshold_components(
+    x,
+    threshold: float,
+    mesh=None,
+    axis_name: str = "data",
+    connectivity: int = 1,
+) -> jnp.ndarray:
+    """ctt-stream under the sharded collective: threshold + global CC as
+    one device-resident sequence — the boolean mask is born on device and
+    flows straight into the collective label program, never crossing to
+    host (the collective analog of the fused block chain's elided
+    threshold intermediate).
+
+    ``x`` is the z-sharded raw volume (``mesh.put_from_store`` placement;
+    pad slabs must be 0.0).  Only ``greater``-mode with ``threshold >= 0``
+    is supported: zero pad slabs then threshold to background, preserving
+    the host-threshold path's pad contract — callers with other modes keep
+    the host-side transform.  Labels match ``sharded_connected_components``
+    on the host-thresholded mask exactly.
+    """
+    if threshold < 0:
+        raise ValueError(
+            "fused_threshold_components requires threshold >= 0 (pad "
+            "slabs are 0.0 and must stay background)"
+        )
+    mask = jax.jit(lambda v: v > threshold)(x)
+    return sharded_connected_components(
+        mask, mesh=mesh, axis_name=axis_name, connectivity=connectivity
+    )

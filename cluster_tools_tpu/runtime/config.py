@@ -37,6 +37,10 @@ DEFAULT_GLOBAL_CONFIG: Dict[str, Any] = {
     # batches in flight on the tpu target: depth d overlaps batch i+1's host
     # chunk IO with batch i's device execution (1 = serial loop)
     "pipeline_depth": 2,
+    # ctt-stream: workflows may declare fused task chains (one streaming
+    # pass, elided intermediates); False forces task-at-a-time execution
+    # everywhere (CTT_STREAM_FUSION=0 is the per-process override)
+    "stream_fusion": True,
     "devices": None,  # None = all jax.devices()
     "seed": 0,
     # multi-host scale-out: run the SAME driver script on every host with

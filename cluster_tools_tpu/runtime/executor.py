@@ -19,6 +19,11 @@ process, so the backends here are:
 
 Both report per-block success/failure so the task layer can retry exactly the
 failed blocks.
+
+The split protocol has a cross-TASK generalization in ``runtime/stream.py``
+(ctt-stream): a workflow-declared FusedChain runs several split-protocol
+tasks as one streaming pass — one read per slab, all compute stages on
+device, elided intermediates never reach the store.
 """
 
 from __future__ import annotations
@@ -79,6 +84,42 @@ def profiler_trace(config: Dict[str, Any]):
     import jax
 
     return jax.profiler.trace(profile_dir)
+
+
+def resolve_batch_size(config: Dict[str, Any]) -> int:
+    """Blocks per device dispatch: the ``device_batch_size`` config knob,
+    else the measured pin (CTT_DEVICE_BATCH / chip pin file), else the
+    backend-aware default — times the visible device count.  Shared by the
+    TpuExecutor and the fused-chain runner (ctt-stream) so a fused and an
+    unfused run chunk the block list identically."""
+    bs_conf = config.get("device_batch_size")
+    if bs_conf is None:
+        # measured pin (env var, else the backend-tagged pin file —
+        # tools/chip_session.py writes CTT_DEVICE_BATCH), else the
+        # backend-aware default; malformed pins degrade to the default
+        # like every other CTT_* switch
+        from ..ops import _backend
+
+        pin = _backend.pinned_value("CTT_DEVICE_BATCH")
+        try:
+            bs_conf = int(pin)
+        except (TypeError, ValueError):
+            import jax
+
+            # backend-aware default: see runtime/config.py
+            bs_conf = 1 if jax.default_backend() == "cpu" else 8
+    batch_size = max(int(bs_conf), 1)
+    devices = config.get("devices")
+    if devices and devices != "global":
+        n_dev = len(devices)
+    else:
+        try:
+            import jax
+
+            n_dev = jax.local_device_count()
+        except Exception:  # pragma: no cover
+            n_dev = 1
+    return batch_size * n_dev
 
 
 class BaseExecutor:
@@ -210,25 +251,7 @@ class TpuExecutor(BaseExecutor):
                 task, blocking, block_ids, config
             )
 
-        bs_conf = config.get("device_batch_size")
-        if bs_conf is None:
-            # measured pin (env var, else the backend-tagged pin file —
-            # tools/chip_session.py writes CTT_DEVICE_BATCH), else the
-            # backend-aware default; malformed pins degrade to the default
-            # like every other CTT_* switch
-            from ..ops import _backend
-
-            pin = _backend.pinned_value("CTT_DEVICE_BATCH")
-            try:
-                bs_conf = int(pin)
-            except (TypeError, ValueError):
-                import jax
-
-                # backend-aware default: see runtime/config.py
-                bs_conf = 1 if jax.default_backend() == "cpu" else 8
-        batch_size = max(int(bs_conf), 1)
-        n_dev = self._n_devices(config)
-        batch_size *= n_dev
+        batch_size = resolve_batch_size(config)
 
         done: List[int] = []
         failed: List[int] = []
@@ -499,17 +522,6 @@ class TpuExecutor(BaseExecutor):
             ),
         )
 
-    @staticmethod
-    def _n_devices(config) -> int:
-        devices = config.get("devices")
-        if devices:
-            return len(devices)
-        try:
-            import jax
-
-            return jax.local_device_count()
-        except Exception:  # pragma: no cover
-            return 1
 
 
 _EXECUTORS = {

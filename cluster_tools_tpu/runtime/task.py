@@ -359,9 +359,78 @@ class BlockTask(Task):
 
     ``allow_retry=False`` marks tasks whose block outputs cannot safely be redone
     (reference block_components.py:27).
+
+    Split-protocol tasks may additionally opt into **cross-task fusion**
+    (``fusable = True`` + the ``fusion_*`` contract below): a workflow can
+    then declare a :class:`runtime.stream.FusedChain` over them, and the
+    chain executes as one streaming pass — each block batch is read once,
+    flows through every member's ``compute_batch``, and elided
+    intermediates never reach the store (see ``runtime/stream.py``).
     """
 
     allow_retry: bool = True
+
+    # -- ctt-stream: cross-task fusion contract ------------------------------
+    #
+    # Split-protocol tasks that support running as a fused-chain member set
+    # ``fusable = True`` and declare what they read; everything defaults to
+    # "reads its input dataset block-wise with no halo, carries nothing".
+
+    fusable: bool = False
+
+    def fusion_halo(self, config) -> Optional[Sequence[int]]:
+        """Halo this task's per-block reads need (None = zero): the chain
+        reads each block once at the max halo over members and serves the
+        smaller reads as crops — the halo reconciliation between stages."""
+        return None
+
+    def fusion_inputs(self, config) -> List[tuple]:
+        """(path, key) datasets read per block — the shared-read prefetch
+        set, and how the planner detects in-chain producer→consumer edges."""
+        return []
+
+    def fused_read_batch(self, handoffs, block_ids, blocking, config):
+        """Build this member's compute payload from upstream device
+        handoffs (``handoffs[(path, key)]`` = producing member's handoff).
+        MUST be overridden by members consuming an in-chain product — the
+        planner refuses the chain otherwise (the product may be elided and
+        its store copy may not exist)."""
+        raise NotImplementedError(
+            f"{self.identifier} consumes an in-chain product but does not "
+            "implement fused_read_batch"
+        )
+
+    def fused_compute_batch(self, payload, blocking, config, elided=False):
+        """Returns ``(result_for_write, handoff)``.  Default: the task's
+        own ``compute_batch`` with the result doubling as the handoff.
+        Overrides can keep the handoff device-resident (and skip the host
+        materialization entirely when ``elided``)."""
+        result = self.compute_batch(payload, blocking, config)
+        return result, result
+
+    def fused_elided_nbytes(self, handoff, blocking, config) -> int:
+        """Store bytes this member's elided output would have written —
+        the ``stream.elided_bytes`` accounting hook."""
+        return 0
+
+    # Carried merge state (per-slab uniques / max ids, face-edge
+    # equivalence tables, histograms): updated on the serialized compute
+    # thread in batch order, AFTER the whole batch computed successfully
+    # (a retried batch never half-applies), finalized once after the pass.
+
+    def fusion_carry_init(self, blocking, config):
+        return None
+
+    def fusion_carry_update(self, carry, result, block_ids, blocking, config):
+        return carry
+
+    def fusion_carry_nbytes(self, carry) -> int:
+        return 0
+
+    def fusion_finalize(self, carry, blocking, config) -> None:
+        """Write deferred small state (e.g. offsets / face-equivalence
+        chunks that make ``covers`` tasks' outputs) after the pass."""
+        return None
 
     # -- multi-host: per-process status + all-process completion -------------
 

@@ -51,6 +51,18 @@ class WorkflowBase(Task):
         config dir (reference workflows.py:102-107)."""
         return {"global": dict(cfg.DEFAULT_GLOBAL_CONFIG)}
 
+    def fused_chains(self) -> List:
+        """Declared fusible chains (ctt-stream): a list of
+        ``runtime.stream.FusedChain`` over member tasks.  ``build()``
+        attempts each chain as one streaming pass before running its
+        members task-at-a-time; any ineligible chain silently falls back.
+        Lint rule CTT011 statically validates declarations."""
+        return []
+
+
+def _task_key(task: Task) -> str:
+    return f"{type(task).__module__}.{type(task).__qualname__}:{task.output().path}"
+
 
 def _toposort(roots: Sequence[Task]) -> List[Task]:
     order: List[Task] = []
@@ -58,7 +70,7 @@ def _toposort(roots: Sequence[Task]) -> List[Task]:
     visiting: set = set()
 
     def visit(task: Task) -> None:
-        key = f"{type(task).__module__}.{type(task).__qualname__}:{task.output().path}"
+        key = _task_key(task)
         if key in seen:
             return
         if key in visiting:
@@ -75,6 +87,28 @@ def _toposort(roots: Sequence[Task]) -> List[Task]:
     return order
 
 
+def _collect_chains(order: Sequence[Task]):
+    """Fused-chain declarations from the workflow nodes of a build, mapped
+    by member/covered task key so the build loop can attempt a chain when
+    it reaches the first incomplete task the chain would satisfy.  A
+    declaration that raises is dropped loudly (declarations must never
+    break a build)."""
+    by_key: Dict[str, object] = {}
+    for task in order:
+        if not isinstance(task, WorkflowBase):
+            continue
+        try:
+            chains = list(task.fused_chains())
+        except Exception as e:
+            print(f"[ctt-stream] ignoring fused_chains() of {task!r}: "
+                  f"{type(e).__name__}: {e}")
+            continue
+        for chain in chains:
+            for member in list(chain.members) + list(chain.covers):
+                by_key.setdefault(_task_key(member), chain)
+    return by_key
+
+
 def build(tasks: Sequence[Task], raise_on_failure: bool = True) -> bool:
     """Run a set of root tasks and their dependencies.  Returns success."""
     # persistent XLA executable cache: fresh worker processes skip the
@@ -89,11 +123,26 @@ def build(tasks: Sequence[Task], raise_on_failure: bool = True) -> bool:
         # resume after a multi-host failure: stale aborted flags from the
         # prior run would otherwise fail peers' barriers immediately
         task.clear_stale_abort()
+    chains_by_key = _collect_chains(order)
+    attempted: set = set()
     try:
         with obs_trace.span("build", kind="run", n_tasks=len(order)):
             for task in order:
                 if task.complete():
                     continue
+                # ctt-stream: an incomplete task covered by a declared
+                # fused chain triggers ONE attempt at running the whole
+                # chain as a streaming pass; on success the members' and
+                # covered tasks' status files are complete and the loop
+                # skips them.  A declined/failed chain leaves no status
+                # behind, so execution proceeds task-at-a-time unchanged.
+                chain = chains_by_key.get(_task_key(task))
+                if chain is not None and id(chain) not in attempted:
+                    attempted.add(id(chain))
+                    from . import stream
+
+                    if stream.try_run_chain(chain) and task.complete():
+                        continue
                 try:
                     task.run()
                 except Exception:

@@ -15,6 +15,14 @@ from ..utils.blocking import Blocking
 SCRATCH_STORE_NAME = "data.zarr"
 
 
+def fusion_wrap(ds, path: str, key: str):
+    """Route a dataset through the fused chain's active per-batch read
+    cache (ctt-stream) — a no-op outside a chain's read stage."""
+    from ..parallel.dispatch import wrap_with_read_cache
+
+    return wrap_with_read_cache(ds, path, key)
+
+
 def scratch_store_path(tmp_folder: str) -> str:
     """The shared per-tmp-folder scratch store (single source of truth)."""
     return os.path.join(tmp_folder, SCRATCH_STORE_NAME)
@@ -52,7 +60,13 @@ class VolumeTask(BlockTask):
     # -- datasets ------------------------------------------------------------
 
     def input_ds(self, mode: str = "r"):
-        return store.file_reader(self.input_path, mode)[self.input_key]
+        # ctt-stream seam: inside a fused chain's read stage the thread
+        # carries a per-batch BlockReadCache — reads come back as crops of
+        # the one shared store read instead of hitting the codec again
+        return fusion_wrap(
+            store.file_reader(self.input_path, mode)[self.input_key],
+            self.input_path, self.input_key,
+        )
 
     def output_ds(self, mode: str = "a"):
         return store.file_reader(self.output_path, mode)[self.output_key]
@@ -80,6 +94,17 @@ class VolumeTask(BlockTask):
             chunks=chunks,
             compression="gzip",
         )
+
+    # -- ctt-stream fusion contract ------------------------------------------
+
+    def fusion_inputs(self, config):
+        """Per-block dataset reads of a volume-to-volume task: the input
+        (plus the optional mask) — the fused chain's shared-read set."""
+        pairs = [(self.input_path, self.input_key)]
+        mask_path = getattr(self, "mask_path", None)
+        if mask_path:
+            pairs.append((mask_path, getattr(self, "mask_key", None)))
+        return pairs
 
     # -- scratch data --------------------------------------------------------
 

@@ -37,12 +37,42 @@ def _threshold_batch(batch: jnp.ndarray, threshold: float, mode: str, sigma):
 class ThresholdTask(VolumeTask):
     task_name = "threshold"
     output_dtype = "uint8"
+    # ctt-stream: fusable chain member; typically the elided head of a
+    # threshold → components chain (the mask never leaves HBM)
+    fusable = True
 
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
         conf = super().default_task_config()
         conf.update({"threshold": 0.5, "threshold_mode": "greater", "sigma": 0.0})
         return conf
+
+    # -- ctt-stream fusion contract ------------------------------------------
+
+    def fused_compute_batch(self, payload, blocking: Blocking, config,
+                            elided=False):
+        """Device handoff for in-chain consumers: the uint8 mask stays a
+        sharded device array ([B_padded, *block], plus the real batch
+        size); when the mask volume is elided the host materialization is
+        skipped entirely — the intermediate never leaves HBM."""
+        batch = payload
+        sigma = config.get("sigma", 0.0) or 0.0
+        if isinstance(sigma, list):
+            sigma = tuple(sigma)
+        xb, n = put_sharded(batch.data, config)
+        dev = _threshold_batch(
+            xb, float(config.get("threshold", 0.5)),
+            config.get("threshold_mode", "greater"), sigma,
+        )
+        handoff = {"batch": batch, "labels": dev, "n": n}
+        result = None if elided else (batch, np.asarray(dev)[:n])
+        return result, handoff
+
+    def fused_elided_nbytes(self, handoff, blocking: Blocking, config) -> int:
+        # the uint8 mask bytes that were neither written nor re-read
+        return sum(
+            int(np.prod(bh.inner.shape)) for bh in handoff["batch"].blocks
+        )
 
     # -- split batch protocol (three-stage executor pipeline) ---------------
 

@@ -87,6 +87,130 @@ class BlockComponentsTask(VolumeTask):
         )
         return conf
 
+    # -- ctt-stream fusion contract ------------------------------------------
+    #
+    # As a fused-chain member this task (a) consumes the upstream threshold
+    # mask as a device handoff — the mask never round-trips through the
+    # store — and (b) carries the downstream merge state forward while its
+    # labels are still in memory: per-block max ids (the merge-offsets
+    # input) and face-edge equivalence tables (the block-faces output, the
+    # same (a, b) value-pair format ops/unionfind.merge_value_table
+    # resolves device-side for ctt-cc tile faces).  The chain's ``covers``
+    # list then stamps MergeOffsetsTask/BlockFacesTask complete without
+    # either re-reading one voxel of the labels volume.
+
+    fusable = True
+
+    def fused_read_batch(self, handoffs, block_ids, blocking: Blocking,
+                         config):
+        """Payload from the upstream threshold handoff: the device mask
+        replaces the store read of the mask dataset (which may be elided
+        and never exist).  uint8 0/1 values compare against the 0.5
+        default threshold exactly like the float32 store read would."""
+        h = handoffs[(self.input_path, self.input_key)]
+        from ..parallel.dispatch import BlockBatch
+
+        batch = BlockBatch(
+            data=h["labels"], valid=None,
+            blocks=list(h["batch"].blocks),
+            block_ids=list(h["batch"].block_ids),
+        )
+        if self.mask_path:
+            from ..utils import store as _store
+
+            mask_ds = _store.file_reader(self.mask_path, "r")[self.mask_key]
+            masks = [
+                mask_ds[bh.outer.slicing].astype(bool) for bh in batch.blocks
+            ]
+        else:
+            masks = None
+        return batch, masks
+
+    def fusion_carry_init(self, blocking: Blocking, config):
+        return {
+            "max_ids": np.zeros(blocking.n_blocks, dtype=np.int64),
+            "planes": {},  # (block_id, axis) -> the block's last label plane
+            "pairs": {},   # block_id -> axis -> (lo_vals, hi_vals) int64
+        }
+
+    def fusion_carry_update(self, carry, result, block_ids,
+                            blocking: Blocking, config):
+        """Per-slab carry: record each block's max id and its upper
+        boundary planes; resolve faces against the carried plane of the
+        lower neighbor (already processed — block ids stream in ascending
+        C-order, so the carry window is one slab of planes).  Pair values
+        stay block-local; offsets are applied at finalize, after the last
+        slab fixes the global offset table."""
+        if result is None:
+            return carry
+        batch, labels = result
+        for i, bid in enumerate(batch.block_ids):
+            bh = batch.blocks[i]
+            lab = labels[i][bh.inner_local.slicing]
+            carry["max_ids"][bid] = int(lab.max())
+            for axis in range(blocking.ndim):
+                if blocking.neighbor_id(bid, axis, lower=False) is not None:
+                    carry["planes"][(bid, axis)] = np.take(
+                        lab, lab.shape[axis] - 1, axis=axis
+                    ).astype(np.int64)
+                nb = blocking.neighbor_id(bid, axis, lower=True)
+                if nb is not None:
+                    lo = carry["planes"].pop((nb, axis))
+                    hi = np.take(lab, 0, axis=axis).astype(np.int64)
+                    both = (lo > 0) & (hi > 0)
+                    if both.any():
+                        carry["pairs"].setdefault(nb, {})[axis] = (
+                            lo[both], hi[both]
+                        )
+        return carry
+
+    def fusion_carry_nbytes(self, carry) -> int:
+        n = carry["max_ids"].nbytes
+        n += sum(a.nbytes for a in carry["planes"].values())
+        n += sum(
+            lo.nbytes + hi.nbytes
+            for per_axis in carry["pairs"].values()
+            for lo, hi in per_axis.values()
+        )
+        return n
+
+    def fusion_finalize(self, carry, blocking: Blocking, config) -> None:
+        """Write the carried merge state in the exact shape the downstream
+        tasks would have produced: the offsets npz (MergeOffsetsTask) and
+        one FACES_KEY chunk per block (BlockFacesTask) — byte-identical
+        pair tables, so MergeAssignmentsTask and WriteTask run unchanged."""
+        import os
+
+        if carry is None:
+            return
+        max_ids = carry["max_ids"]
+        offsets = np.roll(np.cumsum(max_ids), 1)
+        offsets[0] = 0
+        empty_blocks = np.nonzero(max_ids == 0)[0]
+        np.savez(
+            os.path.join(self.tmp_folder, OFFSETS_NAME),
+            offsets=offsets,
+            empty_blocks=empty_blocks,
+            n_labels=np.int64(max_ids.sum()),
+        )
+        faces = self.tmp_ragged(FACES_KEY, blocking.n_blocks, np.int64)
+        for bid in range(blocking.n_blocks):
+            parts = []
+            for axis, ngb_id, _face in blocking.iterate_faces(bid, halo=1):
+                got = carry["pairs"].get(bid, {}).get(axis)
+                if got is None:
+                    continue
+                lo, hi = got
+                a = lo + offsets[bid]
+                b = hi + offsets[ngb_id]
+                parts.append(np.unique(np.stack([a, b], axis=1), axis=0))
+            out = (
+                np.concatenate(parts, axis=0).reshape(-1)
+                if parts
+                else np.array([], dtype=np.int64)
+            )
+            faces.write_chunk((bid,), out)
+
     # -- split batch protocol (three-stage executor pipeline) ---------------
 
     def read_batch(self, block_ids: List[int], blocking: Blocking, config):
@@ -321,7 +445,14 @@ class ShardedComponentsTask(VolumeSimpleTask):
         conf = super().default_task_config()
         conf.update(
             {"threshold": 0.5, "threshold_mode": "greater", "sigma": 0.0,
-             "connectivity": 1}
+             "connectivity": 1,
+             # ctt-stream: threshold on DEVICE, fused into the collective
+             # CC program (parallel.sharded.fused_threshold_components) —
+             # HBM holds the float volume instead of the bool mask, but
+             # the mask never crosses the host boundary.  Only greater-
+             # mode, sigma 0, unmasked; other settings keep the
+             # host-threshold ingest transform.
+             "device_threshold": False}
         )
         return conf
 
@@ -349,6 +480,32 @@ class ShardedComponentsTask(VolumeSimpleTask):
         n_dev = len(devices)
         threshold = float(conf.get("threshold", 0.5))
         sigma = conf.get("sigma", 0.0) or 0.0  # scalar or per-axis sequence
+
+        device_threshold = (
+            bool(conf.get("device_threshold", False))
+            and mode == "greater"
+            and threshold >= 0
+            and not self.mask_path
+            and not np.any(np.asarray(sigma) > 0)
+        )
+        if device_threshold:
+            # ctt-stream collective fusion: the raw volume streams to HBM
+            # and thresholds there, feeding the CC program directly — the
+            # mask intermediate never exists host-side
+            from ..parallel.mesh import fetch_global
+            from ..parallel.sharded import fused_threshold_components
+
+            x_d = put_from_store(
+                in_ds, mesh, dtype=np.float32, pad_to=n_dev,
+            )
+            raw_labels = fetch_global(
+                fused_threshold_components(
+                    x_d, threshold, mesh=mesh,
+                    connectivity=int(conf.get("connectivity", 1)),
+                )
+            )[:z]
+            self._write_labels(raw_labels, conf, n_dev)
+            return
 
         if np.any(np.asarray(sigma) > 0):
             # smoothing runs on host over the full volume (scipy) — the
@@ -389,6 +546,15 @@ class ShardedComponentsTask(VolumeSimpleTask):
                 connectivity=int(conf.get("connectivity", 1)),
             )
         )[:z]
+        self._write_labels(raw_labels, conf, n_dev)
+
+    def _write_labels(self, raw_labels, conf, n_dev: int) -> None:
+        """Relabel + write the collective CC result (shared by the
+        host-threshold and device-threshold/fused ingest paths)."""
+        import jax
+
+        from ..utils import store as store_mod
+
         if jax.process_index() != 0:
             return  # process 0 owns the writes
 

@@ -115,11 +115,18 @@ def _normalize_host(data: np.ndarray) -> np.ndarray:
 class WatershedTask(VolumeTask):
     task_name = "watershed"
     output_dtype = "uint64"
+    # ctt-stream: fusable chain member reading the raw boundary map — in a
+    # fused chain it shares the head's store read (its halo'd outer boxes
+    # ARE the chain's shared read; smaller-halo members get crops)
+    fusable = True
 
     def __init__(self, *args, mask_path: str = None, mask_key: str = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.mask_path = mask_path
         self.mask_key = mask_key
+
+    def fusion_halo(self, config):
+        return tuple(config.get("halo") or [0, 0, 0])
 
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
@@ -169,7 +176,12 @@ class WatershedTask(VolumeTask):
     def _load_mask_batch(self, batch) -> Optional[np.ndarray]:
         if not self.mask_path:
             return None
-        mask_ds = store.file_reader(self.mask_path, "r")[self.mask_key]
+        from .base import fusion_wrap
+
+        mask_ds = fusion_wrap(
+            store.file_reader(self.mask_path, "r")[self.mask_key],
+            self.mask_path, self.mask_key,
+        )
         full_shape = batch.data.shape[1:]
         return np.stack([
             _pad_block(mask_ds[bh.outer.slicing].astype(bool), full_shape)
@@ -443,6 +455,8 @@ class TwoPassWatershedTask(WatershedTask):
     """
 
     task_name = "two_pass_watershed"
+    # pass 2 reads labels its own dispatch writes — never stream-fusable
+    fusable = False
 
     def __init__(self, *args, pass_id: int = 0, **kwargs):
         super().__init__(*args, **kwargs)

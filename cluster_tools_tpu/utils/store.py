@@ -214,6 +214,20 @@ def _chunk_cache_budget_bytes() -> int:
 _CHUNK_CACHE = _DecodedChunkCache(_chunk_cache_budget_bytes())
 
 
+def set_chunk_cache_budget(max_bytes: Optional[int]) -> int:
+    """Override the decoded-chunk LRU budget in-process; returns the
+    previous budget.  ``None`` restores the ``CTT_CHUNK_CACHE_MB``
+    resolution; any change clears cached entries.  Store-traffic
+    measurements (the ctt-stream bench/smoke) set 0 so ``store.bytes_read``
+    reflects actual codec-boundary traffic instead of LRU luck."""
+    prev = _CHUNK_CACHE.max_bytes
+    _CHUNK_CACHE.max_bytes = (
+        _chunk_cache_budget_bytes() if max_bytes is None else max(int(max_bytes), 0)
+    )
+    _CHUNK_CACHE.clear()
+    return prev
+
+
 class Attributes:
     """JSON-file-backed attribute mapping (``.zattrs`` / n5 ``attributes.json``)."""
 

@@ -39,6 +39,7 @@ from .postprocessing import (
     SizeFilterWorkflow,
 )
 from .stitching import MulticutStitchingWorkflow, SimpleStitchingWorkflow
+from .streaming import StreamingSegmentationWorkflow
 from .ilastik import IlastikCarvingWorkflow, IlastikPredictionWorkflow
 from .relabel import RelabelWorkflow, UniqueWorkflow
 from .transformations import LinearTransformationWorkflow
@@ -87,6 +88,7 @@ __all__ = [
     "TwoPassMwsWorkflow",
     "MulticutStitchingWorkflow",
     "SimpleStitchingWorkflow",
+    "StreamingSegmentationWorkflow",
     "LinearTransformationWorkflow",
     "RelabelWorkflow",
     "UniqueWorkflow",

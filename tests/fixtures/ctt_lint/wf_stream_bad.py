@@ -1,0 +1,111 @@
+"""ctt-lint fixture: fused-chain contract violations (CTT011).
+
+Three findings expected on BadStreamWorkflow:
+  1. a chain member that is not a fusable split-protocol task;
+  2. an in-chain consumer of a produced pair without fused_read_batch;
+  3. an out-of-chain task consuming the elided intermediate.
+"""
+
+from typing import Sequence
+
+from cluster_tools_tpu.runtime.stream import FusedChain
+from cluster_tools_tpu.runtime.workflow import WorkflowBase
+from cluster_tools_tpu.tasks.base import VolumeTask
+
+
+class _BadProducer(VolumeTask):
+    task_name = "fixture_bad_stream_producer"
+    output_dtype = "uint8"
+    fusable = True
+
+    def read_batch(self, block_ids, blocking, config):
+        return block_ids
+
+    def compute_batch(self, payload, blocking, config):
+        return payload
+
+    def write_batch(self, result, blocking, config):
+        pass
+
+
+class _NoProtocolMember(VolumeTask):
+    """fusable claimed but the split protocol is missing."""
+
+    task_name = "fixture_bad_stream_noproto"
+    output_dtype = "uint64"
+    fusable = True
+
+
+class _LazyConsumer(VolumeTask):
+    """Consumes the in-chain product without fused_read_batch."""
+
+    task_name = "fixture_bad_stream_lazy"
+    output_dtype = "uint64"
+    fusable = True
+
+    def read_batch(self, block_ids, blocking, config):
+        return block_ids
+
+    def compute_batch(self, payload, blocking, config):
+        return payload
+
+    def write_batch(self, result, blocking, config):
+        pass
+
+
+class _OutsideConsumer(VolumeTask):
+    """Out of chain, reads the elided mask — it will never exist."""
+
+    task_name = "fixture_bad_stream_outside"
+    output_dtype = "uint64"
+
+
+class BadStreamWorkflow(WorkflowBase):
+    task_name = "fixture_stream_bad_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None,
+                 target=None, input_path=None, input_key=None,
+                 output_path=None, output_key=None,
+                 dependencies: Sequence = ()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target,
+                         dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+    def _tasks(self):
+        mask_key = self.output_key + "_m"
+        producer = _BadProducer(
+            self.tmp_folder, self.config_dir,
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=mask_key,
+        )
+        noproto = _NoProtocolMember(
+            self.tmp_folder, self.config_dir, dependencies=[producer],
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=self.output_key + "_x",
+        )
+        lazy = _LazyConsumer(
+            self.tmp_folder, self.config_dir, dependencies=[producer],
+            input_path=self.output_path, input_key=mask_key,
+            output_path=self.output_path, output_key=self.output_key + "_y",
+        )
+        outside = _OutsideConsumer(
+            self.tmp_folder, self.config_dir, dependencies=[lazy],
+            input_path=self.output_path, input_key=mask_key,
+            output_path=self.output_path, output_key=self.output_key,
+        )
+        return producer, noproto, lazy, outside
+
+    def requires(self):
+        _, _, _, outside = self._tasks()
+        return [outside]
+
+    def fused_chains(self):
+        producer, noproto, lazy, _ = self._tasks()
+        return [FusedChain(
+            name="fixture_stream_bad",
+            members=[producer, noproto, lazy],
+            elide={producer.identifier},
+        )]

@@ -1,0 +1,80 @@
+"""ctt-lint fixture: a correctly declared fused streaming chain (CTT011) —
+zero findings expected.  Mirrors StreamingSegmentationWorkflow's shape:
+fusable split-protocol members, elided intermediate consumed only
+in-chain via fused_read_batch."""
+
+from cluster_tools_tpu.runtime.stream import FusedChain
+from cluster_tools_tpu.runtime.workflow import WorkflowBase
+from cluster_tools_tpu.tasks.base import VolumeTask
+
+
+class _StreamProducer(VolumeTask):
+    task_name = "fixture_stream_producer"
+    output_dtype = "uint8"
+    fusable = True
+
+    def read_batch(self, block_ids, blocking, config):
+        return block_ids
+
+    def compute_batch(self, payload, blocking, config):
+        return payload
+
+    def write_batch(self, result, blocking, config):
+        pass
+
+
+class _StreamConsumer(VolumeTask):
+    task_name = "fixture_stream_consumer"
+    output_dtype = "uint64"
+    fusable = True
+
+    def read_batch(self, block_ids, blocking, config):
+        return block_ids
+
+    def fused_read_batch(self, handoffs, block_ids, blocking, config):
+        return handoffs[(self.input_path, self.input_key)]
+
+    def compute_batch(self, payload, blocking, config):
+        return payload
+
+    def write_batch(self, result, blocking, config):
+        pass
+
+
+class GoodStreamWorkflow(WorkflowBase):
+    task_name = "fixture_stream_good_workflow"
+
+    def __init__(self, tmp_folder, config_dir=None, max_jobs=None,
+                 target=None, input_path=None, input_key=None,
+                 output_path=None, output_key=None, dependencies=()):
+        super().__init__(tmp_folder, config_dir, max_jobs, target,
+                         dependencies)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+    def _tasks(self):
+        producer = _StreamProducer(
+            self.tmp_folder, self.config_dir,
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=self.output_key + "_m",
+        )
+        consumer = _StreamConsumer(
+            self.tmp_folder, self.config_dir, dependencies=[producer],
+            input_path=self.output_path, input_key=self.output_key + "_m",
+            output_path=self.output_path, output_key=self.output_key,
+        )
+        return producer, consumer
+
+    def requires(self):
+        _, consumer = self._tasks()
+        return [consumer]
+
+    def fused_chains(self):
+        producer, consumer = self._tasks()
+        return [FusedChain(
+            name="fixture_stream_good",
+            members=[producer, consumer],
+            elide={producer.identifier},
+        )]

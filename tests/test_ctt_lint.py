@@ -650,6 +650,28 @@ class TestGraphValidator:
     def test_good_fixture_is_clean(self):
         assert validate_workflow_file(os.path.join(FIXTURES, "wf_good.py")) == []
 
+    def test_stream_chain_good_fixture_is_clean(self):
+        # a correctly declared fused chain (fusable split-protocol members,
+        # elided product consumed in-chain via fused_read_batch) is silent
+        assert validate_workflow_file(
+            os.path.join(FIXTURES, "wf_stream_good.py")
+        ) == []
+
+    def test_stream_chain_bad_fixture(self):
+        path = os.path.join(FIXTURES, "wf_stream_bad.py")
+        findings = validate_workflow_file(path)
+        assert ids(findings) == ["CTT011"]
+        assert len(findings) == 3
+        msgs = "\n".join(f.message for f in findings)
+        # 1) member without the split protocol
+        assert "_NoProtocolMember" in msgs
+        # 2) in-chain consumer without fused_read_batch
+        assert "fused_read_batch" in msgs
+        # 3) out-of-chain consumer of the elided intermediate
+        assert "_OutsideConsumer" in msgs and "elided" in msgs
+        anchor = line_of(path, "class BadStreamWorkflow")
+        assert all(f.line == anchor for f in findings)
+
     def test_shipped_workflows_are_clean(self):
         # the whole point: the real tree stays lint-clean
         from cluster_tools_tpu.analysis import validate_workflows_dir

@@ -1,0 +1,362 @@
+"""ctt-stream: cross-task fused streaming execution.
+
+Contract under test (ISSUE 7): a declared threshold → thresholded-components
+→ watershed chain executes as ONE streaming pass — byte-identical to the
+task-at-a-time pipeline (zarr + n5, with halos, local + device-sharded
+targets, under injected faults), with the threshold mask elided, the
+merge-offsets/block-faces outputs produced from carried state, strictly
+lower store read traffic, and a zero-overhead fallback path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu import faults
+from cluster_tools_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.tasks.thresholded_components import (
+    FACES_KEY,
+    MAX_IDS_KEY,
+    OFFSETS_NAME,
+)
+from cluster_tools_tpu.utils import file_reader, store as store_mod
+from cluster_tools_tpu.workflows import StreamingSegmentationWorkflow
+
+THRESHOLD = 0.55
+WS_CONF = {
+    "threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+    "halo": [2, 4, 4],
+}
+
+
+@pytest.fixture(autouse=True)
+def _traced(tmp_path):
+    """Metrics/tracing on (counters drive the assertions), chunk LRU off
+    (byte counts must reflect codec-boundary traffic), clean slate."""
+    obs_metrics.reset()
+    prev = store_mod.set_chunk_cache_budget(0)
+    obs_trace.enable(str(tmp_path / "trace"), "stream_test", export_env=False)
+    yield
+    obs_trace.disable()
+    store_mod.set_chunk_cache_budget(prev)
+    obs_metrics.reset()
+
+
+def _volume(shape=(24, 32, 32)):
+    rng = np.random.default_rng(7)
+    raw = ndimage.gaussian_filter(rng.random(shape), 1.0)
+    return ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+
+
+def _stage(tmp_path, ext="n5", shape=(24, 32, 32), chunks=(8, 16, 16)):
+    path = str(tmp_path / f"data.{ext}")
+    file_reader(path).create_dataset("raw", data=_volume(shape), chunks=chunks)
+    return path
+
+
+def _run(tmp_path, path, tag, fused=True, target="tpu", extra_global=None,
+         watershed=True, max_retries=0):
+    config_dir = str(tmp_path / f"configs_{tag}")
+    gconf = {
+        "block_shape": [8, 16, 16], "target": target,
+        "stream_fusion": fused, "device_batch_size": 4,
+        "max_num_retries": max_retries,
+    }
+    gconf.update(extra_global or {})
+    cfg.write_global_config(config_dir, gconf)
+    cfg.write_config(config_dir, "threshold", {"threshold": THRESHOLD})
+    cfg.write_config(config_dir, "watershed", dict(WS_CONF))
+    wf = StreamingSegmentationWorkflow(
+        str(tmp_path / f"tmp_{tag}"), config_dir,
+        input_path=path, input_key="raw",
+        output_path=path, output_key=f"cc_{tag}",
+        watershed=watershed,
+    )
+    before = obs_metrics.snapshot()["counters"]
+    assert build([wf]), f"workflow failed ({tag})"
+    after = obs_metrics.snapshot()["counters"]
+    delta = {
+        k: after.get(k, 0) - before.get(k, 0)
+        for k in set(after) | set(before)
+    }
+    return wf, delta
+
+
+def _read_scratch(tmp_folder, n_blocks):
+    from cluster_tools_tpu.tasks.base import scratch_store_path
+
+    store = file_reader(scratch_store_path(tmp_folder), "r")
+    max_ids = [store[MAX_IDS_KEY].read_chunk((b,)) for b in range(n_blocks)]
+    faces = [store[FACES_KEY].read_chunk((b,)) for b in range(n_blocks)]
+    with np.load(os.path.join(tmp_folder, OFFSETS_NAME)) as f:
+        offsets = {k: f[k] for k in f.files}
+    return max_ids, faces, offsets
+
+
+@pytest.mark.parametrize("ext", ["n5", "zarr"])
+@pytest.mark.parametrize("target", ["local", "tpu"])
+def test_fused_parity(tmp_path, ext, target):
+    """Fused vs task-at-a-time: byte-identical final volumes AND carried
+    merge state (max-id chunks, face-equivalence chunks, offsets npz)."""
+    path = _stage(tmp_path, ext)
+    _, d_fused = _run(tmp_path, path, "fused", fused=True, target=target)
+    _, d_un = _run(tmp_path, path, "plain", fused=False, target=target)
+
+    f = file_reader(path, "r")
+    np.testing.assert_array_equal(f["cc_fused"][:], f["cc_plain"][:])
+    np.testing.assert_array_equal(f["cc_fused_ws"][:], f["cc_plain_ws"][:])
+
+    # recompute oracle: the merged components match scipy on the raw volume
+    from cluster_tools_tpu.ops.evaluation import same_partition
+
+    raw = f["raw"][:]
+    want, n_want = ndimage.label(raw > THRESHOLD)
+    assert n_want > 3
+    assert same_partition(f["cc_fused"][:], want)
+
+    # the threshold mask is elided on the fused path only
+    assert "cc_fused_mask" not in f
+    assert "cc_plain_mask" in f
+
+    # carried merge state is byte-identical to the task-at-a-time scratch
+    n_blocks = 12
+    mi_f, fc_f, off_f = _read_scratch(str(tmp_path / "tmp_fused"), n_blocks)
+    mi_p, fc_p, off_p = _read_scratch(str(tmp_path / "tmp_plain"), n_blocks)
+    for a, b in zip(mi_f, mi_p):
+        np.testing.assert_array_equal(a, b)
+    assert any(c is not None and c.size for c in fc_f)
+    for a, b in zip(fc_f, fc_p):
+        np.testing.assert_array_equal(a, b)
+    for k in off_p:
+        np.testing.assert_array_equal(off_f[k], off_p[k])
+
+    # stream accounting fired exactly once, on the fused run
+    assert d_fused.get("stream.chains") == 1
+    assert d_fused.get("stream.slabs", 0) >= 1
+    assert d_fused.get("stream.elided_bytes", 0) > 0
+    assert d_un.get("stream.chains", 0) == 0
+
+
+def test_store_read_reduction(tmp_path):
+    """The acceptance criterion: fused store.bytes_read at most half of the
+    task-at-a-time run's (the raw volume crosses the codec boundary once,
+    as batch superslabs; the mask round-trip and the faces re-read are
+    gone)."""
+    path = _stage(tmp_path, "n5", shape=(32, 64, 64), chunks=(8, 32, 32))
+    _, d_fused = _run(
+        tmp_path, path, "fused", fused=True,
+        extra_global={"block_shape": [8, 32, 32]},
+    )
+    _, d_un = _run(
+        tmp_path, path, "plain", fused=False,
+        extra_global={"block_shape": [8, 32, 32]},
+    )
+    read_f = d_fused.get("store.bytes_read", 0)
+    read_u = d_un.get("store.bytes_read", 0)
+    assert read_f > 0 and read_u > 0
+    assert read_u >= 2 * read_f, (read_u, read_f)
+    assert d_un.get("store.bytes_written", 0) > d_fused.get(
+        "store.bytes_written", 0
+    )
+
+
+def test_chaos_mid_slab_retry(tmp_path):
+    """A mid-slab injected compute failure retries the whole batch without
+    corrupting carried state: output stays byte-identical to a clean run."""
+    path = _stage(tmp_path, "n5")
+    _, d_clean = _run(tmp_path, path, "clean", fused=True)
+    faults.configure("executor.stage_compute:fail:once;seed=3")
+    try:
+        _, d_chaos = _run(tmp_path, path, "chaos", fused=True, max_retries=2)
+    finally:
+        faults.reset()
+    f = file_reader(path, "r")
+    np.testing.assert_array_equal(f["cc_chaos"][:], f["cc_clean"][:])
+    np.testing.assert_array_equal(f["cc_chaos_ws"][:], f["cc_clean_ws"][:])
+    assert d_chaos.get("faults.injected", 0) > 0
+    assert d_chaos.get("task.blocks_retried", 0) > 0
+    assert d_chaos.get("stream.chains") == 1
+
+    n_blocks = 12
+    mi_a, fc_a, off_a = _read_scratch(str(tmp_path / "tmp_clean"), n_blocks)
+    mi_b, fc_b, off_b = _read_scratch(str(tmp_path / "tmp_chaos"), n_blocks)
+    for a, b in zip(fc_a, fc_b):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(off_a["offsets"], off_b["offsets"])
+
+
+def test_store_fault_heals_inside_chain(tmp_path):
+    """Transient store IO faults during the streaming pass ride the shared
+    retry machinery exactly as in task-at-a-time runs."""
+    path = _stage(tmp_path, "n5")
+    _, _ = _run(tmp_path, path, "ref", fused=True)
+    faults.configure("store.read:io_error:p=0.05;store.write:io_error:p=0.1;seed=5")
+    try:
+        _, d = _run(tmp_path, path, "heal", fused=True, max_retries=2)
+    finally:
+        faults.reset()
+    f = file_reader(path, "r")
+    np.testing.assert_array_equal(f["cc_heal"][:], f["cc_ref"][:])
+    np.testing.assert_array_equal(f["cc_heal_ws"][:], f["cc_ref_ws"][:])
+    assert d.get("store.io_retries", 0) > 0
+
+
+def test_opt_out_config(tmp_path):
+    """stream_fusion=false runs members task-at-a-time: the mask
+    materializes and no stream counters fire."""
+    path = _stage(tmp_path, "n5")
+    _, delta = _run(tmp_path, path, "off", fused=False)
+    assert "cc_off_mask" in file_reader(path, "r")
+    assert delta.get("stream.chains", 0) == 0
+    assert delta.get("stream.slabs", 0) == 0
+
+
+def test_opt_out_env(tmp_path, monkeypatch):
+    """CTT_STREAM_FUSION=0 is the process-wide kill switch."""
+    monkeypatch.setenv("CTT_STREAM_FUSION", "0")
+    path = _stage(tmp_path, "n5")
+    _, delta = _run(tmp_path, path, "env", fused=True)
+    assert "cc_env_mask" in file_reader(path, "r")
+    assert delta.get("stream.chains", 0) == 0
+    assert delta.get("stream.fallbacks", 0) >= 1
+
+
+def test_partial_progress_falls_back(tmp_path):
+    """A chain whose member already has task-at-a-time progress declines
+    (resume safety) and the build completes unfused, same outputs."""
+    from cluster_tools_tpu.tasks.threshold import ThresholdTask
+
+    path = _stage(tmp_path, "n5")
+    config_dir = str(tmp_path / "configs_pre")
+    cfg.write_global_config(
+        config_dir, {"block_shape": [8, 16, 16], "target": "tpu"}
+    )
+    cfg.write_config(config_dir, "threshold", {"threshold": THRESHOLD})
+    tmp_folder = str(tmp_path / "tmp_resume")
+    pre = ThresholdTask(
+        tmp_folder, config_dir,
+        input_path=path, input_key="raw",
+        output_path=path, output_key="cc_resume_mask",
+    )
+    assert build([pre])
+
+    config_dir2 = str(tmp_path / "configs_resume")
+    cfg.write_global_config(
+        config_dir2,
+        {"block_shape": [8, 16, 16], "target": "tpu", "stream_fusion": True},
+    )
+    cfg.write_config(config_dir2, "threshold", {"threshold": THRESHOLD})
+    cfg.write_config(config_dir2, "watershed", dict(WS_CONF))
+    wf = StreamingSegmentationWorkflow(
+        tmp_folder, config_dir2,
+        input_path=path, input_key="raw",
+        output_path=path, output_key="cc_resume",
+    )
+    before = obs_metrics.snapshot()["counters"]
+    assert build([wf])
+    after = obs_metrics.snapshot()["counters"]
+    assert after.get("stream.fallbacks", 0) > before.get("stream.fallbacks", 0)
+    assert after.get("stream.chains", 0) == before.get("stream.chains", 0)
+
+    _, _ = _run(tmp_path, path, "oracle", fused=False)
+    f = file_reader(path, "r")
+    np.testing.assert_array_equal(f["cc_resume"][:], f["cc_oracle"][:])
+
+
+def test_disabled_overhead_smoke(tmp_path):
+    """No chain declared → the PR 3 codepath runs untouched: staged
+    pipeline counters fire, stream counters do not."""
+    from cluster_tools_tpu.tasks.threshold import ThresholdTask
+
+    path = _stage(tmp_path, "n5")
+    config_dir = str(tmp_path / "configs_plain_task")
+    cfg.write_global_config(
+        config_dir,
+        {"block_shape": [8, 16, 16], "target": "tpu",
+         "device_batch_size": 1, "devices": [0], "pipeline_depth": 3},
+    )
+    t = ThresholdTask(
+        str(tmp_path / "tmp_plain_task"), config_dir,
+        input_path=path, input_key="raw",
+        output_path=path, output_key="mask_plain",
+    )
+    before = obs_metrics.snapshot()["counters"]
+    assert build([t])
+    after = obs_metrics.snapshot()["counters"]
+    delta = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+    assert delta.get("executor.stage_batches", 0) > 0
+    assert not any(k.startswith("stream.") for k, v in delta.items() if v)
+
+
+def test_block_read_cache_serves_crops(tmp_path):
+    """Unit: the batch cache serves sub-boxes of the superslab read
+    byte-identically; non-box requests fall through to the store."""
+    from cluster_tools_tpu.parallel.dispatch import (
+        BlockReadCache,
+        CachedDataset,
+    )
+    from cluster_tools_tpu.utils.blocking import Blocking
+
+    path = _stage(tmp_path, "n5")
+    ds = file_reader(path, "r")["raw"]
+    blocking = Blocking((24, 32, 32), (8, 16, 16))
+    cache = BlockReadCache()
+    cache.prefetch(ds, path, "raw", blocking, [0, 1, 2, 3], (2, 4, 4))
+    wrapped = CachedDataset(ds, cache, path, "raw")
+    for bid in (0, 3):
+        bh = blocking.block_with_halo(bid, (2, 4, 4))
+        np.testing.assert_array_equal(
+            wrapped[bh.outer.slicing], ds[bh.outer.slicing]
+        )
+        np.testing.assert_array_equal(
+            wrapped[bh.inner.slicing], ds[bh.inner.slicing]
+        )
+    # out-of-prefetch region and non-box indexing both delegate
+    np.testing.assert_array_equal(wrapped[20:24, :, :], ds[20:24, :, :])
+    np.testing.assert_array_equal(wrapped[3], ds[3])
+    assert wrapped.shape == ds.shape and wrapped.dtype == ds.dtype
+
+
+def test_components_only_chain(tmp_path):
+    """watershed=False: the two-member chain (threshold → components)
+    fuses and matches scipy."""
+    path = _stage(tmp_path, "n5")
+    _, delta = _run(tmp_path, path, "two", fused=True, watershed=False)
+    assert delta.get("stream.chains") == 1
+    f = file_reader(path, "r")
+    from cluster_tools_tpu.ops.evaluation import same_partition
+
+    want, _ = ndimage.label(f["raw"][:] > THRESHOLD)
+    assert same_partition(f["cc_two"][:], want)
+    assert "cc_two_mask" not in f
+
+
+def test_sharded_device_threshold_parity(tmp_path):
+    """ctt-stream under the sharded collective: device-side threshold
+    fused into the collective CC program matches the host-threshold
+    ingest path exactly."""
+    from cluster_tools_tpu.workflows import ThresholdedComponentsWorkflow
+
+    path = _stage(tmp_path, "n5")
+    outs = {}
+    for tag, dev_thr in (("host", False), ("dev", True)):
+        config_dir = str(tmp_path / f"configs_sh_{tag}")
+        cfg.write_global_config(
+            config_dir, {"block_shape": [8, 16, 16], "target": "tpu"}
+        )
+        cfg.write_config(
+            config_dir, "sharded_components",
+            {"threshold": THRESHOLD, "device_threshold": dev_thr},
+        )
+        wf = ThresholdedComponentsWorkflow(
+            str(tmp_path / f"tmp_sh_{tag}"), config_dir,
+            input_path=path, input_key="raw",
+            output_path=path, output_key=f"sh_{tag}",
+            sharded=True,
+        )
+        assert build([wf])
+        outs[tag] = file_reader(path, "r")[f"sh_{tag}"][:]
+    np.testing.assert_array_equal(outs["dev"], outs["host"])

@@ -428,6 +428,127 @@ if [ "$cc_rc" -ne 0 ]; then
     exit "$cc_rc"
 fi
 
+echo "== ctt-stream smoke (fused chain parity + lower store reads) =="
+stream_tmp="$(mktemp -d)"
+cat > "$stream_tmp/stream_driver.py" <<'PY'
+import os, stat, sys
+import numpy as np
+from scipy import ndimage
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import StreamingSegmentationWorkflow
+
+td, tag, fused = sys.argv[1], sys.argv[2], sys.argv[3] == "fused"
+sched = os.path.join(td, "sched")
+os.makedirs(sched, exist_ok=True)
+submit, queue = os.path.join(sched, "submit"), os.path.join(sched, "queue")
+with open(submit, "w") as f:
+    f.write('#!/bin/bash\nscript="${@: -1}"\nbash "$script" >/dev/null 2>&1\n'
+            'echo "Submitted batch job 1"\n')
+with open(queue, "w") as f:
+    f.write("#!/bin/bash\nexit 0\n")
+for p in (submit, queue):
+    os.chmod(p, os.stat(p).st_mode | stat.S_IEXEC)
+
+rng = np.random.default_rng(0)
+raw = ndimage.gaussian_filter(rng.random((24, 48, 48)), (1.0, 2.0, 2.0))
+raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+path = os.path.join(td, f"{tag}.n5")
+file_reader(path).create_dataset("raw", data=raw, chunks=(12, 24, 24))
+config_dir = os.path.join(td, f"configs_{tag}")
+cfg.write_global_config(config_dir, {
+    "block_shape": [12, 24, 24], "target": "slurm", "max_jobs": 2,
+    # batches spanning whole z-slab rows maximize the one-superslab-read
+    # win (a 1-block batch degenerates to per-block halo'd reads)
+    "stream_fusion": fused, "device_batch_size": 4,
+    "poll_interval_s": 0.05, "sbatch_cmd": submit, "squeue_cmd": queue,
+    "worker_env": {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+})
+cfg.write_config(config_dir, "threshold", {"threshold": 0.55})
+cfg.write_config(config_dir, "watershed", {
+    "threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+    "halo": [2, 6, 6],
+})
+wf = StreamingSegmentationWorkflow(
+    os.path.join(td, f"tmp_{tag}"), config_dir, max_jobs=2,
+    input_path=path, input_key="raw",
+    output_path=path, output_key="cc",
+)
+assert build([wf]), f"streaming workflow failed ({tag})"
+PY
+
+# the decoded-chunk LRU would hide exactly the cross-task re-reads the
+# fusion removes at this fixture size — byte counts come from the codec
+# boundary in both runs
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+CTT_CHUNK_CACHE_MB=0 CTT_TRACE_DIR="$obs_tmp/trace" \
+CTT_RUN_ID=ci_stream_unfused \
+    python "$stream_tmp/stream_driver.py" "$stream_tmp/unfused" u unfused
+unfused_rc=$?
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+CTT_CHUNK_CACHE_MB=0 CTT_TRACE_DIR="$obs_tmp/trace" \
+CTT_RUN_ID=ci_stream_fused \
+    python "$stream_tmp/stream_driver.py" "$stream_tmp/fused" f fused
+fused_rc=$?
+if [ "$unfused_rc" -ne 0 ] || [ "$fused_rc" -ne 0 ]; then
+    echo "streaming smoke runs failed (unfused rc=$unfused_rc," \
+         "fused rc=$fused_rc)" >&2
+    exit 1
+fi
+JAX_PLATFORMS=cpu python - "$stream_tmp" "$obs_tmp/trace" <<'PY'
+import json, os, sys
+import numpy as np
+from cluster_tools_tpu.utils import file_reader
+
+td, trace = sys.argv[1], sys.argv[2]
+f_un = file_reader(os.path.join(td, "unfused", "u.n5"), "r")
+f_fu = file_reader(os.path.join(td, "fused", "f.n5"), "r")
+np.testing.assert_array_equal(f_fu["cc"][:], f_un["cc"][:])
+np.testing.assert_array_equal(f_fu["cc_ws"][:], f_un["cc_ws"][:])
+assert "cc_mask" in f_un, "unfused run must materialize the mask"
+assert "cc_mask" not in f_fu, "fused run must elide the mask"
+
+
+def totals(run_id):
+    out = {}
+    rdir = os.path.join(trace, run_id)
+    for name in os.listdir(rdir):
+        if name.startswith("metrics.p"):
+            with open(os.path.join(rdir, name)) as fh:
+                for k, v in json.load(fh)["counters"].items():
+                    out[k] = out.get(k, 0) + v
+    return out
+
+
+t_un, t_fu = totals("ci_stream_unfused"), totals("ci_stream_fused")
+r_un, r_fu = t_un.get("store.bytes_read", 0), t_fu.get("store.bytes_read", 0)
+assert r_un > 0 and r_fu > 0, (r_un, r_fu)
+assert r_fu < r_un, f"fused read bytes {r_fu} not < unfused {r_un}"
+assert t_fu.get("stream.chains", 0) >= 1, t_fu
+assert t_fu.get("stream.elided_bytes", 0) > 0, t_fu
+print("stream smoke ok:", json.dumps({
+    "bytes_read_unfused": round(r_un), "bytes_read_fused": round(r_fu),
+    "reduction": round(r_un / r_fu, 2),
+    "slabs": t_fu.get("stream.slabs"),
+}))
+PY
+stream_rc=$?
+rm -rf "$stream_tmp"
+if [ "$stream_rc" -ne 0 ]; then
+    echo "streaming smoke failed (rc=$stream_rc): fused chain output or" \
+         "store-read reduction regressed" >&2
+    exit "$stream_rc"
+fi
+# the fused trace must summarize cleanly (spans + chain tags well-formed)
+JAX_PLATFORMS=cpu python -m cluster_tools_tpu.obs summarize \
+    "$obs_tmp/trace/ci_stream_fused"
+stream_sum_rc=$?
+if [ "$stream_sum_rc" -ne 0 ]; then
+    echo "obs summarize failed on the fused streaming trace" \
+         "(rc=$stream_sum_rc)" >&2
+    exit "$stream_sum_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
