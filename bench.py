@@ -881,6 +881,23 @@ def bench_ws_e2e(x, block_shape):
         except Exception as e:
             log(f"[ws-e2e] ctt-stream bench failed: {e}")
         try:
+            # ctt-steal: static round-robin vs work-stealing queue on the
+            # async stub scheduler over the skewed-cost (hot z-slab ~8x)
+            # fixture — the scheduler A/B, independent of the device
+            from bench_e2e_lib import run_steal_pipeline
+
+            steal_res = run_steal_pipeline()
+            res.update(steal_res)
+            log(
+                "[ws-e2e] ctt-steal skewed-cost A/B: static "
+                f"{steal_res['ws_e2e_steal_static_wall_s']} s -> steal "
+                f"{steal_res['ws_e2e_steal_wall_s']} s "
+                f"({steal_res['ws_e2e_steal_speedup']}x), parity "
+                f"{steal_res['ws_e2e_steal_parity']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] ctt-steal bench failed: {e}")
+        try:
             # below the driver's 450 s ws budget so a slow baseline can
             # never take the already-measured device numbers down with it
             out = subprocess.run(

@@ -326,6 +326,178 @@ def run_stream_pipeline(vol_path, shape, block_shape, target):
     }
 
 
+_SKEWED_TASK_CLS = None
+
+
+def _skewed_cost_task_cls():
+    """Build (once) the skewed-cost fixture task for the scheduler A/B
+    bench.  Defined lazily so importing bench_e2e_lib stays free of
+    cluster_tools_tpu imports (the cpu-baseline subprocess imports this
+    module before pinning its jax platform), but published as module
+    attribute ``SkewedCostTask`` (via the PEP 562 ``__getattr__`` below)
+    so the driver can pickle it to ``task.pkl`` and scheduler workers can
+    unpickle it by reference."""
+    global _SKEWED_TASK_CLS
+    if _SKEWED_TASK_CLS is not None:
+        return _SKEWED_TASK_CLS
+    from cluster_tools_tpu.tasks.base import VolumeTask
+
+    class SkewedCostTask(VolumeTask):
+        """Every block writes a deterministic transform of its input
+        (byte-comparable across scheduling modes); per-block cost is a
+        calibrated stall — blocks whose z-origin falls in the hot z-slab
+        cost ``hot_s`` seconds, the rest ``base_s`` (the ~8x hot-slab
+        skew).  A sleep, not a compute loop, so the measured walls
+        isolate SCHEDULING (assignment + queue mechanics) from kernel
+        throughput and CPU contention between the worker processes."""
+
+        task_name = "skewed_cost"
+        output_dtype = "float32"
+
+        def process_block(self, block_id, blocking, config):
+            bb = blocking.block(block_id)
+            x = self.input_ds()[bb.slicing]
+            hot = bb.begin[0] < int(config.get("hot_z_end", 0))
+            time.sleep(
+                float(config["hot_s"]) if hot else float(config["base_s"])
+            )
+            self.output_ds()[bb.slicing] = (
+                np.asarray(x, dtype="float32") * 2.0 + 1.0
+            )
+
+    SkewedCostTask.__module__ = __name__
+    SkewedCostTask.__qualname__ = "SkewedCostTask"
+    _SKEWED_TASK_CLS = SkewedCostTask
+    return SkewedCostTask
+
+
+def __getattr__(name):
+    # PEP 562: lets pickle resolve bench_e2e_lib.SkewedCostTask in worker
+    # processes without paying the cluster_tools_tpu import at module load
+    if name == "SkewedCostTask":
+        return _skewed_cost_task_cls()
+    raise AttributeError(name)
+
+
+def _write_async_stub_scheduler(folder, piddir):
+    """sbatch/squeue stand-in that runs jobs in the BACKGROUND (unlike the
+    test suite's synchronous stub): submission returns immediately and the
+    queue command reports one line per still-running job pid — so n_jobs
+    workers really execute concurrently, which is the whole point of a
+    scheduler bench."""
+    os.makedirs(folder, exist_ok=True)
+    os.makedirs(piddir, exist_ok=True)
+    submit = os.path.join(folder, "stub_submit")
+    with open(submit, "w") as f:
+        f.write(
+            "#!/bin/bash\n"
+            'script="${@: -1}"\n'
+            'bash "$script" >/dev/null 2>&1 &\n'
+            f'echo "$!" >> {piddir}/pids\n'
+            'echo "Submitted batch job $!"\n'
+        )
+    queue = os.path.join(folder, "stub_queue")
+    with open(queue, "w") as f:
+        f.write(
+            "#!/bin/bash\n"
+            f'[ -f {piddir}/pids ] || exit 0\n'
+            "while read -r p; do\n"
+            '  kill -0 "$p" 2>/dev/null && echo RUNNING\n'
+            f"done < {piddir}/pids\n"
+            "exit 0\n"
+        )
+    import stat as _stat
+
+    for p in (submit, queue):
+        os.chmod(p, os.stat(p).st_mode | _stat.S_IEXEC)
+    return submit, queue
+
+
+def run_steal_pipeline(n_jobs=4, n_z_blocks=25, base_s=1.5, hot_s=12.0):
+    """ctt-steal contract: static round-robin vs work-stealing wall clock
+    on the async stub scheduler, over a skewed-cost fixture — a hot
+    z-slab whose block costs ``hot_s / base_s`` (~8x) as much as the
+    rest.  Geometry makes the skew bite the frozen split the way a hot
+    volume region bites a real run: slab-blocks (one block per z-slab),
+    so ``ids[0::n_jobs]`` pins the hot slab AND an equal share of cold
+    slabs on job 0 while its siblings go idle — the stealing queue
+    redistributes the cold tail and the wall collapses toward the hot
+    block's own cost.  Both paths must be byte-identical
+    (``ws_e2e_steal_parity``)."""
+    from cluster_tools_tpu.runtime import build, config as cfg
+    from cluster_tools_tpu.utils import file_reader
+
+    task_cls = _skewed_cost_task_cls()
+    rng = np.random.default_rng(0)
+    bz, ny, nx = 2, 16, 16
+    vol = rng.random((n_z_blocks * bz, ny, nx)).astype("float32")
+
+    with tempfile.TemporaryDirectory() as td:
+        walls = {}
+        outputs = {}
+        for tag, sched in (("static", "static"), ("steal", "steal")):
+            submit, queue = _write_async_stub_scheduler(
+                os.path.join(td, f"sched_{tag}"),
+                os.path.join(td, f"pids_{tag}"),
+            )
+            path = os.path.join(td, f"{tag}.n5")
+            file_reader(path).create_dataset(
+                "x", data=vol, chunks=(bz, ny, nx)
+            )
+            config_dir = os.path.join(td, f"configs_{tag}")
+            cfg.write_global_config(config_dir, {
+                "block_shape": [bz, ny, nx],
+                "target": "slurm",
+                "max_jobs": n_jobs,
+                "sched": sched,
+                # one block per lease: the finest redistribution grain,
+                # matching the one-block-per-slab fixture
+                "steal_batch_size": 1,
+                "steal_lease_s": 0.5,
+                # A/B purity: the hot block is legitimately 8x, not a dead
+                # straggler — duplication would re-run it on an idle
+                # worker whose (harmless, losing) copy keeps its job alive
+                # past the owner's finish and pads the measured wall
+                "steal_duplicate": False,
+                "poll_interval_s": 0.2,
+                "sbatch_cmd": submit,
+                "squeue_cmd": queue,
+                "worker_env": {
+                    "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                },
+            })
+            cfg.write_config(config_dir, "skewed_cost", {
+                "hot_z_end": bz,  # the first z-slab is the hot one
+                "base_s": float(base_s),
+                "hot_s": float(hot_s),
+            })
+            task = task_cls(
+                os.path.join(td, f"tmp_{tag}"), config_dir,
+                max_jobs=n_jobs,
+                input_path=path, input_key="x",
+                output_path=path, output_key="y",
+            )
+            t0 = time.perf_counter()
+            ok = build([task])
+            walls[tag] = time.perf_counter() - t0
+            if not ok:
+                raise RuntimeError(f"steal bench run failed ({tag})")
+            outputs[tag] = path
+
+        with file_reader(outputs["static"], "r") as fs, \
+                file_reader(outputs["steal"], "r") as fw:
+            parity = bool(np.array_equal(fs["y"][:], fw["y"][:]))
+
+    return {
+        "ws_e2e_steal_static_wall_s": round(walls["static"], 2),
+        "ws_e2e_steal_wall_s": round(walls["steal"], 2),
+        "ws_e2e_steal_speedup": round(
+            walls["static"] / max(walls["steal"], 1e-9), 2
+        ),
+        "ws_e2e_steal_parity": parity,
+    }
+
+
 def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False,
                     sharded=False):
     """Wall-clock of the WatershedWorkflow alone — the BASELINE.md north

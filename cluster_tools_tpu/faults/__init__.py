@@ -41,6 +41,12 @@ Sites (each named where the corresponding code path lives):
       slow peer; ``fail`` a poisoned barrier).
   ``collective.init`` / ``collective.execute``  — parallel/sharded.py entry
       kernels (init failures trigger the graceful sharded→local fallback).
+  ``sched.claim`` (ctx ``id``: queue item; between candidate selection and
+      the lease link — ``stall`` widens the claim race the link
+      arbitrates) / ``sched.write`` (lease payloads; ``torn`` truncates
+      the lease JSON — readers age it from file mtime, so a torn lease
+      still expires) / ``sched.requeue`` (the expired-lease takeover —
+      stale-requeue storms)  — runtime/queue.py (ctt-steal).
 
 Actions: ``io_error`` (OSError EIO), ``fail`` (FaultInjected), ``kill``
 (``os._exit(KILL_EXIT_CODE)`` — a hard crash, no cleanup), ``stall``
@@ -101,6 +107,7 @@ KNOWN_SITES = frozenset({
     "worker.job", "worker.exit",
     "task.barrier",
     "collective.init", "collective.execute",
+    "sched.claim", "sched.write", "sched.requeue",
 })
 
 KNOWN_ACTIONS = frozenset({"io_error", "fail", "kill", "stall", "torn"})

@@ -27,6 +27,9 @@ Heartbeat file schema (one JSON object, atomically replaced per beat)::
       "blocks_done": 24, "blocks_failed": 1, "blocks_retried": 1,
       "grid": [2, 4, 4] | null,      # blocking grid (heatmap geometry)
       "current_blocks": [{"id": 17, "start_mono": 5529.9}, ...],
+      "queue_depth": 3 | null,       # unclaimed work-queue items as last
+                                     # seen by this worker's pull loop
+                                     # (ctt-steal; null outside steal runs)
       "device_mem_peak_bytes": 1048576 | null
     }
 
@@ -67,7 +70,8 @@ __all__ = [
     "ensure_started", "stop", "beat", "running", "interval_s",
     "note_task", "note_blocks_done", "note_blocks_failed",
     "note_blocks_retried", "note_block_start", "note_block_end",
-    "set_role", "install_sigterm_flush", "FILE_PREFIX", "ENV_INTERVAL",
+    "note_queue_depth", "set_role", "install_sigterm_flush",
+    "FILE_PREFIX", "ENV_INTERVAL",
 ]
 
 ENV_INTERVAL = "CTT_HEARTBEAT_S"
@@ -105,6 +109,7 @@ class _BeatState:
         self.blocks_failed = 0
         self.blocks_retried = 0
         self.grid: Optional[list] = None
+        self.queue_depth: Optional[int] = None  # ctt-steal pull loops only
         self.current: Dict[int, float] = {}  # block id -> start mono
         self.seq = 0
         self.thread: Optional[threading.Thread] = None
@@ -180,6 +185,7 @@ def _write_beat(st: _BeatState, exiting: bool) -> None:
                 {"id": int(b), "start_mono": float(t0)}
                 for b, t0 in current[:_MAX_CURRENT_BLOCKS]
             ],
+            "queue_depth": st.queue_depth,
             "device_mem_peak_bytes": _device_mem_peak_bytes(),
         }
     path = os.path.join(rdir, f"{FILE_PREFIX}{os.getpid()}.json")
@@ -329,6 +335,16 @@ def note_blocks_retried(n: int = 1) -> None:
         return
     with st.lock:
         st.blocks_retried += int(n)
+
+
+def note_queue_depth(n: int) -> None:
+    """ctt-steal: unclaimed work-queue items at this worker's last pull
+    scan — `obs watch` shows how much stealable work remains."""
+    st = _state_if_enabled()
+    if st is None:
+        return
+    with st.lock:
+        st.queue_depth = int(n)
 
 
 def note_block_start(block_id: int) -> None:

@@ -293,6 +293,7 @@ class LiveRun:
                 "blocks_failed": int(hb.get("blocks_failed") or 0),
                 "blocks_retried": int(hb.get("blocks_retried") or 0),
                 "device_mem_peak_bytes": hb.get("device_mem_peak_bytes"),
+                "queue_depth": hb.get("queue_depth"),
                 "current_blocks": hb.get("current_blocks") or [],
                 "mono": float(hb.get("mono") or 0.0),
                 "grid": hb.get("grid"),
@@ -551,6 +552,20 @@ def format_watch(snap: Dict[str, Any]) -> str:
             f"{s['in_flight_s']:.1f}s (median {s['median_s']:.3f}s) "
             f"on pid {s['pid']}"
         )
+    counters = snap.get("counters", {})
+    if any(k.startswith("sched.") for k in counters):
+        # ctt-steal: one line of scheduler health — how much work remains
+        # unclaimed and how the leases have moved
+        depth = snap.get("gauges", {}).get("sched.queue_depth")
+        parts = [
+            f"queue depth {int(depth)}" if isinstance(depth, (int, float))
+            else None,
+            f"claimed {int(counters.get('sched.leases_claimed', 0))}",
+            f"expired {int(counters.get('sched.leases_expired', 0))}",
+            f"requeued {int(counters.get('sched.leases_requeued', 0))}",
+            f"stolen {int(counters.get('sched.leases_stolen', 0))}",
+        ]
+        lines.append("  sched: " + ", ".join(p for p in parts if p))
     for w in snap["stale_workers"]:
         where = f"job {w['job_id']}" if w["job_id"] is not None else "driver"
         lines.append(
@@ -646,6 +661,10 @@ def render_openmetrics(snap: Dict[str, Any]) -> str:
             ("ctt_worker_device_mem_peak_bytes", "gauge", "",
              lambda w: (float(w["device_mem_peak_bytes"])
                         if w["device_mem_peak_bytes"] is not None else None)),
+            ("ctt_worker_queue_depth", "gauge",
+             "unclaimed work-queue items at the worker's last pull (ctt-steal)",
+             lambda w: (float(w["queue_depth"])
+                        if w.get("queue_depth") is not None else None)),
         ]
         for name, mtype, help_text, fn in specs:
             rows = []

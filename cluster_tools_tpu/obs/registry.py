@@ -63,6 +63,14 @@ COUNTERS = frozenset({
     "faults.injected",
     # parallel/sharded.py — collective→local degradations
     "sharded.fallback_local",
+    # runtime/queue.py — ctt-steal work-stealing scheduler
+    "sched.leases_claimed",      # lease links won (gen 0 + requeues)
+    "sched.leases_expired",      # leases found stale (3x cadence) on claim
+    "sched.leases_requeued",     # expired leases taken over at gen+1
+    "sched.leases_stolen",       # straggler items duplicated (no lease;
+                                 # first-writer-wins result)
+    "sched.driver_drain_blocks",  # blocks the driver backstop pulled after
+                                  # every scheduler job had exited
     # runtime/stream.py — ctt-stream fused-chain execution
     "stream.chains",        # fused chains executed to completion
     "stream.slabs",         # block batches (z-slabs) streamed through a chain
@@ -76,6 +84,8 @@ GAUGES = frozenset({
     "compile_cache.entries_at_enable",
     # runtime/stream.py — peak carried merge-state bytes of a fused chain
     "stream.carry_bytes",
+    # runtime/queue.py — unclaimed work-queue items at the last pull scan
+    "sched.queue_depth",
 })
 
 # dynamic name families: one series per <suffix>, allowed by prefix

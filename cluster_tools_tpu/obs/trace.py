@@ -55,6 +55,30 @@ Run-directory file formats (everything ``obs.live`` tails)::
                                 device-memory high-water + an (wall, mono)
                                 anchor and the promised cadence — full
                                 field list in obs/heartbeat.py.
+
+Work-queue file formats (ctt-steal; live in ``<job_dir>/queue/`` next to
+the cluster job scripts, not the trace dir — documented here beside the
+heartbeat schema because leases follow the same clock contract: wall
+stamps for cross-process ageing, monotonic for the writer's diagnostics)::
+
+    manifest.json               written once by the driver (fsync'd
+                                atomic): {"task", "items": [[block ids],
+                                ...], "lease_s", "duplicate",
+                                "created_wall"}.
+    lease.<k>.g<g>.json         generation-g ownership of item k, created
+                                by an exclusive os.link publish (the claim
+                                race's arbiter) and atomically re-stamped
+                                every lease_s by the owner: {"item",
+                                "gen", "blocks", "owner_pid", "job_id",
+                                "host", "claim_wall", "wall", "mono"}.
+                                A stamp older than 3 x lease_s means the
+                                owner is dead (the heartbeat-staleness
+                                rule) — any worker may claim gen g+1.
+    result.<k>.json             item k's terminal record, published
+                                first-writer-wins via the same link
+                                idiom: {"item", "gen", "done", "failed",
+                                "errors", "pid", "job_id", "duplicate",
+                                "seconds", "wall"}.
 """
 
 from __future__ import annotations

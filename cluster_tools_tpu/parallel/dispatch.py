@@ -20,6 +20,17 @@ from ..obs import trace as obs_trace
 from ..utils.blocking import Blocking, BlockWithHalo
 
 
+def form_batches(block_ids: Sequence[int], batch_size: int) -> List[List[int]]:
+    """Chunk a block-id sequence into dispatch batches — the ONE batch
+    formation rule, shared by the device executor (blocks per jit
+    dispatch), the fused-chain runner, and the ctt-steal work queue
+    (blocks per lease), so a pulled item and a static dispatch chunk the
+    same id run identically."""
+    ids = [int(b) for b in block_ids]
+    bs = max(int(batch_size), 1)
+    return [ids[i: i + bs] for i in range(0, len(ids), bs)]
+
+
 @dataclass
 class BlockBatch:
     """A stacked batch of (possibly halo'd) blocks plus their geometry."""

@@ -1,12 +1,18 @@
 """Batch-scheduler executors: Slurm and LSF.
 
 The reference's headline deployment mode (cluster_tasks.py:388-624) re-designed
-on the executor seam: blocks are round-robined over N scheduler jobs
-(``block_list[job_id::n_jobs]``, the reference's assignment at
-cluster_tasks.py:331), each job runs ``runtime.cluster_worker`` on its share
-and writes a per-job status JSON; the submitting process polls the queue and
-aggregates statuses — no shebang rewriting, no script shipping, no
-log-grepping.
+on the executor seam: each scheduler job runs ``runtime.cluster_worker`` and
+writes a per-job status JSON; the submitting process polls the queue and
+aggregates — no shebang rewriting, no script shipping, no log-grepping.
+
+Block assignment (ctt-steal): by default on multi-job runs, workers PULL
+block batches from a shared filesystem work queue with expiring leases
+(``runtime/queue.py`` — worker death self-heals through lease requeue,
+late joiners just start pulling, stragglers get duplicated
+first-writer-wins).  ``CTT_SCHED=static`` (or config ``"sched"``)
+restores the reference's frozen round-robin split
+(``block_list[job_id::n_jobs]``, cluster_tasks.py:331) byte-identically —
+the A/B baseline and the path for ``allow_retry=False`` tasks.
 
 Scheduler interaction is two overridable commands (``submit_command`` /
 ``queue_command``), so the submission path is unit-testable with a stub
@@ -19,12 +25,15 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import shutil
 import subprocess
 import sys
 import time
 from typing import Any, Dict, List, Sequence, Set
 
+from ..obs import metrics as obs_metrics
 from ..utils.blocking import Blocking
+from . import queue as workq
 from .cluster_worker import job_paths
 from .executor import BaseExecutor, RunResult, register_executor
 
@@ -78,21 +87,33 @@ class ClusterExecutor(BaseExecutor):
         from ..utils.store import release_h5_handles
 
         release_h5_handles()
+        mode = workq.resolve_sched(config, task, n_jobs)
+        queue = None
+        if mode == "steal":
+            queue = self._create_queue(task, job_dir, ids, config, n_jobs)
         for job_id in range(n_jobs):
             _, config_path, status_path = job_paths(job_dir, job_id)
             if os.path.exists(status_path):
                 os.remove(status_path)
+            if queue is not None:
+                job_conf = {
+                    # ctt-steal: no frozen share — the worker pulls leased
+                    # block batches from the shared queue
+                    "queue_dir": queue.dir,
+                    "shape": list(blocking.shape),
+                    "block_shape": list(blocking.block_shape),
+                    "config": _jsonable(config),
+                }
+            else:
+                job_conf = {
+                    # reference round-robin assignment cluster_tasks.py:331
+                    "block_ids": ids[job_id::n_jobs],
+                    "shape": list(blocking.shape),
+                    "block_shape": list(blocking.block_shape),
+                    "config": _jsonable(config),
+                }
             with open(config_path, "w") as f:
-                json.dump(
-                    {
-                        # reference round-robin assignment cluster_tasks.py:331
-                        "block_ids": ids[job_id::n_jobs],
-                        "shape": list(blocking.shape),
-                        "block_shape": list(blocking.block_shape),
-                        "config": _jsonable(config),
-                    },
-                    f,
-                )
+                json.dump(job_conf, f)
             script = self._write_job_script(job_dir, job_id, config)
             log = os.path.join(job_dir, f"job_{job_id}.log")
             err = os.path.join(job_dir, f"job_{job_id}.err")
@@ -104,7 +125,96 @@ class ClusterExecutor(BaseExecutor):
                 )
 
         self._wait(job_name, n_jobs)
+        if queue is not None:
+            self._drain_leftovers(task, blocking, config, queue)
+            return self._aggregate_steal(job_dir, n_jobs, queue)
         return self._aggregate(job_dir, n_jobs, ids)
+
+    # -- ctt-steal: queue setup + driver backstop ---------------------------
+
+    def _create_queue(self, task, job_dir: str, ids: List[int],
+                      config, n_jobs: int) -> "workq.WorkQueue":
+        queue_dir = os.path.join(job_dir, "queue")
+        if os.path.isdir(queue_dir):
+            # one queue per dispatch: a retry round (or a resumed driver)
+            # re-publishes exactly its todo list — stale leases/results
+            # from a previous round must not satisfy it
+            shutil.rmtree(queue_dir)
+        return workq.WorkQueue.create(
+            queue_dir, task.identifier, ids,
+            workq.steal_batch_size(config, len(ids), n_jobs),
+            workq._lease_interval_s(config),
+            duplicate=bool(config.get("steal_duplicate", True)),
+        )
+
+    def _drain_leftovers(self, task, blocking, config, queue) -> None:
+        """Elastic worker of last resort: every scheduler job has exited,
+        yet items remain unresolved (workers died holding leases, or the
+        scheduler never really ran them).  The driver pulls the leftovers
+        through the local path itself — completion via lease requeue, not
+        a task-level resubmission round.  Loud: systematic worker
+        breakage must read as 'driver drained N blocks', never as a
+        silently single-process run."""
+        if queue.all_resolved():
+            return
+        from .executor import LocalExecutor
+
+        worker_conf = dict(config)
+        worker_conf["target"] = "local"
+        executor = LocalExecutor(worker_conf)
+
+        def run_item(claim):
+            return executor.run_blocks(
+                task, blocking, claim.block_ids, worker_conf
+            )
+
+        stats = workq.drain(queue, run_item, job_id=None)
+        n = len(stats["done"]) + len(stats["failed"])
+        if n:
+            obs_metrics.inc("sched.driver_drain_blocks", n)
+            print(
+                f"[{self.name}] scheduler jobs exited with "
+                f"{len(stats['items'])} queue item(s) unresolved — driver "
+                f"drained {n} block(s) via lease requeue "
+                f"(task {task.identifier})"
+            )
+
+    def _aggregate_steal(self, job_dir: str, n_jobs: int,
+                         queue) -> RunResult:
+        """Aggregate from the queue's ownership records (satellite of the
+        static `_aggregate` fix): every block's fate comes from the item
+        result written by its ACTUAL last owner — a stolen or requeued
+        block is never blamed on the job a frozen split would have
+        assigned it to.  Job status files contribute job-scope diagnostics
+        (setup failures, crashes) only."""
+        done, failed, errors, _owners = queue.aggregate()
+        for job_id in range(n_jobs):
+            _, _, status_path = job_paths(job_dir, job_id)
+            status = self._read_status(status_path)
+            if status is None:
+                if failed:
+                    errors.setdefault(
+                        -1,
+                        f"job {job_id} wrote no status file (its leases "
+                        "requeued to surviving workers)",
+                    )
+                continue
+            for k, v in status.get("errors", {}).items():
+                if not k.lstrip("-").isdigit():
+                    errors.setdefault(
+                        failed[0] if failed else -1, f"job {job_id} {k}: {v}"
+                    )
+        return sorted(set(done)), failed, errors
+
+    @staticmethod
+    def _read_status(status_path: str):
+        if not os.path.exists(status_path):
+            return None
+        try:
+            with open(status_path) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return None
 
     def _write_job_script(self, job_dir: str, job_id: int, config) -> str:
         script = os.path.join(job_dir, f"job_{job_id}.sh")
@@ -142,8 +252,16 @@ class ClusterExecutor(BaseExecutor):
         failed_set: Set[int] = set(ids)
         errors: Dict[int, str] = {}
         for job_id in range(n_jobs):
-            _, _, status_path = job_paths(job_dir, job_id)
+            _, config_path, status_path = job_paths(job_dir, job_id)
+            # attribute by the job's RECORDED assignment (job_N.json), not
+            # a re-derived slice: the record is what the worker actually
+            # ran, and stays correct if the formation rule ever changes
             job_blocks = ids[job_id::n_jobs]
+            job_conf = self._read_status(config_path)
+            if job_conf is not None and isinstance(
+                job_conf.get("block_ids"), list
+            ):
+                job_blocks = [int(b) for b in job_conf["block_ids"]]
             anchor = job_blocks[0] if job_blocks else -1
             if not os.path.exists(status_path):
                 # job died before writing status (crash/kill/preemption) —

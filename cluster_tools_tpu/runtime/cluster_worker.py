@@ -1,10 +1,23 @@
 """Worker entry point for batch-scheduler executors.
 
-One scheduler job = one invocation of this module: it loads the pickled task,
-runs its assigned blocks through the in-process local path, and writes a
+One scheduler job = one invocation of this module: it loads the pickled
+task, runs blocks through the in-process local path, and writes a
 machine-readable per-job status JSON (the positive-success analog of the
 reference's ``processed job/block`` log lines, function_utils.py:11-16 —
 parsed back by the submitting process without log-grepping).
+
+Two assignment modes (ctt-steal), selected by the job config file:
+
+  * ``"queue_dir"`` present — the worker PULLS leased block batches from
+    the shared work queue (``runtime/queue.py``) until every item has a
+    terminal record: it claims unclaimed items, requeues expired leases
+    of dead peers, and duplicates stragglers first-writer-wins.  The
+    status file then reports the blocks this worker actually ran (plus
+    the item ids), and the submitting process aggregates from the
+    queue's ownership records.  Any process pointed at the job dir can
+    join late and just start pulling — elasticity is the default.
+  * ``"block_ids"`` present — the frozen static share (the reference's
+    round-robin split), byte-identical to the pre-steal path.
 
 Live telemetry (ctt-watch): when tracing is enabled the worker heartbeats
 (``obs/heartbeat.py`` — role ``worker`` + its scheduler job id) so the
@@ -54,6 +67,35 @@ def _write_status(status_path: str, status: dict) -> None:
     atomic_write_bytes(status_path, json.dumps(status).encode())
 
 
+def _drain_queue(queue_dir, task, blocking, config, executor, job_id):
+    """ctt-steal pull loop: claim leased block batches until the queue is
+    fully resolved, running each through the local executor.  The
+    heartbeat total grows with each pull (there is no frozen share), so
+    ``obs watch`` shows a per-worker progress that reflects the blocks
+    this process actually owns."""
+    from ..obs import heartbeat as obs_heartbeat
+    from .queue import WorkQueue, drain
+
+    queue = WorkQueue(queue_dir)
+    ident = getattr(task, "identifier", "unknown")
+    pulled = [0]
+
+    def run_item(claim):
+        pulled[0] += len(claim.block_ids)
+        obs_heartbeat.note_task(ident, pulled[0], grid=blocking.grid_shape)
+        return executor.run_blocks(task, blocking, claim.block_ids, config)
+
+    stats = drain(queue, run_item, job_id=job_id)
+    return {
+        "done": [int(b) for b in stats["done"]],
+        "failed": [int(b) for b in stats["failed"]],
+        "errors": {str(k): v for k, v in stats["errors"].items()},
+        "items": [int(k) for k in stats["items"]],
+        "duplicated_items": [int(k) for k in stats["duplicated"]],
+        "sched": "steal",
+    }
+
+
 def run_job(job_dir: str, job_id: int) -> int:
     task_path, config_path, status_path = job_paths(job_dir, job_id)
     # preemption hook first: a SIGTERM during setup must already flush
@@ -89,34 +131,47 @@ def run_job(job_dir: str, job_id: int) -> int:
 
     blocking = Blocking(job["shape"], job["block_shape"])
     config = dict(job["config"])
+    ident = getattr(task, "identifier", "unknown")
+    queue_dir = job.get("queue_dir")
+    static_ids = job.get("block_ids") or []
     # this job's share in the heartbeat stream: run_blocks is driven
-    # directly here (no Task.run), so the task/total fields need setting
-    obs_heartbeat.note_task(
-        getattr(task, "identifier", "unknown"),
-        len(job["block_ids"]),
-        grid=blocking.grid_shape,
-    )
-    # inside one scheduler job, blocks run through the plain local path
+    # directly here (no Task.run), so the task/total fields need setting.
+    # Queue mode has no frozen share — the total grows per pulled item.
+    obs_heartbeat.note_task(ident, len(static_ids), grid=blocking.grid_shape)
+    # inside one scheduler job, blocks run through the plain local path.
+    # The local executor reads ``max_jobs`` as its thread-pool width, but
+    # in here that key means the SCHEDULER JOB COUNT — a worker that
+    # spawned one block thread per sibling job was a config misuse
+    # (n_jobs x n_jobs block concurrency across the cluster).  Intra-job
+    # width is ``threads_per_job``, the reference's per-job knob.
     config["target"] = "local"
+    try:
+        config["max_jobs"] = max(int(config.get("threads_per_job", 1)), 1)
+    except (TypeError, ValueError):
+        config["max_jobs"] = 1
     executor = LocalExecutor(config)
     try:
         with obs_trace.span(
-            f"job_{job_id}", kind="host",
-            task=getattr(task, "identifier", "unknown"),
-            blocks=len(job["block_ids"]),
+            f"job_{job_id}", kind="host", task=ident,
+            blocks=len(static_ids),
         ):
-            done, failed, errors = executor.run_blocks(
-                task, blocking, job["block_ids"], config
-            )
-        status = {
-            "done": [int(b) for b in done],
-            "failed": [int(b) for b in failed],
-            "errors": {str(k): v for k, v in errors.items()},
-        }
+            if queue_dir:
+                status = _drain_queue(
+                    queue_dir, task, blocking, config, executor, job_id,
+                )
+            else:
+                done, failed, errors = executor.run_blocks(
+                    task, blocking, static_ids, config
+                )
+                status = {
+                    "done": [int(b) for b in done],
+                    "failed": [int(b) for b in failed],
+                    "errors": {str(k): v for k, v in errors.items()},
+                }
     except Exception:
         status = {
             "done": [],
-            "failed": [int(b) for b in job["block_ids"]],
+            "failed": [int(b) for b in static_ids],
             "errors": {"job": traceback.format_exc()},
         }
     # chaos seam: `kill` here dies WITHOUT a status file (the submitter's

@@ -41,6 +41,17 @@ DEFAULT_GLOBAL_CONFIG: Dict[str, Any] = {
     # pass, elided intermediates); False forces task-at-a-time execution
     # everywhere (CTT_STREAM_FUSION=0 is the per-process override)
     "stream_fusion": True,
+    # ctt-steal: cluster-job block assignment — None = auto ("steal" on
+    # multi-job runs of retryable tasks, "static" otherwise); "static"
+    # restores the reference's frozen round-robin split byte-identically.
+    # CTT_SCHED is the per-process override.  Workers pull batches of
+    # steal_batch_size blocks (None = ~4 pulls per worker) under leases
+    # renewed every steal_lease_s seconds (None = the heartbeat cadence);
+    # steal_duplicate enables straggler re-dispatch (first-writer-wins).
+    "sched": None,
+    "steal_batch_size": None,
+    "steal_lease_s": None,
+    "steal_duplicate": True,
     "devices": None,  # None = all jax.devices()
     "seed": 0,
     # multi-host scale-out: run the SAME driver script on every host with

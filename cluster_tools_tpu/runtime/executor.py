@@ -307,9 +307,9 @@ class TpuExecutor(BaseExecutor):
         self, task, blocking, config, ids, batch_size, batch_fn,
         done, failed, errors,
     ) -> None:
-        chunks = [
-            ids[i : i + batch_size] for i in range(0, len(ids), batch_size)
-        ]
+        from ..parallel.dispatch import form_batches
+
+        chunks = form_batches(ids, batch_size)
 
         batch_seconds: List[float] = []  # list.append: safe from pool threads
 

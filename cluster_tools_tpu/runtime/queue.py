@@ -1,0 +1,585 @@
+"""ctt-steal: dynamic work-stealing block scheduler over a filesystem queue.
+
+The batch-scheduler executors previously froze the reference's round-robin
+assignment (``block_list[job_id::n_jobs]``, cluster_tasks.py:331) into each
+job's config file: one slow volume region or one preempted node pinned a
+whole job while its siblings sat idle, and the only recovery from a dead
+worker was a full task-level retry round (resubmission of everything the
+job's status file never reported done).  ctt-watch can *see* those
+stragglers (per-task ETA, in-flight block age, heartbeat staleness) —
+this module is the control loop that *acts* on them.
+
+Instead of a frozen split, the driver publishes one **work queue** on the
+shared filesystem (``<job_dir>/queue/``) and workers *pull* block batches
+under expiring **leases**:
+
+  * ``manifest.json`` — the immutable item list (block-id batches, formed
+    with the same ``parallel.dispatch.form_batches`` chunking the device
+    executor uses) plus the lease cadence; written once by the driver
+    (fsync'd atomic write, the store convention).
+  * ``lease.<k>.g<g>.json`` — generation ``g`` ownership of item ``k``.
+    Claims are **atomic and exclusive**: the payload is staged to a tmp
+    file and ``os.link``-ed into place — the link either creates the name
+    or fails with EEXIST, the same once-latch idiom as the ctt-fault
+    ``O_CREAT|O_EXCL`` cross-process latches, but carrying a full record.
+    The owner re-stamps its lease every ``lease_s`` (atomic replace); a
+    lease whose stamp is older than ``3 x lease_s`` is **expired** — the
+    exact heartbeat-staleness rule ctt-watch uses for suspected-dead
+    workers (obs/live.py, ``stale_intervals = 3``) — and any worker may
+    **requeue** it by claiming generation ``g+1``.  Worker death and
+    preemption are therefore self-healing: no task-level retry round, no
+    resubmission.
+  * ``result.<k>.json`` — terminal per-item record (done/failed blocks,
+    errors, owner pid/job, generation, seconds), published with the same
+    link idiom: **first writer wins**.  That makes straggler duplication
+    safe: an idle worker may re-run the oldest in-flight item
+    (``claim age > straggler_k x median item seconds``, the obs.live
+    straggler rule) without taking the lease — block outputs ride the
+    store's atomic chunk writes and are byte-identical by construction,
+    and whichever copy finishes first owns the accounting.
+
+Elasticity falls out of the pull model: a late-joining process (an extra
+scheduler job, a burst node, or the driver itself as the worker of last
+resort after the scheduler queue drains) just starts pulling.
+
+Clock discipline: lease stamps carry wall time (cross-process ageing, the
+same contract as heartbeat ``wall`` fields — readers compare *stored*
+stamps against one local ``time.time()`` read) plus the writer's
+monotonic clock for diagnostics.  Durations (item seconds) are monotonic.
+
+Chaos sites (ctt-fault): ``sched.claim`` fires between candidate
+selection and the lease link (forces two workers into the claim race the
+link arbitrates), ``sched.write`` supports ``torn`` lease payloads
+(readers fall back to file mtime for ageing — a torn lease still
+expires), ``sched.requeue`` fires on the expired-lease takeover path
+(stale-requeue storms).
+
+The static split remains available and byte-identical:
+``CTT_SCHED=static`` (or global config ``"sched": "static"``) restores
+the frozen ``ids[job_id::n_jobs]`` assignment; the default is ``steal``
+on multi-job runs of retryable tasks (requeue and duplication re-run
+blocks, so ``allow_retry=False`` tasks keep the static split).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .. import faults
+from ..obs import heartbeat as obs_heartbeat
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..utils.store import atomic_write_bytes
+
+__all__ = [
+    "WorkQueue", "Claim", "drain", "resolve_sched", "sched_label",
+    "steal_batch_size", "publish_once", "MANIFEST_NAME", "ENV_SCHED",
+    "STALE_INTERVALS", "STRAGGLER_K",
+]
+
+ENV_SCHED = "CTT_SCHED"
+MANIFEST_NAME = "manifest.json"
+
+# a lease is expired when its stamp is older than STALE_INTERVALS x the
+# renewal cadence — the ctt-watch suspected-dead rule (obs/live.py)
+STALE_INTERVALS = 3.0
+# duplicate the oldest in-flight item once its claim age exceeds
+# STRAGGLER_K x the median completed-item seconds — the ctt-watch
+# straggler rule (obs/live.py)
+STRAGGLER_K = 4.0
+
+_LEASE_RE = re.compile(r"^lease\.(\d+)\.g(\d+)\.json$")
+_RESULT_RE = re.compile(r"^result\.(\d+)\.json$")
+
+
+def publish_once(path: str, payload: bytes) -> bool:
+    """Atomically publish ``payload`` at ``path`` iff nothing is there yet.
+
+    Stage to a pid+thread-unique tmp file (fsync'd, the store convention)
+    and ``os.link`` it into place: the link either creates ``path`` with
+    the full payload visible — no reader can observe a partial file — or
+    fails with EEXIST.  Returns True when this caller won the slot.  The
+    cross-process-exclusive cousin of ``atomic_write_bytes`` (which
+    last-writer-wins replaces)."""
+    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
+    atomic_write_bytes(tmp, payload)
+    try:
+        os.link(tmp, path)
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def resolve_sched(config: Dict[str, Any], task=None,
+                  n_jobs: int = 1) -> str:
+    """Scheduling mode for a cluster dispatch: ``CTT_SCHED`` env beats the
+    global-config ``sched`` key beats the default (``steal`` on multi-job
+    runs of retryable tasks, ``static`` otherwise).  Unknown values are
+    loud — a silently disarmed A/B switch would certify nothing (the
+    CTT_FAULTS precedent, not the degrade-to-default one)."""
+    raw = os.environ.get(ENV_SCHED) or config.get("sched")
+    if raw is not None:
+        mode = str(raw).strip().lower()
+        if mode not in ("static", "steal", "auto", ""):
+            raise ValueError(
+                f"unknown scheduler mode {raw!r} (CTT_SCHED / config "
+                "'sched'): expected 'static', 'steal' or 'auto'"
+            )
+        if mode in ("static", "steal"):
+            if mode == "steal" and task is not None and not task.allow_retry:
+                # requeue/duplication re-run blocks; a task that forbids
+                # redoing block outputs must keep the frozen split
+                return "static"
+            return mode
+    if n_jobs > 1 and (task is None or task.allow_retry):
+        return "steal"
+    return "static"
+
+
+def sched_label(config: Dict[str, Any]) -> str:
+    """The *requested* mode for span/status tagging (``auto`` when neither
+    the env nor the config pins one) — resolution against the task happens
+    in the cluster executor."""
+    raw = os.environ.get(ENV_SCHED) or config.get("sched")
+    mode = str(raw).strip().lower() if raw is not None else ""
+    return mode if mode in ("static", "steal") else "auto"
+
+
+def steal_batch_size(config: Dict[str, Any], n_blocks: int,
+                     n_jobs: int) -> int:
+    """Blocks per lease: the ``steal_batch_size`` config knob, else sized
+    for ~4 pulls per worker — granular enough that a hot region spreads,
+    coarse enough that the claim traffic stays negligible."""
+    raw = config.get("steal_batch_size")
+    try:
+        if raw is not None:
+            return max(int(raw), 1)
+    except (TypeError, ValueError):
+        pass
+    per_worker = max(n_blocks // max(n_jobs, 1), 1)
+    return max(per_worker // 4, 1)
+
+
+def _lease_interval_s(config: Dict[str, Any]) -> float:
+    """Renewal cadence: the ``steal_lease_s`` config knob, default the
+    heartbeat cadence (CTT_HEARTBEAT_S) — the lease staleness signal and
+    the ctt-watch liveness signal tick together."""
+    raw = config.get("steal_lease_s")
+    try:
+        val = float(raw) if raw is not None else obs_heartbeat.interval_s()
+    except (TypeError, ValueError):
+        val = obs_heartbeat.interval_s()
+    return val if val > 0 else obs_heartbeat.interval_s()
+
+
+@dataclass
+class Claim:
+    """One pulled work item: a block batch plus the lease that owns it
+    (``lease_path`` is None for straggler duplicates — the duplicate rides
+    first-writer-wins results instead of ownership)."""
+
+    item: int
+    block_ids: List[int]
+    gen: int
+    lease_path: Optional[str]
+    duplicate: bool = False
+    claim_wall: float = field(default_factory=time.time)
+
+
+class WorkQueue:
+    """Client over one queue directory: the driver creates it, any number
+    of workers (scheduler jobs, late joiners, the driver backstop) pull
+    from it concurrently through :meth:`claim` / :meth:`complete`."""
+
+    def __init__(self, queue_dir: str):
+        self.dir = queue_dir
+        with open(os.path.join(queue_dir, MANIFEST_NAME)) as f:
+            m = json.load(f)
+        self.task = m.get("task", "unknown")
+        self.items: List[List[int]] = [list(map(int, it)) for it in m["items"]]
+        self.lease_s = float(m.get("lease_s", 5.0))
+        self.duplicate_enabled = bool(m.get("duplicate", True))
+        self.stale_after_s = STALE_INTERVALS * self.lease_s
+
+    # -- driver side --------------------------------------------------------
+
+    @staticmethod
+    def create(queue_dir: str, task_id: str, block_ids: Sequence[int],
+               batch_size: int, lease_s: float,
+               duplicate: bool = True) -> "WorkQueue":
+        from ..parallel.dispatch import form_batches
+
+        os.makedirs(queue_dir, exist_ok=True)
+        items = form_batches(block_ids, batch_size)
+        atomic_write_bytes(
+            os.path.join(queue_dir, MANIFEST_NAME),
+            json.dumps({
+                "task": task_id,
+                "items": items,
+                "lease_s": float(lease_s),
+                "duplicate": bool(duplicate),
+                "created_wall": time.time(),
+            }).encode(),
+        )
+        return WorkQueue(queue_dir)
+
+    # -- directory scan ------------------------------------------------------
+
+    def _scan(self):
+        """(results, leases) — ``results[k]`` True when item k has a
+        terminal record; ``leases[k] = (gen, path)`` for the highest
+        generation present."""
+        results: Dict[int, bool] = {}
+        leases: Dict[int, Tuple[int, str]] = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            names = []
+        for name in names:
+            m = _RESULT_RE.match(name)
+            if m:
+                results[int(m.group(1))] = True
+                continue
+            m = _LEASE_RE.match(name)
+            if m:
+                k, g = int(m.group(1)), int(m.group(2))
+                cur = leases.get(k)
+                if cur is None or g > cur[0]:
+                    leases[k] = (g, os.path.join(self.dir, name))
+        return results, leases
+
+    def _read_json(self, path: str) -> Optional[dict]:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            return rec if isinstance(rec, dict) else None
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _lease_age_s(self, path: str, now: float) -> float:
+        """Wall age of a lease's last stamp; a torn/unparsable lease ages
+        from its file mtime — it still expires, just without attribution."""
+        rec = self._read_json(path)
+        stamp = None
+        if rec is not None:
+            try:
+                stamp = float(rec["wall"])
+            except (KeyError, TypeError, ValueError):
+                stamp = None
+        if stamp is None:
+            try:
+                stamp = os.path.getmtime(path)
+            except OSError:
+                return 0.0
+        return max(0.0, now - stamp)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _lease_payload(self, item: int, gen: int, job_id,
+                       claim_wall: float) -> bytes:
+        record = {
+            "item": item,
+            "gen": gen,
+            "blocks": self.items[item],
+            "owner_pid": os.getpid(),
+            "job_id": job_id,
+            "host": _hostname(),
+            "claim_wall": claim_wall,
+            "wall": time.time(),
+            "mono": obs_trace.monotonic(),
+        }
+        payload = json.dumps(record).encode()
+        torn = faults.mangle("sched.write", payload, id=item)
+        return payload if torn is None else torn
+
+    def _try_claim(self, item: int, gen: int, job_id) -> Optional[Claim]:
+        claim_wall = time.time()
+        path = os.path.join(self.dir, f"lease.{item}.g{gen}.json")
+        if not publish_once(
+            path, self._lease_payload(item, gen, job_id, claim_wall)
+        ):
+            return None
+        obs_metrics.inc("sched.leases_claimed")
+        return Claim(
+            item=item, block_ids=list(self.items[item]), gen=gen,
+            lease_path=path, claim_wall=claim_wall,
+        )
+
+    def renew(self, claim: Claim, job_id=None) -> None:
+        """Re-stamp an owned lease (atomic replace — claim exclusivity was
+        decided at link time, renewal only refreshes the staleness clock)."""
+        if claim.lease_path is None:
+            return
+        atomic_write_bytes(
+            claim.lease_path,
+            self._lease_payload(claim.item, claim.gen, job_id,
+                                claim.claim_wall),
+        )
+
+    def claim(self, job_id=None,
+              skip_duplicates: Sequence[int] = ()) -> Optional[Claim]:
+        """Pull the next work item: an unclaimed item first, else an
+        expired lease (requeue), else — when enabled — a straggler
+        duplicate.  Returns None when nothing is claimable *right now*
+        (the caller polls; in-flight leases resolve or expire)."""
+        results, leases = self._scan()
+        open_items = [
+            k for k in range(len(self.items)) if k not in results
+        ]
+        unclaimed = [k for k in open_items if k not in leases]
+        obs_metrics.set_gauge("sched.queue_depth", len(unclaimed))
+        obs_heartbeat.note_queue_depth(len(unclaimed))
+
+        for k in unclaimed:
+            # chaos seam: a stall here widens the window between candidate
+            # selection and the lease link — the claim race the link
+            # arbitrates (exactly one winner, tested with real processes)
+            faults.check("sched.claim", id=k)
+            got = self._try_claim(k, 0, job_id)
+            if got is not None:
+                return got
+
+        now = time.time()
+        expired = []
+        for k in open_items:
+            if k not in leases:
+                continue  # raced: claimed above by someone else just now
+            gen, path = leases[k]
+            age = self._lease_age_s(path, now)
+            if age > self.stale_after_s:
+                expired.append((age, k, gen))
+        # oldest first: the longest-dead owner's work requeues first
+        for age, k, gen in sorted(expired, reverse=True):
+            faults.check("sched.requeue", id=k)
+            got = self._try_claim(k, gen + 1, job_id)
+            if got is not None:
+                obs_metrics.inc("sched.leases_expired")
+                obs_metrics.inc("sched.leases_requeued")
+                return got
+
+        if self.duplicate_enabled:
+            dup = self._claim_duplicate(
+                open_items, leases, results, now, skip_duplicates
+            )
+            if dup is not None:
+                return dup
+        return None
+
+    def _claim_duplicate(self, open_items, leases, results, now,
+                         skip_duplicates) -> Optional[Claim]:
+        """Straggler re-dispatch: duplicate the oldest in-flight item once
+        its claim age exceeds STRAGGLER_K x the median completed-item
+        seconds.  No lease is taken — the duplicate's result publish is
+        first-writer-wins and its chunk writes are byte-identical to the
+        owner's by construction."""
+        seconds = []
+        for k in results:
+            rec = self._read_json(
+                os.path.join(self.dir, f"result.{k}.json")
+            )
+            if rec is not None and isinstance(rec.get("seconds"), (int, float)):
+                seconds.append(float(rec["seconds"]))
+        if not seconds:
+            return None
+        seconds.sort()
+        mid = len(seconds) // 2
+        median = (
+            seconds[mid] if len(seconds) % 2
+            else 0.5 * (seconds[mid - 1] + seconds[mid])
+        )
+        if median <= 0:
+            return None
+        best = None
+        for k in open_items:
+            if k in skip_duplicates or k not in leases:
+                continue
+            rec = self._read_json(leases[k][1])
+            try:
+                claim_wall = float(rec["claim_wall"])
+            except (TypeError, KeyError, ValueError):
+                continue
+            age = now - claim_wall
+            if age > STRAGGLER_K * median and (best is None or age > best[0]):
+                best = (age, k)
+        if best is None:
+            return None
+        _, k = best
+        obs_metrics.inc("sched.leases_stolen")
+        return Claim(
+            item=k, block_ids=list(self.items[k]),
+            gen=leases[k][0], lease_path=None, duplicate=True,
+        )
+
+    def complete(self, claim: Claim, done: Sequence[int],
+                 failed: Sequence[int], errors: Dict[int, str],
+                 seconds: float, job_id=None) -> bool:
+        """Publish the item's terminal record (first writer wins — a
+        duplicate and its straggling owner race here, and the loser's
+        identical block outputs are already on the store)."""
+        record = {
+            "item": claim.item,
+            "gen": claim.gen,
+            "done": [int(b) for b in done],
+            "failed": [int(b) for b in failed],
+            "errors": {str(k): v for k, v in errors.items()},
+            "pid": os.getpid(),
+            "job_id": job_id,
+            "duplicate": bool(claim.duplicate),
+            "seconds": float(seconds),
+            "wall": time.time(),
+        }
+        return publish_once(
+            os.path.join(self.dir, f"result.{claim.item}.json"),
+            json.dumps(record).encode(),
+        )
+
+    # -- completion / aggregation -------------------------------------------
+
+    def all_resolved(self) -> bool:
+        results, _ = self._scan()
+        return len(results) >= len(self.items)
+
+    def aggregate(self):
+        """``(done, failed, errors, owners)`` over the whole queue, with
+        failure attribution from the ACTUAL ownership records — a stolen
+        or requeued item is blamed on its real last owner, never on the
+        job a frozen split would have assigned it to."""
+        done: List[int] = []
+        failed: List[int] = []
+        errors: Dict[int, str] = {}
+        owners: Dict[int, dict] = {}
+        results, leases = self._scan()
+        for k, ids in enumerate(self.items):
+            rec = (
+                self._read_json(os.path.join(self.dir, f"result.{k}.json"))
+                if k in results else None
+            )
+            if rec is not None:
+                done.extend(int(b) for b in rec.get("done", []))
+                failed.extend(int(b) for b in rec.get("failed", []))
+                for key, msg in (rec.get("errors") or {}).items():
+                    if str(key).lstrip("-").isdigit():
+                        errors[int(key)] = msg
+                    elif ids:
+                        errors.setdefault(ids[0], f"item {k} {key}: {msg}")
+                owners[k] = {
+                    "pid": rec.get("pid"), "job_id": rec.get("job_id"),
+                    "gen": rec.get("gen"),
+                    "duplicate": bool(rec.get("duplicate")),
+                }
+                continue
+            failed.extend(ids)
+            anchor = ids[0] if ids else -1
+            if k in leases:
+                gen, path = leases[k]
+                lrec = self._read_json(path) or {}
+                owners[k] = {
+                    "pid": lrec.get("owner_pid"),
+                    "job_id": lrec.get("job_id"), "gen": gen,
+                    "duplicate": False,
+                }
+                errors[anchor] = (
+                    f"item {k} leased by job {lrec.get('job_id')} "
+                    f"(pid {lrec.get('owner_pid')}, gen {gen}) but never "
+                    "produced a result — worker died with the lease "
+                    "unrecovered"
+                )
+            else:
+                errors[anchor] = f"item {k} was never claimed"
+        return done, sorted(set(failed) - set(done)), errors, owners
+
+
+def _hostname() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
+def drain(queue: WorkQueue,
+          run_item: Callable[[Claim], Tuple[List[int], List[int], Dict[int, str]]],
+          job_id=None, poll_s: Optional[float] = None) -> Dict[str, Any]:
+    """Pull-execute-publish until every queue item has a terminal record.
+
+    ``run_item(claim) -> (done, failed, errors)`` executes one block
+    batch (a cluster worker routes it through the local executor).  A
+    renewal thread re-stamps the held lease at half the cadence; an
+    exception from ``run_item`` publishes an all-failed result (the
+    deterministic-failure path stays task-retry-mediated — only worker
+    *death* rides the expiry requeue).  When nothing is claimable the
+    worker waits: in-flight leases either resolve, expire (requeue), or
+    age into straggler duplication."""
+    stats: Dict[str, Any] = {
+        "done": [], "failed": [], "errors": {}, "items": [],
+        "duplicated": [],
+    }
+    duplicated: set = set()
+    if poll_s is None:
+        poll_s = min(max(queue.lease_s / 4.0, 0.05), 1.0)
+    while True:
+        claim = queue.claim(job_id=job_id, skip_duplicates=duplicated)
+        if claim is None:
+            if queue.all_resolved():
+                return stats
+            time.sleep(poll_s)  # ctt: noqa[CTT009] queue poll, not an IO retry — in-flight leases resolve, expire, or age into duplication
+            continue
+        if claim.duplicate:
+            duplicated.add(claim.item)
+            stats["duplicated"].append(claim.item)
+        stop = threading.Event()
+        renewer = None
+        if claim.lease_path is not None:
+            renewer = threading.Thread(
+                target=_renew_loop, args=(queue, claim, job_id, stop),
+                name="ctt-lease-renew", daemon=True,
+            )
+            renewer.start()
+        t0 = obs_trace.monotonic()
+        try:
+            with obs_trace.span(
+                "work_item", kind="host", task=queue.task,
+                item=claim.item, blocks=len(claim.block_ids),
+                duplicate=claim.duplicate,
+            ):
+                done, failed, errors = run_item(claim)
+        except Exception:
+            done, failed = [], list(claim.block_ids)
+            errors = {claim.block_ids[0] if claim.block_ids else -1:
+                      traceback.format_exc()}
+        finally:
+            stop.set()
+            if renewer is not None:
+                renewer.join(timeout=max(queue.lease_s, 1.0))
+        won = queue.complete(
+            claim, done, failed, errors, obs_trace.monotonic() - t0,
+            job_id=job_id,
+        )
+        if won:
+            stats["done"].extend(int(b) for b in done)
+            stats["failed"].extend(int(b) for b in failed)
+            stats["errors"].update(errors)
+            stats["items"].append(claim.item)
+
+
+def _renew_loop(queue: WorkQueue, claim: Claim, job_id,
+                stop: threading.Event) -> None:
+    interval = max(queue.lease_s / 2.0, 0.05)
+    while not stop.wait(interval):
+        try:
+            queue.renew(claim, job_id=job_id)
+        except OSError:
+            # renewal is best-effort liveness, like heartbeats: a full
+            # disk must not take the worker down — worst case the lease
+            # expires and the item is duplicated, byte-identically
+            pass

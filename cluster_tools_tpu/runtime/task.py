@@ -593,13 +593,18 @@ class BlockTask(Task):
         self, target, blocking, config, executor, block_ids, todo, done,
         runtimes, max_retries, failure_fraction,
     ) -> None:
+        # ctt-steal: tag dispatch spans with the requested scheduling mode
+        # so obs trace/diff can segment static-vs-steal A/B runs
+        from .queue import sched_label
+
+        sched = sched_label(config)
         attempt = 0
         while todo:
             t0 = obs_trace.monotonic()
             with obs_trace.span(
                 "dispatch", kind="dispatch", task=self.identifier,
                 attempt=attempt, blocks=len(todo),
-                grid=list(blocking.grid_shape),
+                grid=list(blocking.grid_shape), sched=sched,
             ):
                 newly_done, failed, errors = executor.run_blocks(
                     self, blocking, todo, config

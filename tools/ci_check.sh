@@ -549,6 +549,131 @@ if [ "$stream_sum_rc" -ne 0 ]; then
     exit "$stream_sum_rc"
 fi
 
+echo "== ctt-steal smoke (worker kill -> lease requeue, digest == static run) =="
+steal_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu CTT_TRACE_DIR="$obs_tmp/trace" CTT_RUN_ID=ci_steal \
+CTT_FAULT_STATE_DIR="$steal_tmp/fault_state" \
+    python - "$steal_tmp" <<'PY'
+import hashlib, json, os, stat, sys
+
+# the chaos spec must reach only the STEALING run's workers (the static
+# baseline stays fault-free); armed per-run below via worker_env-inherited
+# process environment
+CHAOS_SPEC = "executor.block:kill:ids=2,once;seed=21"
+
+import numpy as np
+from scipy import ndimage
+
+from cluster_tools_tpu.obs import trace as obs_trace
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+td = sys.argv[1]
+sched = os.path.join(td, "sched")
+os.makedirs(sched, exist_ok=True)
+submit, queue = os.path.join(sched, "submit"), os.path.join(sched, "queue")
+with open(submit, "w") as f:
+    f.write('#!/bin/bash\nscript="${@: -1}"\nbash "$script" >/dev/null 2>&1\n'
+            'echo "Submitted batch job 1"\n')
+with open(queue, "w") as f:
+    f.write("#!/bin/bash\nexit 0\n")
+for p in (submit, queue):
+    os.chmod(p, os.stat(p).st_mode | stat.S_IEXEC)
+
+rng = np.random.default_rng(0)
+raw = ndimage.gaussian_filter(rng.random((16, 32, 32)), (1.0, 2.0, 2.0))
+raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+
+
+def run_ws(key, sched_mode, spec=None):
+    if spec is None:
+        os.environ.pop("CTT_FAULTS", None)
+    else:
+        os.environ["CTT_FAULTS"] = spec
+    path = os.path.join(td, f"{key}.n5")
+    file_reader(path).create_dataset("bnd", data=raw, chunks=(8, 16, 16))
+    config_dir = os.path.join(td, f"configs_{key}")
+    cfg.write_global_config(config_dir, {
+        "block_shape": [8, 16, 16], "target": "slurm", "max_jobs": 3,
+        "sched": sched_mode, "steal_lease_s": 0.2, "steal_batch_size": 2,
+        "max_num_retries": 2, "retry_failure_fraction": 0.9,
+        "poll_interval_s": 0.05, "sbatch_cmd": submit, "squeue_cmd": queue,
+        "worker_env": {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+    })
+    cfg.write_config(config_dir, "watershed", {
+        "threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+        "halo": [2, 4, 4],
+    })
+    wf = WatershedWorkflow(
+        os.path.join(td, f"tmp_{key}"), config_dir, max_jobs=3,
+        input_path=path, input_key="bnd",
+        output_path=path, output_key="ws",
+    )
+    try:
+        assert build([wf]), f"{key} watershed build failed"
+    finally:
+        os.environ.pop("CTT_FAULTS", None)
+    return path
+
+
+def digest(root):
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+static = run_ws("static", "static")
+steal = run_ws("steal", "steal", CHAOS_SPEC)
+
+np.testing.assert_array_equal(
+    file_reader(steal, "r")["ws"][:], file_reader(static, "r")["ws"][:]
+)
+assert digest(os.path.join(steal, "ws")) == digest(
+    os.path.join(static, "ws")
+), "stealing chaos output not byte-identical to the static run"
+
+# the kill latched (a worker really died mid-item, once across processes)
+latches = os.listdir(os.environ["CTT_FAULT_STATE_DIR"])
+assert any(l.startswith("executor.block") for l in latches), latches
+
+# recovery went through lease requeue, NOT a task-level retry round
+status = json.load(open(os.path.join(
+    td, "tmp_steal", "status", "watershed.status.json")))
+assert status["complete"] and len(status["block_runtimes"]) == 1, status
+
+from cluster_tools_tpu.obs import metrics as obs_metrics
+
+obs_metrics.flush()  # the driver's own counters (task.blocks_retried) too
+totals = {}
+run_dir = obs_trace.run_dir()
+for name in os.listdir(run_dir):
+    if name.startswith("metrics.p"):
+        with open(os.path.join(run_dir, name)) as f:
+            for k, v in json.load(f)["counters"].items():
+                totals[k] = totals.get(k, 0) + v
+assert totals.get("sched.leases_expired", 0) >= 1, totals
+assert totals.get("sched.leases_requeued", 0) >= 1, totals
+assert totals.get("task.blocks_retried", 0) == 0, totals
+print("steal smoke ok:", json.dumps({
+    k: round(v, 2) for k, v in sorted(totals.items())
+    if k.startswith("sched.")
+}))
+PY
+steal_rc=$?
+rm -rf "$steal_tmp"
+if [ "$steal_rc" -ne 0 ]; then
+    echo "steal smoke failed (rc=$steal_rc): killed worker did not" \
+         "self-heal via lease requeue to a byte-identical output" >&2
+    exit "$steal_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
