@@ -898,6 +898,24 @@ def bench_ws_e2e(x, block_shape):
         except Exception as e:
             log(f"[ws-e2e] ctt-steal bench failed: {e}")
         try:
+            # ctt-serve: N back-to-back small workflows, fresh process
+            # per workflow vs one warm daemon — the setup-amortization
+            # headline, independent of the device (pinned cpu)
+            from bench_e2e_lib import run_serve_pipeline
+
+            serve_res = run_serve_pipeline()
+            res.update(serve_res)
+            log(
+                "[ws-e2e] ctt-serve daemon A/B: "
+                f"{serve_res['ws_e2e_serve_jobs']} jobs cold-process "
+                f"{serve_res['ws_e2e_serve_cold_wall_s']} s -> daemon "
+                f"{serve_res['ws_e2e_serve_wall_s']} s "
+                f"({serve_res['ws_e2e_serve_speedup']}x), parity "
+                f"{serve_res['ws_e2e_serve_parity']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] ctt-serve bench failed: {e}")
+        try:
             # below the driver's 450 s ws budget so a slow baseline can
             # never take the already-measured device numbers down with it
             out = subprocess.run(

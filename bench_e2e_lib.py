@@ -498,6 +498,185 @@ def run_steal_pipeline(n_jobs=4, n_z_blocks=25, base_s=1.5, hot_s=12.0):
     }
 
 
+def run_serve_pipeline(n_jobs=6, shape=(8, 32, 32), block_shape=(8, 16, 16)):
+    """ctt-serve contract: N back-to-back small watershed workflows,
+    cold-process vs daemon-submitted — the amortization headline.
+
+    The cold path is the pre-serve deployment: each workflow runs in a
+    FRESH python process (interpreter + jax import + cache loads + build),
+    sequentially — what a sweep of small user submissions used to cost.
+    The serve path starts ONE ``python -m cluster_tools_tpu.serve`` daemon
+    and submits the same N workflows back-to-back over its HTTP API; the
+    daemon's warm ExecutionContext (in-process jit caches, devices, chunk
+    LRU) makes every job after the first marginal-cost.
+
+    Discipline: both paths share the persistent on-disk compile cache
+    and each runs one UNTIMED warmup workflow first (the warm-vs-warm
+    convention of this suite — the disk cache is equally hot for both, so
+    the measured gap is process amortization, not disk-cache luck).  Each
+    of the N jobs gets its OWN volume (z-rolled copies), identical
+    between the paths, and every output must be byte-identical
+    (``ws_e2e_serve_parity``: arrays + chunk-file digests).  Runs pinned
+    to JAX_PLATFORMS=cpu like the steal bench: the quantity under test is
+    scheduling/setup amortization, not kernel throughput."""
+    import hashlib
+    import signal
+    import subprocess
+
+    from cluster_tools_tpu.serve import ServeClient
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.default_rng(0)
+    from scipy import ndimage
+
+    base = ndimage.gaussian_filter(rng.random(shape), (1.0, 2.0, 2.0))
+    base = ((base - base.min()) / (base.max() - base.min())).astype(
+        "float32"
+    )
+    ws_conf = {"threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+               "halo": [2, 4, 4]}
+    gconf = {"block_shape": list(block_shape), "target": "tpu"}
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": ""}
+    for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+        env.pop(k, None)
+
+    def digest(root):
+        h = hashlib.sha256()
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames.sort()
+            for name in sorted(filenames):
+                p = os.path.join(dirpath, name)
+                h.update(os.path.relpath(p, root).encode())
+                with open(p, "rb") as f:
+                    h.update(f.read())
+        return h.hexdigest()
+
+    with tempfile.TemporaryDirectory() as td:
+        from cluster_tools_tpu.utils import file_reader
+
+        vols = {}
+        for i in range(-1, n_jobs):  # -1 = the untimed warmup volume
+            vols[i] = np.roll(base, 3 * (i + 1), axis=1)
+            for side in ("cold", "serve"):
+                file_reader(
+                    os.path.join(td, f"{side}_{i}.n5")
+                ).create_dataset(
+                    "bnd", data=vols[i], chunks=tuple(block_shape)
+                )
+
+        driver = os.path.join(td, "cold_driver.py")
+        with open(driver, "w") as f:
+            f.write(
+                "import os, sys\n"
+                "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                f"sys.path.insert(0, {here!r})\n"
+                "import jax\n"
+                "jax.config.update('jax_platforms', 'cpu')\n"
+                "from cluster_tools_tpu.runtime import build, config as cfg\n"
+                "from cluster_tools_tpu.workflows import WatershedWorkflow\n"
+                "data_path, tag, td = sys.argv[1:4]\n"
+                "config_dir = os.path.join(td, 'configs_' + tag)\n"
+                f"cfg.write_global_config(config_dir, {gconf!r})\n"
+                f"cfg.write_config(config_dir, 'watershed', {ws_conf!r})\n"
+                "wf = WatershedWorkflow(\n"
+                "    os.path.join(td, 'tmp_' + tag), config_dir,\n"
+                "    input_path=data_path, input_key='bnd',\n"
+                "    output_path=data_path, output_key='ws')\n"
+                "assert build([wf])\n"
+            )
+
+        def one_cold(i, tag):
+            proc = subprocess.run(
+                [sys.executable, driver,
+                 os.path.join(td, f"cold_{i}.n5"), tag, td],
+                capture_output=True, text=True, env=env, timeout=600,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"cold run {tag} failed:\n{proc.stderr[-2000:]}"
+                )
+
+        one_cold(-1, "warmup")  # disk compile cache hot for BOTH paths
+        t0 = time.perf_counter()
+        for i in range(n_jobs):
+            one_cold(i, f"c{i}")
+        cold_wall = time.perf_counter() - t0
+
+        state_dir = os.path.join(td, "serve_state")
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "cluster_tools_tpu.serve",
+             "--state-dir", state_dir],
+            env=env, cwd=here,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            deadline = time.perf_counter() + 120
+            client = None
+            while time.perf_counter() < deadline:
+                if daemon.poll() is not None:
+                    raise RuntimeError(
+                        f"serve daemon died:\n{daemon.stderr.read()[-2000:]}"
+                    )
+                try:
+                    client = ServeClient(state_dir=state_dir)
+                    client.healthz()
+                    break
+                except Exception:
+                    time.sleep(0.1)
+            if client is None:
+                raise RuntimeError("serve daemon never became healthy")
+
+            def submit(i, tag):
+                data_path = os.path.join(td, f"serve_{i}.n5")
+                return client.submit(
+                    "WatershedWorkflow",
+                    {
+                        "tmp_folder": os.path.join(td, f"tmp_s_{tag}"),
+                        "config_dir": os.path.join(td, f"configs_s_{tag}"),
+                        "input_path": data_path, "input_key": "bnd",
+                        "output_path": data_path, "output_key": "ws",
+                    },
+                    configs={"global": dict(gconf),
+                             "watershed": dict(ws_conf)},
+                )
+
+            client.wait(submit(-1, "warmup"), timeout_s=600)
+            t0 = time.perf_counter()
+            job_ids = [submit(i, f"s{i}") for i in range(n_jobs)]
+            for jid in job_ids:
+                client.wait(jid, timeout_s=600)
+            serve_wall = time.perf_counter() - t0
+        finally:
+            daemon.send_signal(signal.SIGTERM)
+            try:
+                daemon.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                daemon.kill()
+                daemon.wait(timeout=30)
+
+        parity = True
+        for i in range(n_jobs):
+            cold_path = os.path.join(td, f"cold_{i}.n5")
+            serve_path = os.path.join(td, f"serve_{i}.n5")
+            with file_reader(cold_path, "r") as fc, \
+                    file_reader(serve_path, "r") as fs:
+                if not np.array_equal(fc["ws"][:], fs["ws"][:]):
+                    parity = False
+            if digest(os.path.join(cold_path, "ws")) != digest(
+                os.path.join(serve_path, "ws")
+            ):
+                parity = False
+
+    return {
+        "ws_e2e_serve_jobs": int(n_jobs),
+        "ws_e2e_serve_cold_wall_s": round(cold_wall, 2),
+        "ws_e2e_serve_wall_s": round(serve_wall, 2),
+        "ws_e2e_serve_speedup": round(cold_wall / max(serve_wall, 1e-9), 2),
+        "ws_e2e_serve_parity": parity,
+    }
+
+
 def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False,
                     sharded=False):
     """Wall-clock of the WatershedWorkflow alone — the BASELINE.md north

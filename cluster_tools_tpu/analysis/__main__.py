@@ -73,8 +73,8 @@ def main(argv=None) -> int:
     if args.paths is None:
         paths = [
             os.path.join(pkg_root, d)
-            for d in ("faults", "obs", "ops", "parallel", "runtime", "tasks",
-                      "workflows", "utils")
+            for d in ("faults", "obs", "ops", "parallel", "runtime", "serve",
+                      "tasks", "workflows", "utils")
         ]
         tests_dir = os.path.join(repo_root, "tests")
         if os.path.isdir(tests_dir):

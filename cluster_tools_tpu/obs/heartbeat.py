@@ -30,6 +30,10 @@ Heartbeat file schema (one JSON object, atomically replaced per beat)::
       "queue_depth": 3 | null,       # unclaimed work-queue items as last
                                      # seen by this worker's pull loop
                                      # (ctt-steal; null outside steal runs)
+      "draining": false,             # true once a serve daemon started its
+                                     # SIGTERM drain (ctt-serve): still
+                                     # alive, finishing in-flight jobs,
+                                     # refusing new submissions
       "device_mem_peak_bytes": 1048576 | null
     }
 
@@ -70,8 +74,8 @@ __all__ = [
     "ensure_started", "stop", "beat", "running", "interval_s",
     "note_task", "note_blocks_done", "note_blocks_failed",
     "note_blocks_retried", "note_block_start", "note_block_end",
-    "note_queue_depth", "set_role", "install_sigterm_flush",
-    "FILE_PREFIX", "ENV_INTERVAL",
+    "note_queue_depth", "note_draining", "set_role",
+    "install_sigterm_flush", "FILE_PREFIX", "ENV_INTERVAL",
 ]
 
 ENV_INTERVAL = "CTT_HEARTBEAT_S"
@@ -110,6 +114,7 @@ class _BeatState:
         self.blocks_retried = 0
         self.grid: Optional[list] = None
         self.queue_depth: Optional[int] = None  # ctt-steal pull loops only
+        self.draining = False  # ctt-serve SIGTERM drain in progress
         self.current: Dict[int, float] = {}  # block id -> start mono
         self.seq = 0
         self.thread: Optional[threading.Thread] = None
@@ -186,6 +191,7 @@ def _write_beat(st: _BeatState, exiting: bool) -> None:
                 for b, t0 in current[:_MAX_CURRENT_BLOCKS]
             ],
             "queue_depth": st.queue_depth,
+            "draining": st.draining,
             "device_mem_peak_bytes": _device_mem_peak_bytes(),
         }
     path = os.path.join(rdir, f"{FILE_PREFIX}{os.getpid()}.json")
@@ -345,6 +351,17 @@ def note_queue_depth(n: int) -> None:
         return
     with st.lock:
         st.queue_depth = int(n)
+
+
+def note_draining() -> None:
+    """ctt-serve: the daemon entered its SIGTERM drain — readers (`obs
+    watch`, /metrics scrapes) distinguish 'alive, finishing, refusing
+    submissions' from both healthy and dead."""
+    st = _state_if_enabled()
+    if st is None:
+        return
+    with st.lock:
+        st.draining = True
 
 
 def note_block_start(block_id: int) -> None:

@@ -294,6 +294,7 @@ class LiveRun:
                 "blocks_retried": int(hb.get("blocks_retried") or 0),
                 "device_mem_peak_bytes": hb.get("device_mem_peak_bytes"),
                 "queue_depth": hb.get("queue_depth"),
+                "draining": bool(hb.get("draining")),
                 "current_blocks": hb.get("current_blocks") or [],
                 "mono": float(hb.get("mono") or 0.0),
                 "grid": hb.get("grid"),
@@ -407,6 +408,17 @@ class LiveRun:
             "counters": counters,
             "gauges": gauges,
         }
+
+    def task_median_s(self, task: str) -> Optional[float]:
+        """Median completed-block duration for one task, from the spans
+        ingested so far (incremental — call freely).  The lease-aware
+        straggler baseline ``runtime/queue.py`` rides: the work queue's
+        duplication threshold uses THIS median instead of recomputing its
+        own from item result records, so duplication can fire before the
+        queue's first result lands and both detectors agree on what
+        'slow' means."""
+        self._ingest_shards()
+        return self._median(list(self._durations.get(task, {}).values()))
 
     # -- heatmap ------------------------------------------------------------
 
@@ -566,6 +578,31 @@ def format_watch(snap: Dict[str, Any]) -> str:
             f"stolen {int(counters.get('sched.leases_stolen', 0))}",
         ]
         lines.append("  sched: " + ", ".join(p for p in parts if p))
+    if any(k.startswith("serve.") for k in counters):
+        # ctt-serve: one line of daemon health — queue pressure, admission
+        # outcomes, and how warm the compile state is running
+        gauges = snap.get("gauges", {})
+        parts = []
+        for label, key, store in (
+            ("queue depth", "serve.queue_depth", gauges),
+            ("running", "serve.running_jobs", gauges),
+            ("submitted", "serve.submissions", counters),
+            ("done", "serve.jobs_done", counters),
+            ("failed", "serve.jobs_failed", counters),
+            ("rejected", "serve.quota_rejections", counters),
+            ("warm", "serve.warm_compile_jobs", counters),
+            ("cold", "serve.cold_compile_jobs", counters),
+        ):
+            val = store.get(key)
+            if isinstance(val, (int, float)):
+                parts.append(f"{label} {int(val)}")
+        lines.append("  serve: " + ", ".join(parts))
+    for w in snap["workers"]:
+        if w.get("draining") and not w["exiting"]:
+            lines.append(
+                f"  DRAINING: pid {w['pid']} ({w['role']}) — finishing "
+                "in-flight jobs, submissions refused"
+            )
     for w in snap["stale_workers"]:
         where = f"job {w['job_id']}" if w["job_id"] is not None else "driver"
         lines.append(
@@ -665,6 +702,11 @@ def render_openmetrics(snap: Dict[str, Any]) -> str:
              "unclaimed work-queue items at the worker's last pull (ctt-steal)",
              lambda w: (float(w["queue_depth"])
                         if w.get("queue_depth") is not None else None)),
+            # only emitted for processes that ever raised the flag, so
+            # non-serve expositions are byte-unchanged
+            ("ctt_worker_draining", "gauge",
+             "1 while a serve daemon drains (alive, refusing submissions)",
+             lambda w: 1.0 if w.get("draining") else None),
         ]
         for name, mtype, help_text, fn in specs:
             rows = []

@@ -76,6 +76,19 @@ COUNTERS = frozenset({
     "stream.slabs",         # block batches (z-slabs) streamed through a chain
     "stream.elided_bytes",  # intermediate bytes neither written nor re-read
     "stream.fallbacks",     # declared chains that declined/failed to fuse
+    # serve/ — ctt-serve persistent serving daemon
+    "serve.submissions",        # admitted job submissions
+    "serve.quota_rejections",   # 429s: queue depth or tenant quota said no
+    "serve.jobs_done",          # jobs executed to a successful result
+    "serve.jobs_failed",        # jobs whose build raised/failed
+    "serve.warm_compile_jobs",  # jobs whose (workflow, block-shape)
+                                # signature already ran on this daemon —
+                                # served from warm in-process compile
+                                # caches (per-job persistent-cache deltas
+                                # ride the job result)
+    "serve.cold_compile_jobs",  # first job of a signature: pays compiles
+    "serve.leases_requeued",    # stale job leases taken over at gen+1
+                                # (a predecessor daemon died mid-job)
 })
 
 # -- gauges (metrics.set_gauge) ---------------------------------------------
@@ -86,6 +99,10 @@ GAUGES = frozenset({
     "stream.carry_bytes",
     # runtime/queue.py — unclaimed work-queue items at the last pull scan
     "sched.queue_depth",
+    # serve/ — the daemon's job queue: queued (unleased) jobs + builds
+    # currently executing
+    "serve.queue_depth",
+    "serve.running_jobs",
 })
 
 # dynamic name families: one series per <suffix>, allowed by prefix

@@ -79,6 +79,32 @@ stamps for cross-process ageing, monotonic for the writer's diagnostics)::
                                 idiom: {"item", "gen", "done", "failed",
                                 "errors", "pid", "job_id", "duplicate",
                                 "seconds", "wall"}.
+
+Serving-daemon file formats (ctt-serve; live in the daemon's state dir —
+the same lease clock contract, lifted from block-batch grain to job
+grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
+
+    serve.json                  the endpoint record, atomically replaced
+                                at daemon start: {"host", "port", "pid",
+                                "started_wall", "run_id"} — clients
+                                discover the daemon by file, not by port
+                                convention.
+    jobs/job.<id>.json          one submission, published exactly once
+                                (exclusive link): {"id", "seq", "schema",
+                                "workflow", "kwargs", "configs",
+                                "tenant", "priority", "submit_wall"}.
+    jobs/lease.<id>.g<g>.json   generation-g execution ownership,
+                                re-stamped every lease_s by the running
+                                daemon: {"job", "gen", "owner_pid",
+                                "claim_wall", "wall", "mono"}.  Stale
+                                beyond 3 x lease_s = the daemon died
+                                mid-job; the next daemon on the same
+                                state dir claims gen g+1.
+    jobs/result.<id>.json       terminal record, first writer wins:
+                                {"id", "gen", "ok", "error", "seconds",
+                                "warm", "compile_cache": {"hits",
+                                "misses"}, "tenant", "pid",
+                                "finished_wall"}.
 """
 
 from __future__ import annotations

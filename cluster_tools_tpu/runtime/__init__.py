@@ -1,7 +1,7 @@
 from . import config
 from .task import Task, BlockTask, FailedBlocksError, Target
 from .executor import get_executor
-from .workflow import WorkflowBase, build
+from .workflow import ExecutionContext, WorkflowBase, build
 
 __all__ = [
     "config",
@@ -10,6 +10,7 @@ __all__ = [
     "FailedBlocksError",
     "Target",
     "get_executor",
+    "ExecutionContext",
     "WorkflowBase",
     "build",
 ]

@@ -75,6 +75,42 @@ def process_topology(gconf: Dict[str, Any]):
         raise ValueError(f"process_id {pid} out of range for {num} processes")
     return pid, max(num, 1)
 
+# ctt-serve: the persistent serving daemon's knobs.  Lives here (not in
+# serve/) because it follows the same two-level JSON convention: the
+# daemon reads ``serve.config`` from its state dir over these defaults,
+# exactly like tasks read ``<task>.config`` over DEFAULT_TASK_CONFIG.
+DEFAULT_SERVE_CONFIG: Dict[str, Any] = {
+    "host": "127.0.0.1",   # loopback only: the daemon is a local submission
+    "port": 0,             # endpoint (0 = ephemeral, recorded in serve.json)
+    # executor threads running builds concurrently.  1 keeps device
+    # dispatch strictly serialized (the deterministic default); raising it
+    # interleaves independent jobs' host stages on one warm process.
+    "concurrency": 1,
+    # admission control: total unfinished jobs (queued + running) the
+    # daemon accepts before rejecting submissions with 429
+    "max_queue_depth": 64,
+    # per-tenant in-flight ceiling (None disables); "tenant_quotas" maps
+    # tenant name -> override for heavier/lighter tenants
+    "tenant_quota": 8,
+    "tenant_quotas": {},
+    # job-lease renewal cadence (None = the heartbeat cadence): a daemon
+    # killed mid-job leaves a lease that goes stale after 3x this and is
+    # requeued by the next daemon on the same state dir
+    "lease_s": None,
+    # SIGTERM drain: how long to wait for in-flight jobs before dying
+    # anyway (queued jobs are durable either way)
+    "drain_timeout_s": 300.0,
+}
+
+
+def serve_config(state_dir: Optional[str]) -> Dict[str, Any]:
+    """Daemon config: ``serve.config`` in the state dir over the defaults
+    (same merge discipline as :func:`global_config`)."""
+    conf = dict(DEFAULT_SERVE_CONFIG)
+    conf.update(read_config(state_dir, "serve"))
+    return conf
+
+
 DEFAULT_TASK_CONFIG: Dict[str, Any] = {
     "threads_per_job": 1,
     # host threads for a block batch's chunk reads (gzip-decode bound;

@@ -113,12 +113,12 @@ def resolve_batch_size(config: Dict[str, Any]) -> int:
     if devices and devices != "global":
         n_dev = len(devices)
     else:
-        try:
-            import jax
+        # resolved once per process via the execution context (ctt-serve):
+        # a long-lived daemon dispatches thousands of batches and must not
+        # re-query the backend for a constant on each one
+        from .workflow import ExecutionContext
 
-            n_dev = jax.local_device_count()
-        except Exception:  # pragma: no cover
-            n_dev = 1
+        n_dev = ExecutionContext.process_context().local_device_count()
     return batch_size * n_dev
 
 

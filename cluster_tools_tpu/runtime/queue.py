@@ -212,6 +212,7 @@ class WorkQueue:
         self.lease_s = float(m.get("lease_s", 5.0))
         self.duplicate_enabled = bool(m.get("duplicate", True))
         self.stale_after_s = STALE_INTERVALS * self.lease_s
+        self._live = None  # lazy obs.live reader (lease-aware stragglers)
 
     # -- driver side --------------------------------------------------------
 
@@ -377,13 +378,32 @@ class WorkQueue:
                 return dup
         return None
 
-    def _claim_duplicate(self, open_items, leases, results, now,
-                         skip_duplicates) -> Optional[Claim]:
-        """Straggler re-dispatch: duplicate the oldest in-flight item once
-        its claim age exceeds STRAGGLER_K x the median completed-item
-        seconds.  No lease is taken — the duplicate's result publish is
-        first-writer-wins and its chunk writes are byte-identical to the
-        owner's by construction."""
+    def _live_median_block_s(self) -> Optional[float]:
+        """Per-BLOCK median duration for this queue's task from the live
+        trace (``obs.live.LiveRun.task_median_s``) — the lease-aware
+        straggler baseline.  None when tracing is off or no block of this
+        task has finished yet (the caller then falls back to the queue's
+        own item-seconds median)."""
+        if not obs_trace.enabled():
+            return None
+        rdir = obs_trace.run_dir()
+        if rdir is None:
+            return None
+        if self._live is None:
+            from ..obs.live import LiveRun
+
+            self._live = LiveRun(rdir)
+        try:
+            med = self._live.task_median_s(self.task)
+        except Exception:
+            # a torn/alien trace dir must never break the pull loop —
+            # worst case the queue keeps its own median
+            return None
+        return med if med and med > 0 else None
+
+    def _item_median_s(self, results) -> Optional[float]:
+        """Median completed-ITEM seconds from this queue's own result
+        records — the pre-ctt-serve baseline, now the fallback."""
         seconds = []
         for k in results:
             rec = self._read_json(
@@ -399,7 +419,28 @@ class WorkQueue:
             seconds[mid] if len(seconds) % 2
             else 0.5 * (seconds[mid - 1] + seconds[mid])
         )
-        if median <= 0:
+        return median if median > 0 else None
+
+    def _claim_duplicate(self, open_items, leases, results, now,
+                         skip_duplicates) -> Optional[Claim]:
+        """Straggler re-dispatch: duplicate the oldest in-flight item once
+        its claim age exceeds STRAGGLER_K x the median item cost.  No
+        lease is taken — the duplicate's result publish is
+        first-writer-wins and its chunk writes are byte-identical to the
+        owner's by construction.
+
+        The baseline median is lease-aware (ROADMAP item 1 follow-up):
+        obs.live's per-task median BLOCK duration — the same number `obs
+        watch` flags stragglers with — scaled by the candidate item's
+        block count, preferred over the queue's own median of completed-
+        item seconds.  The two detectors then agree on what 'slow' means,
+        and duplication can fire before the queue's FIRST result record
+        lands (a hot first item no longer stalls unflagged)."""
+        med_block = self._live_median_block_s()
+        med_item = None if med_block is not None else (
+            self._item_median_s(results)
+        )
+        if med_block is None and med_item is None:
             return None
         best = None
         for k in open_items:
@@ -411,7 +452,13 @@ class WorkQueue:
             except (TypeError, KeyError, ValueError):
                 continue
             age = now - claim_wall
-            if age > STRAGGLER_K * median and (best is None or age > best[0]):
+            baseline = (
+                med_block * max(len(self.items[k]), 1)
+                if med_block is not None else med_item
+            )
+            if age > STRAGGLER_K * baseline and (
+                best is None or age > best[0]
+            ):
                 best = (age, k)
         if best is None:
             return None

@@ -1,0 +1,37 @@
+"""ctt-serve: the persistent serving daemon ("millions of users" mode).
+
+Every workflow run used to be a cold process: interpreter + jax import,
+mesh/device resolution, XLA compiles (the persistent disk cache helps but
+still re-loads executables), an empty decoded-chunk LRU, and device
+buffers dropped between tasks.  ``python -m cluster_tools_tpu.serve``
+keeps all of that warm in ONE long-lived process — the
+:class:`runtime.workflow.ExecutionContext` extracted from ``build()`` —
+and accepts workflow *submissions* over a local HTTP API, with a durable
+job queue, admission control, per-tenant concurrency quotas, and
+priorities.  Execution is byte-identical to a fresh-process ``build()``;
+only the setup cost is amortized.
+
+Layout:
+
+  * :mod:`serve.protocol`  — the submission wire schema + workflow
+    resolution (what a job JSON may say and how it becomes a Task);
+  * :mod:`serve.jobs`      — the durable on-disk job queue (the ctt-steal
+    ``publish_once`` lease/result idiom over job granularity: queued jobs
+    survive daemon death, stale leases requeue on restart);
+  * :mod:`serve.admission` — queue-depth + per-tenant quota gate;
+  * :mod:`serve.server`    — the daemon (HTTP endpoints, executor
+    threads, SIGTERM drain);
+  * :mod:`serve.client`    — the local submission client.
+"""
+
+from .client import QuotaRejected, ServeClient, read_endpoint
+from .jobs import JobQueue
+from .server import ServeDaemon
+
+__all__ = [
+    "JobQueue",
+    "QuotaRejected",
+    "ServeClient",
+    "ServeDaemon",
+    "read_endpoint",
+]

@@ -1,0 +1,60 @@
+"""CLI: ``python -m cluster_tools_tpu.serve`` — run the serving daemon.
+
+    python -m cluster_tools_tpu.serve --state-dir DIR [--port P]
+        [--host H] [--concurrency N] [--max-queue-depth N]
+        [--tenant-quota N] [--lease-s S] [--drain-timeout-s S]
+
+The daemon binds loopback (ephemeral port by default), publishes its
+endpoint to ``<state_dir>/serve.json``, and serves until SIGTERM/SIGINT,
+which triggers a drain: in-flight jobs finish, queued jobs stay durable
+in ``<state_dir>/jobs/`` for the next daemon over the same state dir.
+Flags override ``<state_dir>/serve.config`` which overrides
+``runtime.config.DEFAULT_SERVE_CONFIG``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m cluster_tools_tpu.serve",
+        description="ctt-serve: persistent workflow serving daemon "
+        "(warm mesh/compile/chunk caches across submissions)",
+    )
+    parser.add_argument("--state-dir", required=True,
+                        help="endpoint record, job queue, and default "
+                        "trace dir")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--concurrency", type=int, default=None)
+    parser.add_argument("--max-queue-depth", type=int, default=None)
+    parser.add_argument("--tenant-quota", type=int, default=None)
+    parser.add_argument("--lease-s", type=float, default=None)
+    parser.add_argument("--drain-timeout-s", type=float, default=None)
+    args = parser.parse_args(argv)
+
+    from .server import ServeDaemon
+
+    daemon = ServeDaemon(args.state_dir, config={
+        "host": args.host,
+        "port": args.port,
+        "concurrency": args.concurrency,
+        "max_queue_depth": args.max_queue_depth,
+        "tenant_quota": args.tenant_quota,
+        "lease_s": args.lease_s,
+        "drain_timeout_s": args.drain_timeout_s,
+    })
+    daemon.install_signal_handlers()
+    endpoint = daemon.start()
+    print(f"[serve] listening on http://{endpoint['host']}:"
+          f"{endpoint['port']} (state dir {args.state_dir})", flush=True)
+    print(json.dumps(endpoint, sort_keys=True), flush=True)
+    return daemon.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

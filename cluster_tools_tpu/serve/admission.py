@@ -1,0 +1,69 @@
+"""ctt-serve admission control: who gets into the queue, and when not.
+
+Two gates, both evaluated against the durable queue's live accounting
+(:meth:`serve.jobs.JobQueue.stats`) at submission time:
+
+  * **queue depth** — total unfinished jobs (queued + running) at or over
+    ``max_queue_depth`` rejects the submission.  Backpressure, not
+    buffering: a client is told *now* that the daemon is saturated
+    (HTTP 429) instead of its job aging silently at the queue tail.
+  * **tenant quota** — per-tenant in-flight ceiling (``tenant_quota``
+    default, ``tenant_quotas[name]`` override, None disables): one noisy
+    tenant cannot occupy the whole queue; everyone else's admission
+    headroom is what the quota leaves free.
+
+Rejections count as ``serve.quota_rejections`` (the lease-budget analog
+of the steal queue's admission role: here a *job* lease you cannot take
+yet is simply a job the daemon refuses to enqueue).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+
+__all__ = ["AdmissionController"]
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        max_queue_depth: Optional[int] = 64,
+        tenant_quota: Optional[int] = 8,
+        tenant_quotas: Optional[Dict[str, int]] = None,
+    ):
+        self.max_queue_depth = (
+            int(max_queue_depth) if max_queue_depth else None
+        )
+        self.tenant_quota = int(tenant_quota) if tenant_quota else None
+        self.tenant_quotas = {
+            str(k): int(v) for k, v in (tenant_quotas or {}).items()
+        }
+
+    def quota_for(self, tenant: str) -> Optional[int]:
+        return self.tenant_quotas.get(tenant, self.tenant_quota)
+
+    def admit(self, tenant: str,
+              stats: Dict[str, Any]) -> Tuple[bool, Optional[str]]:
+        """(admitted, reason-if-not) for one submission given the queue's
+        current accounting."""
+        if (
+            self.max_queue_depth is not None
+            and stats.get("in_flight", 0) >= self.max_queue_depth
+        ):
+            obs_metrics.inc("serve.quota_rejections")
+            return False, (
+                f"queue full: {stats['in_flight']} jobs in flight "
+                f">= max_queue_depth {self.max_queue_depth}"
+            )
+        quota = self.quota_for(tenant)
+        if quota is not None:
+            used = stats.get("per_tenant", {}).get(tenant, 0)
+            if used >= quota:
+                obs_metrics.inc("serve.quota_rejections")
+                return False, (
+                    f"tenant {tenant!r} quota exhausted: {used} jobs in "
+                    f"flight >= quota {quota}"
+                )
+        return True, None

@@ -1,0 +1,142 @@
+"""ctt-serve client: submit workflows to a running daemon and wait.
+
+Discovery is file-based: the daemon publishes ``serve.json`` (host, port,
+pid, run id) into its state dir; ``ServeClient(state_dir)`` reads it.
+Everything else is four tiny HTTP calls over loopback (stdlib urllib — a
+client must not drag jax in just to submit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+from ..obs import trace as obs_trace
+from .server import ENDPOINT_NAME
+
+__all__ = ["QuotaRejected", "ServeClient", "read_endpoint"]
+
+
+class QuotaRejected(RuntimeError):
+    """The daemon refused admission (429: queue depth or tenant quota)."""
+
+
+class JobFailed(RuntimeError):
+    """The daemon executed the job and it failed."""
+
+
+def read_endpoint(state_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(state_dir, ENDPOINT_NAME)) as f:
+        return json.load(f)
+
+
+class ServeClient:
+    def __init__(
+        self,
+        state_dir: Optional[str] = None,
+        endpoint: Optional[str] = None,
+        timeout_s: float = 30.0,
+    ):
+        if endpoint is None:
+            if state_dir is None:
+                raise ValueError("need state_dir or endpoint")
+            ep = read_endpoint(state_dir)
+            endpoint = f"http://{ep['host']}:{ep['port']}"
+        self.base = endpoint.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    # -- raw HTTP ------------------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[dict] = None):
+        req = urllib.request.Request(
+            self.base + path,
+            data=(
+                json.dumps(payload).encode() if payload is not None else None
+            ),
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            try:
+                reason = json.loads(detail).get("reason", detail)
+            except ValueError:
+                reason = detail
+            if e.code == 429:
+                raise QuotaRejected(reason) from None
+            raise RuntimeError(
+                f"{method} {path} -> HTTP {e.code}: {reason}"
+            ) from None
+        return json.loads(body) if body else None
+
+    # -- API -----------------------------------------------------------------
+
+    def submit(
+        self,
+        workflow: str,
+        kwargs: Dict[str, Any],
+        configs: Optional[Dict[str, dict]] = None,
+        tenant: str = "default",
+        priority: int = 0,
+    ) -> str:
+        """Submit one workflow; returns the job id.  Raises
+        :class:`QuotaRejected` when admission says no."""
+        out = self._request("POST", "/api/v1/jobs", {
+            "workflow": workflow,
+            "kwargs": kwargs,
+            "configs": configs or {},
+            "tenant": tenant,
+            "priority": priority,
+        })
+        return out["job_id"]
+
+    def status(self, job_id: str) -> Dict[str, Any]:
+        return self._request("GET", f"/api/v1/jobs/{job_id}")
+
+    def list_jobs(self) -> list:
+        return self._request("GET", "/api/v1/jobs")["jobs"]
+
+    def wait(self, job_id: str, timeout_s: float = 600.0,
+             poll_s: float = 0.1,
+             raise_on_failure: bool = True) -> Dict[str, Any]:
+        """Poll until the job reaches a terminal state; returns the full
+        state dict."""
+        deadline = obs_trace.monotonic() + float(timeout_s)
+        while True:
+            state = self.status(job_id)
+            if state["state"] in ("done", "failed"):
+                if state["state"] == "failed" and raise_on_failure:
+                    err = (state.get("result") or {}).get("error")
+                    raise JobFailed(f"job {job_id} failed: {err}")
+                return state
+            if obs_trace.monotonic() > deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {state['state']} after "
+                    f"{timeout_s:.0f}s"
+                )
+            time.sleep(poll_s)  # ctt: noqa[CTT009] status poll, not an IO retry — the daemon pushes nothing, clients poll
+
+    def submit_and_wait(self, workflow: str, kwargs: Dict[str, Any],
+                        **kw) -> Dict[str, Any]:
+        wait_kw = {
+            k: kw.pop(k)
+            for k in ("timeout_s", "poll_s", "raise_on_failure")
+            if k in kw
+        }
+        return self.wait(self.submit(workflow, kwargs, **kw), **wait_kw)
+
+    def healthz(self) -> Dict[str, Any]:
+        return self._request("GET", "/healthz")
+
+    def metrics_text(self) -> str:
+        req = urllib.request.Request(self.base + "/metrics")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return resp.read().decode()

@@ -1,0 +1,288 @@
+"""ctt-serve durable job queue: the ctt-steal lease idiom at job grain.
+
+``runtime/queue.py`` arbitrates *block batches* inside one dispatch with
+an immutable manifest; the daemon needs the same guarantees for *jobs*
+that arrive over time — so this module reuses the exact primitives
+(``publish_once`` exclusive links, atomically re-stamped leases, the
+``STALE_INTERVALS`` staleness rule, first-writer-wins results) over a
+growing directory instead of a fixed manifest:
+
+    <state_dir>/jobs/
+      job.<id>.json          the submission record (published exactly once)
+      lease.<id>.g<g>.json   generation-g execution ownership, re-stamped
+                             every ``lease_s`` by the running daemon; a
+                             stamp older than 3 x lease_s means the owner
+                             died mid-job — the next daemon on the same
+                             state dir claims gen g+1 (requeue)
+      result.<id>.json       terminal record, first writer wins
+
+Everything a client submitted is therefore durable: daemon death loses
+nothing (queued jobs sit untouched, a leased job's stale lease requeues),
+and a SIGTERM drain only has to finish in-flight work — the disk is the
+queue.  Claim order is (-priority, submission sequence): priorities are
+literally claim order, as the lease substrate makes natural.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..obs import heartbeat as obs_heartbeat
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..runtime.queue import STALE_INTERVALS, publish_once
+from ..utils.store import atomic_write_bytes
+
+__all__ = ["JobClaim", "JobQueue"]
+
+_JOB_RE = re.compile(r"^job\.(j\d{6})\.json$")
+_LEASE_RE = re.compile(r"^lease\.(j\d{6})\.g(\d+)\.json$")
+_RESULT_RE = re.compile(r"^result\.(j\d{6})\.json$")
+
+
+@dataclass
+class JobClaim:
+    """One leased job: the record plus the lease that owns it."""
+
+    job_id: str
+    record: Dict[str, Any]
+    gen: int
+    lease_path: str
+    claim_wall: float = field(default_factory=time.time)
+
+
+class JobQueue:
+    def __init__(self, root: str, lease_s: Optional[float] = None):
+        os.makedirs(root, exist_ok=True)
+        self.dir = root
+        try:
+            self.lease_s = float(lease_s) if lease_s else 0.0
+        except (TypeError, ValueError):
+            self.lease_s = 0.0
+        if self.lease_s <= 0:
+            self.lease_s = obs_heartbeat.interval_s()
+        self.stale_after_s = STALE_INTERVALS * self.lease_s
+
+    # -- directory scan ------------------------------------------------------
+
+    def _scan(self):
+        """(jobs, leases, results): job ids present, highest-generation
+        lease path per job, and terminal-record presence."""
+        jobs: List[str] = []
+        leases: Dict[str, tuple] = {}
+        results: set = set()
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            names = []
+        for name in names:
+            m = _JOB_RE.match(name)
+            if m:
+                jobs.append(m.group(1))
+                continue
+            m = _RESULT_RE.match(name)
+            if m:
+                results.add(m.group(1))
+                continue
+            m = _LEASE_RE.match(name)
+            if m:
+                jid, g = m.group(1), int(m.group(2))
+                cur = leases.get(jid)
+                if cur is None or g > cur[0]:
+                    leases[jid] = (g, os.path.join(self.dir, name))
+        return sorted(jobs), leases, results
+
+    def _read_json(self, path: str) -> Optional[dict]:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            return rec if isinstance(rec, dict) else None
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _record(self, job_id: str) -> Optional[dict]:
+        return self._read_json(os.path.join(self.dir, f"job.{job_id}.json"))
+
+    def _lease_age_s(self, path: str, now: float) -> float:
+        rec = self._read_json(path)
+        stamp = None
+        if rec is not None:
+            try:
+                stamp = float(rec["wall"])
+            except (KeyError, TypeError, ValueError):
+                stamp = None
+        if stamp is None:
+            # torn lease: age from mtime, the runtime/queue.py convention
+            try:
+                stamp = os.path.getmtime(path)
+            except OSError:
+                return 0.0
+        return max(0.0, now - stamp)
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, record: Dict[str, Any]) -> str:
+        """Durably publish one job; returns its id.  Ids are a dense
+        sequence (claim order ties break on it), allocated by probing the
+        next free slot with the exclusive link — concurrent submitters
+        cannot collide."""
+        jobs, _, _ = self._scan()
+        seq = (int(jobs[-1][1:]) + 1) if jobs else 1
+        while True:
+            job_id = f"j{seq:06d}"
+            rec = dict(record)
+            rec.update({"id": job_id, "seq": seq, "submit_wall": time.time()})
+            if publish_once(
+                os.path.join(self.dir, f"job.{job_id}.json"),
+                json.dumps(rec, sort_keys=True).encode(),
+            ):
+                obs_metrics.inc("serve.submissions")
+                return job_id
+            seq += 1
+
+    # -- claiming ------------------------------------------------------------
+
+    def pending(self) -> List[dict]:
+        """Unfinished jobs with no live lease, in claim order
+        (-priority, seq)."""
+        jobs, leases, results = self._scan()
+        now = time.time()
+        out = []
+        for jid in jobs:
+            if jid in results:
+                continue
+            if jid in leases and (
+                self._lease_age_s(leases[jid][1], now) <= self.stale_after_s
+            ):
+                continue
+            rec = self._record(jid)
+            if rec is not None:
+                out.append(rec)
+        out.sort(key=lambda r: (-int(r.get("priority", 0)), int(r["seq"])))
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        """Queue accounting for admission + gauges: per-tenant and total
+        unfinished (queued + running) job counts."""
+        jobs, leases, results = self._scan()
+        now = time.time()
+        per_tenant: Dict[str, int] = {}
+        queued = running = 0
+        for jid in jobs:
+            if jid in results:
+                continue
+            rec = self._record(jid) or {}
+            tenant = rec.get("tenant", "default")
+            per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
+            if jid in leases and (
+                self._lease_age_s(leases[jid][1], now) <= self.stale_after_s
+            ):
+                running += 1
+            else:
+                queued += 1
+        return {
+            "queued": queued,
+            "running": running,
+            "in_flight": queued + running,
+            "per_tenant": per_tenant,
+            "total_jobs": len(jobs),
+        }
+
+    def _lease_payload(self, job_id: str, gen: int,
+                       claim_wall: float) -> bytes:
+        return json.dumps({
+            "job": job_id,
+            "gen": gen,
+            "owner_pid": os.getpid(),
+            "claim_wall": claim_wall,
+            "wall": time.time(),
+            "mono": obs_trace.monotonic(),
+        }).encode()
+
+    def claim_next(self) -> Optional[JobClaim]:
+        """Lease the highest-priority claimable job: unleased first; a
+        job whose lease went stale (a daemon died mid-job) requeues at
+        gen+1 — restart recovery, the runtime/queue.py expiry rule."""
+        _, leases, _ = self._scan()
+        for rec in self.pending():
+            jid = rec["id"]
+            gen = 0
+            if jid in leases:
+                # stale lease (pending() already aged it): take over
+                gen = leases[jid][0] + 1
+            claim_wall = time.time()
+            path = os.path.join(self.dir, f"lease.{jid}.g{gen}.json")
+            if publish_once(path, self._lease_payload(jid, gen, claim_wall)):
+                if gen > 0:
+                    obs_metrics.inc("serve.leases_requeued")
+                return JobClaim(
+                    job_id=jid, record=rec, gen=gen, lease_path=path,
+                    claim_wall=claim_wall,
+                )
+            # claim raced away; fall through to the next candidate
+        return None
+
+    def renew(self, claim: JobClaim) -> None:
+        atomic_write_bytes(
+            claim.lease_path,
+            self._lease_payload(claim.job_id, claim.gen, claim.claim_wall),
+        )
+
+    def complete(self, claim: JobClaim, result: Dict[str, Any]) -> bool:
+        """Publish the terminal record (first writer wins — a requeued
+        duplicate of a slow-but-alive predecessor loses cleanly)."""
+        rec = dict(result)
+        rec.update({
+            "id": claim.job_id,
+            "gen": claim.gen,
+            "pid": os.getpid(),
+            "finished_wall": time.time(),
+        })
+        return publish_once(
+            os.path.join(self.dir, f"result.{claim.job_id}.json"),
+            json.dumps(rec, sort_keys=True).encode(),
+        )
+
+    # -- read-side -----------------------------------------------------------
+
+    def get(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """Full job state: record + derived state + result (if any)."""
+        rec = self._record(job_id)
+        if rec is None:
+            return None
+        result = self._read_json(
+            os.path.join(self.dir, f"result.{job_id}.json")
+        )
+        if result is not None:
+            state = "done" if result.get("ok") else "failed"
+        else:
+            _, leases, _ = self._scan()
+            now = time.time()
+            if job_id in leases and (
+                self._lease_age_s(leases[job_id][1], now)
+                <= self.stale_after_s
+            ):
+                state = "running"
+            else:
+                state = "queued"
+        return {"id": job_id, "state": state, "record": rec,
+                "result": result}
+
+    def list(self) -> List[Dict[str, Any]]:
+        jobs, _, _ = self._scan()
+        out = []
+        for jid in jobs:
+            st = self.get(jid)
+            if st is not None:
+                out.append({
+                    "id": jid, "state": st["state"],
+                    "tenant": st["record"].get("tenant"),
+                    "priority": st["record"].get("priority", 0),
+                    "workflow": st["record"].get("workflow"),
+                })
+        return out

@@ -1,0 +1,150 @@
+"""ctt-serve submission protocol: the wire schema and its validation.
+
+One job = one JSON object POSTed to ``/api/v1/jobs`` (full file-format
+reference beside the heartbeat/lease schemas in ``obs/trace.py``)::
+
+    {
+      "workflow": "WatershedWorkflow"            # name in
+                                                 # cluster_tools_tpu.workflows,
+                  | "pkg.mod:ClassName",         # or an importable dotted
+                                                 # path to any Task subclass
+      "kwargs":   {"tmp_folder": ..., ...},      # constructor arguments
+      "configs":  {"global": {...},              # optional: config files the
+                   "<task_name>": {...}},        # daemon writes into
+                                                 # kwargs["config_dir"] before
+                                                 # building ("global" goes
+                                                 # through write_global_config)
+      "tenant":   "default",                     # quota accounting key
+      "priority": 0                              # higher claims first
+    }
+
+Responses: ``{"job_id": "j000001", "state": "queued"}`` on admission,
+HTTP 429 ``{"error": "rejected", "reason": ...}`` on quota/queue-depth
+rejection, HTTP 400 on schema violations, HTTP 503 while draining.
+
+Job state read back from ``GET /api/v1/jobs/<id>``::
+
+    {"id", "state": "queued" | "running" | "done" | "failed",
+     "record": {<the submission>},
+     "result": {"ok", "error", "seconds", "warm",
+                "compile_cache": {"hits", "misses"}, "finished_wall"} | null}
+
+The daemon executes jobs by resolving ``workflow`` to a Task class,
+instantiating it with ``kwargs``, and running ``runtime.build([task],
+context=<the daemon's warm ExecutionContext>)`` — the submission/
+execution split: clients describe work, the daemon owns the warm device
+state that executes it.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, Tuple
+
+SCHEMA_VERSION = 1
+
+JOB_STATES = ("queued", "running", "done", "failed")
+
+
+class ProtocolError(ValueError):
+    """A submission that violates the schema (HTTP 400, never a retry)."""
+
+
+def validate_submission(payload: Any) -> Dict[str, Any]:
+    """Normalize + validate one submission JSON into a job record.  Loud:
+    a malformed submission is a client bug, not a degraded default."""
+    if not isinstance(payload, dict):
+        raise ProtocolError("submission must be a JSON object")
+    workflow = payload.get("workflow")
+    if not isinstance(workflow, str) or not workflow.strip():
+        raise ProtocolError("'workflow' must be a non-empty string")
+    kwargs = payload.get("kwargs", {})
+    if not isinstance(kwargs, dict):
+        raise ProtocolError("'kwargs' must be an object")
+    if not isinstance(kwargs.get("tmp_folder"), str):
+        raise ProtocolError("kwargs.tmp_folder (string) is required")
+    configs = payload.get("configs", {})
+    if configs is None:
+        configs = {}
+    if not isinstance(configs, dict) or not all(
+        isinstance(k, str) and isinstance(v, dict) for k, v in configs.items()
+    ):
+        raise ProtocolError("'configs' must map config names to objects")
+    if configs and not isinstance(kwargs.get("config_dir"), str):
+        raise ProtocolError(
+            "'configs' given but kwargs.config_dir (the directory to write "
+            "them into) is missing"
+        )
+    tenant = payload.get("tenant", "default")
+    if not isinstance(tenant, str) or not tenant:
+        raise ProtocolError("'tenant' must be a non-empty string")
+    try:
+        priority = int(payload.get("priority", 0))
+    except (TypeError, ValueError):
+        raise ProtocolError("'priority' must be an integer") from None
+    return {
+        "schema": SCHEMA_VERSION,
+        "workflow": workflow.strip(),
+        "kwargs": kwargs,
+        "configs": configs,
+        "tenant": tenant,
+        "priority": priority,
+    }
+
+
+def resolve_workflow(spec: str):
+    """Resolve a workflow spec to a Task class.
+
+    A bare name looks up ``cluster_tools_tpu.workflows`` (the supported
+    catalog); ``pkg.mod:Class`` (or dotted ``pkg.mod.Class``) imports any
+    Task subclass — the daemon is a same-user local service, so the trust
+    boundary is the process owner, exactly like the pickled ``task.pkl``
+    the cluster workers already load."""
+    from ..runtime.task import Task
+
+    cls = None
+    if ":" in spec:
+        mod_name, _, cls_name = spec.partition(":")
+    elif "." in spec:
+        mod_name, _, cls_name = spec.rpartition(".")
+    else:
+        mod_name, cls_name = "", spec
+    if not mod_name:
+        from .. import workflows
+
+        cls = getattr(workflows, cls_name, None)
+        if cls is None:
+            raise ProtocolError(
+                f"unknown workflow {spec!r} (not in "
+                "cluster_tools_tpu.workflows; use 'pkg.mod:Class' for "
+                "custom tasks)"
+            )
+    else:
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError as e:
+            raise ProtocolError(f"cannot import {mod_name!r}: {e}") from e
+        cls = getattr(mod, cls_name, None)
+        if cls is None:
+            raise ProtocolError(f"{mod_name!r} has no attribute {cls_name!r}")
+    if not (isinstance(cls, type) and issubclass(cls, Task)):
+        raise ProtocolError(f"{spec!r} is not a Task subclass")
+    return cls
+
+
+def job_signature(record: Dict[str, Any]) -> Tuple:
+    """The warm-state key of a job: workflow class + block geometry.
+
+    Two jobs sharing a signature run the same jit programs at the same
+    shapes, so the second is served from the daemon's in-process compile
+    caches — the ``serve.warm_compile_jobs`` counter keys on this (the
+    per-job persistent-cache hit/miss deltas are recorded alongside in
+    the job result; in-memory cache hits emit no jax events, which is
+    precisely why they need their own accounting)."""
+    block_shape = None
+    gconf = record.get("configs", {}).get("global")
+    if isinstance(gconf, dict):
+        bs = gconf.get("block_shape")
+        if isinstance(bs, (list, tuple)):
+            block_shape = tuple(int(b) for b in bs)
+    return (record["workflow"], block_shape)

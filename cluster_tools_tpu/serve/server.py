@@ -1,0 +1,430 @@
+"""ctt-serve daemon: one warm process serving many workflow submissions.
+
+``ServeDaemon`` owns the warm :class:`runtime.workflow.ExecutionContext`
+(device set, persistent compile cache, decoded-chunk LRU, heartbeat
+wiring) and runs three kinds of threads over the durable
+:class:`serve.jobs.JobQueue`:
+
+  * an HTTP thread (``ThreadingHTTPServer`` on loopback) handling
+    submissions, status reads, ``/metrics`` (OpenMetrics — the obs.live
+    exposition, so a scrape job watches the daemon exactly like a cluster
+    run) and ``/healthz``;
+  * ``concurrency`` executor threads that claim leased jobs in priority
+    order and run ``runtime.build([task], context=<warm context>)`` —
+    byte-identical to a fresh-process build, minus the setup cost;
+  * per-running-job lease-renewal threads (the runtime/queue.py cadence),
+    so a daemon killed mid-job leaves a lease that goes stale and
+    requeues on the next daemon over the same state dir.
+
+Shutdown is a **drain** (rides ``obs.heartbeat.install_sigterm_flush``:
+the chained SIGTERM handler flushes telemetry, then triggers the drain
+instead of dying): submissions start answering 503, heartbeats carry
+``draining: true``, in-flight jobs finish and publish results, queued
+jobs stay durable on disk for the next daemon.  A mid-job client
+disconnect affects only that client's HTTP thread — the job keeps
+running and its result stays readable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ..obs import heartbeat as obs_heartbeat
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..runtime import config as cfg
+from ..runtime.workflow import ExecutionContext, build
+from ..utils.store import atomic_write_bytes
+from . import protocol
+from .admission import AdmissionController
+from .jobs import JobClaim, JobQueue
+
+__all__ = ["ServeDaemon", "ENDPOINT_NAME"]
+
+ENDPOINT_NAME = "serve.json"
+
+
+class ServeDaemon:
+    def __init__(self, state_dir: str,
+                 config: Optional[Dict[str, Any]] = None):
+        os.makedirs(state_dir, exist_ok=True)
+        self.state_dir = state_dir
+        conf = cfg.serve_config(state_dir)
+        if config:
+            conf.update({k: v for k, v in config.items() if v is not None})
+        self.config = conf
+        # telemetry: join the ambient run when CTT_TRACE_DIR is set (CI,
+        # bench), else trace into the state dir so /metrics and heartbeats
+        # are always live for scrapes
+        if not obs_trace.enabled() and not os.environ.get(obs_trace.ENV_DIR):
+            obs_trace.enable(
+                os.path.join(state_dir, "trace"),
+                f"serve_{os.getpid()}", export_env=False,
+            )
+        self.context = ExecutionContext(role="serve").install()
+        self.jobs = JobQueue(
+            os.path.join(state_dir, "jobs"), lease_s=conf.get("lease_s")
+        )
+        self.admission = AdmissionController(
+            conf.get("max_queue_depth"), conf.get("tenant_quota"),
+            conf.get("tenant_quotas"),
+        )
+        self.draining = False
+        self._stop = threading.Event()   # end of the main run() loop
+        self._wake = threading.Event()   # new work / drain for executors
+        self._running_jobs = 0
+        self._state_lock = threading.Lock()
+        self._warm_signatures: set = set()
+        self._live_lock = threading.Lock()
+        self._live_reader = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads: list = []
+        self.port: Optional[int] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> Dict[str, Any]:
+        """Bind, spawn HTTP + executor threads, publish the endpoint
+        record.  Returns the endpoint dict."""
+        host = str(self.config.get("host", "127.0.0.1"))
+        port = int(self.config.get("port", 0) or 0)
+        daemon = self
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((host, port), _Handler)
+        self._httpd.ctt_daemon = daemon
+        self.port = self._httpd.server_address[1]
+        http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ctt-serve-http",
+            daemon=True,
+        )
+        http_thread.start()
+        self._threads.append(http_thread)
+        for i in range(max(int(self.config.get("concurrency", 1)), 1)):
+            t = threading.Thread(
+                target=self._executor_loop, name=f"ctt-serve-exec-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        endpoint = {
+            "host": host,
+            "port": self.port,
+            "pid": os.getpid(),
+            "started_wall": time.time(),
+            "run_id": obs_trace.current_run_id(),
+        }
+        atomic_write_bytes(
+            os.path.join(self.state_dir, ENDPOINT_NAME),
+            json.dumps(endpoint, sort_keys=True).encode(),
+        )
+        self._publish_gauges()
+        return endpoint
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → drain.  The drain trigger goes in FIRST, then
+        ``install_sigterm_flush`` wraps it: on SIGTERM the flush handler
+        runs (metrics + shards + final heartbeat land even if the drain
+        then hangs) and chains into the trigger instead of re-raising —
+        the daemon drains and exits cleanly rather than dying mid-job."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _trigger(signum, frame):
+            self.request_drain()
+
+        signal.signal(signal.SIGTERM, _trigger)
+        signal.signal(signal.SIGINT, _trigger)
+        obs_heartbeat.install_sigterm_flush()
+
+    def request_drain(self) -> None:
+        """Flip into draining: refuse new submissions, let in-flight jobs
+        finish, keep queued jobs durable for the next daemon."""
+        self.draining = True
+        obs_heartbeat.note_draining()
+        obs_heartbeat.beat()  # readers see the flag now, not next cadence
+        self._wake.set()
+        self._stop.set()
+
+    def run(self) -> int:
+        """Foreground loop: start (if not already), serve until drained,
+        tear down."""
+        if self._httpd is None:
+            self.start()
+        try:
+            while not self._stop.wait(0.2):
+                pass
+            return self._drain_and_stop()
+        finally:
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            obs_heartbeat.beat(exiting=True)
+            obs_trace.flush()
+
+    def _drain_and_stop(self) -> int:
+        deadline = obs_trace.monotonic() + float(
+            self.config.get("drain_timeout_s", 300.0)
+        )
+        self._wake.set()
+        while obs_trace.monotonic() < deadline:
+            with self._state_lock:
+                busy = self._running_jobs
+            if busy == 0:
+                break
+            time.sleep(0.1)
+        stats = self.jobs.stats()
+        print(
+            f"[serve] drained: {stats['queued']} queued job(s) left durable "
+            f"for the next daemon, {self._running_jobs} still running "
+            "(leases will expire and requeue)",
+            flush=True,
+        )
+        return 0
+
+    # -- submission (HTTP thread) -------------------------------------------
+
+    def submit(self, payload: Any) -> Dict[str, Any]:
+        """Validate + admit + enqueue one submission.  Raises
+        ``protocol.ProtocolError`` (400) or ``Rejected`` (429)."""
+        record = protocol.validate_submission(payload)
+        if self.draining:
+            raise Draining("daemon is draining; resubmit to its successor")
+        ok, reason = self.admission.admit(record["tenant"], self.jobs.stats())
+        if not ok:
+            raise Rejected(reason)
+        job_id = self.jobs.submit(record)
+        self._publish_gauges()
+        self._wake.set()
+        return {"job_id": job_id, "state": "queued"}
+
+    # -- execution (executor threads) ---------------------------------------
+
+    def _executor_loop(self) -> None:
+        while True:
+            if self.draining:
+                # queued jobs stay durable for the next daemon — the
+                # drain only finishes what is already executing
+                return
+            claim = self.jobs.claim_next()
+            if claim is None:
+                self._wake.wait(timeout=self.jobs.lease_s / 4.0)
+                self._wake.clear()
+                continue
+            with self._state_lock:
+                self._running_jobs += 1
+            self._publish_gauges()
+            try:
+                self._run_job(claim)
+            finally:
+                with self._state_lock:
+                    self._running_jobs -= 1
+                self._publish_gauges()
+
+    def _run_job(self, claim: JobClaim) -> None:
+        rec = claim.record
+        stop = threading.Event()
+        renewer = threading.Thread(
+            target=self._renew_loop, args=(claim, stop),
+            name="ctt-serve-lease", daemon=True,
+        )
+        renewer.start()
+        sig = protocol.job_signature(rec)
+        warm = sig in self._warm_signatures
+        before = obs_metrics.snapshot()["counters"]
+        t0 = obs_trace.monotonic()
+        ok, error = True, None
+        try:
+            with obs_trace.span(
+                "serve_job", kind="host", job=claim.job_id,
+                tenant=rec.get("tenant"), workflow=rec.get("workflow"),
+            ):
+                task = self._instantiate(rec)
+                if not build([task], context=self.context):
+                    ok, error = False, "build returned failure"
+        except Exception:
+            ok, error = False, traceback.format_exc()
+        seconds = obs_trace.monotonic() - t0
+        after = obs_metrics.snapshot()["counters"]
+
+        def delta(name: str) -> float:
+            return after.get(name, 0.0) - before.get(name, 0.0)
+
+        if ok:
+            self._warm_signatures.add(sig)
+            obs_metrics.inc("serve.jobs_done")
+            obs_metrics.inc(
+                "serve.warm_compile_jobs" if warm
+                else "serve.cold_compile_jobs"
+            )
+        else:
+            obs_metrics.inc("serve.jobs_failed")
+        self.jobs.complete(claim, {
+            "ok": ok,
+            "error": (error or "")[-4000:] or None,
+            "seconds": seconds,
+            "warm": warm and ok,
+            "compile_cache": {
+                "hits": delta("compile_cache.cache_hits"),
+                "misses": delta("compile_cache.cache_misses"),
+            },
+            "tenant": rec.get("tenant"),
+        })
+        obs_metrics.flush()  # results readable => counters scrapeable
+
+    def _instantiate(self, rec: Dict[str, Any]):
+        cls = protocol.resolve_workflow(rec["workflow"])
+        kwargs = dict(rec.get("kwargs") or {})
+        configs = rec.get("configs") or {}
+        if configs:
+            config_dir = kwargs["config_dir"]
+            for name, conf in configs.items():
+                if name == "global":
+                    cfg.write_global_config(config_dir, conf)
+                else:
+                    cfg.write_config(config_dir, name, conf)
+        return cls(**kwargs)
+
+    def _renew_loop(self, claim: JobClaim, stop: threading.Event) -> None:
+        interval = max(self.jobs.lease_s / 2.0, 0.05)
+        while not stop.wait(interval):
+            try:
+                self.jobs.renew(claim)
+            except OSError:
+                # best-effort liveness, the heartbeat/queue convention: a
+                # full disk costs at worst a spurious requeue later
+                pass
+
+    # -- observability -------------------------------------------------------
+
+    def _publish_gauges(self) -> None:
+        stats = self.jobs.stats()
+        obs_metrics.set_gauge("serve.queue_depth", stats["queued"])
+        with self._state_lock:
+            obs_metrics.set_gauge("serve.running_jobs", self._running_jobs)
+
+    def metrics_text(self) -> str:
+        """The OpenMetrics exposition for ``/metrics``: flush this
+        process's counters, then render the live snapshot of the run dir
+        (all participating processes' counters + heartbeats), falling
+        back to a process-local snapshot when tracing is off."""
+        obs_metrics.flush()
+        rdir = obs_trace.run_dir()
+        from ..obs import live as obs_live
+
+        if rdir is not None:
+            with self._live_lock:
+                if (
+                    self._live_reader is None
+                    or self._live_reader.run_dir != rdir
+                ):
+                    self._live_reader = obs_live.LiveRun(rdir)
+                snap = self._live_reader.poll()
+        else:
+            snap = {
+                "counters": obs_metrics.snapshot()["counters"],
+                "gauges": obs_metrics.snapshot()["gauges"],
+                "workers": [], "tasks": {}, "stragglers": [],
+                "malformed_lines": 0,
+            }
+        return obs_live.render_openmetrics(snap)
+
+    def healthz(self) -> Dict[str, Any]:
+        return {
+            "ok": True,
+            "draining": self.draining,
+            "pid": os.getpid(),
+            "queue": self.jobs.stats(),
+            "context": self.context.describe(),
+            "run_id": obs_trace.current_run_id(),
+        }
+
+
+class Rejected(RuntimeError):
+    """Admission said no (HTTP 429)."""
+
+
+class Draining(RuntimeError):
+    """The daemon is shutting down (HTTP 503)."""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one daemon serves many short local requests; default request logging
+    # to stderr would drown the job logs
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass
+
+    @property
+    def daemon(self) -> ServeDaemon:
+        return self.server.ctt_daemon
+
+    def _reply(self, code: int, payload, content_type="application/json"):
+        try:
+            body = (
+                payload.encode()
+                if isinstance(payload, str)
+                else json.dumps(payload, sort_keys=True).encode()
+            )
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError, socket.timeout):
+            # mid-response client disconnect: the client's problem, never
+            # the daemon's — the job (if any) keeps running
+            pass
+
+    def do_GET(self):  # noqa: N802 - stdlib naming
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/healthz":
+            return self._reply(200, self.daemon.healthz())
+        if path == "/metrics":
+            return self._reply(
+                200, self.daemon.metrics_text(),
+                content_type=(
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8"
+                ),
+            )
+        if path == "/api/v1/jobs":
+            return self._reply(200, {"jobs": self.daemon.jobs.list()})
+        if path.startswith("/api/v1/jobs/"):
+            state = self.daemon.jobs.get(path.rsplit("/", 1)[1])
+            if state is None:
+                return self._reply(404, {"error": "no such job"})
+            return self._reply(200, state)
+        return self._reply(404, {"error": f"no such path {path!r}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib naming
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path != "/api/v1/jobs":
+            return self._reply(404, {"error": f"no such path {path!r}"})
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, OSError) as e:
+            return self._reply(400, {"error": f"bad request body: {e}"})
+        try:
+            return self._reply(200, self.daemon.submit(payload))
+        except protocol.ProtocolError as e:
+            return self._reply(400, {"error": "invalid", "reason": str(e)})
+        except Rejected as e:
+            return self._reply(429, {"error": "rejected", "reason": str(e)})
+        except Draining as e:
+            return self._reply(503, {"error": "draining", "reason": str(e)})
+        except Exception:
+            return self._reply(
+                500, {"error": "internal", "reason": traceback.format_exc()}
+            )

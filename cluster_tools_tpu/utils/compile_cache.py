@@ -26,6 +26,13 @@ import os
 _ACTIVE_DIR: str | None = None
 
 
+def active_dir() -> str | None:
+    """The directory jax is caching executables to, or None when the cache
+    was never enabled / is disabled (introspection for the serve daemon's
+    /healthz and the ExecutionContext describe())."""
+    return _ACTIVE_DIR
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Turn on jax's persistent compilation cache (idempotent).
 

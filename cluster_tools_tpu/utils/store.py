@@ -228,6 +228,12 @@ def set_chunk_cache_budget(max_bytes: Optional[int]) -> int:
     return prev
 
 
+def chunk_cache_budget() -> int:
+    """The decoded-chunk LRU's current byte budget (introspection for the
+    serve daemon / ExecutionContext; 0 means the cache is disabled)."""
+    return _CHUNK_CACHE.max_bytes
+
+
 class Attributes:
     """JSON-file-backed attribute mapping (``.zattrs`` / n5 ``attributes.json``)."""
 

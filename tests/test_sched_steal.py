@@ -275,6 +275,61 @@ class TestStragglerDuplication:
         assert failed == [] and sorted(done) == list(range(8))
         assert owners[0]["job_id"] == 1 and owners[0]["duplicate"]
 
+    def test_duplicate_fires_from_live_median_before_first_result(
+        self, tmp_path, traced_metrics
+    ):
+        """Lease-aware straggler thresholds (ROADMAP item 1 follow-up,
+        landed with ctt-serve): when the live trace already carries
+        completed block durations for this task, duplication uses
+        obs.live's per-task median (scaled by the item's block count) —
+        so it can fire before ANY item result record exists, where the
+        queue's own median was previously blind."""
+        from cluster_tools_tpu.obs import trace as obs_trace
+
+        q = WorkQueue.create(
+            str(tmp_path / "q"), "t", list(range(4)), 2, 60.0
+        )
+        straggler = q.claim(job_id=0)   # item 0, runs "forever"
+        fast = WorkQueue(str(tmp_path / "q"))
+        other = fast.claim(job_id=1)    # item 1, also in flight
+        assert other is not None and other.item == 1
+        # zero results and no trace data: no baseline, no duplicate
+        assert fast.claim(job_id=1) is None
+        # completed block spans land in the live trace (the obs watch
+        # straggler baseline): median block 0.01 s
+        run_dir = obs_trace.run_dir()
+        os.makedirs(run_dir, exist_ok=True)
+        with open(os.path.join(run_dir, "spans.p1.t1.jsonl"), "w") as f:
+            f.write(json.dumps({
+                "type": "header", "run": "sched_unit", "pid": 1, "tid": 1,
+                "host": "synth", "wall": 1000.0, "mono": 10.0,
+            }) + "\n")
+            for i in range(5):
+                f.write(json.dumps({
+                    "type": "span", "id": i + 1, "parent": None,
+                    "name": "block", "kind": "host",
+                    "t0": 10.0 + i, "t1": 10.01 + i, "pid": 1, "tid": 1,
+                    "attrs": {"task": "t", "block": 100 + i},
+                }) + "\n")
+        # age the straggler's CLAIM well past 4 x (median x item blocks)
+        lease = json.load(open(straggler.lease_path))
+        lease["claim_wall"] -= 3600.0
+        with open(straggler.lease_path, "w") as f:
+            json.dump(lease, f)
+        dup = WorkQueue(str(tmp_path / "q")).claim(job_id=1)
+        assert dup is not None and dup.duplicate and dup.item == 0
+        # a different task's spans are not a baseline for this queue
+        q2 = WorkQueue.create(
+            str(tmp_path / "q2"), "other_task", [0, 1], 1, 60.0
+        )
+        s2 = q2.claim(job_id=0)
+        assert q2.claim(job_id=0) is not None  # item 1 also in flight
+        lease = json.load(open(s2.lease_path))
+        lease["claim_wall"] -= 3600.0
+        with open(s2.lease_path, "w") as f:
+            json.dump(lease, f)
+        assert WorkQueue(str(tmp_path / "q2")).claim(job_id=1) is None
+
 
 # --------------------------------------------------------------------------
 # real-process tests: claim race + elastic late joiner

@@ -674,6 +674,132 @@ if [ "$steal_rc" -ne 0 ]; then
     exit "$steal_rc"
 fi
 
+echo "== ctt-serve smoke (two jobs -> warm hit, /metrics parses, SIGTERM drain) =="
+serve_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$serve_tmp" <<'PY'
+import json, os, re, signal, subprocess, sys, time
+
+td = sys.argv[1]
+state_dir = os.path.join(td, "state")
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+       "CTT_HEARTBEAT_S": "0.2"}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+from cluster_tools_tpu.serve import JobQueue, ServeClient
+from cluster_tools_tpu.utils import file_reader
+
+path = os.path.join(td, "d.n5")
+rng = np.random.default_rng(0)
+file_reader(path).create_dataset(
+    "seg", data=rng.integers(0, 50, (8, 16, 16)).astype(np.uint64),
+    chunks=(4, 8, 8),
+)
+
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "cluster_tools_tpu.serve",
+     "--state-dir", state_dir, "--lease-s", "0.5"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+)
+try:
+    client = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        assert daemon.poll() is None, daemon.stderr.read()
+        try:
+            client = ServeClient(state_dir=state_dir)
+            client.healthz()
+            break
+        except Exception:
+            time.sleep(0.1)
+    assert client is not None, "daemon never became healthy"
+
+    # two small workflows back-to-back: the second must be served from
+    # the daemon's warm compile state
+    states = []
+    for i in (1, 2):
+        states.append(client.submit_and_wait(
+            "UniqueWorkflow",
+            {"tmp_folder": os.path.join(td, f"tmp{i}"),
+             "config_dir": os.path.join(td, "configs"),
+             "input_path": path, "input_key": "seg",
+             "output_path": path, "output_key": f"u{i}"},
+            configs={"global": {"block_shape": [4, 8, 8]}},
+            timeout_s=300,
+        ))
+    assert states[0]["result"]["ok"] and states[1]["result"]["ok"]
+    assert not states[0]["result"]["warm"], states[0]["result"]
+    assert states[1]["result"]["warm"], states[1]["result"]
+
+    text = client.metrics_text()
+    with open(os.path.join(td, "exposition.txt"), "w") as f:
+        f.write(text)
+    vals = {
+        ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+        for ln in text.splitlines()
+        if ln and not ln.startswith("#")
+    }
+    assert vals.get("ctt_serve_warm_compile_jobs_total", 0) >= 1, vals
+    assert vals.get("ctt_serve_jobs_done_total", 0) >= 2, vals
+
+    # SIGTERM -> drain: clean exit, heartbeat flags the drain
+    daemon.send_signal(signal.SIGTERM)
+    rc = daemon.wait(timeout=120)
+    assert rc == 0, (rc, daemon.stderr.read()[-2000:])
+    ep = json.load(open(os.path.join(state_dir, "serve.json")))
+    run_dir = os.path.join(state_dir, "trace", ep["run_id"])
+    hbs = [n for n in os.listdir(run_dir) if n.startswith("hb.p")]
+    assert hbs, os.listdir(run_dir)
+    hb = json.load(open(os.path.join(run_dir, hbs[0])))
+    assert hb["draining"] is True and hb["exiting"] is True, hb
+    # nothing queued was lost (both jobs completed pre-drain)
+    q = JobQueue(os.path.join(state_dir, "jobs"), lease_s=0.5)
+    assert all(j["state"] == "done" for j in q.list()), q.list()
+    print("serve smoke ok: cold->warm accounting, drain clean")
+finally:
+    if daemon.poll() is None:
+        daemon.kill()
+        daemon.wait(timeout=30)
+PY
+serve_rc=$?
+if [ "$serve_rc" -ne 0 ]; then
+    rm -rf "$serve_tmp"
+    echo "serve smoke failed (rc=$serve_rc): daemon warm-compile" \
+         "accounting, /metrics, or SIGTERM drain regressed" >&2
+    exit "$serve_rc"
+fi
+# the daemon's exposition must be valid OpenMetrics (same validator as
+# the watch smoke)
+python - "$serve_tmp/exposition.txt" <<'PY'
+import re, sys
+with open(sys.argv[1]) as f:
+    text = f.read()
+lines = text.splitlines()
+assert lines and lines[-1] == "# EOF", "exposition must end with # EOF"
+try:
+    from prometheus_client.openmetrics.parser import (
+        text_string_to_metric_families,
+    )
+    families = list(text_string_to_metric_families(text))
+    assert families, "no metric families in exposition"
+except ImportError:
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.+eEinfa]+$")
+    meta = re.compile(r"^# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* \w+|HELP .+|EOF)$")
+    for line in lines:
+        assert sample.match(line) or meta.match(line), f"bad line: {line}"
+print("serve prom exposition ok")
+PY
+serve_prom_rc=$?
+rm -rf "$serve_tmp"
+if [ "$serve_prom_rc" -ne 0 ]; then
+    echo "serve /metrics output is not valid OpenMetrics" \
+         "(rc=$serve_prom_rc)" >&2
+    exit "$serve_prom_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
