@@ -85,10 +85,15 @@ the same lease clock contract, lifted from block-batch grain to job
 grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
 
     serve.json                  the endpoint record, atomically replaced
-                                at daemon start: {"host", "port", "pid",
-                                "started_wall", "run_id"} — clients
-                                discover the daemon by file, not by port
-                                convention.
+                                at daemon start with mode 0600: {"host",
+                                "port", "pid", "started_wall", "run_id",
+                                "token"} — clients discover the daemon by
+                                file, not by port convention, and
+                                "token" (required on every request
+                                except /healthz, via X-CTT-Serve-Token
+                                or Authorization: Bearer) makes reading
+                                this file the authorization: loopback
+                                reachability alone grants nothing.
     jobs/job.<id>.json          one submission, published exactly once
                                 (exclusive link): {"id", "seq", "schema",
                                 "workflow", "kwargs", "configs",

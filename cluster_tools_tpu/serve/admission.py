@@ -33,10 +33,14 @@ class AdmissionController:
         tenant_quota: Optional[int] = 8,
         tenant_quotas: Optional[Dict[str, int]] = None,
     ):
+        # None disables a gate; 0 is a real ceiling ("admit nothing"),
+        # so normalize on identity, not truthiness
         self.max_queue_depth = (
-            int(max_queue_depth) if max_queue_depth else None
+            int(max_queue_depth) if max_queue_depth is not None else None
         )
-        self.tenant_quota = int(tenant_quota) if tenant_quota else None
+        self.tenant_quota = (
+            int(tenant_quota) if tenant_quota is not None else None
+        )
         self.tenant_quotas = {
             str(k): int(v) for k, v in (tenant_quotas or {}).items()
         }

@@ -1,9 +1,12 @@
 """ctt-serve client: submit workflows to a running daemon and wait.
 
 Discovery is file-based: the daemon publishes ``serve.json`` (host, port,
-pid, run id) into its state dir; ``ServeClient(state_dir)`` reads it.
-Everything else is four tiny HTTP calls over loopback (stdlib urllib — a
-client must not drag jax in just to submit).
+pid, run id, auth token) into its state dir with mode 0600;
+``ServeClient(state_dir)`` reads it — being able to read the file IS the
+authorization, and the client sends the token on every request.  When
+constructed from a bare ``endpoint`` URL instead, pass ``token=``
+explicitly.  Everything else is four tiny HTTP calls over loopback
+(stdlib urllib — a client must not drag jax in just to submit).
 """
 
 from __future__ import annotations
@@ -40,16 +43,27 @@ class ServeClient:
         state_dir: Optional[str] = None,
         endpoint: Optional[str] = None,
         timeout_s: float = 30.0,
+        token: Optional[str] = None,
     ):
-        if endpoint is None:
-            if state_dir is None:
-                raise ValueError("need state_dir or endpoint")
+        if state_dir is not None and (endpoint is None or token is None):
             ep = read_endpoint(state_dir)
-            endpoint = f"http://{ep['host']}:{ep['port']}"
+            if endpoint is None:
+                endpoint = f"http://{ep['host']}:{ep['port']}"
+            if token is None:
+                token = ep.get("token")
+        if endpoint is None:
+            raise ValueError("need state_dir or endpoint")
         self.base = endpoint.rstrip("/")
+        self.token = token
         self.timeout_s = float(timeout_s)
 
     # -- raw HTTP ------------------------------------------------------------
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-CTT-Serve-Token"] = self.token
+        return headers
 
     def _request(self, method: str, path: str,
                  payload: Optional[dict] = None):
@@ -58,7 +72,7 @@ class ServeClient:
             data=(
                 json.dumps(payload).encode() if payload is not None else None
             ),
-            headers={"Content-Type": "application/json"},
+            headers=self._headers(),
             method=method,
         )
         try:
@@ -137,6 +151,8 @@ class ServeClient:
         return self._request("GET", "/healthz")
 
     def metrics_text(self) -> str:
-        req = urllib.request.Request(self.base + "/metrics")
+        req = urllib.request.Request(
+            self.base + "/metrics", headers=self._headers()
+        )
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return resp.read().decode()

@@ -18,9 +18,16 @@ reference beside the heartbeat/lease schemas in ``obs/trace.py``)::
       "priority": 0                              # higher claims first
     }
 
+Every request except the bare ``/healthz`` liveness probe must carry the
+daemon's auth token (``X-CTT-Serve-Token: <token>`` or ``Authorization:
+Bearer <token>``), published only through the mode-0600 ``serve.json``
+endpoint record — reading that file is the authorization; the loopback
+port itself is reachable by any local user and grants nothing.
+
 Responses: ``{"job_id": "j000001", "state": "queued"}`` on admission,
 HTTP 429 ``{"error": "rejected", "reason": ...}`` on quota/queue-depth
-rejection, HTTP 400 on schema violations, HTTP 503 while draining.
+rejection, HTTP 400 on schema violations, HTTP 401 on a missing/wrong
+token, HTTP 503 while draining.
 
 Job state read back from ``GET /api/v1/jobs/<id>``::
 
@@ -97,9 +104,11 @@ def resolve_workflow(spec: str):
 
     A bare name looks up ``cluster_tools_tpu.workflows`` (the supported
     catalog); ``pkg.mod:Class`` (or dotted ``pkg.mod.Class``) imports any
-    Task subclass — the daemon is a same-user local service, so the trust
-    boundary is the process owner, exactly like the pickled ``task.pkl``
-    the cluster workers already load."""
+    Task subclass.  Resolution runs arbitrary import-time code, which is
+    why it is only ever reached behind the daemon's request token (the
+    mode-0600 ``serve.json``): the trust boundary is "can read the
+    daemon owner's files", like the pickled ``task.pkl`` the cluster
+    workers already load — NOT "can open a loopback socket"."""
     from ..runtime.task import Task
 
     cls = None

@@ -8,7 +8,11 @@ wiring) and runs three kinds of threads over the durable
   * an HTTP thread (``ThreadingHTTPServer`` on loopback) handling
     submissions, status reads, ``/metrics`` (OpenMetrics — the obs.live
     exposition, so a scrape job watches the daemon exactly like a cluster
-    run) and ``/healthz``;
+    run) and ``/healthz``.  Every request except the bare ``/healthz``
+    liveness probe must present the daemon's auth token (published only
+    through the mode-0600 ``serve.json``): a loopback port is reachable
+    by any local user, and a submission resolves and instantiates Task
+    classes — admission is gated on filesystem permissions instead;
   * ``concurrency`` executor threads that claim leased jobs in priority
     order and run ``runtime.build([task], context=<warm context>)`` —
     byte-identical to a fresh-process build, minus the setup cost;
@@ -27,8 +31,10 @@ running and its result stays readable.
 
 from __future__ import annotations
 
+import hmac
 import json
 import os
+import secrets
 import signal
 import socket
 import threading
@@ -42,7 +48,6 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..runtime import config as cfg
 from ..runtime.workflow import ExecutionContext, build
-from ..utils.store import atomic_write_bytes
 from . import protocol
 from .admission import AdmissionController
 from .jobs import JobClaim, JobQueue
@@ -50,6 +55,25 @@ from .jobs import JobClaim, JobQueue
 __all__ = ["ServeDaemon", "ENDPOINT_NAME"]
 
 ENDPOINT_NAME = "serve.json"
+
+
+def _write_private(path: str, payload: bytes) -> None:
+    """Atomic replace with mode 0600 from birth: ``serve.json`` carries
+    the daemon's auth token, so its readability IS the trust boundary —
+    a loopback port is reachable by every local user, the endpoint file
+    only by the daemon's owner."""
+    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class ServeDaemon:
@@ -77,11 +101,18 @@ class ServeDaemon:
             conf.get("max_queue_depth"), conf.get("tenant_quota"),
             conf.get("tenant_quotas"),
         )
+        # per-daemon auth secret: published only through serve.json
+        # (mode 0600), required on every request except /healthz — a
+        # submission instantiates arbitrary Task classes, so admission
+        # to the socket must be gated on filesystem permissions, not on
+        # loopback reachability (any local user can reach 127.0.0.1)
+        self.token = secrets.token_hex(16)
         self.draining = False
         self._stop = threading.Event()   # end of the main run() loop
         self._wake = threading.Event()   # new work / drain for executors
         self._running_jobs = 0
         self._state_lock = threading.Lock()
+        self._submit_lock = threading.Lock()
         self._warm_signatures: set = set()
         self._live_lock = threading.Lock()
         self._live_reader = None
@@ -124,8 +155,9 @@ class ServeDaemon:
             "pid": os.getpid(),
             "started_wall": time.time(),
             "run_id": obs_trace.current_run_id(),
+            "token": self.token,
         }
-        atomic_write_bytes(
+        _write_private(
             os.path.join(self.state_dir, ENDPOINT_NAME),
             json.dumps(endpoint, sort_keys=True).encode(),
         )
@@ -152,6 +184,13 @@ class ServeDaemon:
         """Flip into draining: refuse new submissions, let in-flight jobs
         finish, keep queued jobs durable for the next daemon."""
         self.draining = True
+        # on the SIGTERM path the flush handler (install_sigterm_flush)
+        # has already stopped the beat thread before chaining here —
+        # restart it so heartbeats keep carrying ``draining: true`` for
+        # the whole drain window (up to drain_timeout_s) instead of the
+        # daemon going silent and readers flagging it stale; run()'s
+        # final teardown stops it for good
+        obs_heartbeat.ensure_started(role="serve")
         obs_heartbeat.note_draining()
         obs_heartbeat.beat()  # readers see the flag now, not next cadence
         self._wake.set()
@@ -170,7 +209,9 @@ class ServeDaemon:
             if self._httpd is not None:
                 self._httpd.shutdown()
                 self._httpd.server_close()
-            obs_heartbeat.beat(exiting=True)
+            # stop the (possibly drain-restarted) beat thread and stamp
+            # the final ``exiting`` heartbeat in one move
+            obs_heartbeat.stop(final=True)
             obs_trace.flush()
 
     def _drain_and_stop(self) -> int:
@@ -201,10 +242,17 @@ class ServeDaemon:
         record = protocol.validate_submission(payload)
         if self.draining:
             raise Draining("daemon is draining; resubmit to its successor")
-        ok, reason = self.admission.admit(record["tenant"], self.jobs.stats())
-        if not ok:
-            raise Rejected(reason)
-        job_id = self.jobs.submit(record)
+        # admit + enqueue must be one atomic step across the HTTP handler
+        # threads: check-then-act on stats() would let concurrent
+        # submissions all see the same headroom and overshoot the queue
+        # depth / tenant quota together
+        with self._submit_lock:
+            ok, reason = self.admission.admit(
+                record["tenant"], self.jobs.stats()
+            )
+            if not ok:
+                raise Rejected(reason)
+            job_id = self.jobs.submit(record)
         self._publish_gauges()
         self._wake.set()
         return {"job_id": job_id, "state": "queued"}
@@ -246,15 +294,22 @@ class ServeDaemon:
         t0 = obs_trace.monotonic()
         ok, error = True, None
         try:
-            with obs_trace.span(
-                "serve_job", kind="host", job=claim.job_id,
-                tenant=rec.get("tenant"), workflow=rec.get("workflow"),
-            ):
-                task = self._instantiate(rec)
-                if not build([task], context=self.context):
-                    ok, error = False, "build returned failure"
-        except Exception:
-            ok, error = False, traceback.format_exc()
+            try:
+                with obs_trace.span(
+                    "serve_job", kind="host", job=claim.job_id,
+                    tenant=rec.get("tenant"), workflow=rec.get("workflow"),
+                ):
+                    task = self._instantiate(rec)
+                    if not build([task], context=self.context):
+                        ok, error = False, "build returned failure"
+            except Exception:
+                ok, error = False, traceback.format_exc()
+        finally:
+            # the renewer dies with the job: a persistent daemon would
+            # otherwise accumulate one thread (each re-stamping the lease
+            # file forever) per executed job
+            stop.set()
+            renewer.join(timeout=5.0)
         seconds = obs_trace.monotonic() - t0
         after = obs_metrics.snapshot()["counters"]
 
@@ -369,6 +424,25 @@ class _Handler(BaseHTTPRequestHandler):
     def daemon(self) -> ServeDaemon:
         return self.server.ctt_daemon
 
+    def _authorized(self) -> bool:
+        """The per-daemon token from serve.json (mode 0600), via
+        ``X-CTT-Serve-Token`` or ``Authorization: Bearer``.  Everything
+        but the bare liveness probe requires it: loopback reachability
+        is not a trust boundary on a shared host."""
+        supplied = self.headers.get("X-CTT-Serve-Token") or ""
+        if not supplied:
+            auth = self.headers.get("Authorization") or ""
+            if auth.startswith("Bearer "):
+                supplied = auth[len("Bearer "):]
+        return hmac.compare_digest(supplied, self.daemon.token)
+
+    def _reject_unauthorized(self):
+        return self._reply(401, {
+            "error": "unauthorized",
+            "reason": "missing or wrong daemon token (read it from the "
+                      "state dir's serve.json)",
+        })
+
     def _reply(self, code: int, payload, content_type="application/json"):
         try:
             body = (
@@ -389,7 +463,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - stdlib naming
         path = self.path.split("?", 1)[0].rstrip("/")
         if path == "/healthz":
+            # tokenless liveness probe (the k8s/scrape-target convention);
+            # everything else is authenticated
             return self._reply(200, self.daemon.healthz())
+        if not self._authorized():
+            return self._reject_unauthorized()
         if path == "/metrics":
             return self._reply(
                 200, self.daemon.metrics_text(),
@@ -411,6 +489,10 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/")
         if path != "/api/v1/jobs":
             return self._reply(404, {"error": f"no such path {path!r}"})
+        if not self._authorized():
+            # refused before the body is even parsed: an unauthenticated
+            # submission must never reach workflow resolution
+            return self._reject_unauthorized()
         try:
             length = int(self.headers.get("Content-Length") or 0)
             payload = json.loads(self.rfile.read(length) or b"{}")

@@ -24,7 +24,10 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
+import urllib.error
+import urllib.request
 
 import numpy as np
 import pytest
@@ -35,6 +38,7 @@ from cluster_tools_tpu.runtime import ExecutionContext, build
 from cluster_tools_tpu.serve import (
     JobQueue, QuotaRejected, ServeClient, ServeDaemon,
 )
+from cluster_tools_tpu.serve.client import read_endpoint
 from cluster_tools_tpu.serve.admission import AdmissionController
 from cluster_tools_tpu.serve.protocol import (
     ProtocolError, job_signature, resolve_workflow, validate_submission,
@@ -321,6 +325,16 @@ class TestAdmission:
                                      "per_tenant": {"a": 999}})
         assert ok
 
+    def test_zero_limits_mean_admit_nothing(self):
+        """0 is a real ceiling, not a truthy-falsy 'unlimited': only
+        None disables a gate."""
+        adm = AdmissionController(max_queue_depth=0, tenant_quota=None)
+        ok, reason = adm.admit("a", {"in_flight": 0, "per_tenant": {}})
+        assert not ok and "queue full" in reason
+        adm = AdmissionController(max_queue_depth=None, tenant_quota=0)
+        ok, reason = adm.admit("a", {"in_flight": 0, "per_tenant": {}})
+        assert not ok and "quota" in reason
+
 
 # --------------------------------------------------------------------------
 # daemon end-to-end (in process)
@@ -493,6 +507,69 @@ class TestServeDaemon:
         state = client.wait(job, timeout_s=120)
         assert state["result"]["ok"]
 
+    def test_requests_require_daemon_token(self, tmp_path, daemon_factory):
+        """The auth gate: serve.json is 0600 and carries the token; a
+        tokenless caller gets 401 everywhere but /healthz — never
+        reaching workflow resolution (arbitrary imports) in particular."""
+        daemon = daemon_factory(tmp_path / "state")
+        state_dir = str(tmp_path / "state")
+        ep = read_endpoint(state_dir)
+        assert ep["token"] == daemon.token
+        mode = os.stat(os.path.join(state_dir, "serve.json")).st_mode
+        assert mode & 0o777 == 0o600
+        base = f"http://{ep['host']}:{ep['port']}"
+        # tokenless liveness probe stays open
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            assert json.loads(resp.read())["ok"]
+        # everything else answers 401 without the token
+        for method, path, data in (
+            ("GET", "/api/v1/jobs", None),
+            ("GET", "/metrics", None),
+            ("POST", "/api/v1/jobs",
+             json.dumps(_sleep_vol_job(str(tmp_path), "auth", 0.01))
+             .encode()),
+        ):
+            req = urllib.request.Request(
+                base + path, data=data, method=method,
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 401, (method, path)
+        # the file-discovered client carries the token on every call
+        client = ServeClient(state_dir=state_dir)
+        assert client.token == daemon.token
+        assert client.list_jobs() == []
+        assert client.metrics_text().rstrip().endswith("# EOF")
+        # Bearer form works too (prometheus-style authorization)
+        req = urllib.request.Request(
+            base + "/api/v1/jobs",
+            headers={"Authorization": f"Bearer {daemon.token}"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read()) == {"jobs": []}
+
+    def test_lease_renewer_threads_stop_with_jobs(
+        self, tmp_path, daemon_factory
+    ):
+        """Each job's lease renewer must die with the job — a persistent
+        daemon otherwise accumulates one immortal thread per job."""
+        daemon_factory(tmp_path / "state")
+        client = ServeClient(state_dir=str(tmp_path / "state"))
+        td = str(tmp_path)
+        for i in range(3):
+            state = client.submit_and_wait(**_submit_kw(
+                _sleep_vol_job(td, f"lr{i}", 0.01)), timeout_s=120)
+            assert state["result"]["ok"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            alive = [t for t in threading.enumerate()
+                     if t.name == "ctt-serve-lease" and t.is_alive()]
+            if not alive:
+                break
+            time.sleep(0.05)
+        assert not alive, f"leaked lease renewers: {alive}"
+
     def test_watch_renders_serve_line(self, tmp_path, daemon_factory):
         from cluster_tools_tpu.obs.live import LiveRun, format_watch
 
@@ -581,6 +658,42 @@ class TestSigtermDrain:
                 for i in range(2)
             ]
             proc.send_signal(signal.SIGTERM)
+            # heartbeats keep landing DURING the drain: the SIGTERM
+            # flush stops the beat thread, request_drain restarts it —
+            # readers must see live draining beats (not `exiting`, not
+            # staleness) while the in-flight job finishes
+            run_dir = os.path.join(
+                str(state_dir), "trace",
+                json.load(open(state_dir / "serve.json"))["run_id"],
+            )
+
+            def read_hb():
+                names = [n for n in os.listdir(run_dir)
+                         if n.startswith("hb.p")]
+                assert names, os.listdir(run_dir)
+                return json.load(open(os.path.join(run_dir, names[0])))
+
+            draining_beats = []
+            deadline = time.monotonic() + 1.5
+            while time.monotonic() < deadline:
+                try:
+                    hb = read_hb()
+                except (OSError, json.JSONDecodeError):
+                    hb = None
+                if (
+                    hb
+                    and hb.get("draining")
+                    and not hb.get("exiting")
+                    and hb["seq"] not in [b["seq"] for b in draining_beats]
+                ):
+                    draining_beats.append(hb)
+                    if len(draining_beats) >= 2:
+                        break
+                time.sleep(0.05)
+            assert len(draining_beats) >= 2, (
+                "heartbeat went silent during the drain: "
+                f"{draining_beats}"
+            )
             rc = proc.wait(timeout=120)
             assert rc == 0, (proc.stdout.read(), proc.stderr.read())
             # the in-flight job drained to a real result ...
@@ -591,13 +704,7 @@ class TestSigtermDrain:
             for jid in queued:
                 assert q.get(jid)["state"] == "queued"
             # ... and the heartbeat flagged the drain before exit
-            run_dir = os.path.join(str(state_dir), "trace",
-                                   json.load(open(
-                                       state_dir / "serve.json"
-                                   ))["run_id"])
-            hbs = [n for n in os.listdir(run_dir) if n.startswith("hb.p")]
-            assert hbs, os.listdir(run_dir)
-            hb = json.load(open(os.path.join(run_dir, hbs[0])))
+            hb = read_hb()
             assert hb["draining"] is True and hb["exiting"] is True
         finally:
             if proc.poll() is None:
