@@ -916,6 +916,28 @@ def bench_ws_e2e(x, block_shape):
         except Exception as e:
             log(f"[ws-e2e] ctt-serve bench failed: {e}")
         try:
+            # ctt-cloud: the same watershed against the stub object store
+            # (subprocess HTTP server) vs POSIX — remote walls, IO hidden
+            # behind compute, and chunk-digest parity
+            from bench_e2e_lib import run_remote_pipeline
+
+            remote_res = run_remote_pipeline(
+                vol_path, x.shape, block_shape, "tpu"
+            )
+            res.update(remote_res)
+            log(
+                "[ws-e2e] ctt-cloud remote store: cold "
+                f"{remote_res['ws_e2e_remote_cold_wall_s']} s, warm "
+                f"{remote_res['ws_e2e_remote_warm_wall_s']} s "
+                f"({remote_res['ws_e2e_remote_vs_posix_warm']}x the posix "
+                f"warm wall {remote_res['ws_e2e_remote_posix_warm_wall_s']}"
+                f" s), read hidden "
+                f"{remote_res['ws_e2e_remote_read_hidden_s']} s, parity "
+                f"{remote_res['ws_e2e_remote_parity']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] ctt-cloud bench failed: {e}")
+        try:
             # below the driver's 450 s ws budget so a slow baseline can
             # never take the already-measured device numbers down with it
             out = subprocess.run(

@@ -677,6 +677,136 @@ def run_serve_pipeline(n_jobs=6, shape=(8, 32, 32), block_shape=(8, 16, 16)):
     }
 
 
+def run_remote_pipeline(vol_path, shape, block_shape, target):
+    """ctt-cloud contract: the WatershedWorkflow run against the local
+    stub object server (tests/objstub.py, spawned as a SUBPROCESS so its
+    request handling never shares the GIL with compute) vs the POSIX
+    store — cold + warm remote walls, the host-IO seconds the pipeline
+    hid on the warm remote run, and byte parity (arrays AND chunk-file
+    digests; gzip chunks are deterministic, so a remote run must produce
+    the exact same files).
+
+    Discipline matches run_ws_pipeline: cold on ``bnd``, warm on the
+    DISTINCT z-rolled ``bnd_warm`` copy in fresh scratch — jit caches
+    reused, no result-cache replay.  The fault-free timing run is the
+    honest latency model (chaos byte-identity rides the test suite and
+    the ci_check cloud smoke); the gate is the warm remote wall within
+    1.5x of the warm POSIX wall with parity true."""
+    import subprocess
+
+    from cluster_tools_tpu.obs import metrics as obs_metrics
+    from cluster_tools_tpu.obs import trace as obs_trace
+    from cluster_tools_tpu.runtime import build, config as cfg
+    from cluster_tools_tpu.utils import file_reader
+    from cluster_tools_tpu.workflows import WatershedWorkflow
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def digest(root):
+        import hashlib
+
+        h = hashlib.sha256()
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames.sort()
+            for name in sorted(filenames):
+                p = os.path.join(dirpath, name)
+                h.update(os.path.relpath(p, root).encode())
+                with open(p, "rb") as f:
+                    h.update(f.read())
+        return h.hexdigest()
+
+    with tempfile.TemporaryDirectory() as td:
+        data_path = _stage_volume(td, vol_path, shape, block_shape, True)
+        objroot = os.path.join(td, "objroot")
+        served = os.path.join(objroot, "data.n5")
+        vol = np.load(vol_path).astype(np.float32)
+        f = file_reader(served)
+        f.create_dataset("bnd", data=vol, chunks=tuple(block_shape))
+        f.create_dataset(
+            "bnd_warm", data=np.roll(vol, 7, axis=1),
+            chunks=tuple(block_shape),
+        )
+
+        port_file = os.path.join(td, "stub.port")
+        stub = subprocess.Popen([
+            sys.executable, os.path.join(here, "tests", "objstub.py"),
+            "--root", objroot, "--port-file", port_file,
+        ])
+        trace_was_on = obs_trace.enabled()
+        if not trace_was_on:
+            obs_trace.enable(
+                os.path.join(td, "trace"), "remote_bench", export_env=False
+            )
+        try:
+            deadline = time.perf_counter() + 30
+            while not os.path.exists(port_file):
+                if stub.poll() is not None:
+                    raise RuntimeError("objstub died on startup")
+                if time.perf_counter() > deadline:
+                    raise RuntimeError("objstub never came up")
+                time.sleep(0.05)
+            url = f"http://127.0.0.1:{open(port_file).read().strip()}"
+
+            def one_run(tag, path, input_key, out_key):
+                config_dir = os.path.join(td, f"configs_{tag}")
+                cfg.write_global_config(
+                    config_dir,
+                    {"block_shape": list(block_shape), "target": target,
+                     "pipeline_depth": 3},
+                )
+                cfg.write_config(
+                    config_dir, "watershed", dict(WS_TASK_CONFIG)
+                )
+                wf = WatershedWorkflow(
+                    os.path.join(td, f"tmp_{tag}"), config_dir,
+                    input_path=path, input_key=input_key,
+                    output_path=path, output_key=out_key,
+                )
+                before = obs_metrics.snapshot()["counters"]
+                t0 = time.perf_counter()
+                ok = build([wf])
+                wall = time.perf_counter() - t0
+                after = obs_metrics.snapshot()["counters"]
+                if not ok:
+                    raise RuntimeError(f"remote bench run failed ({tag})")
+                hidden = after.get("executor.stage_hidden_io_s", 0.0) \
+                    - before.get("executor.stage_hidden_io_s", 0.0)
+                return wall, hidden
+
+            local_cold, _ = one_run("l_cold", data_path, "bnd", "ws_cold")
+            local_warm, _ = one_run("l_warm", data_path, "bnd_warm", "ws")
+            remote_cold, _ = one_run(
+                "r_cold", f"{url}/data.n5", "bnd", "ws_cold"
+            )
+            remote_warm, hidden = one_run(
+                "r_warm", f"{url}/data.n5", "bnd_warm", "ws"
+            )
+
+            with file_reader(data_path, "r") as fl, \
+                    file_reader(served, "r") as fr:
+                parity = bool(np.array_equal(fl["ws"][:], fr["ws"][:]))
+            if digest(os.path.join(data_path, "ws")) != digest(
+                os.path.join(served, "ws")
+            ):
+                parity = False
+        finally:
+            if not trace_was_on:
+                obs_trace.disable()
+            stub.terminate()
+            stub.wait(timeout=30)
+
+    return {
+        "ws_e2e_remote_cold_wall_s": round(remote_cold, 2),
+        "ws_e2e_remote_warm_wall_s": round(remote_warm, 2),
+        "ws_e2e_remote_posix_warm_wall_s": round(local_warm, 2),
+        "ws_e2e_remote_vs_posix_warm": round(
+            remote_warm / max(local_warm, 1e-9), 2
+        ),
+        "ws_e2e_remote_read_hidden_s": round(hidden, 3),
+        "ws_e2e_remote_parity": parity,
+    }
+
+
 def run_ws_pipeline(vol_path, shape, block_shape, target, warm=False,
                     sharded=False):
     """Wall-clock of the WatershedWorkflow alone — the BASELINE.md north

@@ -31,6 +31,10 @@ Sites (each named where the corresponding code path lives):
       IO; ``store.write`` additionally supports the ``torn`` action, which
       truncates the chunk payload on disk (torn-write simulation) and raises
       ``CorruptChunk`` so the shared retry / block-retry machinery rewrites it.
+  ``store.remote_read`` (GET/HEAD) / ``store.remote_write`` (PUT/DELETE)
+      — utils/store_backend.py object-store requests (ctt-cloud): one check
+      per HTTP round trip, so ``p=`` chaos models a flaky gateway at
+      request grain (the request-level retry must absorb it).
   ``executor.block`` (ctx ``id``: block id) / ``executor.batch`` /
       ``executor.stage_read`` / ``executor.stage_compute`` /
       ``executor.stage_write``  — runtime/executor.py dispatch paths.
@@ -102,6 +106,7 @@ class FaultSpecError(ValueError):
 
 KNOWN_SITES = frozenset({
     "store.read", "store.write", "store.decode",
+    "store.remote_read", "store.remote_write",
     "executor.block", "executor.batch",
     "executor.stage_read", "executor.stage_compute", "executor.stage_write",
     "worker.job", "worker.exit",

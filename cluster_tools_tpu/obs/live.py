@@ -597,6 +597,22 @@ def format_watch(snap: Dict[str, Any]) -> str:
             if isinstance(val, (int, float)):
                 parts.append(f"{label} {int(val)}")
         lines.append("  serve: " + ", ".join(parts))
+    if any(k.startswith("store.remote_") for k in counters):
+        # ctt-cloud: one line of remote-IO health — request volume, wire
+        # bytes, retries absorbed, and how many requests are in flight
+        inflight = snap.get("gauges", {}).get("store.remote_inflight")
+        parts = [
+            f"reads {int(counters.get('store.remote_reads', 0))}",
+            f"writes {int(counters.get('store.remote_writes', 0))}",
+            f"retries {int(counters.get('store.remote_retries', 0))}",
+            "read "
+            f"{counters.get('store.remote_bytes_read', 0) / 1e6:.1f} MB",
+            "written "
+            f"{counters.get('store.remote_bytes_written', 0) / 1e6:.1f} MB",
+            (f"inflight {int(inflight)}"
+             if isinstance(inflight, (int, float)) else None),
+        ]
+        lines.append("  remote: " + ", ".join(p for p in parts if p))
     for w in snap["workers"]:
         if w.get("draining") and not w["exiting"]:
             lines.append(

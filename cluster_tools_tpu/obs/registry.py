@@ -37,6 +37,14 @@ COUNTERS = frozenset({
     "store.aligned_chunk_writes",
     # utils/retry.py — backoff sleeps absorbed on transient chunk IO
     "store.io_retries",
+    # utils/store_backend.py — ctt-cloud object-store backend: HTTP
+    # requests (GET/HEAD = reads, PUT/DELETE = writes), wire bytes, and
+    # backoff sleeps absorbed on transient remote requests
+    "store.remote_reads",
+    "store.remote_writes",
+    "store.remote_retries",
+    "store.remote_bytes_read",
+    "store.remote_bytes_written",
     # utils/compile_cache.py — jax.monitoring persistent-cache events
     "compile_cache.cache_hits",
     "compile_cache.cache_misses",
@@ -54,6 +62,10 @@ COUNTERS = frozenset({
     "executor.stage_compute_s",
     "executor.stage_write_s",
     "executor.stage_hidden_io_s",
+    # ctt-cloud async-prefetch lookahead stage (advisory LRU warming
+    # ahead of the in-order compute stage)
+    "executor.prefetch_batches",
+    "executor.stage_prefetch_s",
     # ops/cc.py — ctt-cc coarse-to-fine kernel stats (host-side emission
     # from the connected_components_coarse wrapper, never inside jit)
     "cc.fixpoint_iters",
@@ -95,6 +107,8 @@ COUNTERS = frozenset({
 
 GAUGES = frozenset({
     "compile_cache.entries_at_enable",
+    # utils/store_backend.py — remote HTTP requests currently in flight
+    "store.remote_inflight",
     # runtime/stream.py — peak carried merge-state bytes of a fused chain
     "stream.carry_bytes",
     # runtime/queue.py — unclaimed work-queue items at the last pull scan

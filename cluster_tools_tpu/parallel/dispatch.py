@@ -31,6 +31,30 @@ def form_batches(block_ids: Sequence[int], batch_size: int) -> List[List[int]]:
     return [ids[i: i + bs] for i in range(0, len(ids), bs)]
 
 
+def batch_outer_boxes(blocking: Blocking, block_ids: Sequence[int],
+                      halo: Sequence[int]):
+    """Halo'd outer boxes of a batch plus its bounding box and the
+    bounding-box profitability verdict — the ONE rule shared by the
+    fused-chain read cache and the async-prefetch hook (ctt-cloud):
+    consecutive C-order ids form a (near-)contiguous region, so one
+    bounding-box read decodes every covered chunk exactly once; sparse id
+    runs (retry rounds) fall back to per-block boxes.
+
+    Returns ``(blocks, lo, hi, bbox_profitable)`` where ``bbox_profitable``
+    is True when the bounding box holds no more voxels than the per-block
+    outer boxes combined."""
+    bhs = [blocking.block_with_halo(bid, tuple(halo)) for bid in block_ids]
+    lo = tuple(
+        min(bh.outer.begin[d] for bh in bhs) for d in range(blocking.ndim)
+    )
+    hi = tuple(
+        max(bh.outer.end[d] for bh in bhs) for d in range(blocking.ndim)
+    )
+    bbox_voxels = int(np.prod([e - b for b, e in zip(lo, hi)]))
+    block_voxels = sum(int(np.prod(bh.outer.shape)) for bh in bhs)
+    return bhs, lo, hi, bbox_voxels <= block_voxels
+
+
 @dataclass
 class BlockBatch:
     """A stacked batch of (possibly halo'd) blocks plus their geometry."""
@@ -81,18 +105,8 @@ class BlockReadCache:
         extra = len(ds.shape) - blocking.ndim
         lead = tuple(slice(0, s) for s in ds.shape[:extra])
         boxes = self._boxes.setdefault((path, key), [])
-        bhs = [blocking.block_with_halo(bid, tuple(halo)) for bid in block_ids]
-        lo = tuple(
-            min(bh.outer.begin[d] for bh in bhs) for d in range(blocking.ndim)
-        )
-        hi = tuple(
-            max(bh.outer.end[d] for bh in bhs) for d in range(blocking.ndim)
-        )
-        bbox_voxels = int(np.prod([e - b for b, e in zip(lo, hi)]))
-        block_voxels = sum(
-            int(np.prod(bh.outer.shape)) for bh in bhs
-        )
-        if bbox_voxels <= block_voxels:
+        bhs, lo, hi, bbox_ok = batch_outer_boxes(blocking, block_ids, halo)
+        if bbox_ok:
             index = lead + tuple(slice(b, e) for b, e in zip(lo, hi))
             arr = np.asarray(ds[index])
             boxes.append((

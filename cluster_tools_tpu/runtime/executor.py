@@ -403,9 +403,19 @@ class TpuExecutor(BaseExecutor):
         batch i−1's chunk encodes both happen under batch i's program (XLA
         releases the GIL during execution).  A stage failure for a batch
         degrades that batch to the per-block fallback; other batches are
-        unaffected."""
+        unaffected.
+
+        Async prefetch (ctt-cloud): tasks exposing ``prefetch_batch``
+        additionally get a lookahead stage that warms the decoded-chunk
+        LRU up to ``depth`` batches BEYOND the read stage's own window —
+        chunk fetches overlap as many concurrent range requests as the
+        store backend allows instead of one blocking slice per read
+        thread, so the read stage of a high-latency object store degrades
+        to LRU hits.  Prefetch is advisory (failures surface on the real
+        read) and disabled with ``prefetch: false``."""
         read_fn, compute_fn, write_fn = staged
-        stage_s = {"read": 0.0, "compute": 0.0, "write": 0.0}
+        stage_s = {"read": 0.0, "compute": 0.0, "write": 0.0,
+                   "prefetch": 0.0}
         acc_lock = threading.Lock()
 
         def _acc(stage: str, dt: float) -> None:
@@ -434,6 +444,23 @@ class TpuExecutor(BaseExecutor):
                 write_fn(result, blocking, config)
             _acc("write", time.perf_counter() - t0)
 
+        prefetch_fn = getattr(task, "prefetch_batch", None)
+        if not config.get("prefetch", True):
+            prefetch_fn = None
+
+        def _prefetch(chunk):
+            t0 = time.perf_counter()
+            try:
+                with obs_trace.span(
+                    "stage_prefetch", kind="host_io", task=task.identifier,
+                    blocks=len(chunk), block_ids=list(chunk),
+                ):
+                    prefetch_fn(chunk, blocking, config)
+                obs_metrics.inc("executor.prefetch_batches")
+            except Exception:  # ctt: noqa[CTT009] prefetch is advisory — the read stage re-raises and classifies any real failure
+                pass
+            _acc("prefetch", time.perf_counter() - t0)
+
         n_blocks = sum(len(c) for c in chunks)
         reads: deque = deque()   # (chunk, Future[payload])
         writes: deque = deque()  # (chunk, Future[None], t_batch0)
@@ -441,7 +468,22 @@ class TpuExecutor(BaseExecutor):
             depth, thread_name_prefix="ctt-read"
         ) as read_pool, ThreadPoolExecutor(
             depth, thread_name_prefix="ctt-write"
-        ) as write_pool:
+        ) as write_pool, ThreadPoolExecutor(
+            depth, thread_name_prefix="ctt-prefetch-stage"
+        ) as prefetch_pool:
+            # lookahead frontier: the first ``depth`` chunks go straight
+            # to the read pool (prefetching them would double-fetch), so
+            # the prefetch stage starts ``depth`` ahead and stays ``depth``
+            # beyond the read window throughout
+            next_prefetch = depth
+
+            def _advance_prefetch(upto: int) -> None:
+                nonlocal next_prefetch
+                if prefetch_fn is None:
+                    return
+                while next_prefetch < min(upto, len(chunks)):
+                    prefetch_pool.submit(_prefetch, chunks[next_prefetch])
+                    next_prefetch += 1
 
             def _drain_write():
                 chunk, fut, t_batch0 = writes.popleft()
@@ -491,7 +533,8 @@ class TpuExecutor(BaseExecutor):
                     _drain_write()
 
             t_wall0 = time.perf_counter()
-            for chunk in chunks:
+            for i, chunk in enumerate(chunks):
+                _advance_prefetch(i + 1 + depth)
                 reads.append((chunk, read_pool.submit(_read, chunk)))
                 while len(reads) >= depth:
                     _drain_read()
@@ -511,6 +554,7 @@ class TpuExecutor(BaseExecutor):
         obs_metrics.inc("executor.stage_read_s", stage_s["read"])
         obs_metrics.inc("executor.stage_compute_s", stage_s["compute"])
         obs_metrics.inc("executor.stage_write_s", stage_s["write"])
+        obs_metrics.inc("executor.stage_prefetch_s", stage_s["prefetch"])
         # IO seconds the pipeline hid behind (serialized) compute: summed
         # read+write stage time minus the wall the compute stage left open
         obs_metrics.inc(

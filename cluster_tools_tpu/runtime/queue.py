@@ -214,6 +214,13 @@ class WorkQueue:
         self.stale_after_s = STALE_INTERVALS * self.lease_s
         self._live = None  # lazy obs.live reader (lease-aware stragglers)
 
+    def _now(self) -> float:
+        """Reader-side wall clock for lease/claim ageing — a seam so tests
+        inject time instead of sleeping real fractions of the cadence
+        (expiry decisions become deterministic under arbitrary CI load;
+        writer-side stamps stay on the real clock)."""
+        return time.time()
+
     # -- driver side --------------------------------------------------------
 
     @staticmethod
@@ -352,7 +359,7 @@ class WorkQueue:
             if got is not None:
                 return got
 
-        now = time.time()
+        now = self._now()
         expired = []
         for k in open_items:
             if k not in leases:

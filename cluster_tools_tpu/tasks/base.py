@@ -95,6 +95,46 @@ class VolumeTask(BlockTask):
             compression="gzip",
         )
 
+    # -- ctt-cloud async prefetch ---------------------------------------------
+
+    def prefetch_halo(self, config) -> Sequence[int]:
+        """Halo of the regions ``read_batch`` will request — the task
+        config's ``halo`` key when it matches the spatial rank, else no
+        halo.  An approximate halo is fine: prefetch works at chunk
+        granularity and is advisory, so over/under-shoot degrades to a few
+        extra (or missed) chunk warms, never to wrong data."""
+        halo = config.get("halo")
+        if not halo:
+            return (0,) * self.space_ndim
+        halo = tuple(int(h) for h in halo)
+        if len(halo) != self.space_ndim:
+            return (0,) * self.space_ndim
+        return halo
+
+    def prefetch_batch(self, block_ids, blocking: Blocking, config) -> int:
+        """Warm the decoded-chunk LRU with every input chunk the batch's
+        read stage will need (the executor's async-prefetch stage issues
+        this up to ``pipeline_depth`` batches ahead of the in-order
+        compute stage — ctt-cloud).  Consecutive ids prefetch as one
+        bounding superslab (each chunk probed once); sparse id runs fall
+        back to per-block outer boxes.  Returns the chunk count submitted
+        (0 when the dataset has no prefetch support, e.g. hdf5)."""
+        from ..parallel.dispatch import batch_outer_boxes
+
+        ds = self.input_ds()
+        prefetch = getattr(ds, "prefetch", None)
+        if prefetch is None or not block_ids:
+            return 0
+        halo = self.prefetch_halo(config)
+        extra = len(ds.shape) - blocking.ndim
+        lead = tuple(slice(0, s) for s in ds.shape[:extra])
+        bhs, lo, hi, bbox_ok = batch_outer_boxes(blocking, block_ids, halo)
+        if bbox_ok:
+            return prefetch(
+                lead + tuple(slice(b, e) for b, e in zip(lo, hi))
+            )
+        return sum(prefetch(lead + bh.outer.slicing) for bh in bhs)
+
     # -- ctt-stream fusion contract ------------------------------------------
 
     def fusion_inputs(self, config):

@@ -54,18 +54,35 @@ Transient-failure resilience (ctt-fault):
   * fault-injection sites ``store.read`` / ``store.write`` /
     ``store.decode`` (see ``cluster_tools_tpu/faults``) exercise all of the
     above deterministically, including torn-write simulation.
+
+Object-store backend (ctt-cloud):
+
+  * every byte-level operation goes through a :class:`StoreBackend`
+    (``utils/store_backend.py``): POSIX keeps the exact behavior above,
+    and ``http(s)://`` paths speak GET/PUT/HEAD/DELETE with ``Range``
+    reads against an object store (the URL scheme, wire schema, and the
+    local stub server contract are documented in that module);
+  * remote datasets key the decoded-chunk LRU by the
+    ``(ETag, Last-Modified, Content-Length)`` HEAD signature instead of
+    the POSIX inode triple — warm entries cost one HEAD, not one GET,
+    making the LRU the latency shield for high-RTT stores;
+  * remote chunk IO retries under ``store.remote_retries`` through the
+    same backoff helper, with request-level fault sites
+    ``store.remote_read`` / ``store.remote_write``;
+  * :meth:`Dataset.prefetch` warms the LRU for a region with fetches
+    fanned over a pool — the async-prefetch primitive the executor read
+    stage issues ahead of compute (``runtime/executor.py``).
 """
 
 from __future__ import annotations
 
 import gzip
-import json
 import os
 import struct
 import threading
+import urllib.parse
 import zlib
 from collections import OrderedDict
-from concurrent.futures import ThreadPoolExecutor
 from itertools import product
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -75,6 +92,15 @@ from .. import faults
 from ..obs import metrics as obs_metrics
 from .blocking import _ceil_div
 from .retry import io_retry
+from .store_backend import (  # noqa: F401  (re-exported API)
+    CorruptChunk,
+    HttpBackend,
+    PosixBackend,
+    StoreBackend,
+    atomic_write_bytes,
+    backend_for,
+    is_remote_path,
+)
 
 try:  # h5py is available in the image, but keep it optional
     import h5py
@@ -83,46 +109,14 @@ except ImportError:  # pragma: no cover
 
 __all__ = [
     "file_reader", "File", "Dataset", "RaggedDataset", "CorruptChunk",
-    "atomic_write_bytes",
+    "atomic_write_bytes", "backend_for", "is_remote_path",
 ]
 
 
-class CorruptChunk(OSError):
-    """A chunk read back but failed to decode — truncated or garbled
-    payload, i.e. a torn write.  OSError subclass so the shared IO retry
-    treats it as transient (a concurrent rewrite may land between
-    attempts); if it never heals it fails the reading block cleanly and
-    block retry repairs the store by rerunning the writer."""
-
-
-# fsync before rename is the durability half of atomicity: without it a
-# power failure can surface the renamed file EMPTY (metadata reached the
-# journal, data didn't).  Chunk scratch on tmpfs doesn't care; status/meta
-# JSON does.  CTT_STORE_FSYNC=0 opts out for throwaway stores.
+# fsync opt-out mirrors store_backend (RaggedDataset writes .npy directly)
 _FSYNC = os.environ.get("CTT_STORE_FSYNC", "1").lower() not in (
     "0", "false", "off", ""
 )
-
-
-def atomic_write_bytes(path: str, payload: bytes) -> None:
-    # tmp name must be unique per pid AND thread: concurrent block threads
-    # writing the same meta file (e.g. two workers group-initializing the
-    # shared scratch store) would otherwise replace each other's tmp away
-    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
-    try:
-        with open(tmp, "wb") as f:
-            f.write(payload)
-            if _FSYNC:
-                f.flush()
-                os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        # failed writes must not litter .tmpPID.TID files in shared stores
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
 
 
 # original (pre-ctt-fault) internal name, kept for callers/tests
@@ -130,7 +124,11 @@ _atomic_write_bytes = atomic_write_bytes
 
 
 def _write_json(path: str, obj: Any) -> None:
-    _atomic_write_bytes(path, json.dumps(obj, indent=2).encode())
+    backend_for(path).write_json(path, obj)
+
+
+def _exists(path: str) -> bool:
+    return backend_for(path).exists(path)
 
 
 def _gzip_compress(raw: bytes) -> bytes:
@@ -143,8 +141,7 @@ def _gzip_compress(raw: bytes) -> bytes:
 
 
 def _read_json(path: str) -> Any:
-    with open(path) as f:
-        return json.load(f)
+    return backend_for(path).read_json(path)
 
 
 class _DecodedChunkCache:
@@ -246,9 +243,10 @@ class Attributes:
         self._reserved = tuple(reserved)
 
     def _load(self) -> Dict[str, Any]:
-        if os.path.exists(self._path):
+        try:
             return _read_json(self._path)
-        return {}
+        except FileNotFoundError:
+            return {}
 
     def _store(self, obj: Dict[str, Any]) -> None:
         _write_json(self._path, obj)
@@ -463,11 +461,16 @@ class _ZarrFormat:
 
     @staticmethod
     def is_array(path: str) -> bool:
-        return os.path.exists(os.path.join(path, _ZarrFormat.array_meta))
+        return _exists(
+            backend_for(path).join(path, _ZarrFormat.array_meta)
+        )
 
     @staticmethod
     def init_group(path: str) -> None:
-        _write_json(os.path.join(path, _ZarrFormat.group_meta), {"zarr_format": 2})
+        _write_json(
+            backend_for(path).join(path, _ZarrFormat.group_meta),
+            {"zarr_format": 2},
+        )
 
 
 class _N5Format:
@@ -490,8 +493,8 @@ class _N5Format:
 
     @staticmethod
     def write_meta(path: str, shape, chunks, dtype: np.dtype, compression) -> None:
-        meta_path = os.path.join(path, _N5Format.array_meta)
-        meta = _read_json(meta_path) if os.path.exists(meta_path) else {}
+        meta_path = backend_for(path).join(path, _N5Format.array_meta)
+        meta = _read_json(meta_path) if _exists(meta_path) else {}
         if compression is None:
             n5_comp = {"type": "raw"}
         elif _is_blosc(compression):
@@ -598,20 +601,25 @@ class _N5Format:
 
     @staticmethod
     def is_array(path: str) -> bool:
-        meta_path = os.path.join(path, _N5Format.array_meta)
-        if not os.path.exists(meta_path):
+        meta_path = backend_for(path).join(path, _N5Format.array_meta)
+        if not _exists(meta_path):
             return False
         return "dimensions" in _read_json(meta_path)
 
     @staticmethod
     def init_group(path: str) -> None:
-        meta_path = os.path.join(path, _N5Format.group_meta)
-        if not os.path.exists(meta_path):
+        meta_path = backend_for(path).join(path, _N5Format.group_meta)
+        if not _exists(meta_path):
             _write_json(meta_path, {"n5": "2.0.0"})
 
 
 def _format_for(path: str):
-    ext = os.path.splitext(path)[1].lower()
+    # a remote path is a URL: the container extension lives on the URL
+    # path component (query/fragment stripped)
+    name = path
+    if is_remote_path(path):
+        name = urllib.parse.urlsplit(path).path
+    ext = os.path.splitext(name.rstrip("/"))[1].lower()
     if ext in (".zarr", ".zr"):
         return _ZarrFormat
     if ext == ".n5":
@@ -629,6 +637,7 @@ class Dataset:
         self.path = path
         self._fmt = fmt
         self._readonly = readonly
+        self._backend = backend_for(path)
         spec = fmt.read_meta(path)
         self.shape = spec["shape"]
         self.chunks = spec["chunks"]
@@ -636,8 +645,13 @@ class Dataset:
         self.compression = spec["compression"]
         self.fill_value = spec["fill_value"]
         self._separator = spec["separator"]
+        # remote datasets default to the wide fan-out (high-RTT range
+        # reads want request overlap); posix keeps the serial default —
+        # ``set_read_threads`` / ``ds.n_threads = n`` override either way
+        self.n_threads = self._backend.default_threads
         self.attrs = Attributes(
-            os.path.join(path, fmt.attrs_file), reserved=fmt.attrs_reserved
+            self._backend.join(path, fmt.attrs_file),
+            reserved=fmt.attrs_reserved,
         )
 
     # -- basic properties ----------------------------------------------------
@@ -657,7 +671,9 @@ class Dataset:
     # -- chunk level ---------------------------------------------------------
 
     def _chunk_path(self, grid_pos: Sequence[int]) -> str:
-        return os.path.join(self.path, self._fmt.chunk_key(grid_pos, self._separator))
+        return self._backend.join(
+            self.path, self._fmt.chunk_key(grid_pos, self._separator)
+        )
 
     def _chunk_extent(self, grid_pos: Sequence[int]) -> Tuple[Tuple[int, int], ...]:
         return tuple(
@@ -688,25 +704,31 @@ class Dataset:
     def _decoded_chunk(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
         """One chunk decoded at FULL chunk shape (edge chunks zero-padded),
         read-only, through the process-global decoded-chunk LRU.  Returns
-        None if the chunk is unwritten.  The stat → read window is benign:
-        a concurrent rewrite can at worst cache fresh content under the old
-        signature, which the next reader's stat turns into a miss."""
+        None if the chunk is unwritten.  The signature → read window is
+        benign: a concurrent rewrite can at worst cache fresh content under
+        the old signature, which the next reader's probe turns into a miss.
+        Remote datasets use the backend's ``(ETag, Last-Modified, size)``
+        signature — a warm hit costs one HEAD instead of one ranged GET,
+        and transient probe errors retry instead of degrading to
+        fill_value."""
         p = self._chunk_path(grid_pos)
+        backend = self._backend
         sig = None
         if _CHUNK_CACHE.max_bytes > 0:
             try:
-                st = os.stat(p)
-            except OSError:
+                sig = io_retry(
+                    lambda: backend.signature(p),
+                    what=f"stat chunk {p}", counter=backend.retry_counter,
+                )
+            except FileNotFoundError:
                 return None
-            sig = (st.st_ino, st.st_mtime_ns, st.st_size)
             hit = _CHUNK_CACHE.get(p, sig)
             if hit is not None:
                 obs_metrics.inc("store.chunk_cache_hits")
                 return hit
         def _load() -> np.ndarray:
             faults.check("store.read", path=p)
-            with open(p, "rb") as f:
-                payload = f.read()
+            payload = backend.read_bytes(p)
             # obs counters at the codec boundary: what actually crossed the
             # filesystem (compressed payload bytes), not the decoded size
             obs_metrics.inc("store.chunks_read")
@@ -716,7 +738,9 @@ class Dataset:
         try:
             # transient OSError / torn-chunk decode retries with backoff;
             # a missing chunk (FileNotFoundError) is normal and final
-            full = io_retry(_load, what=f"read chunk {p}")
+            full = io_retry(
+                _load, what=f"read chunk {p}", counter=backend.retry_counter
+            )
         except FileNotFoundError:
             return None
         full.setflags(write=False)  # shared across cache readers
@@ -724,6 +748,33 @@ class Dataset:
             obs_metrics.inc("store.chunk_cache_misses")
             _CHUNK_CACHE.put(p, sig, full)
         return full
+
+    def prefetch(self, bb, n_threads: Optional[int] = None) -> int:
+        """Warm the decoded-chunk LRU with every chunk overlapping ``bb``,
+        fetches fanned over a thread pool — the async-prefetch primitive
+        (ctt-cloud): the executor read stage issues these AHEAD of the
+        in-order compute stage, so high-latency range reads overlap device
+        programs instead of blocking one read thread per slice.  Advisory
+        by contract: per-chunk failures are swallowed (the real read
+        re-raises and classifies), and nothing happens when the LRU is
+        disabled (nothing could be retained).  Returns the chunk count
+        submitted."""
+        if _CHUNK_CACHE.max_bytes <= 0:
+            return 0
+        bb, _ = self._normalize_bb(bb)
+        positions = list(self._chunks_overlapping(bb))
+        if not positions:
+            return 0
+
+        def _warm(grid_pos) -> None:
+            try:
+                self._decoded_chunk(grid_pos)
+            except Exception:  # ctt: noqa[CTT009] prefetch is advisory — the real read retries and classifies this chunk's failure loudly
+                pass
+
+        n = int(n_threads or getattr(self, "n_threads", 1) or 1)
+        self._backend.map(_warm, positions, n)
+        return len(positions)
 
     def read_chunk(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
         """Read one chunk (cropped to the volume at edges), or None if unwritten."""
@@ -744,7 +795,7 @@ class Dataset:
                 f"chunk {tuple(grid_pos)} expects shape {expected}, got {data.shape}"
             )
         p = self._chunk_path(grid_pos)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
+        self._backend.makedirs(self._backend.dirname(p))
         payload = self._fmt.encode_chunk(
             np.asarray(data, dtype=self.dtype), self.chunks, self.compression
         )
@@ -763,7 +814,7 @@ class Dataset:
             torn = faults.mangle("store.write", payload, path=p)
             obs_metrics.inc("store.chunks_written")
             obs_metrics.inc("store.bytes_written", len(payload))
-            atomic_write_bytes(p, payload if torn is None else torn)
+            self._backend.write_bytes(p, payload if torn is None else torn)
             if torn is not None:
                 raise CorruptChunk(
                     f"torn write injected for {p} "
@@ -771,7 +822,10 @@ class Dataset:
                 )
 
         try:
-            io_retry(_commit, what=f"write chunk {p}")
+            io_retry(
+                _commit, what=f"write chunk {p}",
+                counter=self._backend.retry_counter,
+            )
         finally:
             _CHUNK_CACHE.invalidate(p)
 
@@ -788,7 +842,7 @@ class Dataset:
             data, self.chunks, self.compression, n_varlen=data.size
         )
         p = self._chunk_path(grid_pos)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
+        self._backend.makedirs(self._backend.dirname(p))
         self._commit_chunk_payload(p, payload)
 
     def read_chunk_varlen(self, grid_pos: Sequence[int]) -> Optional[np.ndarray]:
@@ -799,8 +853,7 @@ class Dataset:
 
         def _load() -> np.ndarray:
             faults.check("store.read", path=p)
-            with open(p, "rb") as f:
-                payload = f.read()
+            payload = self._backend.read_bytes(p)
             obs_metrics.inc("store.chunks_read")
             obs_metrics.inc("store.bytes_read", len(payload))
             try:
@@ -837,7 +890,10 @@ class Dataset:
                 ) from e
 
         try:
-            return io_retry(_load, what=f"read varlen chunk {p}")
+            return io_retry(
+                _load, what=f"read varlen chunk {p}",
+                counter=self._backend.retry_counter,
+            )
         except FileNotFoundError:
             return None
 
@@ -902,15 +958,11 @@ class Dataset:
 
         positions = list(self._chunks_overlapping(bb))
         n_threads = int(getattr(self, "n_threads", 1) or 1)
-        if n_threads > 1 and len(positions) > 1:
-            # the reference's ``ds.n_threads = n`` idiom (z5py datasets):
-            # file IO and zlib/gzip decompression release the GIL, so the
-            # fan-out overlaps chunk decode even on few cores
-            with ThreadPoolExecutor(min(n_threads, len(positions))) as pool:
-                list(pool.map(_assemble, positions))
-        else:
-            for grid_pos in positions:
-                _assemble(grid_pos)
+        # the reference's ``ds.n_threads = n`` idiom (z5py datasets): file
+        # IO and zlib/gzip decompression release the GIL, so the fan-out
+        # overlaps chunk decode even on few cores; remote backends run it
+        # on their persistent pool (keep-alive connection reuse)
+        self._backend.map(_assemble, positions, n_threads)
         if int_axes:
             out = out.reshape(
                 tuple(s for ax, s in enumerate(out_shape) if ax not in int_axes)
@@ -954,14 +1006,10 @@ class Dataset:
 
         positions = list(self._chunks_overlapping(bb))
         n_threads = int(getattr(self, "n_threads", 1) or 1)
-        if n_threads > 1 and len(positions) > 1:
-            # mirror of the read fan-out: each grid position is a distinct
-            # chunk file, so the per-chunk encode+replace jobs are disjoint
-            with ThreadPoolExecutor(min(n_threads, len(positions))) as pool:
-                list(pool.map(_write_one, positions))
-        else:
-            for grid_pos in positions:
-                _write_one(grid_pos)
+        # mirror of the read fan-out: each grid position is a distinct
+        # chunk file, so the per-chunk encode+replace jobs are disjoint
+        # ("parallel multipart-style" chunk PUTs on the remote backend)
+        self._backend.map(_write_one, positions, n_threads)
 
     def __repr__(self) -> str:
         return f"Dataset({self.path!r}, shape={self.shape}, chunks={self.chunks}, dtype={self.dtype})"
@@ -978,6 +1026,13 @@ class RaggedDataset:
     META = ".ragged.json"
 
     def __init__(self, path: str):
+        if is_remote_path(path):
+            # ragged scratch serializes straight through np.save/np.load;
+            # it lives in the LOCAL tmp_folder by construction, so a remote
+            # path here is a caller bug, not a missing feature
+            raise NotImplementedError(
+                "ragged datasets are POSIX-only (scratch data stays local)"
+            )
         self.path = path
         meta = _read_json(os.path.join(path, self.META))
         self.grid_shape = tuple(meta["grid_shape"])
@@ -986,6 +1041,10 @@ class RaggedDataset:
 
     @classmethod
     def create(cls, path: str, grid_shape: Sequence[int], dtype) -> "RaggedDataset":
+        if is_remote_path(path):
+            raise NotImplementedError(
+                "ragged datasets are POSIX-only (scratch data stays local)"
+            )
         os.makedirs(path, exist_ok=True)
         _write_json(
             os.path.join(path, cls.META),
@@ -995,6 +1054,8 @@ class RaggedDataset:
 
     @classmethod
     def exists(cls, path: str) -> bool:
+        if is_remote_path(path):
+            return False  # ragged data never lives remote (see __init__)
         return os.path.exists(os.path.join(path, cls.META))
 
     def _chunk_path(self, grid_pos) -> str:
@@ -1031,9 +1092,10 @@ class Group:
         self._fmt = fmt
         self._rel = rel
         self._readonly = readonly
-        self.path = os.path.join(root, rel) if rel else root
+        self._backend = backend_for(root)
+        self.path = self._backend.join(root, rel) if rel else root
         if not readonly:
-            os.makedirs(self.path, exist_ok=True)
+            self._backend.makedirs(self.path)
             fmt.init_group(self.path)
         # groups keep the structural keys guarded (writing "dimensions" into a
         # group's attributes.json would make is_array misclassify it) but allow
@@ -1042,18 +1104,18 @@ class Group:
             k for k in fmt.attrs_reserved if k != "dataType"
         )
         self.attrs = Attributes(
-            os.path.join(self.path, fmt.attrs_file), reserved=group_reserved
+            self._backend.join(self.path, fmt.attrs_file),
+            reserved=group_reserved,
         )
 
     # -- navigation ----------------------------------------------------------
 
     def __contains__(self, key: str) -> bool:
-        p = os.path.join(self.path, key)
-        return os.path.isdir(p)
+        return self._backend.isdir(self._backend.join(self.path, key))
 
     def __getitem__(self, key: str):
-        p = os.path.join(self.path, key)
-        if not os.path.isdir(p):
+        p = self._backend.join(self.path, key)
+        if not self._backend.isdir(p):
             raise KeyError(key)
         if self._fmt.is_array(p):
             return Dataset(p, self._fmt, readonly=self._readonly)
@@ -1064,19 +1126,19 @@ class Group:
 
     def require_group(self, key: str) -> "Group":
         rel = os.path.join(self._rel, key) if self._rel else key
-        if self._readonly and not os.path.isdir(os.path.join(self.path, key)):
+        if self._readonly and not self._backend.isdir(
+            self._backend.join(self.path, key)
+        ):
             raise PermissionError(f"container opened read-only: {self.path}")
         return Group(self._root, self._fmt, rel, readonly=self._readonly)
 
     create_group = require_group
 
     def keys(self):
-        if not os.path.isdir(self.path):
-            return []
         return [
             k
-            for k in sorted(os.listdir(self.path))
-            if os.path.isdir(os.path.join(self.path, k))
+            for k in self._backend.listdir(self.path)
+            if self._backend.isdir(self._backend.join(self.path, k))
         ]
 
     # -- dataset creation ----------------------------------------------------
@@ -1119,7 +1181,7 @@ class Group:
             compression = "gzip"
         if compression == "raw":
             compression = None
-        p = os.path.join(self.path, key)
+        p = self._backend.join(self.path, key)
         if self._fmt.is_array(p):
             if not exist_ok:
                 raise ValueError(f"dataset exists: {p}")
@@ -1128,16 +1190,14 @@ class Group:
             # overwrite semantics: a rerun that brings new data must not
             # silently keep the stale array (shape/width may have changed —
             # e.g. merge_edge_features after a quantile_mode switch)
-            import shutil
-
-            shutil.rmtree(p)
+            self._backend.rmtree(p)
         # intermediate groups
         parts = key.split("/")
         grp = self
         for part in parts[:-1]:
             grp = grp.require_group(part)
-        dpath = os.path.join(grp.path, parts[-1])
-        os.makedirs(dpath, exist_ok=True)
+        dpath = self._backend.join(grp.path, parts[-1])
+        self._backend.makedirs(dpath)
         self._fmt.write_meta(dpath, tuple(shape), tuple(chunks), np.dtype(dtype), compression)
         ds = Dataset(dpath, self._fmt)
         if data is not None:
@@ -1146,7 +1206,7 @@ class Group:
 
     def require_dataset(self, key: str, shape=None, dtype=None, chunks=None,
                         compression="default") -> Dataset:
-        p = os.path.join(self.path, key)
+        p = self._backend.join(self.path, key)
         if self._fmt.is_array(p):
             ds = Dataset(p, self._fmt)
             if shape is not None and tuple(shape) != ds.shape:
@@ -1160,7 +1220,7 @@ class Group:
     ) -> RaggedDataset:
         if self._readonly:
             raise PermissionError(f"container opened read-only: {self.path}")
-        p = os.path.join(self.path, key)
+        p = self._backend.join(self.path, key)
         if RaggedDataset.exists(p):
             return RaggedDataset(p)
         return RaggedDataset.create(p, grid_shape, dtype)
@@ -1171,7 +1231,7 @@ class File(Group):
 
     def __init__(self, path: str, mode: str = "a"):
         fmt = _format_for(path)
-        if mode == "r" and not os.path.isdir(path):
+        if mode == "r" and not backend_for(path).isdir(path):
             raise FileNotFoundError(path)
         super().__init__(path, fmt, readonly=(mode == "r"))
         self.mode = mode
@@ -1459,8 +1519,20 @@ def file_reader(path: str, mode: str = "a"):
     """Open a chunked container by extension: .zarr/.zr, .n5, .h5/.hdf5.
 
     Mirrors the façade the reference builds over elf.io/z5py
-    (reference utils/volume_utils.py:21-22).
+    (reference utils/volume_utils.py:21-22).  ``http(s)://`` paths open
+    the same zarr/n5 layouts against an object store (ctt-cloud,
+    ``utils/store_backend.py``); hdf5 stays a local-file format.
     """
+    if is_remote_path(path):
+        ext = os.path.splitext(
+            urllib.parse.urlsplit(path).path.rstrip("/")
+        )[1].lower()
+        if ext in (".h5", ".hdf5", ".hdf"):
+            raise ValueError(
+                "hdf5 containers cannot be served over the object-store "
+                "backend (single-file format); use .zarr/.n5"
+            )
+        return File(path, mode)
     ext = os.path.splitext(path)[1].lower()
     if ext in (".h5", ".hdf5", ".hdf"):
         if h5py is None:

@@ -1,0 +1,600 @@
+"""ctt-cloud: the storage-backend seam under the chunked store.
+
+``utils/store.py`` historically spoke straight to the filesystem
+(``open``/``os.stat``/``os.replace``) from ``Dataset``/``Group`` and the
+format adapters.  Production EM volumes live in S3/GCS-style object
+stores — zarr's native habitat — so the byte-level operations now go
+through a :class:`StoreBackend`:
+
+  * :class:`PosixBackend` — the original behavior, byte for byte (atomic
+    tmp+rename writes, ``(inode, mtime_ns, size)`` freshness signatures);
+  * :class:`HttpBackend` — ``http://`` / ``https://`` object stores
+    speaking plain GET/PUT/HEAD/DELETE with ``Range`` reads.
+
+URL scheme
+----------
+
+A dataset path is simply a URL whose last path component carries the
+container extension, e.g.::
+
+    http://objstore:9000/bucket/volume.n5        (container root)
+    http://objstore:9000/bucket/volume.zarr/raw  (dataset inside it)
+
+``file_reader`` routes any ``http(s)://`` path here; everything after the
+origin is the object key namespace.  The wire protocol is the small
+object-store subset the local stub server (``tests/objstub.py``) and any
+S3/GCS HTTP gateway can serve:
+
+  ``GET <key>``      → 200 + object bytes; honors ``Range: bytes=a-b``
+                       (206 + ``Content-Range``); 404 when absent.
+                       A *directory* key returns a JSON array of child
+                       names with the ``X-CTT-Dir: 1`` header (the
+                       listing analog of ``os.listdir`` — object stores
+                       express this as a delimiter list query; the stub
+                       keeps it a plain GET).
+  ``PUT <key>``      → store bytes atomically, create parents; 200/201.
+  ``HEAD <key>``     → existence + freshness headers (``ETag``,
+                       ``Last-Modified``, ``Content-Length``,
+                       ``X-CTT-Dir`` for directories).
+  ``DELETE <key>``   → remove the object (or prefix/directory tree); 204.
+
+Freshness: the decoded-chunk LRU keys remote entries by the
+``(ETag, Last-Modified, Content-Length)`` HEAD signature — the object
+store's analog of the POSIX ``(inode, mtime_ns, size)`` triple — so a
+rewrite by any process anywhere is a cache miss, never stale data, and a
+warm LRU entry costs one HEAD instead of one ranged GET (the LRU is the
+latency shield that makes high-RTT stores usable).
+
+Resilience: every request checks the ``store.remote_read`` (GET/HEAD) or
+``store.remote_write`` (PUT/DELETE) fault site, and transient failures
+(connection errors, 5xx, truncated multipart ranges) surface as
+``OSError`` so the shared backoff helper (``utils/retry.py``) absorbs
+them — chunk IO retries at the Dataset layer under the
+``store.remote_retries`` counter, metadata helpers retry internally.  A
+*truncated* single-object body (the server promised more bytes than it
+sent) is returned short on purpose: the chunk decode classifies it as
+:class:`CorruptChunk`, exactly like a torn POSIX write, so the same
+retry/heal machinery applies.
+
+Knobs (env, read once per process):
+
+  ``CTT_REMOTE_THREADS``    chunk fan-out + multipart pool width (default 16)
+  ``CTT_REMOTE_TIMEOUT_S``  per-request socket timeout (default 30)
+  ``CTT_REMOTE_RANGE_MB``   objects larger than this split into parallel
+                            range GETs (default 8; 0 = never split)
+"""
+
+from __future__ import annotations
+
+import errno
+import http.client
+import json
+import os
+import shutil
+import threading
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import faults
+from ..obs import metrics as obs_metrics
+
+__all__ = [
+    "StoreBackend", "PosixBackend", "HttpBackend", "CorruptChunk",
+    "backend_for", "is_remote_path", "atomic_write_bytes",
+]
+
+
+class CorruptChunk(OSError):
+    """A chunk read back but failed to decode — truncated or garbled
+    payload, i.e. a torn write (or a truncated object-store response).
+    OSError subclass so the shared IO retry treats it as transient (a
+    concurrent rewrite may land between attempts); if it never heals it
+    fails the reading block cleanly and block retry repairs the store by
+    rerunning the writer."""
+
+
+# fsync before rename is the durability half of atomicity: without it a
+# power failure can surface the renamed file EMPTY (metadata reached the
+# journal, data didn't).  Chunk scratch on tmpfs doesn't care; status/meta
+# JSON does.  CTT_STORE_FSYNC=0 opts out for throwaway stores.
+_FSYNC = os.environ.get("CTT_STORE_FSYNC", "1").lower() not in (
+    "0", "false", "off", ""
+)
+
+
+def atomic_write_bytes(path: str, payload: bytes) -> None:
+    # tmp name must be unique per pid AND thread: concurrent block threads
+    # writing the same meta file (e.g. two workers group-initializing the
+    # shared scratch store) would otherwise replace each other's tmp away
+    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            if _FSYNC:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # failed writes must not litter .tmpPID.TID files in shared stores
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _env_pos_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        val = float(raw) if raw is not None else default
+    except (TypeError, ValueError):
+        val = default  # malformed degrades to default, the CTT_* convention
+    return max(val, 0.0)
+
+
+class StoreBackend:
+    """Byte-level operations of one storage namespace.
+
+    Paths are whatever the owning :func:`backend_for` resolution hands
+    out: filesystem paths for :class:`PosixBackend`, full URLs for
+    :class:`HttpBackend`.  Chunk payload calls (``read_bytes`` /
+    ``write_bytes`` / ``signature``) raise ``FileNotFoundError`` for
+    absent objects and ``OSError`` for transient trouble — the caller
+    (``Dataset``) wraps them in the shared backoff retry under this
+    backend's ``retry_counter``.  Metadata helpers (json/list/exists)
+    absorb their own transients."""
+
+    name = "posix"
+    is_remote = False
+    retry_counter = "store.io_retries"
+    default_threads = 1  # Dataset.n_threads starting point
+
+    # -- path algebra --------------------------------------------------------
+
+    def join(self, *parts: str) -> str:
+        return os.path.join(*parts)
+
+    def dirname(self, path: str) -> str:
+        return os.path.dirname(path)
+
+    # -- payload bytes -------------------------------------------------------
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, payload: bytes) -> None:
+        atomic_write_bytes(path, payload)
+
+    def signature(self, path: str):
+        """Freshness signature for the decoded-chunk LRU; raises
+        ``FileNotFoundError`` when the object is absent.  POSIX:
+        ``(inode, mtime_ns, size)`` — ``os.replace`` changes the inode, so
+        any rewrite (in- or cross-process) is a miss."""
+        st = os.stat(path)
+        return (st.st_ino, st.st_mtime_ns, st.st_size)
+
+    def remove(self, path: str) -> None:
+        os.unlink(path)
+
+    # -- namespace / metadata ------------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def listdir(self, path: str) -> List[str]:
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def rmtree(self, path: str) -> None:
+        shutil.rmtree(path)
+
+    def read_json(self, path: str) -> Any:
+        with open(path) as f:
+            return json.load(f)
+
+    def write_json(self, path: str, obj: Any) -> None:
+        self.write_bytes(path, json.dumps(obj, indent=2).encode())
+
+    # -- fan-out -------------------------------------------------------------
+
+    def map(self, fn, items, n_threads: int) -> list:
+        """Apply ``fn`` over ``items`` with up to ``n_threads`` workers —
+        the chunk fan-out seam.  POSIX spins an ephemeral pool (thread
+        startup is noise next to codec work); the HTTP backend overrides
+        with a persistent pool so worker threads keep their keep-alive
+        connections across calls."""
+        items = list(items)
+        n = min(max(int(n_threads), 1), len(items))
+        if n <= 1:
+            return [fn(it) for it in items]
+        with ThreadPoolExecutor(n) as pool:
+            return list(pool.map(fn, items))
+
+
+# -- remote inflight gauge (module-level: one series across backends) -------
+
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT = 0
+
+
+def _note_inflight(delta: int) -> None:
+    global _INFLIGHT
+    with _INFLIGHT_LOCK:
+        _INFLIGHT += delta
+        value = _INFLIGHT
+    obs_metrics.set_gauge("store.remote_inflight", value)
+
+
+class HttpBackend(StoreBackend):
+    """``http(s)://`` object-store namespace over plain range-read HTTP.
+
+    One instance per origin (scheme + host + port), with one keep-alive
+    connection per thread and a shared fetch pool for multipart range
+    reads — "parallel multipart-style" IO rides chunk-level fan-out
+    (``Dataset.n_threads`` defaults to ``CTT_REMOTE_THREADS`` on remote
+    datasets) plus intra-object range splitting for oversized objects."""
+
+    name = "http"
+    is_remote = True
+    retry_counter = "store.remote_retries"
+
+    def __init__(self, origin: str):
+        parsed = urllib.parse.urlsplit(origin)
+        if parsed.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported remote scheme in {origin!r}")
+        self.origin = f"{parsed.scheme}://{parsed.netloc}"
+        self._scheme = parsed.scheme
+        self._netloc = parsed.netloc
+        self._tls = threading.local()
+        self._pool_lock = threading.Lock()
+        # two PERSISTENT pools (threads keep their keep-alive connections
+        # across calls — ephemeral pools pay connect churn per region):
+        # "fan" runs chunk-level operations, "range" runs multipart part
+        # fetches.  Separate so a fan task issuing a multipart read can
+        # never deadlock waiting on its own pool.
+        self._pools: Dict[str, ThreadPoolExecutor] = {}
+        self.default_threads = max(
+            int(_env_pos_float("CTT_REMOTE_THREADS", 16)), 1
+        )
+        self.timeout_s = _env_pos_float("CTT_REMOTE_TIMEOUT_S", 30.0) or 30.0
+        self.range_bytes = int(
+            _env_pos_float("CTT_REMOTE_RANGE_MB", 8.0) * 1024 * 1024
+        )
+
+    # -- connection plumbing -------------------------------------------------
+
+    def join(self, *parts: str) -> str:
+        out = parts[0].rstrip("/")
+        for part in parts[1:]:
+            out = out + "/" + str(part).strip("/")
+        return out
+
+    def dirname(self, path: str) -> str:
+        return path.rsplit("/", 1)[0]
+
+    def _key(self, path: str) -> str:
+        """The request target for a full URL of this origin."""
+        if path.startswith(self.origin):
+            key = path[len(self.origin):]
+        else:
+            key = urllib.parse.urlsplit(path).path
+        if not key.startswith("/"):
+            key = "/" + key
+        return urllib.parse.quote(key)
+
+    def _connection(self):
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            cls = (
+                http.client.HTTPSConnection
+                if self._scheme == "https"
+                else http.client.HTTPConnection
+            )
+            conn = cls(self._netloc, timeout=self.timeout_s)
+            self._tls.conn = conn
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._tls, "conn", None)
+        self._tls.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # ctt: noqa[CTT009] socket teardown of a failed connection cannot be allowed to mask the request error
+                pass
+
+    def _pool(self, kind: str) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            pool = self._pools.get(kind)
+            if pool is None:
+                pool = ThreadPoolExecutor(
+                    self.default_threads,
+                    thread_name_prefix=f"ctt-remote-{kind}",
+                )
+                self._pools[kind] = pool
+            return pool
+
+    def map(self, fn, items, n_threads: int) -> list:
+        items = list(items)
+        if len(items) <= 1 or int(n_threads) <= 1:
+            return [fn(it) for it in items]
+        return list(self._pool("fan").map(fn, items))
+
+    def _request(
+        self, method: str, path: str,
+        body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, Any, bytes, bool]:
+        """One HTTP round trip: ``(status, headers, body, truncated)``.
+
+        Network-level trouble (refused/reset/timeout, garbled response)
+        raises ``OSError(EIO)`` — retryable.  A body shorter than the
+        promised ``Content-Length`` (server hiccup mid-stream) comes back
+        with ``truncated=True`` and the partial bytes so callers can
+        classify it (chunk decode → ``CorruptChunk``) instead of hiding
+        it behind a generic error."""
+        site = (
+            "store.remote_write" if method in ("PUT", "DELETE")
+            else "store.remote_read"
+        )
+        faults.check(site, path=path)
+        obs_metrics.inc(
+            "store.remote_writes" if site == "store.remote_write"
+            else "store.remote_reads"
+        )
+        _note_inflight(1)
+        try:
+            conn = self._connection()
+            try:
+                conn.request(
+                    method, self._key(path), body=body,
+                    headers=dict(headers or {}),
+                )
+                resp = conn.getresponse()
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                self._drop_connection()
+                raise OSError(
+                    errno.EIO,
+                    f"{method} {path} failed: {type(e).__name__}: {e}",
+                ) from e
+            truncated = False
+            try:
+                # http.client returns b"" for HEAD (length pinned to 0),
+                # so reading unconditionally keeps keep-alive hygiene
+                data = resp.read()
+            except http.client.IncompleteRead as e:
+                # the server promised Content-Length and closed early: a
+                # truncated object read — deliver the partial payload for
+                # torn-write-style classification by the decoder
+                data = e.partial
+                truncated = True
+                self._drop_connection()
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                self._drop_connection()
+                raise OSError(
+                    errno.EIO,
+                    f"{method} {path} body read failed: {e}",
+                ) from e
+            if body is not None:
+                obs_metrics.inc("store.remote_bytes_written", len(body))
+            if data:
+                obs_metrics.inc("store.remote_bytes_read", len(data))
+            return resp.status, resp.headers, data, truncated
+        finally:
+            _note_inflight(-1)
+
+
+    def _raise_for(self, status: int, method: str, path: str) -> None:
+        if status == 404:
+            raise FileNotFoundError(f"{path} (HTTP 404)")
+        # a 5xx may have left the server mid-request (e.g. an unread PUT
+        # body on a keep-alive socket): reconnect rather than risk the
+        # next request landing on poisoned connection state
+        self._drop_connection()
+        # everything unexpected is transient until the backoff gives up:
+        # object-store gateways surface overload as 429/500/503, and a
+        # hard 4xx failing loudly after 3 retries is still loud
+        raise OSError(errno.EIO, f"HTTP {status} on {method} {path}")
+
+    # -- payload bytes -------------------------------------------------------
+
+    def read_bytes(self, path: str) -> bytes:
+        split = self.range_bytes
+        if split <= 0:
+            status, _, data, _ = self._request("GET", path)
+            if status != 200:
+                self._raise_for(status, "GET", path)
+            return data
+        status, hdrs, data, truncated = self._request(
+            "GET", path, headers={"Range": f"bytes=0-{split - 1}"}
+        )
+        if status == 200:
+            return data  # server ignored the range; body is the object
+        if status != 206:
+            self._raise_for(status, "GET", path)
+        total = _content_range_total(hdrs.get("Content-Range"))
+        if truncated or total is None or total <= len(data):
+            # short first window: decode classifies (CorruptChunk) and the
+            # shared retry re-fetches — same contract as a torn POSIX chunk
+            return data
+        # parallel multipart-style range reads for the tail
+        offsets = list(range(len(data), total, split))
+
+        def _read_part(offset: int) -> bytes:
+            from .retry import io_retry
+
+            end = min(offset + split, total) - 1
+
+            def _fetch() -> bytes:
+                st, _, part, part_trunc = self._request(
+                    "GET", path, headers={"Range": f"bytes={offset}-{end}"}
+                )
+                if st not in (200, 206):
+                    self._raise_for(st, "GET", path)
+                if part_trunc or len(part) != end - offset + 1:
+                    raise OSError(
+                        errno.EIO,
+                        f"truncated range response for {path} "
+                        f"[{offset}, {end}]: got {len(part)} bytes",
+                    )
+                return part
+
+            return io_retry(
+                _fetch, what=f"range read {path}@{offset}",
+                counter=self.retry_counter,
+            )
+
+        parts = list(self._pool("range").map(_read_part, offsets))
+        return data + b"".join(parts)
+
+    def write_bytes(self, path: str, payload: bytes) -> None:
+        status, _, _, _ = self._request("PUT", path, body=payload)
+        if status not in (200, 201, 204):
+            self._raise_for(status, "PUT", path)
+
+    def signature(self, path: str):
+        """``(ETag, Last-Modified, Content-Length)`` from a HEAD — the
+        remote analog of the POSIX inode triple (any rewrite changes the
+        ETag/mtime, so stale LRU entries can only miss)."""
+        status, hdrs, _, _ = self._request("HEAD", path)
+        if status != 200:
+            self._raise_for(status, "HEAD", path)
+        return (
+            hdrs.get("ETag"),
+            hdrs.get("Last-Modified"),
+            hdrs.get("Content-Length"),
+        )
+
+    def remove(self, path: str) -> None:
+        from .retry import io_retry
+
+        def _delete() -> None:
+            status, _, _, _ = self._request("DELETE", path)
+            if status not in (200, 202, 204, 404):
+                self._raise_for(status, "DELETE", path)
+
+        io_retry(_delete, what=f"delete {path}", counter=self.retry_counter)
+
+    # -- namespace / metadata ------------------------------------------------
+    # metadata helpers absorb their own transients (the callers are not
+    # under the Dataset-level chunk retry)
+
+    def _head(self, path: str) -> Tuple[int, Any]:
+        from .retry import io_retry
+
+        def _probe():
+            status, hdrs, _, _ = self._request("HEAD", path)
+            if status >= 500 or status == 429:
+                self._raise_for(status, "HEAD", path)
+            return status, hdrs
+
+        return io_retry(
+            _probe, what=f"head {path}", counter=self.retry_counter
+        )
+
+    def exists(self, path: str) -> bool:
+        status, _ = self._head(path)
+        return status == 200
+
+    def isdir(self, path: str) -> bool:
+        status, hdrs = self._head(path)
+        return status == 200 and hdrs.get("X-CTT-Dir") == "1"
+
+    def listdir(self, path: str) -> List[str]:
+        from .retry import io_retry
+
+        def _list() -> List[str]:
+            status, hdrs, data, truncated = self._request("GET", path)
+            if status == 404:
+                return []
+            if status != 200 or truncated:
+                self._raise_for(status if status != 200 else 500,
+                                "GET", path)
+            if hdrs.get("X-CTT-Dir") != "1":
+                return []
+            names = json.loads(data.decode())
+            return sorted(str(n) for n in names)
+
+        return io_retry(
+            _list, what=f"list {path}", counter=self.retry_counter
+        )
+
+    def makedirs(self, path: str) -> None:
+        return None  # object namespaces have no directories to create
+
+    def rmtree(self, path: str) -> None:
+        self.remove(path)
+
+    def read_json(self, path: str) -> Any:
+        from .retry import io_retry
+
+        def _load() -> Any:
+            payload = self.read_bytes(path)
+            try:
+                return json.loads(payload.decode())
+            except ValueError as e:
+                # truncated/garbled metadata responses heal like torn
+                # chunks: retryable, loud if persistent
+                raise CorruptChunk(
+                    f"metadata {path} failed to parse "
+                    f"({len(payload)} bytes): {e}"
+                ) from e
+
+        return io_retry(
+            _load, what=f"read meta {path}", counter=self.retry_counter
+        )
+
+    def write_json(self, path: str, obj: Any) -> None:
+        from .retry import io_retry
+
+        payload = json.dumps(obj, indent=2).encode()
+        io_retry(
+            lambda: self.write_bytes(path, payload),
+            what=f"write meta {path}", counter=self.retry_counter,
+        )
+
+
+def _content_range_total(value: Optional[str]) -> Optional[int]:
+    """Total object size from a ``Content-Range: bytes a-b/total`` header."""
+    if not value or "/" not in value:
+        return None
+    total = value.rsplit("/", 1)[1].strip()
+    try:
+        return int(total)
+    except ValueError:
+        return None  # "*" (unknown) or garbage: treat as unsplittable
+
+
+PosixBackend = StoreBackend  # posix IS the base behavior
+_POSIX = StoreBackend()
+_REMOTE_LOCK = threading.Lock()
+_REMOTE: Dict[str, HttpBackend] = {}
+
+
+def is_remote_path(path: str) -> bool:
+    return isinstance(path, str) and path.startswith(("http://", "https://"))
+
+
+def backend_for(path: str) -> StoreBackend:
+    """The backend owning ``path``: the process-wide POSIX singleton, or
+    one cached :class:`HttpBackend` per remote origin (so every dataset
+    of one store shares connections, pool, and counters)."""
+    if not is_remote_path(path):
+        return _POSIX
+    parsed = urllib.parse.urlsplit(path)
+    origin = f"{parsed.scheme}://{parsed.netloc}"
+    with _REMOTE_LOCK:
+        backend = _REMOTE.get(origin)
+        if backend is None:
+            backend = HttpBackend(origin)
+            _REMOTE[origin] = backend
+        return backend

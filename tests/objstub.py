@@ -1,0 +1,319 @@
+"""Local stub object server for ctt-cloud tests, CI, and the bench.
+
+Serves a directory tree over the small object-store HTTP subset the
+``HttpBackend`` speaks (the wire schema is documented in
+``cluster_tools_tpu/utils/store_backend.py``):
+
+  ``GET /key``     → 200 + bytes; ``Range: bytes=a-b`` → 206 +
+                     ``Content-Range``; a directory returns a JSON array
+                     of child names with ``X-CTT-Dir: 1``; 404 if absent.
+  ``HEAD /key``    → headers only: ``ETag`` (mtime_ns-size, changes on
+                     every atomic replace), ``Last-Modified``,
+                     ``Content-Length``, ``X-CTT-Dir`` for directories.
+  ``PUT /key``     → atomic write (tmp + rename), parents created; 201.
+  ``DELETE /key``  → unlink file / remove tree; 204 (404 if absent).
+
+Chaos injection (hermetic flaky-network simulation, seeded so CI runs
+are reproducible):
+
+  * ``fail_rate`` — each request independently 503s with this
+    probability (the client's backoff retry must absorb it);
+  * ``slow_s`` — failed-coin requests stall this long before answering
+    (latency spikes instead of hard errors) when ``slow_rate`` hits;
+  * ``truncate_next(substr, times)`` — the next ``times`` GET responses
+    whose path contains ``substr`` advertise the full ``Content-Length``
+    but send only half the body and drop the connection — the truncated
+    object read that must classify as ``CorruptChunk`` downstream.
+
+Run in-process (``StubObjectStore(root, ...)`` context manager) or as a
+subprocess for shell harnesses::
+
+    python tests/objstub.py --root DIR --port-file F [--fail-rate 0.05]
+                            [--seed 7] [--slow-s 0.05] [--slow-rate 0.0]
+
+The subprocess writes ``<port>`` to ``--port-file`` once listening and
+serves until SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import email.utils
+import json
+import os
+import random
+import re
+import shutil
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)$")
+
+
+class _Policy:
+    """Seeded chaos decisions shared by all handler threads."""
+
+    def __init__(self, fail_rate=0.0, seed=0, slow_s=0.0, slow_rate=0.0):
+        self.fail_rate = float(fail_rate)
+        self.slow_s = float(slow_s)
+        self.slow_rate = float(slow_rate)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._truncate = []  # [substr, remaining] pairs
+        self.requests = 0
+        self.failures = 0
+        self.truncations = 0
+
+    def decide(self, method: str, path: str):
+        """(fail_503, slow, truncate) for one request."""
+        with self._lock:
+            self.requests += 1
+            fail = (
+                self.fail_rate > 0.0
+                and self._rng.random() < self.fail_rate
+            )
+            slow = (
+                self.slow_rate > 0.0
+                and self._rng.random() < self.slow_rate
+            )
+            truncate = False
+            if method == "GET" and not fail:
+                for pair in self._truncate:
+                    if pair[1] > 0 and pair[0] in path:
+                        pair[1] -= 1
+                        truncate = True
+                        self.truncations += 1
+                        break
+            if fail:
+                self.failures += 1
+            return fail, slow, truncate
+
+    def truncate_next(self, substr: str, times: int = 1) -> None:
+        with self._lock:
+            self._truncate.append([substr, int(times)])
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "ctt-objstub/1"
+
+    # -- helpers -------------------------------------------------------------
+
+    def _fs_path(self):
+        """The served filesystem path for the request target, confined to
+        the root (traversal-safe)."""
+        raw = self.path.split("?", 1)[0].split("#", 1)[0]
+        from urllib.parse import unquote
+
+        rel = os.path.normpath(unquote(raw).lstrip("/"))
+        if rel.startswith(".."):
+            return None
+        return os.path.join(self.server.root, rel)
+
+    def _send(self, status, body=b"", headers=(), include_body=True):
+        self.send_response(status)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if include_body and body:
+            self.wfile.write(body)
+
+    def _object_headers(self, p):
+        st = os.stat(p)
+        return [
+            ("ETag", f'"{st.st_mtime_ns:x}-{st.st_size:x}"'),
+            ("Last-Modified", email.utils.formatdate(
+                st.st_mtime, usegmt=True
+            )),
+        ]
+
+    def _chaos(self, drain: bool = False):
+        fail, slow, truncate = self.server.policy.decide(
+            self.command, self.path
+        )
+        if slow:
+            time.sleep(self.server.policy.slow_s)
+        if fail:
+            if drain:
+                # consume the request body before failing it: an unread
+                # PUT payload on a keep-alive socket would otherwise be
+                # parsed as the NEXT request line
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            return True, truncate
+        return False, truncate
+
+    # -- verbs ---------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (http.server naming)
+        failed, truncate = self._chaos()
+        if failed:
+            return
+        p = self._fs_path()
+        if p is None or not os.path.exists(p):
+            self._send(404, b"not found")
+            return
+        if os.path.isdir(p):
+            body = json.dumps(sorted(os.listdir(p))).encode()
+            self._send(200, body, headers=[
+                ("Content-Type", "application/json"), ("X-CTT-Dir", "1"),
+            ])
+            return
+        with open(p, "rb") as f:
+            data = f.read()
+        headers = self._object_headers(p)
+        status = 200
+        rng = self.headers.get("Range")
+        if rng:
+            m = _RANGE_RE.match(rng.strip())
+            if m:
+                lo = int(m.group(1))
+                hi = int(m.group(2)) if m.group(2) else len(data) - 1
+                hi = min(hi, len(data) - 1)
+                if lo <= hi:
+                    headers.append((
+                        "Content-Range", f"bytes {lo}-{hi}/{len(data)}"
+                    ))
+                    data = data[lo: hi + 1]
+                    status = 206
+        if truncate and len(data) > 1:
+            # advertise the full length, deliver half, drop the socket:
+            # the truncated-object read the client must classify
+            self.send_response(status)
+            for k, v in headers:
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(data)))
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(data[: len(data) // 2])
+            self.close_connection = True
+            return
+        self._send(status, data, headers=headers)
+
+    def do_HEAD(self):  # noqa: N802
+        failed, _ = self._chaos()
+        if failed:
+            return
+        p = self._fs_path()
+        if p is None or not os.path.exists(p):
+            self._send(404)
+            return
+        if os.path.isdir(p):
+            self._send(200, headers=[("X-CTT-Dir", "1")])
+            return
+        st = os.stat(p)
+        self.send_response(200)
+        for k, v in self._object_headers(p):
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(st.st_size))
+        self.end_headers()
+
+    def do_PUT(self):  # noqa: N802
+        failed, _ = self._chaos(drain=True)
+        if failed:
+            return
+        p = self._fs_path()
+        if p is None:
+            self._send(404, b"not found")
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".put{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(body)
+        os.replace(tmp, p)
+        self._send(201)
+
+    def do_DELETE(self):  # noqa: N802
+        failed, _ = self._chaos()
+        if failed:
+            return
+        p = self._fs_path()
+        if p is None or not os.path.exists(p):
+            self._send(404)
+            return
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+        else:
+            os.unlink(p)
+        self._send(204)
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if os.environ.get("CTT_OBJSTUB_LOG"):
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+
+class StubObjectStore:
+    """In-process stub server: ``with StubObjectStore(root) as url: ...``
+    where ``url`` is the origin (``http://127.0.0.1:<port>``)."""
+
+    def __init__(self, root, fail_rate=0.0, seed=0, slow_s=0.0,
+                 slow_rate=0.0):
+        os.makedirs(root, exist_ok=True)
+        self.root = os.path.abspath(root)
+        self.policy = _Policy(fail_rate, seed, slow_s, slow_rate)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self.httpd.daemon_threads = True
+        self.httpd.root = self.root
+        self.httpd.policy = self.policy
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="ctt-objstub", daemon=True
+        )
+
+    def start(self) -> "StubObjectStore":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def truncate_next(self, substr: str, times: int = 1) -> None:
+        self.policy.truncate_next(substr, times)
+
+    def __enter__(self) -> "StubObjectStore":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--port-file", required=True)
+    ap.add_argument("--fail-rate", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slow-s", type=float, default=0.0)
+    ap.add_argument("--slow-rate", type=float, default=0.0)
+    args = ap.parse_args()
+    store = StubObjectStore(
+        args.root, fail_rate=args.fail_rate, seed=args.seed,
+        slow_s=args.slow_s, slow_rate=args.slow_rate,
+    ).start()
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(store.port))
+    os.replace(tmp, args.port_file)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: done.set())
+    signal.signal(signal.SIGINT, lambda *a: done.set())
+    done.wait()
+    store.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

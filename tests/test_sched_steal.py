@@ -178,14 +178,19 @@ class TestExpiryAndRequeue:
     def test_expired_lease_requeues_at_next_generation(
         self, tmp_path, traced_metrics
     ):
+        # injected reader clock (WorkQueue._now) instead of real sleeps:
+        # with a sub-second cadence a loaded CI host could age the fresh
+        # lease past 3x BEFORE the freshness assertion ran — the timing
+        # flake this test used to carry.  A wide cadence makes "fresh"
+        # unbreakable and the advanced clock makes "expired" exact.
         obs_metrics = traced_metrics
-        lease_s = 0.1
+        lease_s = 30.0
         q = WorkQueue.create(str(tmp_path / "q"), "t", [0, 1], 2, lease_s)
         dead = q.claim(job_id=0)  # owner "dies": never renews, never completes
         assert dead is not None
         before = obs_metrics.snapshot()["counters"]
         assert q.claim(job_id=1) is None  # lease still fresh
-        time.sleep(STALE_INTERVALS * lease_s + 0.1)
+        q._now = lambda: time.time() + STALE_INTERVALS * lease_s + 1.0
         takeover = q.claim(job_id=1)
         assert takeover is not None
         assert takeover.item == dead.item and takeover.gen == 1
@@ -203,7 +208,7 @@ class TestExpiryAndRequeue:
     def test_torn_lease_still_expires_via_mtime(self, tmp_path):
         from cluster_tools_tpu import faults
 
-        lease_s = 0.1
+        lease_s = 30.0
         q = WorkQueue.create(str(tmp_path / "q"), "t", [0], 1, lease_s)
         faults.configure("sched.write:torn:bytes=5;seed=1")
         try:
@@ -215,7 +220,10 @@ class TestExpiryAndRequeue:
         assert len(raw) == 5
         with pytest.raises(json.JSONDecodeError):
             json.loads(raw)
-        time.sleep(STALE_INTERVALS * lease_s + 0.1)
+        # torn leases age from file mtime; the injected reader clock
+        # (WorkQueue._now) expires it without sleeping 3x the cadence
+        assert q.claim(job_id=1) is None  # still fresh by mtime
+        q._now = lambda: time.time() + STALE_INTERVALS * lease_s + 1.0
         takeover = q.claim(job_id=1)
         assert takeover is not None and takeover.gen == 1
 
@@ -436,7 +444,8 @@ class TestRealProcesses:
 
 
 def _threshold_run(tmp_path, rng_data, tag, *, sched=None, faults_spec=None,
-                   state_dir=None, trace_run=None, max_jobs=3):
+                   state_dir=None, trace_run=None, max_jobs=3,
+                   extra_global=None):
     """One ThresholdTask run through the stub scheduler; returns the n5
     output dataset dir (for byte digests) and the task status path."""
     from cluster_tools_tpu.tasks.threshold import ThresholdTask
@@ -454,7 +463,11 @@ def _threshold_run(tmp_path, rng_data, tag, *, sched=None, faults_spec=None,
         "max_num_retries": 2,
         "retry_failure_fraction": 0.9,
         "poll_interval_s": 0.05,
-        "steal_lease_s": 0.2,
+        # a full-second cadence (expiry at 3 s): the renewer stamps every
+        # 0.5 s, so ~6 consecutive starved renewals would be needed for a
+        # LIVE lease to expire spuriously — the worker-kill test was flaky
+        # under full-suite load at 0.2 s (PR 9 review)
+        "steal_lease_s": 1.0,
         "steal_batch_size": 2,
         "sbatch_cmd": submit,
         "squeue_cmd": queue,
@@ -462,6 +475,8 @@ def _threshold_run(tmp_path, rng_data, tag, *, sched=None, faults_spec=None,
     }
     if sched is not None:
         gconf["sched"] = sched
+    if extra_global:
+        gconf.update(extra_global)
     cfg.write_global_config(config_dir, gconf)
     cfg.write_config(config_dir, "threshold", {"threshold": 0.5})
     env_keys = {}
@@ -538,13 +553,21 @@ class TestStubSchedulerIntegration:
         """A worker hard-killed mid-item (executor.block kill) loses its
         lease; a surviving worker requeues it after expiry.  The run
         completes in ONE dispatch round (zero task-level retries) and the
-        output is byte-identical to a fault-free run."""
+        output is byte-identical to a fault-free run.
+
+        Duplication is disabled for the chaos run: straggler duplication
+        and lease expiry RACE to recover a killed item (both are correct,
+        first writer wins), so with it enabled the ``leases_expired >= 1``
+        assertion was a coin flip under load — the PR 9 tier-1 flake.
+        With ``steal_duplicate: false`` the expiry path is the only
+        recovery route and the assertion is deterministic."""
         out_ref, _, _ = _threshold_run(tmp_path, vol, "ref", sched="steal")
         out_chaos, status, tmp_chaos = _threshold_run(
             tmp_path, vol, "chaos", sched="steal",
             faults_spec="executor.block:kill:ids=5,once;seed=11",
             state_dir=str(tmp_path / "fault_state"),
             trace_run="steal_chaos",
+            extra_global={"steal_duplicate": False},
         )
         assert _digest_tree(out_ref) == _digest_tree(out_chaos)
         # the kill really fired (cross-process latch)

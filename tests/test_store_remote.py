@@ -1,0 +1,484 @@
+"""ctt-cloud: object-store backend + async prefetch read stage.
+
+Covers the StoreBackend seam end to end against the local stub object
+server (tests/objstub.py): container/dataset roundtrips over HTTP with
+byte parity to POSIX, the remote-signature decoded-chunk LRU (warm/cold
+accounting, ETag-change invalidation), CorruptChunk classification of
+truncated responses, request-level retry under injected 5xx chaos, the
+executor's async-prefetch lookahead stage, and the watershed e2e
+byte-identity acceptance gate.
+"""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+from objstub import StubObjectStore
+
+from cluster_tools_tpu.utils import store
+from cluster_tools_tpu.utils.store import CorruptChunk, file_reader
+
+
+@pytest.fixture
+def stub(tmp_path):
+    with StubObjectStore(str(tmp_path / "objroot")) as srv:
+        yield srv
+
+
+@pytest.fixture
+def traced_metrics(tmp_path):
+    """Counters are live only while tracing is enabled (the one ctt-obs
+    switch); flip it on for tests asserting store.remote_* movement."""
+    from cluster_tools_tpu.obs import metrics as obs_metrics
+    from cluster_tools_tpu.obs import trace as obs_trace
+
+    was_on = obs_trace.enabled()
+    if not was_on:
+        obs_trace.enable(str(tmp_path / "trace"), "cloud_unit",
+                         export_env=False)
+    try:
+        yield obs_metrics
+    finally:
+        if not was_on:
+            obs_trace.disable()
+
+
+def _digest_tree(root):
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def _fresh_cache():
+    """Clear the process-global decoded-chunk LRU between scenarios."""
+    store.set_chunk_cache_budget(None)
+
+
+# --------------------------------------------------------------------------
+# backend roundtrips
+
+
+class TestRemoteRoundtrip:
+    @pytest.mark.parametrize("ext,compression", [
+        ("zarr", "default"), ("n5", "gzip"), ("zarr", None),
+    ])
+    def test_byte_parity_with_posix(self, tmp_path, stub, rng, ext,
+                                    compression):
+        """The same create/write through the HTTP backend produces the
+        SAME chunk files (digests included) as the POSIX backend — the
+        stub serves a real directory, so the comparison is exact."""
+        _fresh_cache()
+        data = rng.random((20, 33, 17)).astype("float32")
+        local = str(tmp_path / f"local.{ext}")
+        file_reader(local).create_dataset(
+            "x", data=data, chunks=(8, 16, 8), compression=compression
+        )
+        remote_url = f"{stub.url}/remote.{ext}"
+        file_reader(remote_url).create_dataset(
+            "x", data=data, chunks=(8, 16, 8), compression=compression
+        )
+        assert _digest_tree(os.path.join(local, "x")) == _digest_tree(
+            os.path.join(stub.root, f"remote.{ext}", "x")
+        )
+        back = file_reader(remote_url, "r")["x"][:]
+        assert np.array_equal(back, data)
+        # region RMW write through the remote path
+        f = file_reader(remote_url)
+        f["x"][2:10, 5:20, 3:9] = 7.0
+        assert np.all(
+            file_reader(remote_url, "r")["x"][2:10, 5:20, 3:9] == 7.0
+        )
+
+    def test_group_navigation_attrs_and_keys(self, tmp_path, stub, rng):
+        _fresh_cache()
+        url = f"{stub.url}/vol.zarr"
+        f = file_reader(url)
+        grp = f.require_group("seg")
+        ds = grp.create_dataset(
+            "labels", data=rng.integers(0, 9, (8, 8, 8), dtype="uint32"),
+            chunks=(4, 4, 4),
+        )
+        ds.attrs["maxId"] = 8
+        f2 = file_reader(url, "r")
+        assert "seg" in f2
+        assert f2["seg"].keys() == ["labels"]
+        assert f2["seg"]["labels"].attrs["maxId"] == 8
+        with pytest.raises(KeyError):
+            f2["missing"]
+        with pytest.raises(FileNotFoundError):
+            file_reader(f"{stub.url}/absent.zarr", "r")
+
+    def test_varlen_chunks_remote(self, tmp_path, stub):
+        _fresh_cache()
+        url = f"{stub.url}/scratch.n5"
+        ds = file_reader(url).create_dataset(
+            "edges", shape=(64,), dtype="uint64", chunks=(16,),
+            compression="gzip",
+        )
+        payload = np.arange(37, dtype="uint64")
+        ds.write_chunk_varlen((1,), payload)
+        back = file_reader(url, "r")["edges"].read_chunk_varlen((1,))
+        assert np.array_equal(back, payload)
+
+    def test_remote_h5_is_rejected(self, stub):
+        with pytest.raises(ValueError, match="hdf5"):
+            file_reader(f"{stub.url}/vol.h5")
+
+    def test_ragged_stays_posix_only(self, stub):
+        from cluster_tools_tpu.utils.store import RaggedDataset
+
+        with pytest.raises(NotImplementedError, match="POSIX-only"):
+            RaggedDataset.create(f"{stub.url}/ragged", (4,), "uint64")
+
+
+# --------------------------------------------------------------------------
+# remote decoded-chunk LRU
+
+
+class TestRemoteChunkLRU:
+    def test_warm_vs_cold_hit_accounting(self, tmp_path, stub, rng,
+                                         traced_metrics):
+        """Cold read: one GET + one miss per chunk.  Warm read: every
+        chunk an LRU hit — NO further GETs cross the wire, only the HEAD
+        freshness probes (the latency shield)."""
+        _fresh_cache()
+        data = rng.random((16, 16, 16)).astype("float32")
+        url = f"{stub.url}/lru.zarr"
+        file_reader(url).create_dataset("x", data=data, chunks=(8, 8, 8))
+        ds = file_reader(url, "r")["x"]
+
+        def snap():
+            return dict(traced_metrics.snapshot()["counters"])
+
+        b0 = snap()
+        assert np.array_equal(ds[:], data)
+        b1 = snap()
+        cold_misses = b1.get("store.chunk_cache_misses", 0) - b0.get(
+            "store.chunk_cache_misses", 0
+        )
+        cold_chunks = b1.get("store.chunks_read", 0) - b0.get(
+            "store.chunks_read", 0
+        )
+        assert cold_misses == 8 and cold_chunks == 8
+        assert np.array_equal(ds[:], data)
+        b2 = snap()
+        assert b2.get("store.chunk_cache_hits", 0) - b1.get(
+            "store.chunk_cache_hits", 0
+        ) == 8
+        # warm: zero chunk payloads crossed the codec boundary
+        assert b2.get("store.chunks_read", 0) == b1.get(
+            "store.chunks_read", 0
+        )
+
+    def test_etag_change_invalidates(self, tmp_path, stub, rng):
+        """An out-of-band rewrite (another process, another host) changes
+        the HEAD signature, so the next read re-fetches — freshness
+        degrades to a re-decode, never to stale data."""
+        _fresh_cache()
+        data = rng.random((8, 8, 8)).astype("float32")
+        url = f"{stub.url}/inv.zarr"
+        file_reader(url).create_dataset("x", data=data, chunks=(8, 8, 8))
+        ds = file_reader(url, "r")["x"]
+        assert np.array_equal(ds[:], data)  # cached
+        # rewrite the object BEHIND the backend: straight into the stub's
+        # served tree, the way a foreign writer would
+        other = str(tmp_path / "other.zarr")
+        new = (data * 2.0 + 1.0).astype("float32")
+        file_reader(other).create_dataset("x", data=new, chunks=(8, 8, 8))
+        src = os.path.join(other, "x", "0.0.0")
+        dst = os.path.join(stub.root, "inv.zarr", "x", "0.0.0")
+        os.replace(src, dst)
+        assert np.array_equal(ds[:], new)
+
+    def test_prefetch_warms_lru_and_counts(self, tmp_path, stub, rng,
+                                           traced_metrics):
+        _fresh_cache()
+        data = rng.random((16, 32, 16)).astype("float32")
+        url = f"{stub.url}/pf.zarr"
+        file_reader(url).create_dataset("x", data=data, chunks=(8, 16, 8))
+        ds = file_reader(url, "r")["x"]
+        n = ds.prefetch(np.s_[0:16, 0:32, 0:16])
+        assert n == 8
+        before = traced_metrics.snapshot()["counters"]
+        assert np.array_equal(ds[:], data)
+        after = traced_metrics.snapshot()["counters"]
+        assert after.get("store.chunk_cache_hits", 0) - before.get(
+            "store.chunk_cache_hits", 0
+        ) == 8
+        # disabled LRU: prefetch is a no-op by contract
+        prev = store.set_chunk_cache_budget(0)
+        try:
+            assert ds.prefetch(np.s_[0:16, 0:32, 0:16]) == 0
+        finally:
+            store.set_chunk_cache_budget(None)
+            del prev
+
+
+# --------------------------------------------------------------------------
+# resilience: truncation + injected request failures
+
+
+class TestRemoteResilience:
+    def test_truncated_response_classifies_corrupt_and_heals(
+        self, tmp_path, stub, rng, traced_metrics
+    ):
+        """A truncated object body (full Content-Length, half the bytes)
+        must classify exactly like a torn POSIX chunk: CorruptChunk →
+        transient → the retry re-fetches and the read heals
+        byte-identically."""
+        _fresh_cache()
+        data = rng.random((8, 8, 8)).astype("float32")
+        url = f"{stub.url}/trunc.zarr"
+        file_reader(url).create_dataset("x", data=data, chunks=(8, 8, 8))
+        ds = file_reader(url, "r")["x"]
+        before = traced_metrics.snapshot()["counters"]
+        stub.truncate_next("x/0.0.0", times=1)
+        healed = ds.read_chunk((0, 0, 0))
+        assert np.array_equal(healed, data)
+        after = traced_metrics.snapshot()["counters"]
+        assert after.get("store.remote_retries", 0) > before.get(
+            "store.remote_retries", 0
+        )
+        assert stub.policy.truncations == 1
+
+    def test_persistent_truncation_raises_corrupt_chunk(
+        self, tmp_path, stub, rng, monkeypatch
+    ):
+        _fresh_cache()
+        monkeypatch.setenv("CTT_IO_RETRIES", "1")
+        data = rng.random((8, 8, 8)).astype("float32")
+        url = f"{stub.url}/trunc2.zarr"
+        file_reader(url).create_dataset("x", data=data, chunks=(8, 8, 8))
+        ds = file_reader(url, "r")["x"]
+        stub.truncate_next("x/0.0.0", times=10)
+        with pytest.raises(CorruptChunk):
+            ds.read_chunk((0, 0, 0))
+
+    def test_5xx_chaos_roundtrip_is_byte_identical(self, tmp_path, rng,
+                                                   traced_metrics):
+        """A flaky gateway (8% of ALL requests 503) is absorbed by the
+        request-level backoff: writes and reads both land byte-identical
+        to the fault-free POSIX reference, with store.remote_retries > 0
+        recording the recoveries."""
+        _fresh_cache()
+        data = rng.random((16, 16, 16)).astype("float32")
+        local = str(tmp_path / "ref.n5")
+        file_reader(local).create_dataset(
+            "x", data=data, chunks=(4, 8, 8), compression="gzip"
+        )
+        with StubObjectStore(
+            str(tmp_path / "chaosroot"), fail_rate=0.08, seed=11,
+            slow_s=0.02, slow_rate=0.1,  # latency spikes ride along
+        ) as srv:
+            url = f"{srv.url}/chaos.n5"
+            file_reader(url).create_dataset(
+                "x", data=data, chunks=(4, 8, 8), compression="gzip"
+            )
+            assert np.array_equal(file_reader(url, "r")["x"][:], data)
+            assert srv.policy.failures > 0, (
+                "chaos never fired — the test certifies nothing"
+            )
+            assert _digest_tree(os.path.join(local, "x")) == _digest_tree(
+                os.path.join(srv.root, "chaos.n5", "x")
+            )
+        counters = traced_metrics.snapshot()["counters"]
+        assert counters.get("store.remote_retries", 0) > 0
+
+    def test_remote_fault_sites_fire(self, tmp_path, stub, rng):
+        from cluster_tools_tpu import faults
+
+        _fresh_cache()
+        data = rng.random((8, 8, 8)).astype("float32")
+        url = f"{stub.url}/faults.zarr"
+        file_reader(url).create_dataset("x", data=data, chunks=(8, 8, 8))
+        ds = file_reader(url, "r")["x"]
+        faults.configure(
+            "store.remote_read:io_error:times=1;seed=3"
+        )
+        try:
+            # the injected request error is transient: the read retries
+            # through it and still returns the data
+            assert np.array_equal(ds[:], data)
+            assert faults.decision_log()
+        finally:
+            faults.reset()
+
+
+# --------------------------------------------------------------------------
+# registry + watch line
+
+
+class TestRemoteObservability:
+    def test_remote_metrics_registered(self):
+        from cluster_tools_tpu.obs import registry
+
+        for name in (
+            "store.remote_reads", "store.remote_writes",
+            "store.remote_retries", "store.remote_bytes_read",
+            "store.remote_bytes_written", "executor.prefetch_batches",
+            "executor.stage_prefetch_s",
+        ):
+            assert registry.is_known_counter(name), name
+        assert registry.is_known_gauge("store.remote_inflight")
+
+    def test_watch_renders_remote_line(self, tmp_path):
+        from cluster_tools_tpu.obs.live import LiveRun, format_watch
+
+        run = str(tmp_path / "run")
+        os.makedirs(run)
+        with open(os.path.join(run, "metrics.p1.json"), "w") as f:
+            json.dump({
+                "counters": {
+                    "store.remote_reads": 120, "store.remote_writes": 30,
+                    "store.remote_retries": 2,
+                    "store.remote_bytes_read": 5.0e6,
+                    "store.remote_bytes_written": 2.5e6,
+                },
+                "gauges": {"store.remote_inflight": 4},
+            }, f)
+        text = format_watch(LiveRun(run).poll())
+        assert "remote: reads 120, writes 30, retries 2" in text
+        assert "read 5.0 MB" in text and "written 2.5 MB" in text
+        assert "inflight 4" in text
+
+
+# --------------------------------------------------------------------------
+# executor integration: async prefetch + e2e acceptance
+
+
+class TestRemotePipeline:
+    @staticmethod
+    def _subprocess_stub(td, root, fail_rate, seed):
+        """The stub as a SUBPROCESS: in-process server threads would share
+        the GIL with jax host compute and bleed server time into the
+        executor's stage walls — the e2e stage accounting must measure
+        the client side only (and a separate process is what production
+        looks like anyway)."""
+        import subprocess
+        import sys as _sys
+        import time as _time
+
+        port_file = os.path.join(td, "stub.port")
+        proc = subprocess.Popen([
+            _sys.executable,
+            os.path.join(os.path.dirname(__file__), "objstub.py"),
+            "--root", root, "--port-file", port_file,
+            "--fail-rate", str(fail_rate), "--seed", str(seed),
+        ])
+        deadline = _time.monotonic() + 30
+        while not os.path.exists(port_file):
+            assert proc.poll() is None, "stub server died on startup"
+            assert _time.monotonic() < deadline, "stub server never came up"
+            _time.sleep(0.02)
+        with open(port_file) as f:
+            port = int(f.read())
+        return proc, f"http://127.0.0.1:{port}"
+
+    def _ws_run(self, td, tag, data_path, out_key="ws", depth=3):
+        from cluster_tools_tpu.runtime import build, config as cfg
+        from cluster_tools_tpu.workflows import WatershedWorkflow
+
+        config_dir = os.path.join(td, f"configs_{tag}")
+        cfg.write_global_config(config_dir, {
+            "block_shape": [8, 32, 32], "target": "tpu",
+            "pipeline_depth": depth,
+        })
+        cfg.write_config(config_dir, "watershed", {
+            "threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+            "halo": [2, 4, 4],
+        })
+        wf = WatershedWorkflow(
+            os.path.join(td, f"tmp_{tag}"), config_dir,
+            input_path=data_path, input_key="bnd",
+            output_path=data_path, output_key=out_key,
+        )
+        assert build([wf]), tag
+
+    def test_ws_e2e_remote_chaos_byte_identical_and_prefetched(
+        self, tmp_path, rng, traced_metrics, monkeypatch
+    ):
+        """The acceptance gate: watershed against the stub object store
+        with 5% injected request failures is byte-identical (chunk
+        digests included) to the POSIX run, the async-prefetch stage ran,
+        and the read stage is not the critical path at depth 3."""
+        from scipy import ndimage
+
+        _fresh_cache()
+        # 32 blocks of (8, 32, 32): with the 8-virtual-device batch of 8
+        # that is 4 dispatch chunks — enough for the depth-3 read window
+        # AND a lookahead prefetch beyond it (the stage is a no-op when
+        # every chunk fits inside the read window)
+        base = ndimage.gaussian_filter(
+            rng.random((16, 256, 64)), (1.0, 2.0, 2.0)
+        )
+        vol = (
+            (base - base.min()) / (base.max() - base.min())
+        ).astype("float32")
+        td = str(tmp_path)
+        local = os.path.join(td, "local.n5")
+        file_reader(local).create_dataset(
+            "bnd", data=vol, chunks=(8, 32, 32), compression="gzip"
+        )
+        self._ws_run(td, "local", local)
+        # retry sleeps are real wall in the read stage; the chaos run must
+        # absorb 5% failures without its backoff dominating the accounting
+        monkeypatch.setenv("CTT_IO_BACKOFF_BASE_S", "0.001")
+        root = os.path.join(td, "objroot")
+        os.makedirs(root)
+        served = os.path.join(root, "data.n5")
+        file_reader(served).create_dataset(
+            "bnd", data=vol, chunks=(8, 32, 32), compression="gzip"
+        )
+        proc, url = self._subprocess_stub(td, root, fail_rate=0.05, seed=7)
+        try:
+            before = dict(traced_metrics.snapshot()["counters"])
+            self._ws_run(td, "remote", f"{url}/data.n5")
+            mid = dict(traced_metrics.snapshot()["counters"])
+            # warm-LRU rerun (same input volume, fresh scratch): reads are
+            # HEAD freshness probes + LRU hits — the latency-shield run
+            self._ws_run(td, "remote_warm", f"{url}/data.n5",
+                         out_key="ws2")
+            after = dict(traced_metrics.snapshot()["counters"])
+            # byte-identity including chunk digests, both runs
+            assert _digest_tree(os.path.join(local, "ws")) == _digest_tree(
+                os.path.join(served, "ws")
+            )
+            a = file_reader(local, "r")["ws"][:]
+            b = file_reader(served, "r")["ws"][:]
+            b2 = file_reader(served, "r")["ws2"][:]
+            assert np.array_equal(a, b)
+            assert np.array_equal(a, b2)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+        def cold(name):
+            return mid.get(name, 0.0) - before.get(name, 0.0)
+
+        def warm(name):
+            return after.get(name, 0.0) - mid.get(name, 0.0)
+
+        assert cold("store.remote_reads") > 0
+        assert cold("store.remote_writes") > 0
+        assert cold("store.remote_retries") > 0
+        # the lookahead stage really issued prefetches...
+        assert cold("executor.prefetch_batches") > 0
+        # ...and on the warm-LRU run host reads are hidden behind device
+        # compute (the acceptance gate at pipeline_depth >= 3).  Stage
+        # seconds are OCCUPANCY — read-stage walls overlap compute by
+        # design and absorb its GIL time — so the critical-path claim is
+        # asserted through the metric built for it: more IO seconds were
+        # hidden behind the serialized compute stage than the entire read
+        # stage occupied, hence the read stage cannot be the critical path.
+        assert warm("store.chunk_cache_hits") > 0
+        assert warm("executor.stage_hidden_io_s") > warm(
+            "executor.stage_read_s"
+        )

@@ -800,6 +800,159 @@ if [ "$serve_prom_rc" -ne 0 ]; then
     exit "$serve_prom_rc"
 fi
 
+echo "== ctt-cloud smoke (serve daemon against the stub object store, 5% request chaos) =="
+# the deployability gate: the ctt-serve daemon executes a watershed whose
+# input AND output live in an object store (the tests/objstub.py stub,
+# injecting 5% request failures), and the result is byte-identical —
+# chunk digests included — to an in-process POSIX run, with the daemon's
+# /metrics showing nonzero remote IO and absorbed retries.
+cloud_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$cloud_tmp" <<'PY'
+import hashlib, json, os, signal, subprocess, sys, time
+
+td = sys.argv[1]
+repo_root = os.environ.get("PYTHONPATH", "").split(os.pathsep)[0] or "."
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+       "CTT_HEARTBEAT_S": "0.2"}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+from scipy import ndimage
+
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.serve import ServeClient
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import WatershedWorkflow
+
+rng = np.random.default_rng(0)
+base = ndimage.gaussian_filter(rng.random((16, 64, 64)), (1.0, 2.0, 2.0))
+vol = ((base - base.min()) / (base.max() - base.min())).astype("float32")
+ws_conf = {"threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+           "halo": [2, 4, 4]}
+gconf = {"block_shape": [8, 32, 32], "target": "tpu", "pipeline_depth": 3}
+
+
+def digest(root):
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+# POSIX reference, in-process
+local = os.path.join(td, "local.n5")
+file_reader(local).create_dataset(
+    "bnd", data=vol, chunks=(8, 32, 32), compression="gzip"
+)
+config_dir = os.path.join(td, "configs_local")
+cfg.write_global_config(config_dir, gconf)
+cfg.write_config(config_dir, "watershed", ws_conf)
+assert build([WatershedWorkflow(
+    os.path.join(td, "tmp_local"), config_dir,
+    input_path=local, input_key="bnd",
+    output_path=local, output_key="ws",
+)]), "posix reference run failed"
+
+# stub object store with 5% injected request failures
+objroot = os.path.join(td, "objroot")
+os.makedirs(objroot)
+served = os.path.join(objroot, "data.n5")
+file_reader(served).create_dataset(
+    "bnd", data=vol, chunks=(8, 32, 32), compression="gzip"
+)
+port_file = os.path.join(td, "stub.port")
+stub = subprocess.Popen([
+    sys.executable, os.path.join(repo_root, "tests", "objstub.py"),
+    "--root", objroot, "--port-file", port_file,
+    "--fail-rate", "0.05", "--seed", "7",
+], env=env)
+daemon = None
+try:
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file):
+        assert stub.poll() is None, "objstub died on startup"
+        assert time.monotonic() < deadline, "objstub never came up"
+        time.sleep(0.05)
+    url = f"http://127.0.0.1:{open(port_file).read().strip()}"
+
+    state_dir = os.path.join(td, "state")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.serve",
+         "--state-dir", state_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    client = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        assert daemon.poll() is None, daemon.stderr.read()
+        try:
+            client = ServeClient(state_dir=state_dir)
+            client.healthz()
+            break
+        except Exception:
+            time.sleep(0.1)
+    assert client is not None, "daemon never became healthy"
+
+    state = client.submit_and_wait(
+        "WatershedWorkflow",
+        {"tmp_folder": os.path.join(td, "tmp_remote"),
+         "config_dir": os.path.join(td, "configs_remote"),
+         "input_path": f"{url}/data.n5", "input_key": "bnd",
+         "output_path": f"{url}/data.n5", "output_key": "ws"},
+        configs={"global": dict(gconf), "watershed": dict(ws_conf)},
+        timeout_s=600,
+    )
+    assert state["result"]["ok"], state
+
+    # byte-identity: the store the stub served now holds the SAME chunk
+    # files as the POSIX run
+    assert digest(os.path.join(local, "ws")) == digest(
+        os.path.join(served, "ws")
+    ), "remote watershed output is not byte-identical to the POSIX run"
+
+    # remote counters visible through the daemon's own exposition
+    vals = {
+        ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+        for ln in client.metrics_text().splitlines()
+        if ln and not ln.startswith("#")
+    }
+    assert vals.get("ctt_store_remote_reads_total", 0) > 0, vals
+    assert vals.get("ctt_store_remote_writes_total", 0) > 0, vals
+    assert vals.get("ctt_store_remote_retries_total", 0) > 0, (
+        "5% request chaos never forced a retry", vals,
+    )
+    print("cloud smoke ok:", json.dumps({
+        "remote_reads": vals.get("ctt_store_remote_reads_total"),
+        "remote_writes": vals.get("ctt_store_remote_writes_total"),
+        "remote_retries": vals.get("ctt_store_remote_retries_total"),
+    }))
+finally:
+    if daemon is not None:
+        daemon.send_signal(signal.SIGTERM)
+        try:
+            daemon.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+            daemon.wait(timeout=30)
+    stub.terminate()
+    stub.wait(timeout=30)
+PY
+cloud_rc=$?
+rm -rf "$cloud_tmp"
+if [ "$cloud_rc" -ne 0 ]; then
+    echo "cloud smoke failed (rc=$cloud_rc): the serve daemon could not" \
+         "produce a byte-identical watershed against the stub object" \
+         "store under 5% request chaos" >&2
+    exit "$cloud_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
