@@ -916,6 +916,28 @@ def bench_ws_e2e(x, block_shape):
         except Exception as e:
             log(f"[ws-e2e] ctt-serve bench failed: {e}")
         try:
+            # ctt-hbm: two back-to-back serve jobs on the same volume —
+            # warm device-buffer cache + aggregated dispatch + transfer
+            # stage vs the PR 9/10 serve warm path (pinned cpu: transfer
+            # and dispatch economics, not kernel throughput)
+            from bench_e2e_lib import run_hbm_pipeline
+
+            hbm_res = run_hbm_pipeline()
+            res.update(hbm_res)
+            log(
+                "[ws-e2e] ctt-hbm warm HBM A/B: upload bytes cold "
+                f"{hbm_res['ws_e2e_hbm_upload_bytes_cold']} -> warm "
+                f"{hbm_res['ws_e2e_hbm_upload_bytes_warm']}, dispatches "
+                f"{hbm_res['ws_e2e_hbm_dispatches']} for "
+                f"{hbm_res['ws_e2e_hbm_blocks']} blocks, warm wall "
+                f"{hbm_res['ws_e2e_hbm_warm_wall_s']} s vs base "
+                f"{hbm_res['ws_e2e_hbm_base_warm_wall_s']} s "
+                f"({hbm_res['ws_e2e_hbm_warm_speedup']}x), parity "
+                f"{hbm_res['ws_e2e_hbm_parity']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] ctt-hbm bench failed: {e}")
+        try:
             # ctt-cloud: the same watershed against the stub object store
             # (subprocess HTTP server) vs POSIX — remote walls, IO hidden
             # behind compute, and chunk-digest parity

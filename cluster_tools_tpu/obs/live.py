@@ -597,6 +597,28 @@ def format_watch(snap: Dict[str, Any]) -> str:
             if isinstance(val, (int, float)):
                 parts.append(f"{label} {int(val)}")
         lines.append("  serve: " + ", ".join(parts))
+    if any(k.startswith("device.") for k in counters):
+        # ctt-hbm: one line of device-pipeline health — bytes that crossed
+        # to HBM vs uploads the warm buffer cache absorbed, dispatch
+        # aggregation, and resident cache pressure.  Sits beside the
+        # per-worker device-memory high-water the heartbeats carry
+        # (ctt_worker_device_mem_peak_bytes in the prom exposition).
+        gauges = snap.get("gauges", {})
+        cache_b = gauges.get("device.cache_bytes")
+        inflight = gauges.get("device.inflight_uploads")
+        parts = [
+            "uploaded "
+            f"{counters.get('device.upload_bytes', 0) / 1e6:.1f} MB",
+            f"skipped {int(counters.get('device.uploads_skipped', 0))}",
+            f"dispatches {int(counters.get('device.dispatches', 0))}",
+            f"fused blocks {int(counters.get('device.fused_blocks', 0))}",
+            f"evictions {int(counters.get('device.cache_evictions', 0))}",
+            (f"cache {cache_b / 1e6:.1f} MB"
+             if isinstance(cache_b, (int, float)) else None),
+            (f"inflight {int(inflight)}"
+             if isinstance(inflight, (int, float)) else None),
+        ]
+        lines.append("  device: " + ", ".join(p for p in parts if p))
     if any(k.startswith("store.remote_") for k in counters):
         # ctt-cloud: one line of remote-IO health — request volume, wire
         # bytes, retries absorbed, and how many requests are in flight

@@ -66,6 +66,17 @@ COUNTERS = frozenset({
     # ahead of the in-order compute stage)
     "executor.prefetch_batches",
     "executor.stage_prefetch_s",
+    # ctt-hbm double-buffered transfer stage: seconds the upload thread
+    # spent moving batches to HBM (overlap vs compute derives from this)
+    "executor.stage_upload_s",
+    # runtime/hbm.py — ctt-hbm device-resident pipelines
+    "device.upload_bytes",      # host bytes that actually crossed to HBM
+    "device.uploads_skipped",   # batches served from the warm buffer cache
+    "device.cache_evictions",   # LRU evictions (explicit .delete() frees)
+    "device.dispatches",        # device program launches (batch grain)
+    "device.fused_blocks",      # blocks that rode an aggregated (stacked)
+                                # dispatch — hbm_stack > 1 economics
+
     # ops/cc.py — ctt-cc coarse-to-fine kernel stats (host-side emission
     # from the connected_components_coarse wrapper, never inside jit)
     "cc.fixpoint_iters",
@@ -109,6 +120,10 @@ GAUGES = frozenset({
     "compile_cache.entries_at_enable",
     # utils/store_backend.py — remote HTTP requests currently in flight
     "store.remote_inflight",
+    # runtime/hbm.py — ctt-hbm: resident HBM buffer-cache bytes and
+    # host→device transfers currently in flight (the two-slot gate)
+    "device.cache_bytes",
+    "device.inflight_uploads",
     # runtime/stream.py — peak carried merge-state bytes of a fused chain
     "stream.carry_bytes",
     # runtime/queue.py — unclaimed work-queue items at the last pull scan

@@ -41,6 +41,12 @@ DEFAULT_GLOBAL_CONFIG: Dict[str, Any] = {
     # pass, elided intermediates); False forces task-at-a-time execution
     # everywhere (CTT_STREAM_FUSION=0 is the per-process override)
     "stream_fusion": True,
+    # ctt-hbm aggregated dispatch: read payloads per fused device dispatch
+    # in the staged pipeline (the coarse-CC (n_tiles, ...) stacked shape
+    # generalized to the split-protocol kernels).  None resolves
+    # CTT_HBM_STACK, else 1 — the pre-hbm one-dispatch-per-batch shape;
+    # host IO granularity (read/write batches) is unchanged either way.
+    "hbm_stack": None,
     # ctt-steal: cluster-job block assignment — None = auto ("steal" on
     # multi-job runs of retryable tasks, "static" otherwise); "static"
     # restores the reference's frozen round-robin split byte-identically.
@@ -100,6 +106,12 @@ DEFAULT_SERVE_CONFIG: Dict[str, Any] = {
     # SIGTERM drain: how long to wait for in-flight jobs before dying
     # anyway (queued jobs are durable either way)
     "drain_timeout_s": 300.0,
+    # ctt-hbm warm device-buffer cache budget (MB) for the daemon's
+    # ExecutionContext: back-to-back jobs on the same volume reuse the
+    # HBM-resident uploads instead of re-transferring.  0 disables (the
+    # plain cold-process default); plain processes opt in via
+    # CTT_HBM_CACHE_MB instead.
+    "hbm_cache_mb": 512.0,
 }
 
 

@@ -327,6 +327,7 @@ class TpuExecutor(BaseExecutor):
                     blocks=len(chunk), block_ids=list(chunk),
                 ):
                     batch_fn(chunk, blocking, config)
+                obs_metrics.inc("device.dispatches")
                 dt = time.perf_counter() - t0
                 batch_seconds.append(dt)
                 _record(
@@ -412,11 +413,50 @@ class TpuExecutor(BaseExecutor):
         store backend allows instead of one blocking slice per read
         thread, so the read stage of a high-latency object store degrades
         to LRU hits.  Prefetch is advisory (failures surface on the real
-        read) and disabled with ``prefetch: false``."""
+        read) and disabled with ``prefetch: false``.
+
+        ctt-hbm adds two device-side levers on the same skeleton:
+
+          * **aggregated dispatch** — with ``hbm_stack: k`` (or
+            ``CTT_HBM_STACK``) and a task implementing
+            ``stack_payloads``/``unstack_results``, up to ``k``
+            consecutive read payloads concatenate into ONE ``(sum_B,
+            ...)`` stacked device dispatch (the coarse-CC ``(n_tiles,
+            ...)`` shape generalized); results split back per batch for
+            the write pool, so host IO granularity is unchanged while
+            dispatch count drops k-fold.  Kernels are vmapped over the
+            leading axis — the stacked dispatch is byte-identical to the
+            per-batch (and per-block) path, which remains the fallback.
+          * **double-buffered device prefetch** — tasks exposing
+            ``upload_batch`` get a transfer stage between read and
+            compute: while batch k's device program runs, batch k+1's
+            host arrays are already crossing to HBM on a transfer
+            thread, bounded to ``runtime.hbm.UPLOAD_SLOTS`` (2) in-flight
+            uploads (the same process-wide gate interleaves two serve
+            jobs' uploads at ``concurrency > 1``).  Disabled together
+            with the prefetch lookahead by ``prefetch: false``."""
         read_fn, compute_fn, write_fn = staged
+        from . import hbm
+
         stage_s = {"read": 0.0, "compute": 0.0, "write": 0.0,
-                   "prefetch": 0.0}
+                   "prefetch": 0.0, "upload": 0.0}
         acc_lock = threading.Lock()
+
+        stack_n = 1
+        stack_fn = getattr(task, "stack_payloads", None)
+        unstack_fn = getattr(task, "unstack_results", None)
+        if stack_fn is not None and unstack_fn is not None:
+            stack_n = hbm.hbm_stack(config)
+        upload_fn = getattr(task, "upload_batch", None)
+        # ``prefetch: false`` opts out of ALL lookahead (the acceptance
+        # switch restoring pre-hbm execution together with
+        # CTT_HBM_CACHE_MB=0); ``hbm_prefetch: false`` disables only the
+        # device transfer stage, leaving the ctt-cloud LRU prefetch alone
+        # (the honest A/B baseline for the hbm bench)
+        if not config.get("prefetch", True) or not config.get(
+            "hbm_prefetch", True
+        ):
+            upload_fn = None
 
         def _acc(stage: str, dt: float) -> None:
             with acc_lock:
@@ -462,15 +502,18 @@ class TpuExecutor(BaseExecutor):
             _acc("prefetch", time.perf_counter() - t0)
 
         n_blocks = sum(len(c) for c in chunks)
-        reads: deque = deque()   # (chunk, Future[payload])
-        writes: deque = deque()  # (chunk, Future[None], t_batch0)
+        reads: deque = deque()    # (chunk, Future[payload])
+        uploads: deque = deque()  # (group, counts, Future[payload])
+        writes: deque = deque()   # (chunk, Future[None], t_batch0)
         with ThreadPoolExecutor(
             depth, thread_name_prefix="ctt-read"
         ) as read_pool, ThreadPoolExecutor(
             depth, thread_name_prefix="ctt-write"
         ) as write_pool, ThreadPoolExecutor(
             depth, thread_name_prefix="ctt-prefetch-stage"
-        ) as prefetch_pool:
+        ) as prefetch_pool, ThreadPoolExecutor(
+            1, thread_name_prefix="ctt-hbm-upload"
+        ) as upload_pool:
             # lookahead frontier: the first ``depth`` chunks go straight
             # to the read pool (prefetching them would double-fetch), so
             # the prefetch stage starts ``depth`` ahead and stays ``depth``
@@ -501,45 +544,115 @@ class TpuExecutor(BaseExecutor):
                 obs_heartbeat.note_blocks_done(len(chunk))
                 obs_heartbeat.note_block_end(chunk[0])
 
-            def _drain_read():
-                chunk, fut = reads.popleft()
-                t_batch0 = time.perf_counter()
-                try:
-                    payload = fut.result()
-                    faults.check("executor.stage_compute", id=chunk[0])
-                    t0 = time.perf_counter()
-                    with obs_trace.span(
-                        "stage_compute", kind="device",
-                        task=task.identifier, blocks=len(chunk),
-                        block_ids=list(chunk),
-                    ):
-                        result = compute_fn(payload, blocking, config)
-                    dt = time.perf_counter() - t0
-                    _acc("compute", dt)
-                    _record(task, f"batch_{chunk[0]}_{chunk[-1]}",
-                            len(chunk), dt)
-                except Exception:
+            def _fallback_group(group):
+                # called from an except block: every batch of the failed
+                # dispatch group degrades to the per-block path
+                for chunk in group:
                     self._per_block_fallback(
                         task, blocking, config, chunk, done, failed,
                         errors, traceback.format_exc(),
                     )
                     obs_heartbeat.note_block_end(chunk[0])
+
+            def _upload(payload):
+                # transfer thread (ctt-hbm): batch k+1 crosses to HBM
+                # while batch k's device program runs
+                t0 = time.perf_counter()
+                out = upload_fn(payload, blocking, config)
+                _acc("upload", time.perf_counter() - t0)
+                return out
+
+            def _compute_group(group, counts, payload):
+                all_ids = [b for c in group for b in c]
+                t_batch0 = time.perf_counter()
+                try:
+                    faults.check("executor.stage_compute", id=group[0][0])
+                    t0 = time.perf_counter()
+                    with obs_trace.span(
+                        "stage_compute", kind="device",
+                        task=task.identifier, blocks=len(all_ids),
+                        block_ids=all_ids,
+                    ):
+                        result = compute_fn(payload, blocking, config)
+                    obs_metrics.inc("device.dispatches")
+                    if len(group) > 1:
+                        obs_metrics.inc("device.fused_blocks", len(all_ids))
+                    dt = time.perf_counter() - t0
+                    _acc("compute", dt)
+                    _record(task, f"batch_{all_ids[0]}_{all_ids[-1]}",
+                            len(all_ids), dt)
+                    results = (
+                        unstack_fn(result, counts, blocking, config)
+                        if len(group) > 1 else [result]
+                    )
+                except Exception:
+                    _fallback_group(group)
                     return
-                writes.append(
-                    (chunk, write_pool.submit(_write, chunk, result),
-                     t_batch0)
-                )
+                for chunk, res in zip(group, results):
+                    writes.append(
+                        (chunk, write_pool.submit(_write, chunk, res),
+                         t_batch0)
+                    )
                 while len(writes) > depth:
                     _drain_write()
+
+            def _drain_upload():
+                group, counts, fut = uploads.popleft()
+                try:
+                    payload = fut.result()
+                except Exception:
+                    _fallback_group(group)
+                    return
+                _compute_group(group, counts, payload)
+
+            def _consume():
+                """Form one dispatch group (up to ``stack_n`` read
+                payloads, stacked) and move it down the pipeline — the
+                upload stage when armed, else straight to compute.  The
+                deques are FIFO throughout, so the device sees the exact
+                dispatch sequence of the serial loop."""
+                group, payloads = [], []
+                while reads and len(group) < stack_n:
+                    chunk, fut = reads.popleft()
+                    try:
+                        payloads.append(fut.result())
+                        group.append(chunk)
+                    except Exception:
+                        self._per_block_fallback(
+                            task, blocking, config, chunk, done, failed,
+                            errors, traceback.format_exc(),
+                        )
+                        obs_heartbeat.note_block_end(chunk[0])
+                if not group:
+                    return
+                counts = [len(c) for c in group]
+                try:
+                    payload = (
+                        stack_fn(payloads, blocking, config)
+                        if len(group) > 1 else payloads[0]
+                    )
+                except Exception:
+                    _fallback_group(group)
+                    return
+                if upload_fn is None:
+                    _compute_group(group, counts, payload)
+                    return
+                uploads.append(
+                    (group, counts, upload_pool.submit(_upload, payload))
+                )
+                while len(uploads) >= hbm.UPLOAD_SLOTS:
+                    _drain_upload()
 
             t_wall0 = time.perf_counter()
             for i, chunk in enumerate(chunks):
                 _advance_prefetch(i + 1 + depth)
                 reads.append((chunk, read_pool.submit(_read, chunk)))
-                while len(reads) >= depth:
-                    _drain_read()
+                while len(reads) >= max(depth, stack_n):
+                    _consume()
             while reads:
-                _drain_read()
+                _consume()
+            while uploads:
+                _drain_upload()
             while writes:
                 _drain_write()
         wall = time.perf_counter() - t_wall0
@@ -555,6 +668,7 @@ class TpuExecutor(BaseExecutor):
         obs_metrics.inc("executor.stage_compute_s", stage_s["compute"])
         obs_metrics.inc("executor.stage_write_s", stage_s["write"])
         obs_metrics.inc("executor.stage_prefetch_s", stage_s["prefetch"])
+        obs_metrics.inc("executor.stage_upload_s", stage_s["upload"])
         # IO seconds the pipeline hid behind (serialized) compute: summed
         # read+write stage time minus the wall the compute stage left open
         obs_metrics.inc(

@@ -1,0 +1,542 @@
+"""ctt-hbm: device-resident pipelines — the warm HBM buffer cache.
+
+The host side is latency-tolerant (three-stage pipeline, async prefetch,
+decoded-chunk LRU) but HBM was cold per job: every serve job re-uploaded
+its device arrays even when the previous job on the same daemon had just
+uploaded the identical bytes.  This module is the device analog of the
+decoded-chunk LRU (``utils/store.py``), one layer up:
+
+  * :class:`DeviceBufferCache` — a process-wide LRU of *device* arrays
+    keyed by ``(volume, bounding box, sharding, transform tag)`` with an
+    HBM byte budget (``CTT_HBM_CACHE_MB``).  Eviction calls ``.delete()``
+    on the evicted jax arrays explicitly — HBM must actually free, GC
+    latency is not a memory plan.
+  * Freshness rides the SAME per-chunk store signatures the chunk LRU
+    already computes (POSIX ``(inode, mtime_ns, size)``, remote
+    ``(ETag, Last-Modified, Content-Length)``): a :class:`BatchSource`
+    carries the signature tuple of every chunk overlapping the batch's
+    halo'd bounding box, and any rewrite — in-process, cross-process, or
+    out-of-band on the object store — turns the next probe into a miss.
+    Stale data is structurally impossible; stale HBM merely re-uploads.
+  * ``fetch_or_upload`` — the one call sites use: probe, else build the
+    :class:`DeviceBatch` (the task's ``put_sharded`` uploads) under a
+    process-wide two-slot transfer gate and insert it.
+
+The transfer gate (:func:`upload_slot`) is also the serve-concurrency
+dispatch-interleaving policy: at ``concurrency > 1`` two jobs' upload
+bursts interleave through the same two slots instead of convoying one
+job's entire transfer queue ahead of the other's compute.
+
+Budget resolution: the ``CTT_HBM_CACHE_MB`` environment (default 0 — a
+plain cold workflow process keeps exactly the pre-hbm behavior), or the
+owning :class:`~cluster_tools_tpu.runtime.workflow.ExecutionContext`'s
+``hbm_cache_mb`` argument — the serve daemon passes its ``hbm_cache_mb``
+config (default 512), which is where cross-job reuse lives.  ``0``
+disables everything: no probes, no stats, no cache entries.
+
+Hazard note: an evicted array's ``.delete()`` can race a concurrent
+job still holding the value (serve ``concurrency > 1``).  The window is
+the microseconds between a ``get`` and the dispatch consuming it; a loss
+surfaces as a failed batch and the executor's per-block fallback re-runs
+it from the store — correctness degrades to a retry, never to wrong
+bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+
+__all__ = [
+    "DeviceBufferCache", "DeviceBatch", "BatchSource", "cache",
+    "cache_budget_bytes", "set_cache_budget", "dataset_source",
+    "fetch_or_upload", "sharded_device_batch", "batch_device",
+    "require_data", "upload_slot", "stack_block_batches", "split_stacked",
+    "hbm_stack",
+]
+
+
+def cache_budget_bytes() -> int:
+    """``CTT_HBM_CACHE_MB`` (default 0 = disabled); malformed values
+    degrade to the default like every other CTT_* switch."""
+    raw = os.environ.get("CTT_HBM_CACHE_MB")
+    try:
+        mb = float(raw) if raw is not None else 0.0
+    except (TypeError, ValueError):
+        mb = 0.0
+    return max(int(mb * 1024 * 1024), 0)
+
+
+@dataclass
+class DeviceBatch:
+    """One batch's device-resident upload: the task-defined tuple of
+    device arrays (stacked data + aux planes), the real (unpadded) batch
+    size, and the host bytes that crossed (or would cross) to HBM."""
+
+    arrays: Tuple[Any, ...]
+    n: int
+    nbytes: int
+
+    def delete(self) -> None:
+        for arr in self.arrays:
+            fn = getattr(arr, "delete", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # ctt: noqa[CTT009] double-delete of an already-freed buffer must not mask the eviction path
+                    pass
+
+
+@dataclass(frozen=True)
+class BatchSource:
+    """Identity + freshness of the store region one device upload covers.
+
+    ``key`` is the hashable cache key (dataset path/key, block ids, halo,
+    transform tag, sharding descriptor); ``sig`` is the per-chunk store
+    signature tuple the probe validates against — the chunk LRU's own
+    freshness keys, one level up."""
+
+    key: Tuple
+    sig: Tuple = field(hash=False, compare=False, default=())
+
+
+class DeviceBufferCache:
+    """Process-wide LRU of :class:`DeviceBatch` entries in HBM.
+
+    Same shape as the decoded-chunk LRU: entries carry their source
+    signature, a mismatched probe is a miss (and evicts the stale entry),
+    and inserts evict least-recently-used entries past the byte budget —
+    but eviction here calls ``.delete()`` so the HBM is returned to the
+    allocator immediately."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, Tuple[Tuple, DeviceBatch]]" = (
+            OrderedDict()
+        )
+        self._bytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, source: BatchSource) -> Optional[DeviceBatch]:
+        evicted = None
+        with self._lock:
+            entry = self._entries.get(source.key)
+            if entry is None:
+                return None
+            if entry[0] != source.sig:
+                # store rewrite since the upload: drop the stale buffers
+                evicted = self._pop_locked(source.key)
+            else:
+                self._entries.move_to_end(source.key)
+                return entry[1]
+        if evicted is not None:
+            obs_metrics.inc("device.cache_evictions")
+            evicted.delete()
+            self._publish()
+        return None
+
+    def put(self, source: BatchSource, batch: DeviceBatch) -> None:
+        if self.max_bytes <= 0 or batch.nbytes > self.max_bytes:
+            return
+        evicted = []
+        with self._lock:
+            old = self._pop_locked(source.key)
+            if old is not None:
+                evicted.append(old)
+            self._entries[source.key] = (source.sig, batch)
+            self._bytes += batch.nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                key = next(iter(self._entries))
+                evicted.append(self._pop_locked(key))
+        for batch_out in evicted:
+            obs_metrics.inc("device.cache_evictions")
+            batch_out.delete()
+        self._publish()
+
+    def _pop_locked(self, key) -> Optional[DeviceBatch]:
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return None
+        self._bytes -= entry[1].nbytes
+        return entry[1]
+
+    def clear(self) -> None:
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self._bytes = 0
+        for _, batch in entries:
+            batch.delete()
+        self._publish()
+
+    def _publish(self) -> None:
+        obs_metrics.set_gauge("device.cache_bytes", self._bytes)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "budget_bytes": self.max_bytes,
+                "bytes": self._bytes,
+                "entries": len(self._entries),
+            }
+
+
+def cache() -> Optional[DeviceBufferCache]:
+    """The process context's device-buffer cache, or None when disabled
+    (budget 0) — callers treat None as 'every probe misses, skip the
+    stats'.  When no context exists yet AND the env budget is 0 this
+    returns None without creating (or activating) one, so plain library
+    reads stay exactly as cheap as before ctt-hbm."""
+    from .workflow import ExecutionContext
+
+    ctx = ExecutionContext._PROCESS
+    if ctx is None:
+        if cache_budget_bytes() <= 0:
+            return None
+        ctx = ExecutionContext.process_context()
+    dc = ctx.device_cache()
+    return dc if dc is not None and dc.max_bytes > 0 else None
+
+
+def set_cache_budget(max_bytes: Optional[int]) -> int:
+    """Override the process cache budget (tests / tools); returns the
+    previous budget.  ``None`` restores the ``CTT_HBM_CACHE_MB``
+    resolution; any change clears (and deletes) cached entries."""
+    from .workflow import ExecutionContext
+
+    ctx = ExecutionContext.process_context()
+    dc = ctx.device_cache()
+    prev = dc.max_bytes
+    dc.max_bytes = (
+        cache_budget_bytes() if max_bytes is None else max(int(max_bytes), 0)
+    )
+    dc.clear()
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# source construction (identity + freshness)
+
+
+def _put_devices(b: int, config) -> list:
+    """The device list ``put_sharded`` would pick for a [b, ...] batch
+    (empty = plain single-device transfer)."""
+    if config is not None and config.get("target", "tpu") != "tpu":
+        return []
+    try:
+        from ..parallel.mesh import resolve_devices
+
+        devices = resolve_devices(config)
+    except Exception:
+        return []
+    if b < len(devices):
+        devices = devices[:b]
+    return list(devices) if len(devices) > 1 else []
+
+
+def _shard_desc(b: int, config) -> Tuple:
+    """The device placement ``put_sharded`` would choose for a [b, ...]
+    batch — part of the cache key so a hit can only serve an array with
+    the exact sharding the consumer's dispatch expects."""
+    devices = _put_devices(b, config)
+    if not devices:
+        return ("single",)
+    return tuple(str(d) for d in devices)
+
+
+def dataset_source(ds, path: str, key: str, blocking, block_ids, halo,
+                   tag: Tuple, config) -> Optional[BatchSource]:
+    """Build the :class:`BatchSource` of one batch read: identity from
+    ``(path, key, block ids, halo, tag, sharding)``, freshness from the
+    per-chunk signatures of every chunk overlapping the batch's halo'd
+    bounding box (``Dataset.region_signature`` — the chunk LRU's keys).
+    Returns None when the device cache is disabled, the dataset cannot
+    sign regions (hdf5), or a signature probe failed transiently — the
+    caller then runs the plain uncached path."""
+    if cache() is None or not block_ids:
+        return None
+    sig_fn = getattr(ds, "region_signature", None)
+    if sig_fn is None:
+        return None
+    halo = tuple(int(h) for h in (halo or (0,) * blocking.ndim))
+    from ..parallel.dispatch import batch_outer_boxes
+
+    _, lo, hi, _ = batch_outer_boxes(blocking, block_ids, halo)
+    extra = len(ds.shape) - blocking.ndim
+    lead = tuple(slice(0, s) for s in ds.shape[:extra])
+    bb = lead + tuple(slice(b, e) for b, e in zip(lo, hi))
+    sig = sig_fn(bb)
+    if sig is None:
+        return None
+    return BatchSource(
+        key=(path, key, tuple(int(b) for b in block_ids), halo, tuple(tag),
+             _shard_desc(len(block_ids), config)),
+        sig=sig,
+    )
+
+
+# ---------------------------------------------------------------------------
+# upload path
+
+# the double-buffer transfer gate: at most two uploads in flight process-
+# wide.  Per dispatch this bounds the upload lookahead to two batches
+# (batch k computes while k+1 transfers and k+2 waits at the gate); at
+# serve concurrency > 1 it is the interleaving policy — two jobs' upload
+# bursts alternate through the shared slots instead of convoying.
+UPLOAD_SLOTS = 2
+_UPLOAD_GATE = threading.BoundedSemaphore(UPLOAD_SLOTS)
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT = 0
+
+
+class upload_slot:
+    """Context manager accounting one in-flight host→HBM transfer."""
+
+    def __enter__(self):
+        global _INFLIGHT
+        _UPLOAD_GATE.acquire()
+        with _INFLIGHT_LOCK:
+            _INFLIGHT += 1
+            obs_metrics.set_gauge("device.inflight_uploads", _INFLIGHT)
+        return self
+
+    def __exit__(self, *exc):
+        global _INFLIGHT
+        with _INFLIGHT_LOCK:
+            _INFLIGHT -= 1
+            obs_metrics.set_gauge("device.inflight_uploads", _INFLIGHT)
+        _UPLOAD_GATE.release()
+        return False
+
+
+def fetch_or_upload(source: Optional[BatchSource],
+                    build: Callable[[], DeviceBatch]) -> DeviceBatch:
+    """The one upload call: probe the cache under ``source`` (None =
+    uncacheable), else ``build()`` the device batch under an upload slot
+    and insert it.  Counters: ``device.uploads_skipped`` on a hit,
+    ``device.upload_bytes`` for bytes that actually crossed."""
+    dc = cache() if source is not None else None
+    if dc is not None:
+        hit = dc.get(source)
+        if hit is not None:
+            obs_metrics.inc("device.uploads_skipped")
+            return hit
+    with upload_slot():
+        batch = build()
+    obs_metrics.inc("device.upload_bytes", int(batch.nbytes))
+    if dc is not None:
+        dc.put(source, batch)
+    return batch
+
+
+def sharded_device_batch(data: np.ndarray, config) -> DeviceBatch:
+    """``put_sharded`` as a :class:`DeviceBatch` builder — the standard
+    single-array upload of a stacked block batch."""
+    from ..parallel.mesh import put_sharded
+
+    xb, n = put_sharded(data, config)
+    return DeviceBatch(arrays=(xb,), n=n, nbytes=int(data.nbytes))
+
+
+def batch_device(batch, config,
+                 build: Optional[Callable[[], DeviceBatch]] = None
+                 ) -> DeviceBatch:
+    """Device arrays for a :class:`~..parallel.dispatch.BlockBatch`:
+    the probe result stamped at read time (``batch.device``), else a
+    cache fetch under ``batch.source`` (the transform tag is baked into
+    the source key at read time), else ``build()`` (default: the plain
+    ``put_sharded`` of ``batch.data``).  Raises when the batch was a
+    probe-hit stub (``data is None``) whose entry was evicted in the
+    meantime — the executor's per-block fallback re-reads it."""
+    dev = getattr(batch, "device", None)
+    if dev is not None:
+        return dev
+    if build is None:
+        def build() -> DeviceBatch:
+            return sharded_device_batch(require_data(batch), config)
+    source = getattr(batch, "source", None)
+    if source is not None and not isinstance(source, BatchSource):
+        source = None
+    batch.device = fetch_or_upload(source, build)
+    return batch.device
+
+
+def require_data(batch) -> np.ndarray:
+    """The batch's host data, or a loud error when the batch is a device
+    probe stub whose cache entry has since been evicted (the per-block
+    fallback then re-reads from the store)."""
+    if batch.data is None:
+        raise RuntimeError(
+            "device-cache entry evicted between read probe and compute; "
+            "per-block fallback re-reads the batch"
+        )
+    return batch.data
+
+
+def cached_put_from_store(ds, mesh, *, source_path: str, source_key: str,
+                          tag: Tuple, dtype=None, pad_to=None,
+                          transform=None, pad_value=0):
+    """``parallel.mesh.put_from_store`` through the device-buffer cache:
+    the whole-volume upload of a collective task (sharded watershed /
+    problem) keyed by ``(path, key, full volume, tag, mesh)`` and
+    signature-validated against every chunk of the dataset — the
+    "uploaded ONCE, stays resident" pattern of ShardedWsProblemTask,
+    generalized so back-to-back serve jobs on the same volume skip the
+    re-upload entirely.  ``tag`` must pin every transform-relevant config
+    knob (invert, normalization mode, output dtype)."""
+    from ..parallel.mesh import put_from_store
+
+    def build() -> DeviceBatch:
+        arr = put_from_store(
+            ds, mesh, dtype=dtype, pad_to=pad_to, transform=transform,
+            pad_value=pad_value,
+        )
+        out_dtype = np.dtype(dtype) if dtype is not None else ds.dtype
+        nbytes = int(np.prod(arr.shape)) * out_dtype.itemsize
+        return DeviceBatch(arrays=(arr,), n=int(arr.shape[0]), nbytes=nbytes)
+
+    source = None
+    if cache() is not None:
+        sig_fn = getattr(ds, "region_signature", None)
+        sig = sig_fn(tuple(slice(0, s) for s in ds.shape)) if sig_fn else None
+        if sig is not None:
+            mesh_desc = tuple(str(d) for d in np.ravel(mesh.devices))
+            source = BatchSource(
+                key=(source_path, source_key, "fullvol", tuple(tag),
+                     str(np.dtype(dtype)) if dtype is not None else None,
+                     int(pad_to or 0), mesh_desc),
+                sig=sig,
+            )
+    return fetch_or_upload(source, build).arrays[0]
+
+
+# ---------------------------------------------------------------------------
+# aggregated dispatch helpers (lever b): stack k read payloads' BlockBatches
+# into one (sum_B, ...) stack so the executor issues ONE device dispatch per
+# batch stack — the coarse-CC (n_tiles, ...) shape generalized.  Pure host
+# reshuffling; the kernels are vmapped over the leading axis, so the stacked
+# dispatch is byte-identical to the per-batch (and per-block) results.
+
+
+def stack_block_batches(batches, config=None):
+    """Concatenate BlockBatches along the batch axis (geometry included).
+    When every member is a device probe hit the stack concatenates ON
+    device (no host round trip) and re-places the result exactly as
+    ``put_sharded`` would have placed the stacked host read, so stacked
+    cache hits and stacked uploads dispatch identically.  A stack mixing
+    probe hits and host reads has neither full host data nor full device
+    state — ``batch_device`` then raises and the executor's per-block
+    fallback re-reads (a rare cache-boundary case, never wrong bytes)."""
+    from ..parallel.dispatch import BlockBatch
+
+    if len(batches) == 1:
+        return batches[0]
+    datas = [b.data for b in batches]
+    data = (
+        np.concatenate(datas, axis=0)
+        if all(d is not None for d in datas) else None
+    )
+    valids = [b.valid for b in batches]
+    valid = (
+        np.concatenate(valids, axis=0)
+        if all(v is not None for v in valids) else None
+    )
+    out = BlockBatch(
+        data=data, valid=valid,
+        blocks=[bh for b in batches for bh in b.blocks],
+        block_ids=[bid for b in batches for bid in b.block_ids],
+    )
+    sources = [getattr(b, "source", None) for b in batches]
+    if all(s is not None for s in sources):
+        # the stacked upload is its own cache line: key = member keys
+        # chained, sig = member sigs chained (any member rewrite misses)
+        out.source = BatchSource(
+            key=("stack",) + tuple(s.key for s in sources),
+            sig=tuple(s.sig for s in sources),
+        )
+    devices = [getattr(b, "device", None) for b in batches]
+    if data is None and all(d is not None for d in devices):
+        out.device = _concat_device(devices, config)
+    return out
+
+
+def _concat_device(devices, config) -> DeviceBatch:
+    """Stack per-chunk DeviceBatches that were all probe hits: device-side
+    concatenate of each array slot (sliced to the real n first), then
+    re-pad and re-place to the exact ``put_sharded`` layout of the
+    equivalent stacked host upload."""
+    import jax.numpy as jnp
+
+    n = sum(d.n for d in devices)
+    devs = _put_devices(n, config)
+    pad = (-n) % len(devs) if devs else 0
+    arrays = []
+    for slot in range(len(devices[0].arrays)):
+        parts = [d.arrays[slot][: d.n] for d in devices]
+        arr = jnp.concatenate(parts, axis=0)
+        if pad:
+            arr = jnp.concatenate([arr, jnp.repeat(arr[-1:], pad, axis=0)])
+        if devs:
+            from ..parallel.mesh import get_mesh, shard_batch
+
+            arr = shard_batch(arr, get_mesh(devs))
+        arrays.append(arr)
+    return DeviceBatch(arrays=tuple(arrays), n=n,
+                       nbytes=sum(d.nbytes for d in devices))
+
+
+def split_block_batch(batch, counts) -> list:
+    """Slice a stacked BlockBatch back into per-chunk BlockBatches (the
+    geometry inverse of :func:`stack_block_batches`) — device/source
+    state is deliberately dropped: the splits exist only for the write
+    stage, which consumes geometry + results."""
+    from ..parallel.dispatch import BlockBatch
+
+    out, off = [], 0
+    for c in counts:
+        out.append(BlockBatch(
+            data=None if batch.data is None else batch.data[off: off + c],
+            valid=None if batch.valid is None else batch.valid[off: off + c],
+            blocks=batch.blocks[off: off + c],
+            block_ids=batch.block_ids[off: off + c],
+        ))
+        off += c
+    return out
+
+
+def split_stacked(results: np.ndarray, counts) -> list:
+    """Split a stacked per-block result array back into per-chunk arrays
+    (the inverse of the leading-axis concatenation)."""
+    out, off = [], 0
+    for c in counts:
+        out.append(results[off: off + c])
+        off += c
+    return out
+
+
+def hbm_stack(config) -> int:
+    """Batches per fused device dispatch: the ``hbm_stack`` config knob,
+    else ``CTT_HBM_STACK``, else 1 (off — the pre-hbm dispatch shape);
+    malformed values degrade to 1."""
+    raw = config.get("hbm_stack")
+    if raw is None:
+        raw = os.environ.get("CTT_HBM_STACK")
+    try:
+        n = int(raw) if raw is not None else 1
+    except (TypeError, ValueError):
+        n = 1
+    return max(n, 1)

@@ -33,6 +33,11 @@ Shape citations: arXiv:1711.00975 (one incremental pass, bounded memory,
 small carried state) and arXiv:2210.06438 (fusing fine-grained stages into
 resident device work); the fused ``ShardedWsProblemTask`` proved the
 device-resident two-stage pattern this generalizes.
+
+ctt-hbm: member uploads route through the warm device-buffer cache
+(``runtime/hbm.py``) inside their own compute helpers — a back-to-back
+fused serve job on the same volume skips the head member's store upload
+— and each member dispatch is accounted under ``device.dispatches``.
 """
 
 from __future__ import annotations
@@ -391,6 +396,10 @@ class _ChainRunner:
                 result, handoff = m.fused_compute_batch(
                     payload, plan.blocking, mconf, elided=mid in self.elide
                 )
+            # ctt-hbm accounting: one device dispatch per member per slab
+            # (member uploads route through the warm device-buffer cache
+            # via their own compute helpers — see tasks/threshold.py)
+            obs_metrics.inc("device.dispatches")
             m.record_timing(
                 f"batch_{chunk[0]}_{chunk[-1]}", len(chunk),
                 time.perf_counter() - t1,

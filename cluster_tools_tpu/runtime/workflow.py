@@ -50,12 +50,15 @@ class ExecutionContext:
         compile_cache_path: Optional[str] = None,
         chunk_cache_mb: Optional[float] = None,
         role: Optional[str] = None,
+        hbm_cache_mb: Optional[float] = None,
     ):
         self._compile_cache_path = compile_cache_path
         self._chunk_cache_mb = chunk_cache_mb
+        self._hbm_cache_mb = hbm_cache_mb
         self._role = role
         self._activated = False
         self._n_devices: Optional[int] = None
+        self._device_cache = None
         self.compile_cache_dir: Optional[str] = None
         self.builds_executed = 0
 
@@ -82,6 +85,23 @@ class ExecutionContext:
         self._activated = True
         return self
 
+    def device_cache(self):
+        """The context's warm device-buffer cache (ctt-hbm), created
+        lazily: budget from the ``hbm_cache_mb`` constructor argument
+        (the serve daemon's config — cross-job HBM reuse lives there),
+        else ``CTT_HBM_CACHE_MB`` (default 0 = disabled).  Owned here so
+        the cache's lifetime IS the warm process state's lifetime."""
+        if self._device_cache is None:
+            from . import hbm
+
+            budget = (
+                int(float(self._hbm_cache_mb) * (1 << 20))
+                if self._hbm_cache_mb is not None
+                else hbm.cache_budget_bytes()
+            )
+            self._device_cache = hbm.DeviceBufferCache(max(budget, 0))
+        return self._device_cache
+
     def local_device_count(self) -> int:
         """Visible local devices, resolved once per context — the
         executor's batch sizing rides this instead of asking jax on every
@@ -104,6 +124,7 @@ class ExecutionContext:
             "role": self._role,
             "compile_cache_dir": self.compile_cache_dir,
             "chunk_cache_budget_bytes": store.chunk_cache_budget(),
+            "device_cache": self.device_cache().describe(),  # ctt-hbm
             "devices": self._n_devices,  # None until first dispatch asks
             "builds_executed": self.builds_executed,
             "pid": os.getpid(),

@@ -93,7 +93,14 @@ class ServeDaemon:
                 os.path.join(state_dir, "trace"),
                 f"serve_{os.getpid()}", export_env=False,
             )
-        self.context = ExecutionContext(role="serve").install()
+        # hbm_cache_mb: the daemon's warm device-buffer cache (ctt-hbm) —
+        # the "HBM stays warm across jobs" half of the amortization story;
+        # the two-slot upload gate (runtime/hbm.py) doubles as the
+        # dispatch-interleaving policy at concurrency > 1 (two jobs'
+        # transfer bursts alternate instead of convoying)
+        self.context = ExecutionContext(
+            role="serve", hbm_cache_mb=conf.get("hbm_cache_mb"),
+        ).install()
         self.jobs = JobQueue(
             os.path.join(state_dir, "jobs"), lease_s=conf.get("lease_s")
         )

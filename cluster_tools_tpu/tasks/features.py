@@ -496,12 +496,19 @@ class ShardedProblemTask(VolumeSimpleTask):
                 return d.astype(np.float32) / 255.0
             return np.asarray(d, dtype=np.float32)
 
+        # compact labels depend on the run-local node table, so they stay
+        # uncached; the boundary-map upload routes through the warm
+        # device-buffer cache (ctt-hbm) — a back-to-back serve job on the
+        # same volume reuses the HBM-resident float32 array
+        from ..runtime import hbm
+
         compact_d = put_from_store(
             seg_ds, mesh, dtype=np.int32, pad_to=n_dev, transform=compact_slab
         )
-        data_d = put_from_store(
-            data_ds, mesh, dtype=np.float32, pad_to=n_dev,
-            transform=normalize_slab,
+        data_d = hbm.cached_put_from_store(
+            data_ds, mesh, source_path=self.input_path,
+            source_key=self.input_key, tag=("problem-data",),
+            dtype=np.float32, pad_to=n_dev, transform=normalize_slab,
         )
 
         edges_c, feats = sharded_boundary_edge_features(
@@ -595,9 +602,7 @@ class ShardedWsProblemTask(ShardedProblemTask):
         import jax as _jax
 
         from ..ops.relabel import relabel_consecutive_np
-        from ..parallel.mesh import (
-            get_mesh, put_from_store, put_global, resolve_devices,
-        )
+        from ..parallel.mesh import get_mesh, put_global, resolve_devices
         from ..parallel.sharded_rag import sharded_boundary_edge_features
         from ..utils import store
         from .watershed import _normalize_host, run_sharded_ws_kernel
@@ -635,9 +640,19 @@ class ShardedWsProblemTask(ShardedProblemTask):
             self.record_timing(f"batch_{phase}", 1, _time.perf_counter() - t0)
             return r
 
-        # ONE upload; the array stays resident through watershed AND RAG
-        x_d = timed("upload", lambda: put_from_store(
-            in_ds, mesh, dtype=np.float32, pad_to=n_dev,
+        # ONE upload; the array stays resident through watershed AND RAG —
+        # and, through the shared device-buffer cache (ctt-hbm), across
+        # back-to-back jobs on the same volume: this task's "uploaded
+        # ONCE, stays resident" pattern is exactly what the cache
+        # generalizes, so the upload is no longer an ad-hoc one-off (the
+        # timing record keeps the batch_* breakdown contract)
+        from ..runtime import hbm
+
+        x_d = timed("upload", lambda: hbm.cached_put_from_store(
+            in_ds, mesh, source_path=self.input_path,
+            source_key=self.input_key,
+            tag=("ws-problem-input", bool(invert)),
+            dtype=np.float32, pad_to=n_dev,
             pad_value=1.0 if invert else 0.0,
             transform=_normalize_host,
         ))

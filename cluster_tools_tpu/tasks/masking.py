@@ -25,6 +25,7 @@ import numpy as np
 
 from ..ops.filters import minimum_filter
 from ..parallel.dispatch import read_block_batch, write_block_batch
+from ..runtime import hbm
 from ..utils import store
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, read_threads
@@ -116,9 +117,17 @@ class MinfilterTask(VolumeTask):
 
     def read_batch(self, block_ids, blocking: Blocking, config):
         halo = self._halo(config)
+        # the device-source tag marks the replicate-pad edit below: the
+        # cached upload holds the EDITED batch, so the key must never
+        # collide with a plain zero-padded read of the same region
         batch = read_block_batch(self.input_ds(), blocking, block_ids,
                                  halo=halo, n_threads=read_threads(config),
-                                 dtype="float32")
+                                 dtype="float32",
+                                 device_source=(self.input_path,
+                                                self.input_key,
+                                                ("minfilter-read",), config))
+        if batch.data is None:
+            return batch  # device probe hit: the edited batch is resident
         # replicate-pad the static-shape padding: zero fill would leak
         # "masked out" into border blocks through the min window
         full_shape = batch.data.shape[1:]
@@ -133,14 +142,26 @@ class MinfilterTask(VolumeTask):
                 )
         return batch
 
-    def compute_batch(self, batch, blocking: Blocking, config):
-        from ..parallel.mesh import put_sharded
+    def upload_batch(self, batch, blocking: Blocking, config):
+        hbm.batch_device(batch, config)
+        return batch
 
-        xb, n = put_sharded(batch.data, config)
+    def stack_payloads(self, payloads, blocking: Blocking, config):
+        return hbm.stack_block_batches(payloads, config)
+
+    def unstack_results(self, result, counts, blocking: Blocking, config):
+        batch, out = result
+        return list(zip(
+            hbm.split_block_batch(batch, counts),
+            hbm.split_stacked(out, counts),
+        ))
+
+    def compute_batch(self, batch, blocking: Blocking, config):
+        db = hbm.batch_device(batch, config)
         out = _minfilter_batch(
-            xb, tuple(int(f) for f in config["filter_shape"])
+            db.arrays[0], tuple(int(f) for f in config["filter_shape"])
         )
-        return batch, np.asarray(out)[:n]
+        return batch, np.asarray(out)[:db.n]
 
     def write_batch(self, result, blocking: Blocking, config):
         batch, out = result

@@ -27,7 +27,7 @@ from ..ops import cc as cc_ops
 from ..ops import filters
 from ..ops.unionfind import merge_assignments_device, merge_assignments_np
 from ..parallel.dispatch import read_block_batch, write_block_batch
-from ..parallel.mesh import put_sharded
+from ..runtime import hbm
 from ..utils.blocking import Blocking
 from .base import VolumeSimpleTask, VolumeTask, merge_threads, read_ragged_chunks, read_threads
 
@@ -214,9 +214,13 @@ class BlockComponentsTask(VolumeTask):
     # -- split batch protocol (three-stage executor pipeline) ---------------
 
     def read_batch(self, block_ids: List[int], blocking: Blocking, config):
+        # the device cache covers ONLY the input upload (masks are applied
+        # host-side after compute, so mask freshness never rides the key)
         batch = read_block_batch(
             self.input_ds(), blocking, block_ids, dtype="float32",
             n_threads=read_threads(config),
+            device_source=(self.input_path, self.input_key,
+                           ("components-read",), config),
         )
         if self.mask_path:
             from ..utils import store as _store
@@ -229,17 +233,38 @@ class BlockComponentsTask(VolumeTask):
             masks = None
         return batch, masks
 
+    def upload_batch(self, payload, blocking: Blocking, config):
+        batch, masks = payload
+        hbm.batch_device(batch, config)
+        return payload
+
+    def stack_payloads(self, payloads, blocking: Blocking, config):
+        masks = None
+        if any(p[1] is not None for p in payloads):
+            masks = [m for p in payloads for m in (p[1] or [])]
+        return hbm.stack_block_batches(
+            [p[0] for p in payloads], config
+        ), masks
+
+    def unstack_results(self, result, counts, blocking: Blocking, config):
+        batch, labels = result
+        return list(zip(
+            hbm.split_block_batch(batch, counts),
+            hbm.split_stacked(labels, counts),
+        ))
+
     def compute_batch(self, payload, blocking: Blocking, config):
         batch, masks = payload
         sigma = config.get("sigma", 0.0) or 0.0
         if isinstance(sigma, list):
             sigma = tuple(sigma)
-        xb, n = put_sharded(batch.data, config)
+        db = hbm.batch_device(batch, config)
+        n = db.n
         coarse_tile = config.get("coarse_tile", None)
         if coarse_tile is not None and not isinstance(coarse_tile, int):
             coarse_tile = tuple(coarse_tile)
         labels, _ = _components_batch(
-            xb,
+            db.arrays[0],
             float(config.get("threshold", 0.5)),
             config.get("threshold_mode", "greater"),
             sigma,

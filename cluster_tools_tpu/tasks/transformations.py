@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.dispatch import read_block_batch, write_block_batch
+from ..runtime import hbm
 from ..utils import store
 from ..utils.blocking import Blocking
 from .base import VolumeTask, read_threads
@@ -100,32 +101,58 @@ class LinearTransformationTask(VolumeTask):
     # -- split batch protocol (three-stage executor pipeline) ---------------
 
     def read_batch(self, block_ids, blocking: Blocking, config):
+        # only the input volume routes through the device-buffer cache —
+        # coefficients come from the trafo file and the mask from its own
+        # dataset, neither covered by the input's store signature
         batch = read_block_batch(
             self.input_ds(), blocking, block_ids, dtype="float32",
             n_threads=read_threads(config),
+            device_source=(self.input_path, self.input_key,
+                           ("linear-read",), config),
         )
         a, b = self._coefficients(blocking, block_ids)
 
+        full_shape = (len(block_ids),) + tuple(blocking.block_shape)
         if self.mask_path:
             mask_ds = store.file_reader(self.mask_path, "r")[self.mask_key]
-            mask = np.zeros(batch.data.shape, dtype=bool)
+            mask = np.zeros(full_shape, dtype=bool)
             for i, bh in enumerate(batch.blocks):
                 m = mask_ds[bh.outer.slicing].astype(bool)
                 mask[i][tuple(slice(0, s) for s in m.shape)] = m
         else:
-            mask = np.ones(batch.data.shape, dtype=bool)
+            mask = np.ones(full_shape, dtype=bool)
         return batch, a, b, mask
+
+    def upload_batch(self, payload, blocking: Blocking, config):
+        batch, a, b, mask = payload
+        hbm.batch_device(batch, config)
+        return payload
+
+    def stack_payloads(self, payloads, blocking: Blocking, config):
+        return (
+            hbm.stack_block_batches([p[0] for p in payloads], config),
+            np.concatenate([p[1] for p in payloads], axis=0),
+            np.concatenate([p[2] for p in payloads], axis=0),
+            np.concatenate([p[3] for p in payloads], axis=0),
+        )
+
+    def unstack_results(self, result, counts, blocking: Blocking, config):
+        batch, out = result
+        return list(zip(
+            hbm.split_block_batch(batch, counts),
+            hbm.split_stacked(out, counts),
+        ))
 
     def compute_batch(self, payload, blocking: Blocking, config):
         batch, a, b, mask = payload
         from ..parallel.mesh import put_sharded
 
-        xb, n = put_sharded(batch.data, config)
+        db = hbm.batch_device(batch, config)
         ab, _ = put_sharded(np.asarray(a), config)
         bb, _ = put_sharded(np.asarray(b), config)
         mb, _ = put_sharded(mask, config)
-        out = _linear_batch(xb, ab, bb, mb)
-        return batch, np.asarray(out)[:n]
+        out = _linear_batch(db.arrays[0], ab, bb, mb)
+        return batch, np.asarray(out)[:db.n]
 
     def write_batch(self, result, blocking: Blocking, config):
         batch, out = result

@@ -173,7 +173,7 @@ class WatershedTask(VolumeTask):
             non_maximum_suppression=bool(config["non_maximum_suppression"]),
         )
 
-    def _load_mask_batch(self, batch) -> Optional[np.ndarray]:
+    def _load_mask_batch(self, batch, full_shape) -> Optional[np.ndarray]:
         if not self.mask_path:
             return None
         from .base import fusion_wrap
@@ -182,7 +182,6 @@ class WatershedTask(VolumeTask):
             store.file_reader(self.mask_path, "r")[self.mask_key],
             self.mask_path, self.mask_key,
         )
-        full_shape = batch.data.shape[1:]
         return np.stack([
             _pad_block(mask_ds[bh.outer.slicing].astype(bool), full_shape)
             for bh in batch.blocks
@@ -190,31 +189,115 @@ class WatershedTask(VolumeTask):
 
     # -- split batch protocol (three-stage executor pipeline) ---------------
 
+    def _read_tag(self, config):
+        """Device-cache transform tag: everything that changes the bytes
+        ``read_batch`` uploads (channel window + agglomeration; the
+        normalization is dtype-determined)."""
+        return (
+            "ws-read",
+            str(config.get("channel_begin", 0)),
+            str(config.get("channel_end")),
+            str(config.get("agglomerate_channels", "mean")),
+        )
+
     def read_batch(self, block_ids: List[int], blocking: Blocking, config):
-        """Stage 1: read (channel-agglomerated) halo'd blocks + masks."""
+        """Stage 1: read (channel-agglomerated) halo'd blocks + masks.
+        With the warm device-buffer cache armed (ctt-hbm) and the batch's
+        upload still HBM-resident from a previous job, the host read is
+        skipped entirely — the payload carries only geometry + masks."""
+        from ..parallel.dispatch import BlockBatch
+        from ..runtime import hbm
+
         in_ds = self.input_ds()
         halo = config.get("halo") or [0, 0, 0]
-        datas, blocks = [], []
         full_shape = tuple(
             bs + 2 * h for bs, h in zip(blocking.block_shape, halo)
         )
-        valids = []
-        for bid in block_ids:
-            bh = blocking.block_with_halo(bid, halo)
+        blocks = [blocking.block_with_halo(bid, halo) for bid in block_ids]
+        source = hbm.dataset_source(
+            in_ds, self.input_path, self.input_key, blocking,
+            list(block_ids), halo, self._read_tag(config), config,
+        )
+        if source is not None:
+            dc = hbm.cache()
+            hit = dc.get(source) if dc is not None else None
+            if hit is not None:
+                from ..obs import metrics as obs_metrics
+
+                obs_metrics.inc("device.uploads_skipped")
+                batch = BlockBatch(
+                    data=None, valid=None, blocks=blocks,
+                    block_ids=list(block_ids), source=source, device=hit,
+                )
+                return batch, None, self._load_mask_batch(batch, full_shape)
+        datas, valids = [], []
+        for bh in blocks:
             arr = _read_input_block(in_ds, bh.outer.slicing, config)
             datas.append(_pad_block(arr, full_shape))
             v = np.ones(arr.shape, dtype=bool)
             valids.append(_pad_block(v, full_shape, mode="zero"))
-            blocks.append(bh)
         batch_arr = np.stack(datas)
         valid_arr = np.stack(valids)
 
-        from ..parallel.dispatch import BlockBatch
-
         batch = BlockBatch(
-            data=batch_arr, valid=None, blocks=blocks, block_ids=list(block_ids)
+            data=batch_arr, valid=None, blocks=blocks,
+            block_ids=list(block_ids), source=source,
         )
-        return batch, valid_arr, self._load_mask_batch(batch)
+        return batch, valid_arr, self._load_mask_batch(batch, full_shape)
+
+    def _device_payload(self, batch, valid_arr, config):
+        """(data, valid, starts) on device through the warm buffer cache —
+        all three are deterministic functions of the signed store region
+        plus geometry, so they ride one cache entry; the mask (its own
+        dataset, its own freshness) is uploaded uncached per compute."""
+        from ..runtime import hbm
+
+        def build():
+            data = hbm.require_data(batch)
+            starts = np.array(
+                [bh.inner_local.begin for bh in batch.blocks], dtype=np.int32
+            )
+            xb, n = put_sharded(data, config)
+            vb, _ = put_sharded(valid_arr, config)
+            sb, _ = put_sharded(starts, config)
+            return hbm.DeviceBatch(
+                arrays=(xb, vb, sb), n=n,
+                nbytes=int(data.nbytes + valid_arr.nbytes + starts.nbytes),
+            )
+
+        return hbm.batch_device(batch, config, build=build)
+
+    def upload_batch(self, payload, blocking: Blocking, config):
+        """ctt-hbm transfer stage: batch k+1 crosses to HBM while batch
+        k's flood runs."""
+        batch, valid_arr, _mask = payload
+        self._device_payload(batch, valid_arr, config)
+        return payload
+
+    def stack_payloads(self, payloads, blocking: Blocking, config):
+        from ..runtime import hbm
+
+        batch = hbm.stack_block_batches([p[0] for p in payloads], config)
+        valids = [p[1] for p in payloads]
+        valid = (
+            np.concatenate(valids, axis=0)
+            if all(v is not None for v in valids) else None
+        )
+        masks = [p[2] for p in payloads]
+        mask = (
+            np.concatenate(masks, axis=0)
+            if all(m is not None for m in masks) else None
+        )
+        return batch, valid, mask
+
+    def unstack_results(self, result, counts, blocking: Blocking, config):
+        from ..runtime import hbm
+
+        batch, labels = result
+        return list(zip(
+            hbm.split_block_batch(batch, counts),
+            hbm.split_stacked(labels, counts),
+        ))
 
     def compute_batch(self, payload, blocking: Blocking, config):
         """Stage 2: ONE fused dispatch — flood → inner-box crop → CC
@@ -234,12 +317,9 @@ class WatershedTask(VolumeTask):
             has_halo,
             coarse_tile,
         )
-        starts = np.array(
-            [bh.inner_local.begin for bh in batch.blocks], dtype=np.int32
-        )
-        xb, n_real = put_sharded(batch.data, config)
-        vb, _ = put_sharded(valid_arr, config)
-        sb, _ = put_sharded(starts, config)
+        db = self._device_payload(batch, valid_arr, config)
+        xb, vb, sb = db.arrays
+        n_real = db.n
         if mask is None:
             labels = fused(xb, vb, sb)
         else:
@@ -535,7 +615,7 @@ class TwoPassWatershedTask(WatershedTask):
         batch = BlockBatch(
             data=batch_arr, valid=None, blocks=blocks, block_ids=list(block_ids)
         )
-        mask = self._load_mask_batch(batch)
+        mask = self._load_mask_batch(batch, full_shape)
 
         # tight size-filter bincount bound: own-seed CC ids are consecutive
         # (≤ N/2) and written ids only occupy the halo shell (pass-1 neighbors
@@ -660,7 +740,7 @@ class ShardedWatershedTask(VolumeSimpleTask):
         import jax as _jax
 
         from ..ops.relabel import relabel_consecutive_np
-        from ..parallel.mesh import get_mesh, put_from_store, resolve_devices
+        from ..parallel.mesh import get_mesh, resolve_devices
 
         config = {**self.global_config(), **self.get_task_config()}
         in_ds = store.file_reader(self.input_path, "r")[self.input_key]
@@ -677,9 +757,16 @@ class ShardedWatershedTask(VolumeSimpleTask):
 
         # stream shard-by-shard: peak host RAM on ingest is one shard.
         # Pad slabs sit on the foreground side of the threshold AFTER the
-        # kernel's inversion, exactly like the host-pad path
-        x_d = put_from_store(
-            in_ds, mesh, dtype=np.float32, pad_to=n_dev,
+        # kernel's inversion, exactly like the host-pad path.  The upload
+        # rides the warm device-buffer cache (ctt-hbm): a back-to-back
+        # serve job on the same volume skips the transfer entirely
+        from ..runtime import hbm
+
+        x_d = hbm.cached_put_from_store(
+            in_ds, mesh, source_path=self.input_path,
+            source_key=self.input_key,
+            tag=("sharded-ws-input", bool(invert)),
+            dtype=np.float32, pad_to=n_dev,
             pad_value=1.0 if invert else 0.0,
             transform=_normalize_host,
         )

@@ -63,9 +63,11 @@ Object-store backend (ctt-cloud):
     reads against an object store (the URL scheme, wire schema, and the
     local stub server contract are documented in that module);
   * remote datasets key the decoded-chunk LRU by the
-    ``(ETag, Last-Modified, Content-Length)`` HEAD signature instead of
-    the POSIX inode triple — warm entries cost one HEAD, not one GET,
-    making the LRU the latency shield for high-RTT stores;
+    ``(ETag, Last-Modified, Content-Length)`` signature instead of the
+    POSIX inode triple, and revalidate it ON the read: one conditional
+    GET (``If-None-Match``) answers 304 for a warm entry — zero body
+    bytes, one round trip, no separate HEAD — making the LRU the latency
+    shield for high-RTT stores;
   * remote chunk IO retries under ``store.remote_retries`` through the
     same backoff helper, with request-level fault sites
     ``store.remote_read`` / ``store.remote_write``;
@@ -190,6 +192,18 @@ class _DecodedChunkCache:
             old = self._entries.pop(path, None)
             if old is not None:
                 self._bytes -= old[1].nbytes
+
+    def peek(self, path: str) -> Optional[Tuple[Any, np.ndarray]]:
+        """The ``(signature, array)`` entry regardless of freshness — the
+        conditional-GET path (ctt-cloud) revalidates the signature on the
+        wire (``If-None-Match``) instead of against a local probe."""
+        with self._lock:
+            return self._entries.get(path)
+
+    def touch(self, path: str) -> None:
+        with self._lock:
+            if path in self._entries:
+                self._entries.move_to_end(path)
 
     def clear(self) -> None:
         with self._lock:
@@ -707,12 +721,16 @@ class Dataset:
         None if the chunk is unwritten.  The signature → read window is
         benign: a concurrent rewrite can at worst cache fresh content under
         the old signature, which the next reader's probe turns into a miss.
-        Remote datasets use the backend's ``(ETag, Last-Modified, size)``
-        signature — a warm hit costs one HEAD instead of one ranged GET,
-        and transient probe errors retry instead of degrading to
-        fill_value."""
+        Remote datasets revalidate over the wire instead of a separate
+        HEAD probe: ONE conditional GET (``If-None-Match`` on the cached
+        ETag) either returns 304 — the warm hit, zero body bytes — or the
+        fresh payload plus its new signature, so both the warm and the
+        cold path cost exactly one round trip (the HEAD that used to
+        precede every GET is folded in — ctt-cloud follow-up)."""
         p = self._chunk_path(grid_pos)
         backend = self._backend
+        if backend.is_remote and _CHUNK_CACHE.max_bytes > 0:
+            return self._decoded_chunk_remote(p, backend)
         sig = None
         if _CHUNK_CACHE.max_bytes > 0:
             try:
@@ -748,6 +766,67 @@ class Dataset:
             obs_metrics.inc("store.chunk_cache_misses")
             _CHUNK_CACHE.put(p, sig, full)
         return full
+
+    def _decoded_chunk_remote(self, p: str, backend) -> Optional[np.ndarray]:
+        """Remote chunk read through the LRU with wire revalidation: the
+        cached entry's ETag rides an ``If-None-Match`` conditional GET —
+        304 is the warm hit (one round trip, no body), anything else is
+        the fresh payload WITH its signature (no separate HEAD even on
+        the cold path)."""
+        entry = _CHUNK_CACHE.peek(p)
+        etag = entry[0][0] if entry is not None and entry[0] else None
+
+        def _load():
+            faults.check("store.read", path=p)
+            payload, sig = backend.read_bytes_versioned(p, etag)
+            if payload is None:
+                return None, sig  # 304: cached bytes still current
+            obs_metrics.inc("store.chunks_read")
+            obs_metrics.inc("store.bytes_read", len(payload))
+            return self._decode_classified(p, payload), sig
+
+        try:
+            full, sig = io_retry(
+                _load, what=f"read chunk {p}", counter=backend.retry_counter
+            )
+        except FileNotFoundError:
+            _CHUNK_CACHE.invalidate(p)
+            return None
+        if full is None:
+            obs_metrics.inc("store.chunk_cache_hits")
+            _CHUNK_CACHE.touch(p)
+            return entry[1]
+        full.setflags(write=False)
+        obs_metrics.inc("store.chunk_cache_misses")
+        _CHUNK_CACHE.put(p, sig, full)
+        return full
+
+    def region_signature(self, bb) -> Optional[tuple]:
+        """Per-chunk freshness signatures of every chunk overlapping
+        ``bb`` — the device-buffer cache's (ctt-hbm) invalidation key,
+        riding the exact signatures the decoded-chunk LRU uses (POSIX
+        ``(inode, mtime_ns, size)``, remote ``(ETag, Last-Modified,
+        Content-Length)``).  Unwritten chunks sign as None (they read as
+        fill_value — also content); a transient probe error returns None
+        for the whole region, which callers treat as "uncacheable this
+        round", never as a match."""
+        bb, _ = self._normalize_bb(bb)
+        positions = list(self._chunks_overlapping(bb))
+
+        def _one(grid_pos):
+            p = self._chunk_path(grid_pos)
+            try:
+                return self._backend.signature(p)
+            except FileNotFoundError:
+                return None
+
+        try:
+            sigs = self._backend.map(
+                _one, positions, getattr(self, "n_threads", 1)
+            )
+        except OSError:
+            return None
+        return tuple(sigs)
 
     def prefetch(self, bb, n_threads: Optional[int] = None) -> int:
         """Warm the decoded-chunk LRU with every chunk overlapping ``bb``,

@@ -39,11 +39,15 @@ S3/GCS HTTP gateway can serve:
   ``DELETE <key>``   → remove the object (or prefix/directory tree); 204.
 
 Freshness: the decoded-chunk LRU keys remote entries by the
-``(ETag, Last-Modified, Content-Length)`` HEAD signature — the object
+``(ETag, Last-Modified, Content-Length)`` signature — the object
 store's analog of the POSIX ``(inode, mtime_ns, size)`` triple — so a
-rewrite by any process anywhere is a cache miss, never stale data, and a
-warm LRU entry costs one HEAD instead of one ranged GET (the LRU is the
-latency shield that makes high-RTT stores usable).
+rewrite by any process anywhere is a cache miss, never stale data.
+Revalidation happens ON the read itself (``read_bytes_versioned``): a
+single GET with ``If-None-Match`` on the cached ETag answers 304 for a
+warm entry (one round trip, zero body bytes — the HEAD probe that used
+to precede every chunk GET is folded in) or delivers the fresh payload
+together with its new signature (the LRU is the latency shield that
+makes high-RTT stores usable).
 
 Resilience: every request checks the ``store.remote_read`` (GET/HEAD) or
 ``store.remote_write`` (PUT/DELETE) fault site, and transient failures
@@ -428,33 +432,86 @@ class HttpBackend(StoreBackend):
             return data
         # parallel multipart-style range reads for the tail
         offsets = list(range(len(data), total, split))
-
-        def _read_part(offset: int) -> bytes:
-            from .retry import io_retry
-
-            end = min(offset + split, total) - 1
-
-            def _fetch() -> bytes:
-                st, _, part, part_trunc = self._request(
-                    "GET", path, headers={"Range": f"bytes={offset}-{end}"}
-                )
-                if st not in (200, 206):
-                    self._raise_for(st, "GET", path)
-                if part_trunc or len(part) != end - offset + 1:
-                    raise OSError(
-                        errno.EIO,
-                        f"truncated range response for {path} "
-                        f"[{offset}, {end}]: got {len(part)} bytes",
-                    )
-                return part
-
-            return io_retry(
-                _fetch, what=f"range read {path}@{offset}",
-                counter=self.retry_counter,
+        parts = list(
+            self._pool("range").map(
+                lambda off: self._range_part(path, off, split, total), offsets
             )
-
-        parts = list(self._pool("range").map(_read_part, offsets))
+        )
         return data + b"".join(parts)
+
+    def read_bytes_versioned(
+        self, path: str, etag: Optional[str] = None,
+    ) -> Tuple[Optional[bytes], tuple]:
+        """One conditional GET folding the freshness HEAD into the read
+        (the ctt-cloud follow-up): returns ``(None, sig)`` on 304 — the
+        caller's cached bytes are still current, zero body crossed the
+        wire — or ``(payload, sig)`` where ``sig`` is the
+        ``(ETag, Last-Modified, Content-Length)`` triple taken from the
+        GET response itself, byte-compatible with :meth:`signature`.
+        Large objects keep the multipart range-read tail of
+        :meth:`read_bytes` (continuation ranges are never conditional)."""
+        split = self.range_bytes
+        headers: Dict[str, str] = {}
+        if etag:
+            headers["If-None-Match"] = etag
+        if split > 0:
+            headers["Range"] = f"bytes=0-{split - 1}"
+        status, hdrs, data, truncated = self._request(
+            "GET", path, headers=headers
+        )
+        if status == 304:
+            return None, (
+                hdrs.get("ETag") or etag,
+                hdrs.get("Last-Modified"),
+                hdrs.get("Content-Length"),
+            )
+        if status not in (200, 206):
+            self._raise_for(status, "GET", path)
+        total = (
+            _content_range_total(hdrs.get("Content-Range"))
+            if status == 206 else None
+        )
+        sig = (
+            hdrs.get("ETag"),
+            hdrs.get("Last-Modified"),
+            str(total) if total is not None else hdrs.get("Content-Length"),
+        )
+        if status == 200 or truncated or total is None or total <= len(data):
+            # whole object (or short first window: decode classifies and
+            # the shared retry re-fetches, the torn-POSIX-chunk contract)
+            return data, sig
+        offsets = list(range(len(data), total, split))
+        parts = list(
+            self._pool("range").map(
+                lambda off: self._range_part(path, off, split, total), offsets
+            )
+        )
+        return data + b"".join(parts), sig
+
+    def _range_part(self, path: str, offset: int, split: int,
+                    total: int) -> bytes:
+        from .retry import io_retry
+
+        end = min(offset + split, total) - 1
+
+        def _fetch() -> bytes:
+            st, _, part, part_trunc = self._request(
+                "GET", path, headers={"Range": f"bytes={offset}-{end}"}
+            )
+            if st not in (200, 206):
+                self._raise_for(st, "GET", path)
+            if part_trunc or len(part) != end - offset + 1:
+                raise OSError(
+                    errno.EIO,
+                    f"truncated range response for {path} "
+                    f"[{offset}, {end}]: got {len(part)} bytes",
+                )
+            return part
+
+        return io_retry(
+            _fetch, what=f"range read {path}@{offset}",
+            counter=self.retry_counter,
+        )
 
     def write_bytes(self, path: str, payload: bytes) -> None:
         status, _, _, _ = self._request("PUT", path, body=payload)

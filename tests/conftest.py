@@ -23,6 +23,24 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _hbm_isolated():
+    """ctt-hbm: a test that arms the warm device-buffer cache (directly,
+    or by starting an in-process serve daemon whose context installs one
+    process-wide) must not leak resident entries — or an enabled budget —
+    into later tests' store-traffic accounting.  Restore the environment
+    resolution (default 0 = disabled) and drop cached device arrays."""
+    yield
+    from cluster_tools_tpu.runtime.workflow import ExecutionContext
+
+    ctx = ExecutionContext._PROCESS
+    if ctx is not None and ctx._device_cache is not None:
+        from cluster_tools_tpu.runtime import hbm
+
+        ctx._device_cache.max_bytes = hbm.cache_budget_bytes()
+        ctx._device_cache.clear()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

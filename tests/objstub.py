@@ -5,7 +5,9 @@ Serves a directory tree over the small object-store HTTP subset the
 ``cluster_tools_tpu/utils/store_backend.py``):
 
   ``GET /key``     → 200 + bytes; ``Range: bytes=a-b`` → 206 +
-                     ``Content-Range``; a directory returns a JSON array
+                     ``Content-Range``; ``If-None-Match`` matching the
+                     current ETag → 304 with no body (the warm-hit
+                     revalidation); a directory returns a JSON array
                      of child names with ``X-CTT-Dir: 1``; 404 if absent.
   ``HEAD /key``    → headers only: ``ETag`` (mtime_ns-size, changes on
                      every atomic replace), ``Last-Modified``,
@@ -168,9 +170,16 @@ class _Handler(BaseHTTPRequestHandler):
                 ("Content-Type", "application/json"), ("X-CTT-Dir", "1"),
             ])
             return
+        headers = self._object_headers(p)
+        # conditional GET: a matching If-None-Match answers 304 with no
+        # body — the warm-hit revalidation the client's decoded-chunk LRU
+        # rides instead of a separate HEAD probe
+        inm = self.headers.get("If-None-Match")
+        if inm and inm.strip() == dict(headers)["ETag"]:
+            self._send(304, headers=headers)
+            return
         with open(p, "rb") as f:
             data = f.read()
-        headers = self._object_headers(p)
         status = 200
         rng = self.headers.get("Range")
         if rng:

@@ -1,0 +1,486 @@
+"""ctt-hbm tests: device-resident pipelines.
+
+Covers the PR acceptance contract:
+  * DeviceBufferCache hit/miss/eviction at the budget edge, with explicit
+    ``.delete()`` on evicted device batches;
+  * invalidation on store rewrite — POSIX (inode/mtime signature) and
+    remote (ETag via the stub object store);
+  * fused (stacked) dispatch byte parity vs the per-batch and per-block
+    paths across the converted kernels (threshold, minfilter, linear,
+    block CC, watershed);
+  * double-buffered upload-stage determinism at depth/stack > 1;
+  * serve two-job warm run: the second job on the same volume skips every
+    upload (``device.uploads_skipped`` moves, ``device.upload_bytes``
+    does not), byte-identical output;
+  * disabled-overhead smoke — ``CTT_HBM_CACHE_MB=0`` (the default) plus
+    ``prefetch: false`` restore the pre-hbm execution: no sources, no
+    entries, no new counters.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.obs import metrics as obs_metrics
+from cluster_tools_tpu.obs import trace as obs_trace
+from cluster_tools_tpu.runtime import build, config as cfg, hbm
+from cluster_tools_tpu.utils import store
+from cluster_tools_tpu.utils.store import file_reader
+
+
+@pytest.fixture
+def traced(tmp_path):
+    obs_metrics.reset()
+    obs_trace.enable(str(tmp_path / "trace"), "hbm_test", export_env=False)
+    yield
+    obs_trace.disable()
+    obs_metrics.reset()
+
+
+@pytest.fixture
+def warm_cache(traced):
+    """Arm the process device-buffer cache for one test (the conftest
+    autouse fixture restores the disabled default afterwards)."""
+    hbm.set_cache_budget(256 * 1024 * 1024)
+    yield hbm.cache()
+
+
+def _counters():
+    return dict(obs_metrics.snapshot()["counters"])
+
+
+def _delta(before, after, name):
+    return after.get(name, 0) - before.get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# DeviceBufferCache unit behavior
+
+
+class _FakeArray:
+    def __init__(self):
+        self.deleted = False
+
+    def delete(self):
+        self.deleted = True
+
+
+def _entry(nbytes):
+    arr = _FakeArray()
+    return arr, hbm.DeviceBatch(arrays=(arr,), n=1, nbytes=nbytes)
+
+
+class TestDeviceBufferCache:
+    def test_hit_miss_eviction_at_budget_edge(self, traced):
+        dc = hbm.DeviceBufferCache(100)
+        a_arr, a = _entry(60)
+        b_arr, b = _entry(40)
+        c_arr, c = _entry(10)
+        sa = hbm.BatchSource(key=("a",), sig=(1,))
+        sb = hbm.BatchSource(key=("b",), sig=(1,))
+        sc = hbm.BatchSource(key=("c",), sig=(1,))
+        dc.put(sa, a)
+        dc.put(sb, b)  # 60 + 40 = exactly at budget: both resident
+        assert dc.get(sa) is a and dc.get(sb) is b
+        assert dc.nbytes == 100 and len(dc) == 2
+        # +10 pushes past the budget: LRU (a, refreshed least recently...
+        # get() order above made a then b most recent, so a evicts first)
+        dc.put(sc, c)
+        assert dc.get(sa) is None
+        assert a_arr.deleted, "eviction must .delete() the device arrays"
+        assert dc.get(sb) is b and dc.get(sc) is c
+        assert not b_arr.deleted and not c_arr.deleted
+
+    def test_oversized_entry_never_stored(self, traced):
+        dc = hbm.DeviceBufferCache(50)
+        arr, batch = _entry(51)
+        src = hbm.BatchSource(key=("big",), sig=())
+        dc.put(src, batch)
+        assert dc.get(src) is None and len(dc) == 0
+
+    def test_signature_mismatch_is_miss_and_evicts(self, traced):
+        dc = hbm.DeviceBufferCache(100)
+        arr, batch = _entry(10)
+        dc.put(hbm.BatchSource(key=("k",), sig=(1, 2)), batch)
+        stale = dc.get(hbm.BatchSource(key=("k",), sig=(1, 3)))
+        assert stale is None
+        assert arr.deleted, "a rewritten source must drop the stale buffers"
+        assert len(dc) == 0
+        assert _counters().get("device.cache_evictions", 0) >= 1
+
+    def test_clear_deletes(self, traced):
+        dc = hbm.DeviceBufferCache(100)
+        arr, batch = _entry(10)
+        dc.put(hbm.BatchSource(key=("k",), sig=()), batch)
+        dc.clear()
+        assert arr.deleted and dc.nbytes == 0
+
+
+# ---------------------------------------------------------------------------
+# store-rewrite invalidation (POSIX + remote), via the real source probe
+
+
+def _source_for(ds, path, block_shape, config=None):
+    from cluster_tools_tpu.utils.blocking import Blocking
+
+    blocking = Blocking(ds.shape, block_shape)
+    return hbm.dataset_source(
+        ds, path, "x", blocking, list(range(blocking.n_blocks)),
+        (0, 0, 0), ("t",), config or {"target": "local"},
+    )
+
+
+class TestStoreRewriteInvalidation:
+    def test_posix_rewrite_invalidates(self, tmp_path, warm_cache, rng):
+        path = str(tmp_path / "v.n5")
+        data = rng.random((8, 16, 16)).astype("float32")
+        file_reader(path).create_dataset("x", data=data, chunks=(4, 8, 8))
+        ds = file_reader(path, "a")["x"]
+        src = _source_for(ds, path, (4, 8, 8))
+        assert src is not None
+        arr, batch = _entry(10)
+        warm_cache.put(src, batch)
+        assert warm_cache.get(_source_for(ds, path, (4, 8, 8))) is batch
+        # rewrite one chunk: os.replace changes the inode -> new signature
+        ds[0:4, 0:8, 0:8] = data[0:4, 0:8, 0:8] * 2.0
+        src2 = _source_for(ds, path, (4, 8, 8))
+        assert src2.sig != src.sig
+        assert warm_cache.get(src2) is None
+        assert arr.deleted
+
+    def test_remote_etag_rewrite_invalidates(self, tmp_path, warm_cache,
+                                             rng):
+        objstub = pytest.importorskip("objstub")
+        with objstub.StubObjectStore(str(tmp_path / "objroot")) as stub:
+            url = f"{stub.url}/v.zarr"
+            data = rng.random((8, 8, 8)).astype("float32")
+            file_reader(url).create_dataset("x", data=data, chunks=(8, 8, 8))
+            ds = file_reader(url, "r")["x"]
+            src = _source_for(ds, url, (8, 8, 8))
+            assert src is not None
+            arr, batch = _entry(10)
+            warm_cache.put(src, batch)
+            assert warm_cache.get(_source_for(ds, url, (8, 8, 8))) is batch
+            # foreign rewrite straight into the served tree: the ETag
+            # (mtime_ns-size) changes, the resident upload must miss
+            other = str(tmp_path / "other.zarr")
+            file_reader(other).create_dataset(
+                "x", data=(data * 2 + 1).astype("float32"), chunks=(8, 8, 8)
+            )
+            os.replace(
+                os.path.join(other, "x", "0.0.0"),
+                os.path.join(stub.root, "v.zarr", "x", "0.0.0"),
+            )
+            src2 = _source_for(ds, url, (8, 8, 8))
+            assert src2.sig != src.sig
+            assert warm_cache.get(src2) is None
+            assert arr.deleted
+
+
+# ---------------------------------------------------------------------------
+# fused (stacked) dispatch parity across the converted kernels
+
+
+def _write_vol(tmp_path, rng, shape=(8, 32, 32), chunks=(4, 8, 8)):
+    path = str(tmp_path / "data.n5")
+    if not os.path.exists(path):
+        from scipy import ndimage
+
+        raw = ndimage.gaussian_filter(rng.random(shape), (1.0, 2.0, 2.0))
+        raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+        file_reader(path).create_dataset("bnd", data=raw, chunks=chunks)
+    return path
+
+
+def _gconf(tmp_path, key, **over):
+    config_dir = str(tmp_path / f"configs_{key}")
+    conf = {"block_shape": [4, 8, 8], "target": "tpu",
+            "device_batch_size": 1, "devices": [0], "pipeline_depth": 3}
+    conf.update(over)
+    cfg.write_global_config(config_dir, conf)
+    return config_dir
+
+
+def _task_cases(tmp_path, rng, key):
+    """(name, task) pairs covering every converted kernel, writing to
+    per-run output keys."""
+    from cluster_tools_tpu.tasks.masking import MinfilterTask
+    from cluster_tools_tpu.tasks.threshold import ThresholdTask
+    from cluster_tools_tpu.tasks.thresholded_components import (
+        BlockComponentsTask,
+    )
+    from cluster_tools_tpu.tasks.transformations import (
+        LinearTransformationTask,
+    )
+    from cluster_tools_tpu.tasks.watershed import WatershedTask
+
+    path = _write_vol(tmp_path, rng)
+    mask_path = str(tmp_path / "mask.n5")
+    if not store._exists(os.path.join(mask_path, "m")):
+        file_reader(mask_path).create_dataset(
+            "m", data=(rng.random((8, 32, 32)) > 0.05).astype("uint8"),
+            chunks=(4, 8, 8),
+        )
+    trafo = str(tmp_path / "trafo.json")
+    if not os.path.exists(trafo):
+        import json
+
+        with open(trafo, "w") as f:
+            json.dump({"a": 1.5, "b": -0.1}, f)
+
+    def mk(cls, cfg_name, conf, **kw):
+        config_dir = _gconf(tmp_path, f"{key}_{cfg_name}",
+                            **conf.pop("_global", {}))
+        if conf:
+            cfg.write_config(config_dir, cls.task_name, conf)
+        return cls(
+            str(tmp_path / f"tmp_{key}_{cfg_name}"), config_dir,
+            input_path=path, input_key="bnd",
+            output_path=path, output_key=f"{cfg_name}_{key}", **kw,
+        )
+
+    return [
+        ("threshold", mk(ThresholdTask, "thr", {"threshold": 0.5})),
+        ("minfilter", mk(MinfilterTask, "mf", {"filter_shape": [2, 4, 4]})),
+        ("linear", mk(LinearTransformationTask, "lin", {},
+                      transformation=trafo)),
+        ("components", mk(BlockComponentsTask, "cc", {"threshold": 0.5})),
+        ("watershed", mk(WatershedTask, "ws",
+                         {"threshold": 0.5, "sigma_seeds": 1.6,
+                          "size_filter": 10, "halo": [2, 4, 4]})),
+    ]
+
+
+class TestStackedDispatchParity:
+    def test_fused_stack_byte_identical_per_kernel(self, tmp_path, traced,
+                                                   rng):
+        """hbm_stack=3 (aggregated dispatch) vs the per-block path (the
+        byte oracle — the unstacked batch path is exercised by the rest
+        of the suite): identical arrays for every converted kernel, and
+        the aggregated run issues fewer dispatches than blocks."""
+        path = _write_vol(tmp_path, rng)
+        stacked = dict(_task_cases(tmp_path, rng, "stack"))
+        perblock = dict(_task_cases(tmp_path, rng, "pb"))
+        before = _counters()
+        for name, t in stacked.items():
+            # rewrite the global config with aggregation on
+            cfg.write_global_config(t.config_dir, {
+                "block_shape": [4, 8, 8], "target": "tpu",
+                "device_batch_size": 1, "devices": [0],
+                "pipeline_depth": 3, "hbm_stack": 3,
+            })
+            assert build([t])
+        after = _counters()
+        for name, t in perblock.items():
+            cfg.write_global_config(t.config_dir, {
+                "block_shape": [4, 8, 8], "target": "local", "max_jobs": 1,
+            })
+            assert build([t])
+        f = file_reader(path, "r")
+        for name in stacked:
+            b = f[f"{_key_of(stacked, name)}"][:]
+            c = f[f"{_key_of(perblock, name)}"][:]
+            np.testing.assert_array_equal(b, c, err_msg=name)
+        n_blocks = 2 * 4 * 4
+        dispatches = _delta(before, after, "device.dispatches")
+        assert 0 < dispatches < 5 * n_blocks
+        assert _delta(before, after, "device.fused_blocks") > 0
+
+
+def _key_of(cases, name):
+    return cases[name].output_key
+
+
+# ---------------------------------------------------------------------------
+# double-buffered upload stage
+
+
+class TestUploadStage:
+    def test_double_buffer_depth2_determinism(self, tmp_path, traced, rng):
+        """The transfer stage (prefetch on, depth 3) must be run-to-run
+        deterministic and identical to the serial pre-hbm path
+        (prefetch: false)."""
+        from cluster_tools_tpu.tasks.watershed import WatershedTask
+
+        path = _write_vol(tmp_path, rng)
+        outs = {}
+        for tag, over in (
+            ("up1", {}), ("up2", {}),
+            ("plain", {"prefetch": False, "pipeline_depth": 1}),
+        ):
+            config_dir = _gconf(tmp_path, tag, **over)
+            cfg.write_config(config_dir, "watershed",
+                             {"threshold": 0.5, "sigma_seeds": 1.6,
+                              "size_filter": 10, "halo": [2, 4, 4]})
+            t = WatershedTask(
+                str(tmp_path / f"tmp_{tag}"), config_dir,
+                input_path=path, input_key="bnd",
+                output_path=path, output_key=f"ws_{tag}",
+            )
+            assert build([t])
+            outs[tag] = file_reader(path, "r")[f"ws_{tag}"][:]
+        np.testing.assert_array_equal(outs["up1"], outs["up2"])
+        np.testing.assert_array_equal(outs["up1"], outs["plain"])
+        # the upload stage actually ran on its transfer thread
+        assert _counters().get("executor.stage_upload_s", 0) > 0
+
+    def test_warm_second_build_skips_uploads(self, tmp_path, warm_cache,
+                                             rng):
+        """Two builds over the same volume in one process: the second
+        serves every batch from the warm buffer cache — zero new upload
+        bytes, nonzero skips, identical bytes."""
+        from cluster_tools_tpu.tasks.threshold import ThresholdTask
+
+        path = _write_vol(tmp_path, rng)
+
+        def run(tag):
+            config_dir = _gconf(tmp_path, tag)
+            t = ThresholdTask(
+                str(tmp_path / f"tmp_{tag}"), config_dir,
+                input_path=path, input_key="bnd",
+                output_path=path, output_key=f"thr_{tag}",
+            )
+            assert build([t])
+
+        # output readbacks happen AFTER both measured windows — they are
+        # themselves codec reads and would drown the input accounting
+        c0 = _counters()
+        run("cold")
+        c1 = _counters()
+        run("warm")
+        c2 = _counters()
+        f = file_reader(path, "r")
+        np.testing.assert_array_equal(f["thr_cold"][:], f["thr_warm"][:])
+        assert _delta(c0, c1, "device.upload_bytes") > 0
+        assert _delta(c1, c2, "device.upload_bytes") == 0
+        assert _delta(c1, c2, "device.uploads_skipped") > 0
+        # the warm run ALSO skipped the host input reads (probe-hit
+        # stubs): zero codec misses beyond the advisory LRU prefetches,
+        # which all hit the decoded-chunk LRU warmed by the cold run
+        assert _delta(c1, c2, "store.chunk_cache_misses") == 0
+
+
+# ---------------------------------------------------------------------------
+# serve: two-job warm run
+
+
+class TestServeWarm:
+    def test_two_job_warm_run_skips_uploads(self, tmp_path, rng):
+        from cluster_tools_tpu.runtime.workflow import ExecutionContext
+        from cluster_tools_tpu.serve import ServeClient, ServeDaemon
+
+        was_on = obs_trace.enabled()
+        if not was_on:
+            obs_trace.enable(str(tmp_path / "trace"), "hbm_serve",
+                             export_env=False)
+        prev_ctx = ExecutionContext._PROCESS
+        d = ServeDaemon(str(tmp_path / "state"), config={"concurrency": 1})
+        d.start()
+        try:
+            client = ServeClient(state_dir=str(tmp_path / "state"))
+            path = _write_vol(tmp_path, rng)
+
+            def submit(tag):
+                return client.submit_and_wait(
+                    "WatershedWorkflow",
+                    {
+                        "tmp_folder": str(tmp_path / f"tmp_{tag}"),
+                        "config_dir": str(tmp_path / f"configs_s_{tag}"),
+                        "input_path": path, "input_key": "bnd",
+                        "output_path": path, "output_key": f"ws_{tag}",
+                    },
+                    configs={
+                        "global": {"block_shape": [4, 8, 8],
+                                   "target": "tpu", "devices": [0],
+                                   "device_batch_size": 1,
+                                   "pipeline_depth": 3},
+                        "watershed": {"threshold": 0.5, "sigma_seeds": 1.6,
+                                      "size_filter": 10, "halo": [2, 4, 4]},
+                    },
+                    timeout_s=300,
+                )
+
+            c0 = _counters()
+            s1 = submit("j1")
+            c1 = _counters()
+            s2 = submit("j2")
+            c2 = _counters()
+            assert s1["result"]["ok"] and s2["result"]["ok"]
+            f = file_reader(path, "r")
+            np.testing.assert_array_equal(f["ws_j1"][:], f["ws_j2"][:])
+            assert _delta(c0, c1, "device.upload_bytes") > 0
+            assert _delta(c1, c2, "device.upload_bytes") == 0
+            assert _delta(c1, c2, "device.uploads_skipped") >= 1
+        finally:
+            d.request_drain()
+            if d._httpd is not None:
+                d._httpd.shutdown()
+                d._httpd.server_close()
+            for t in d._threads:
+                if t.name.startswith("ctt-serve-exec"):
+                    t.join(timeout=30)
+            ExecutionContext._PROCESS = prev_ctx
+            if not was_on:
+                obs_trace.disable()
+            obs_metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# disabled-overhead smoke + watch line
+
+
+class TestDisabledAndWatch:
+    def test_disabled_no_sources_no_counters(self, tmp_path, traced, rng):
+        """CTT_HBM_CACHE_MB=0 (the default): no batch sources, no cache
+        entries, no device.upload/skip accounting — the pre-hbm shape."""
+        from cluster_tools_tpu.parallel.dispatch import read_block_batch
+        from cluster_tools_tpu.tasks.threshold import ThresholdTask
+        from cluster_tools_tpu.utils.blocking import Blocking
+
+        assert hbm.cache() is None
+        path = _write_vol(tmp_path, rng)
+        ds = file_reader(path, "r")["bnd"]
+        batch = read_block_batch(
+            ds, Blocking((8, 32, 32), (4, 8, 8)), [0, 1], dtype="float32",
+            device_source=(path, "bnd", ("t",), {"target": "local"}),
+        )
+        assert batch.source is None and batch.device is None
+        config_dir = _gconf(tmp_path, "off")
+        t = ThresholdTask(
+            str(tmp_path / "tmp_off"), config_dir,
+            input_path=path, input_key="bnd",
+            output_path=path, output_key="thr_off",
+        )
+        assert build([t])
+        c = _counters()
+        assert c.get("device.uploads_skipped", 0) == 0
+        assert c.get("device.cache_evictions", 0) == 0
+
+    def test_watch_renders_device_line(self, tmp_path):
+        import json
+
+        from cluster_tools_tpu.obs.live import LiveRun, format_watch
+
+        run = str(tmp_path / "run")
+        os.makedirs(run)
+        with open(os.path.join(run, "metrics.p1.json"), "w") as f:
+            json.dump({
+                "counters": {
+                    "device.upload_bytes": 2.5e6,
+                    "device.uploads_skipped": 3,
+                    "device.dispatches": 7, "device.fused_blocks": 12,
+                    "device.cache_evictions": 1,
+                },
+                "gauges": {"device.cache_bytes": 1.5e6,
+                           "device.inflight_uploads": 1},
+            }, f)
+        text = format_watch(LiveRun(run).poll())
+        line = next(l for l in text.splitlines()
+                    if l.strip().startswith("device:"))
+        assert "uploaded 2.5 MB" in line
+        assert "skipped 3" in line
+        assert "dispatches 7" in line
+        assert "fused blocks 12" in line
+        assert "evictions 1" in line
+        assert "cache 1.5 MB" in line and "inflight 1" in line

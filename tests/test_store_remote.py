@@ -146,9 +146,11 @@ class TestRemoteRoundtrip:
 class TestRemoteChunkLRU:
     def test_warm_vs_cold_hit_accounting(self, tmp_path, stub, rng,
                                          traced_metrics):
-        """Cold read: one GET + one miss per chunk.  Warm read: every
-        chunk an LRU hit — NO further GETs cross the wire, only the HEAD
-        freshness probes (the latency shield)."""
+        """Cold read: ONE conditional GET + one miss per chunk (the old
+        HEAD-then-GET pair is folded into the GET).  Warm read: every
+        chunk an LRU hit revalidated by a 304 — still one request per
+        chunk, but zero payload bytes cross the wire and nothing reaches
+        the codec boundary (the latency shield, ctt-cloud follow-up)."""
         _fresh_cache()
         data = rng.random((16, 16, 16)).astype("float32")
         url = f"{stub.url}/lru.zarr"
@@ -161,22 +163,22 @@ class TestRemoteChunkLRU:
         b0 = snap()
         assert np.array_equal(ds[:], data)
         b1 = snap()
-        cold_misses = b1.get("store.chunk_cache_misses", 0) - b0.get(
-            "store.chunk_cache_misses", 0
-        )
-        cold_chunks = b1.get("store.chunks_read", 0) - b0.get(
-            "store.chunks_read", 0
-        )
-        assert cold_misses == 8 and cold_chunks == 8
+
+        def delta(a, b, name):
+            return b.get(name, 0) - a.get(name, 0)
+
+        assert delta(b0, b1, "store.chunk_cache_misses") == 8
+        assert delta(b0, b1, "store.chunks_read") == 8
+        # the HEAD fold: a cold chunk costs exactly ONE wire request
+        assert delta(b0, b1, "store.remote_reads") == 8
         assert np.array_equal(ds[:], data)
         b2 = snap()
-        assert b2.get("store.chunk_cache_hits", 0) - b1.get(
-            "store.chunk_cache_hits", 0
-        ) == 8
-        # warm: zero chunk payloads crossed the codec boundary
-        assert b2.get("store.chunks_read", 0) == b1.get(
-            "store.chunks_read", 0
-        )
+        assert delta(b1, b2, "store.chunk_cache_hits") == 8
+        # warm: one 304 revalidation per chunk, zero chunk payloads
+        # crossed the codec boundary, zero body bytes crossed the wire
+        assert delta(b1, b2, "store.remote_reads") == 8
+        assert delta(b1, b2, "store.chunks_read") == 0
+        assert delta(b1, b2, "store.remote_bytes_read") == 0
 
     def test_etag_change_invalidates(self, tmp_path, stub, rng):
         """An out-of-band rewrite (another process, another host) changes
@@ -443,7 +445,8 @@ class TestRemotePipeline:
             self._ws_run(td, "remote", f"{url}/data.n5")
             mid = dict(traced_metrics.snapshot()["counters"])
             # warm-LRU rerun (same input volume, fresh scratch): reads are
-            # HEAD freshness probes + LRU hits — the latency-shield run
+            # 304 conditional-GET revalidations + LRU hits — the
+            # latency-shield run
             self._ws_run(td, "remote_warm", f"{url}/data.n5",
                          out_key="ws2")
             after = dict(traced_metrics.snapshot()["counters"])

@@ -953,6 +953,130 @@ if [ "$cloud_rc" -ne 0 ]; then
     exit "$cloud_rc"
 fi
 
+echo "== ctt-hbm smoke (serve daemon: second job zero upload bytes, fused dispatches < blocks, byte-identical) =="
+hbm_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$hbm_tmp" <<'PY'
+import hashlib, json, os, signal, subprocess, sys, time
+
+td = sys.argv[1]
+state_dir = os.path.join(td, "state")
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+from scipy import ndimage
+from cluster_tools_tpu.serve import ServeClient
+from cluster_tools_tpu.utils import file_reader
+
+path = os.path.join(td, "d.n5")
+rng = np.random.default_rng(0)
+raw = ndimage.gaussian_filter(rng.random((8, 32, 32)), (1.0, 2.0, 2.0))
+raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+file_reader(path).create_dataset("bnd", data=raw, chunks=(4, 8, 8))
+n_blocks = 2 * 4 * 4
+
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "cluster_tools_tpu.serve",
+     "--state-dir", state_dir],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+)
+try:
+    client = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        assert daemon.poll() is None, daemon.stderr.read()
+        try:
+            client = ServeClient(state_dir=state_dir)
+            client.healthz()
+            break
+        except Exception:
+            time.sleep(0.1)
+    assert client is not None, "daemon never became healthy"
+
+    def scrape():
+        return {
+            ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+            for ln in client.metrics_text().splitlines()
+            if ln and not ln.startswith("#")
+        }
+
+    # the same small watershed twice (fresh tmp/output per job): the
+    # second job must be served entirely from the warm HBM buffer cache
+    def submit(tag):
+        return client.submit_and_wait(
+            "WatershedWorkflow",
+            {"tmp_folder": os.path.join(td, f"tmp_{tag}"),
+             "config_dir": os.path.join(td, f"configs_{tag}"),
+             "input_path": path, "input_key": "bnd",
+             "output_path": path, "output_key": f"ws_{tag}"},
+            configs={
+                "global": {"block_shape": [4, 8, 8], "target": "tpu",
+                           "device_batch_size": 1, "pipeline_depth": 3,
+                           "hbm_stack": 4},
+                "watershed": {"threshold": 0.5, "sigma_seeds": 1.6,
+                              "size_filter": 10, "halo": [2, 4, 4]},
+            },
+            timeout_s=300,
+        )
+
+    m0 = scrape()
+    s1 = submit("j1")
+    m1 = scrape()
+    s2 = submit("j2")
+    m2 = scrape()
+    assert s1["result"]["ok"] and s2["result"]["ok"]
+
+    def delta(a, b, name):
+        return b.get(name, 0.0) - a.get(name, 0.0)
+
+    up = "ctt_device_upload_bytes_total"
+    assert delta(m0, m1, up) > 0, (m0, m1)
+    # second job: ZERO new upload bytes (warm HBM), >= 1 skip
+    assert delta(m1, m2, up) == 0, (m1, m2)
+    assert delta(m1, m2, "ctt_device_uploads_skipped_total") >= 1
+    # aggregated dispatch: fused dispatch count < block count
+    disp = delta(m1, m2, "ctt_device_dispatches_total")
+    assert 0 < disp < n_blocks, (disp, n_blocks)
+    assert delta(m0, m1, "ctt_device_fused_blocks_total") > 0
+
+    # byte-identity incl. chunk digests between the two jobs' outputs
+    f = file_reader(path, "r")
+    assert np.array_equal(f["ws_j1"][:], f["ws_j2"][:])
+
+    def digest(root):
+        h = hashlib.sha256()
+        for dp, dns, fns in os.walk(root):
+            dns.sort()
+            for n in sorted(fns):
+                p = os.path.join(dp, n)
+                h.update(os.path.relpath(p, root).encode())
+                h.update(open(p, "rb").read())
+        return h.hexdigest()
+
+    assert digest(os.path.join(path, "ws_j1")) == digest(
+        os.path.join(path, "ws_j2")
+    )
+    print("hbm smoke ok: warm job zero upload bytes,",
+          int(disp), "fused dispatches for", n_blocks,
+          "blocks, chunk digests identical")
+finally:
+    daemon.send_signal(signal.SIGTERM)
+    try:
+        daemon.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        daemon.kill()
+        daemon.wait(timeout=30)
+PY
+hbm_rc=$?
+rm -rf "$hbm_tmp"
+if [ "$hbm_rc" -ne 0 ]; then
+    echo "hbm smoke failed (rc=$hbm_rc): warm-HBM upload accounting," \
+         "dispatch aggregation, or byte-identity regressed" >&2
+    exit "$hbm_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
