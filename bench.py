@@ -938,6 +938,29 @@ def bench_ws_e2e(x, block_shape):
         except Exception as e:
             log(f"[ws-e2e] ctt-hbm bench failed: {e}")
         try:
+            # ctt-hier: build the merge hierarchy once through a serve
+            # daemon, sweep thresholds as warm resegment jobs vs a full
+            # pipeline re-run per threshold (pinned cpu: amortization
+            # structure, not kernel throughput)
+            from bench_e2e_lib import run_hier_pipeline
+
+            hier_res = run_hier_pipeline()
+            res.update(hier_res)
+            log(
+                "[ws-e2e] ctt-hier one-flood hierarchy: build "
+                f"{hier_res['ws_e2e_hier_build_wall_s']} s "
+                f"({hier_res['ws_e2e_hier_edges']} edges), warm sweep "
+                f"{hier_res['ws_e2e_hier_sweep_ms_warm']} ms vs full "
+                f"re-run {hier_res['ws_e2e_hier_full_rerun_s']} s "
+                f"({hier_res['ws_e2e_hier_sweep_speedup']}x), volume "
+                f"re-cut {hier_res['ws_e2e_hier_recut_volume_s']} s, "
+                f"warm upload bytes "
+                f"{hier_res['ws_e2e_hier_upload_bytes_warm']}, parity "
+                f"{hier_res['ws_e2e_hier_parity']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] ctt-hier bench failed: {e}")
+        try:
             # ctt-cloud: the same watershed against the stub object store
             # (subprocess HTTP server) vs POSIX — remote walls, IO hidden
             # behind compute, and chunk-digest parity

@@ -76,6 +76,16 @@ COUNTERS = frozenset({
     "device.dispatches",        # device program launches (batch grain)
     "device.fused_blocks",      # blocks that rode an aggregated (stacked)
                                 # dispatch — hbm_stack > 1 economics
+    "device.deferred_deletes",  # evicted batches whose .delete() waited
+                                # for the active dispatch guards to exit
+                                # (the eviction/in-flight race fix)
+
+    # ops/hier.py + tasks/hier.py — ctt-hier one-flood hierarchical
+    # segmentation (host-side emission only, never inside jit)
+    "hier.tables_built",        # blocks whose in-block merge table landed
+    "hier.edges",               # saddle edges persisted into an artifact
+    "hier.cut_edges",           # edges selected (saddle <= t) across cuts
+    "hier.resegment_jobs",      # serve `resegment` jobs run to success
 
     # ops/cc.py — ctt-cc coarse-to-fine kernel stats (host-side emission
     # from the connected_components_coarse wrapper, never inside jit)

@@ -110,6 +110,32 @@ grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
                                 "warm", "compile_cache": {"hits",
                                 "misses"}, "tenant", "pid",
                                 "finished_wall"}.
+
+Hierarchy artifact (ctt-hier; lives BESIDE the labels volume —
+``<output_path>/<output_key>_hierarchy.npz`` by default — because it is
+part of the segmentation product, not run scratch; documented here with
+the other cross-process file contracts)::
+
+    <key>_hierarchy.npz         np.savez, written atomically: {"schema"
+                                (ops/hier.HIER_SCHEMA_VERSION), "a", "b"
+                                (int64 GLOBAL region-id pairs, a < b),
+                                "saddle" (float32, ascending — the sorted
+                                order IS the contract: re-cutting at any
+                                threshold is one searchsorted over this
+                                column), "n_labels", "shape",
+                                "block_shape"}.  Saddle of a pair = min
+                                over the regions' shared boundary of
+                                max(h(p), h(q)) on the flood's working
+                                input.
+    hier_offsets.npz            tmp-folder scratch (the merge_offsets
+                                idiom): {"offsets" (exclusive prefix sum
+                                of per-block max ids), "n_labels"}.
+    data.zarr/hier/*            ragged per-block scratch: ``max_ids``,
+                                ``pairs``/``saddles`` (in-block table,
+                                block-LOCAL ids, (k,2) int64 flattened +
+                                (k,) float32), ``face_pairs``/
+                                ``face_saddles`` (cross-block table,
+                                GLOBAL ids).
 """
 
 from __future__ import annotations

@@ -1,0 +1,385 @@
+"""ctt-hier: one-flood hierarchical segmentation primitives.
+
+The reference stack re-runs the whole block-wise pipeline for every merge
+threshold a proofreader tries.  GPU hierarchical watershed partitioning
+(arXiv:2410.08946, PAPERS.md) shows the hierarchy can be built ONCE and
+re-cut at any level: record, for every pair of adjacent regions, the
+*saddle* — the minimum over their shared boundary of the voxel-pair edge
+weight ``max(h(p), h(q))`` — and segmentation at merge threshold ``t`` is
+exactly "union every region pair whose saddle ≤ t", a value-space
+union-find over the edge table plus one gather through the resolved
+roots.  No flood, no distance transform, no seed detection: the
+re-segmentation cost is O(edges ≤ t) + O(voxels) gather.
+
+This module is the device layer of that story:
+
+  * :func:`block_merge_table` — the FULL-adjacency sibling of
+    ``ops.watershed.flood_merge_table`` (which records tile-face edges
+    only): every canonical-offset voxel adjacency of a labeled block, as
+    static-shape ``(a, b, saddle)`` columns (``a < b``; slots that are
+    not a real inter-region edge carry ``(0, 0, _BIG)``) — vmappable over
+    a stacked block batch, one dispatch per batch.
+  * :func:`reduce_merge_table` / :func:`merge_face_pairs` — host
+    reductions to the per-pair minimum saddle (the hierarchy edge), for
+    in-block tables and 1-voxel block-face slabs respectively.
+  * :func:`cut_table` — threshold the saddle column of a sorted-by-saddle
+    hierarchy (one ``searchsorted``), resolve the selected edges with ONE
+    value-space union-find pass (``ops.unionfind.merge_value_table`` —
+    O(edges) table, not O(labels)), and return the ``(vals, roots)``
+    relabel table.  Padded to power-of-two sizes so a threshold sweep
+    recompiles O(log edges) times, not once per threshold.
+  * :func:`recut_labels` — the re-cut "kernel": one gather of a labels
+    block batch through the relabel table (``apply_value_roots``); labels
+    absent from the table pass through unchanged.
+  * :func:`resegment_np` — the host brute-force oracle (full adjacency
+    union-find with numpy), the parity reference for tests.
+  * :func:`save_hierarchy` / :func:`load_hierarchy` — the persistent
+    artifact (npz, sorted by saddle; schema documented beside the store
+    schemas in ``obs/trace.py``).
+
+Saddle heights are measured on whatever height field the caller passes —
+tasks/hier.py uses the flood's *working input* (the normalized, possibly
+inverted boundary map), which is a per-voxel transform of the stored
+volume and therefore globally consistent across blocks: in-block edges
+(device) and block-face edges (host) land on identical values.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .cc import _canonical_offsets, _shift
+from .unionfind import UnionFindNp, apply_value_roots, merge_value_table
+
+# same non-conducting sentinel as the flood kernels (ops/watershed.py);
+# numpy scalar so importing this module never initializes a backend
+_BIG = np.float32(3.0e38)
+
+HIER_SCHEMA_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# device: full-adjacency merge table of one labeled block
+
+
+@partial(jax.jit, static_argnames=("connectivity", "per_slice"))
+def block_merge_table(
+    labels: jnp.ndarray,
+    heights: jnp.ndarray,
+    connectivity: int = 1,
+    per_slice: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full-adjacency region merge table of a labeled block.
+
+    For every voxel pair ``(p, p + off)`` under the canonical half of the
+    neighborhood with distinct non-zero labels, one slot
+    ``(min(la, lb), max(la, lb), max(h(p), h(p + off)))``.  Returns flat
+    static-shape ``(a, b, saddle)`` columns of length
+    ``len(offsets) * prod(shape)``; non-edge slots carry ``(0, 0, _BIG)``.
+    The per-pair *minimum* saddle (the hierarchy edge weight) is a host
+    reduction — see :func:`reduce_merge_table`.
+
+    Unlike ``ops.watershed.flood_merge_table`` this records EVERY
+    adjacency, not only tile-crossing ones — the complete in-block edge
+    set a re-cut needs (two regions meeting inside a tile must merge at
+    their saddle too).
+    """
+    lab = labels.astype(jnp.int32)
+    h = heights.astype(jnp.float32)
+    a_parts, b_parts, s_parts = [], [], []
+    for off in _canonical_offsets(lab.ndim, connectivity, per_slice):
+        nei_l = _shift(lab, off, jnp.int32(0))
+        nei_h = _shift(h, off, _BIG)
+        ok = (lab > 0) & (nei_l > 0) & (lab != nei_l)
+        a_parts.append(
+            jnp.where(ok, jnp.minimum(lab, nei_l), 0).reshape(-1)
+        )
+        b_parts.append(
+            jnp.where(ok, jnp.maximum(lab, nei_l), 0).reshape(-1)
+        )
+        s_parts.append(
+            jnp.where(ok, jnp.maximum(h, nei_h), _BIG).reshape(-1)
+        )
+    if not a_parts:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z, jnp.zeros((0,), jnp.float32)
+    return (
+        jnp.concatenate(a_parts),
+        jnp.concatenate(b_parts),
+        jnp.concatenate(s_parts),
+    )
+
+
+# ---------------------------------------------------------------------------
+# host: reductions to per-pair minimum saddles
+
+
+def reduce_merge_table(
+    a: np.ndarray, b: np.ndarray, saddle: np.ndarray,
+    normalize: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Reduce raw ``(a, b, saddle)`` columns (device output, face slabs)
+    to the deduplicated per-pair MINIMUM saddle.  Returns
+    ``(pairs[k, 2] int64 with a < b, saddles[k] float32)`` sorted by
+    ``(a, b)``; empty/padding slots (``a == 0`` or ``b == 0``) drop.
+
+    ``normalize=False`` keeps the columns side-ordered instead of
+    swapping each pair to (min, max) — required while the two columns
+    live in DIFFERENT id namespaces (block-face pairs before their
+    per-side offsets are applied; normalizing local ids first would
+    attach the offsets to the wrong sides)."""
+    a = np.asarray(a).reshape(-1).astype(np.int64)
+    b = np.asarray(b).reshape(-1).astype(np.int64)
+    s = np.asarray(saddle).reshape(-1).astype(np.float32)
+    keep = (a > 0) & (b > 0)
+    if not keep.any():
+        return np.zeros((0, 2), np.int64), np.zeros((0,), np.float32)
+    a, b, s = a[keep], b[keep], s[keep]
+    if normalize:
+        lo = np.minimum(a, b)
+        hi = np.maximum(a, b)
+    else:
+        lo, hi = a, b
+    order = np.lexsort((hi, lo))
+    lo, hi, s = lo[order], hi[order], s[order]
+    first = np.concatenate(
+        [[True], (lo[1:] != lo[:-1]) | (hi[1:] != hi[:-1])]
+    )
+    starts = np.nonzero(first)[0]
+    mins = np.minimum.reduceat(s, starts)
+    return np.stack([lo[first], hi[first]], axis=1), mins.astype(np.float32)
+
+
+def merge_face_pairs(
+    lo_labels: np.ndarray,
+    hi_labels: np.ndarray,
+    lo_heights: np.ndarray,
+    hi_heights: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Cross-block hierarchy edges of one 1-voxel block face: the label
+    pair and ``max`` of the two touching height planes, reduced to the
+    per-pair minimum saddle.  The host-side sibling of
+    :func:`block_merge_table` for the stitching step (the
+    ``parallel/sharded.py`` boundary-plane idiom at the block grain).
+
+    The returned pairs stay SIDE-ORDERED (column 0 = lower-block ids,
+    column 1 = upper-block ids, still block-local): the caller applies
+    the two blocks' offsets per column before the global reduction
+    normalizes — swapping to (min, max) here would mix the namespaces."""
+    lo = np.asarray(lo_labels).reshape(-1).astype(np.int64)
+    hi = np.asarray(hi_labels).reshape(-1).astype(np.int64)
+    s = np.maximum(
+        np.asarray(lo_heights, np.float32).reshape(-1),
+        np.asarray(hi_heights, np.float32).reshape(-1),
+    )
+    both = (lo > 0) & (hi > 0)
+    return reduce_merge_table(lo[both], hi[both], s[both], normalize=False)
+
+
+def sort_by_saddle(
+    pairs: np.ndarray, saddles: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Sort hierarchy edges ascending by saddle (ties by pair) — the
+    artifact invariant that makes every threshold cut ONE searchsorted."""
+    order = np.lexsort((pairs[:, 1], pairs[:, 0], saddles))
+    return pairs[order], saddles[order]
+
+
+# ---------------------------------------------------------------------------
+# artifact (sorted-by-saddle global hierarchy)
+
+
+def save_hierarchy(
+    path: str,
+    pairs: np.ndarray,
+    saddles: np.ndarray,
+    n_labels: int,
+    shape,
+    block_shape,
+) -> None:
+    """Persist the sorted global hierarchy (schema in ``obs/trace.py``
+    beside the store/lease schemas).  ``pairs`` are GLOBAL label ids."""
+    pairs, saddles = sort_by_saddle(
+        np.asarray(pairs, np.int64).reshape(-1, 2),
+        np.asarray(saddles, np.float32).reshape(-1),
+    )
+    from ..utils.store import atomic_write_bytes
+
+    import io
+
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        schema=np.int64(HIER_SCHEMA_VERSION),
+        a=pairs[:, 0],
+        b=pairs[:, 1],
+        saddle=saddles,
+        n_labels=np.int64(n_labels),
+        shape=np.asarray(shape, np.int64),
+        block_shape=np.asarray(block_shape, np.int64),
+    )
+    atomic_write_bytes(path, buf.getvalue())
+
+
+def load_hierarchy(path: str) -> dict:
+    """Load a hierarchy artifact; loud on schema mismatch."""
+    with np.load(path) as f:
+        out = {k: f[k] for k in f.files}
+    schema = int(out.get("schema", -1))
+    if schema != HIER_SCHEMA_VERSION:
+        raise ValueError(
+            f"hierarchy artifact {path!r} has schema {schema}, expected "
+            f"{HIER_SCHEMA_VERSION}"
+        )
+    if not (np.diff(out["saddle"]) >= 0).all():
+        raise ValueError(
+            f"hierarchy artifact {path!r} is not sorted by saddle"
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# re-cut: threshold -> one union-find pass -> relabel table
+
+
+def _pad_pow2(arr: np.ndarray, fill) -> np.ndarray:
+    n = arr.shape[0]
+    size = 1
+    while size < n:
+        size *= 2
+    if size == n:
+        return arr
+    return np.concatenate([arr, np.full(size - n, fill, arr.dtype)])
+
+
+def cut_table(
+    a: np.ndarray, b: np.ndarray, saddle: np.ndarray, threshold: float
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Resolve the hierarchy at ``threshold``: select every edge with
+    ``saddle <= threshold`` (one searchsorted — the columns are the
+    sorted artifact) and run ONE value-space union-find pass over the
+    selected pairs.  Returns ``(vals, roots)`` (int32, sorted ``vals``)
+    for :func:`recut_labels`, or None when no edge is selected (identity
+    re-cut).  Edge columns pad to the next power of two with self-loop
+    zeros so a sweep reuses O(log edges) compiled shapes."""
+    k = int(np.searchsorted(saddle, np.float32(threshold), side="right"))
+    if k == 0:
+        return None
+    a_sel = _pad_pow2(np.asarray(a[:k], np.int32), 0)
+    b_sel = _pad_pow2(np.asarray(b[:k], np.int32), 0)
+    vals, roots = merge_value_table(jnp.asarray(a_sel), jnp.asarray(b_sel))
+    return np.asarray(vals), np.asarray(roots)
+
+
+@jax.jit
+def recut_labels(
+    labels: jnp.ndarray, vals: jnp.ndarray, roots: jnp.ndarray
+) -> jnp.ndarray:
+    """Re-segment a labels array at the cut encoded by ``(vals, roots)``:
+    one gather through the relabel table.  Labels absent from the table
+    (regions untouched by any selected edge — including background 0 when
+    the padding self-loops put it in ``vals``) pass through unchanged or
+    map to themselves, so the result is the merged partition with every
+    class renamed to its minimum member id."""
+    return apply_value_roots(labels.astype(jnp.int32), vals, roots)
+
+
+CUT_SCHEMA_VERSION = 1
+
+
+def save_cut_table(
+    path: str, threshold: float, cut, n_labels: int
+) -> None:
+    """Persist one threshold's relabel table (the table-mode sweep
+    product: a proofreading client applies it to whatever view it holds
+    instead of waiting for a full volume rewrite).  ``cut`` is
+    :func:`cut_table`'s result (None = identity)."""
+    import io
+
+    from ..utils.store import atomic_write_bytes
+
+    vals, roots = (
+        (np.zeros(0, np.int32), np.zeros(0, np.int32)) if cut is None
+        else cut
+    )
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        schema=np.int64(CUT_SCHEMA_VERSION),
+        threshold=np.float64(threshold),
+        vals=np.asarray(vals, np.int32),
+        roots=np.asarray(roots, np.int32),
+        n_labels=np.int64(n_labels),
+    )
+    atomic_write_bytes(path, buf.getvalue())
+
+
+def load_cut_table(path: str) -> dict:
+    with np.load(path) as f:
+        out = {k: f[k] for k in f.files}
+    if int(out.get("schema", -1)) != CUT_SCHEMA_VERSION:
+        raise ValueError(f"cut-table artifact {path!r}: schema mismatch")
+    return out
+
+
+def apply_cut_np(
+    labels: np.ndarray, vals: np.ndarray, roots: np.ndarray
+) -> np.ndarray:
+    """Host application of a persisted cut table (the client-side gather:
+    ``apply_value_roots`` semantics in numpy)."""
+    lab = np.asarray(labels).astype(np.int64)
+    vals = np.asarray(vals, np.int64)
+    roots = np.asarray(roots, np.int64)
+    if vals.size == 0:
+        return lab
+    idx = np.clip(np.searchsorted(vals, lab), 0, vals.size - 1)
+    hit = vals[idx] == lab
+    return np.where(hit, roots[idx], lab)
+
+
+# ---------------------------------------------------------------------------
+# host oracle (tests / documentation of the semantics)
+
+
+def resegment_np(
+    labels: np.ndarray,
+    heights: np.ndarray,
+    threshold: float,
+    connectivity: int = 1,
+) -> np.ndarray:
+    """Brute-force re-segmentation oracle: merge every pair of adjacent
+    regions whose saddle (min over their shared boundary of
+    ``max(h(p), h(q))``) is ≤ ``threshold``, entirely with host numpy —
+    the independent parity reference for the hierarchy + re-cut path.
+    Merged classes take their minimum member id (the device semantics)."""
+    lab = np.asarray(labels).astype(np.int64)
+    h = np.asarray(heights, np.float32)
+    pairs_parts = []
+    for off in _canonical_offsets(lab.ndim, connectivity, False):
+        src = tuple(
+            slice(None, -o) if o > 0 else slice(-o, None) for o in off
+        )
+        dst = tuple(
+            slice(o, None) if o > 0 else slice(None, o or None) for o in off
+        )
+        la, lb = lab[src], lab[dst]
+        ok = (la > 0) & (lb > 0) & (la != lb)
+        saddle = np.maximum(h[src], h[dst])
+        ok &= saddle <= np.float32(threshold)
+        if ok.any():
+            pairs_parts.append(
+                np.stack([la[ok], lb[ok]], axis=1)
+            )
+    if not pairs_parts:
+        return lab
+    pairs = np.unique(np.concatenate(pairs_parts, axis=0), axis=0)
+    uniq = np.unique(lab)
+    uf = UnionFindNp(int(uniq.max()) + 1)
+    uf.merge(pairs[:, 0], pairs[:, 1])
+    roots = uf.compress()
+    return roots[lab]

@@ -282,12 +282,14 @@ class TpuExecutor(BaseExecutor):
     ) -> None:
         """Re-run a failed batch block by block so a single poisoned block
         doesn't fail the whole batch."""
+        from . import hbm
+
         for bid in chunk:
             try:
                 with obs_trace.span(
                     "block_fallback", kind="host",
                     task=task.identifier, block=bid,
-                ):
+                ), hbm.use_guard():
                     task.process_block(bid, blocking, config)
                 done.append(bid)
                 obs_heartbeat.note_blocks_done()
@@ -308,6 +310,7 @@ class TpuExecutor(BaseExecutor):
         done, failed, errors,
     ) -> None:
         from ..parallel.dispatch import form_batches
+        from . import hbm
 
         chunks = form_batches(ids, batch_size)
 
@@ -322,10 +325,13 @@ class TpuExecutor(BaseExecutor):
                 t0 = time.perf_counter()
                 # block_ids lets the live reader attribute the batch wall
                 # to each block (the spatial latency heatmap)
+                # the guard pins evicted-entry deletes past this dispatch
+                # (a concurrent serve job's eviction must not free buffers
+                # an in-flight batch still reads — runtime/hbm.py)
                 with obs_trace.span(
                     "block_batch", kind="device", task=task.identifier,
                     blocks=len(chunk), block_ids=list(chunk),
-                ):
+                ), hbm.use_guard():
                     batch_fn(chunk, blocking, config)
                 obs_metrics.inc("device.dispatches")
                 dt = time.perf_counter() - t0
@@ -568,11 +574,13 @@ class TpuExecutor(BaseExecutor):
                 try:
                     faults.check("executor.stage_compute", id=group[0][0])
                     t0 = time.perf_counter()
+                    # use_guard: evictions during the dispatch defer their
+                    # .delete() until no compute is in flight (hbm.py)
                     with obs_trace.span(
                         "stage_compute", kind="device",
                         task=task.identifier, blocks=len(all_ids),
                         block_ids=all_ids,
-                    ):
+                    ), hbm.use_guard():
                         result = compute_fn(payload, blocking, config)
                     obs_metrics.inc("device.dispatches")
                     if len(group) > 1:

@@ -34,12 +34,15 @@ owning :class:`~cluster_tools_tpu.runtime.workflow.ExecutionContext`'s
 config (default 512), which is where cross-job reuse lives.  ``0``
 disables everything: no probes, no stats, no cache entries.
 
-Hazard note: an evicted array's ``.delete()`` can race a concurrent
-job still holding the value (serve ``concurrency > 1``).  The window is
-the microseconds between a ``get`` and the dispatch consuming it; a loss
-surfaces as a failed batch and the executor's per-block fallback re-runs
-it from the store — correctness degrades to a retry, never to wrong
-bytes.
+Eviction guard (ctt-hier follow-up to the original hazard note): an
+evicted array's ``.delete()`` could race a concurrent job still holding
+the value between a ``get`` and the dispatch consuming it (serve
+``concurrency > 1``) — the loss degraded to the per-block fallback, a
+silent slowdown.  The executors now wrap every device-consuming stage in
+:class:`use_guard`, and eviction defers the ``.delete()`` of any batch
+evicted while a guard is active until the LAST guard exits
+(``device.deferred_deletes``): HBM frees a dispatch later at worst, and
+an in-flight dispatch can never lose its buffers.
 """
 
 from __future__ import annotations
@@ -58,9 +61,59 @@ __all__ = [
     "DeviceBufferCache", "DeviceBatch", "BatchSource", "cache",
     "cache_budget_bytes", "set_cache_budget", "dataset_source",
     "fetch_or_upload", "sharded_device_batch", "batch_device",
-    "require_data", "upload_slot", "stack_block_batches", "split_stacked",
-    "hbm_stack",
+    "require_data", "upload_slot", "use_guard", "stack_block_batches",
+    "split_stacked", "hbm_stack",
 ]
+
+
+# ---------------------------------------------------------------------------
+# eviction guard: defer evicted .delete() past in-flight dispatches
+#
+# The window between a cache get and the dispatch consuming the arrays is
+# unlocked by design (the dispatch itself can run seconds).  Instead of
+# per-entry pin counts at every call site, the executors mark the whole
+# device-consuming scope with ``use_guard``; any eviction inside ANY
+# active guard queues its .delete() and the last guard to exit drains the
+# queue.  Epoch semantics: deletes are delayed by at most one overlapping
+# dispatch, never lost.
+
+_GUARD_LOCK = threading.Lock()
+_ACTIVE_GUARDS = 0
+_DEFERRED_DELETES: list = []
+
+
+class use_guard:
+    """Scope during which evicted device batches must not be freed yet
+    (a dispatch may still be consuming them)."""
+
+    def __enter__(self):
+        global _ACTIVE_GUARDS
+        with _GUARD_LOCK:
+            _ACTIVE_GUARDS += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE_GUARDS
+        drain: list = []
+        with _GUARD_LOCK:
+            _ACTIVE_GUARDS -= 1
+            if _ACTIVE_GUARDS == 0 and _DEFERRED_DELETES:
+                drain = list(_DEFERRED_DELETES)
+                _DEFERRED_DELETES.clear()
+        for batch in drain:
+            batch.delete()
+        return False
+
+
+def _delete_or_defer(batch: "DeviceBatch") -> None:
+    """Free an evicted batch now, or queue it while any dispatch guard is
+    active (the eviction/in-flight-dispatch race fix)."""
+    with _GUARD_LOCK:
+        if _ACTIVE_GUARDS > 0:
+            _DEFERRED_DELETES.append(batch)
+            obs_metrics.inc("device.deferred_deletes")
+            return
+    batch.delete()
 
 
 def cache_budget_bytes() -> int:
@@ -145,7 +198,7 @@ class DeviceBufferCache:
                 return entry[1]
         if evicted is not None:
             obs_metrics.inc("device.cache_evictions")
-            evicted.delete()
+            _delete_or_defer(evicted)
             self._publish()
         return None
 
@@ -164,7 +217,7 @@ class DeviceBufferCache:
                 evicted.append(self._pop_locked(key))
         for batch_out in evicted:
             obs_metrics.inc("device.cache_evictions")
-            batch_out.delete()
+            _delete_or_defer(batch_out)
         self._publish()
 
     def _pop_locked(self, key) -> Optional[DeviceBatch]:
@@ -180,7 +233,7 @@ class DeviceBufferCache:
             self._entries.clear()
             self._bytes = 0
         for _, batch in entries:
-            batch.delete()
+            _delete_or_defer(batch)
         self._publish()
 
     def _publish(self) -> None:
@@ -530,11 +583,15 @@ def split_stacked(results: np.ndarray, counts) -> list:
 
 def hbm_stack(config) -> int:
     """Batches per fused device dispatch: the ``hbm_stack`` config knob,
-    else ``CTT_HBM_STACK``, else 1 (off — the pre-hbm dispatch shape);
-    malformed values degrade to 1."""
+    else the measured pin (``CTT_HBM_STACK`` env, else the backend-tagged
+    ``tools/chip_modes.json`` entry written by tools/chip_session.py when
+    aggregation measured ≥ 1.1× — the CTT_DEVICE_BATCH idiom), else 1
+    (off — the pre-hbm dispatch shape); malformed values degrade to 1."""
     raw = config.get("hbm_stack")
     if raw is None:
-        raw = os.environ.get("CTT_HBM_STACK")
+        from ..ops import _backend
+
+        raw = _backend.pinned_value("CTT_HBM_STACK")
     try:
         n = int(raw) if raw is not None else 1
     except (TypeError, ValueError):

@@ -373,10 +373,20 @@ class _ChainRunner:
     def _compute(self, chunk: List[int], payloads) -> Dict[str, Any]:
         """Serialized compute stage: every member's device program for this
         batch, in declared order; handoffs chain members device-side."""
-        plan = self.plan
         handoffs: Dict[Tuple[str, str], Any] = {}
         results: Dict[str, Any] = {}
         t0 = time.perf_counter()
+        from . import hbm
+
+        with hbm.use_guard():
+            self._compute_members(chunk, payloads, handoffs, results)
+        self._acc("compute", time.perf_counter() - t0)
+        return results
+
+    def _compute_members(self, chunk, payloads, handoffs, results) -> None:
+        """Member loop of :meth:`_compute`, inside the hbm eviction guard
+        (device handoffs + cached uploads stay alive across members)."""
+        plan = self.plan
         for m in self.members:
             mid = m.identifier
             faults.check("executor.stage_compute", id=chunk[0])
@@ -413,8 +423,6 @@ class _ChainRunner:
                     "stream.elided_bytes",
                     int(m.fused_elided_nbytes(handoff, plan.blocking, mconf)),
                 )
-        self._acc("compute", time.perf_counter() - t0)
-        return results
 
     def _apply_carry(self, chunk: List[int], results) -> None:
         plan = self.plan

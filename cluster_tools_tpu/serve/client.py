@@ -112,6 +112,45 @@ class ServeClient:
         })
         return out["job_id"]
 
+    def resegment(
+        self,
+        hierarchy: str,
+        labels_path: str,
+        labels_key: str,
+        output_path: str,
+        output_key: str,
+        threshold: float,
+        tmp_folder: str,
+        config_dir: str,
+        configs: Optional[Dict[str, dict]] = None,
+        tenant: str = "default",
+        priority: int = 0,
+        write_volume: bool = True,
+    ) -> str:
+        """ctt-hier threshold sweep step: submit one ``resegment`` job
+        (re-cut a built hierarchy at ``threshold``); returns the job id.
+        Against a warm daemon every step after the first touches only the
+        cached hierarchy + one relabel gather per block batch.
+        ``write_volume=False`` is the interactive mode: the job persists
+        only the relabel table (``<output_key>_cut.npz``) for the client
+        to apply to its current view — the millisecond sweep step."""
+        out = self._request("POST", "/api/v1/jobs", {
+            "type": "resegment",
+            "hierarchy": hierarchy,
+            "labels_path": labels_path,
+            "labels_key": labels_key,
+            "output_path": output_path,
+            "output_key": output_key,
+            "threshold": float(threshold),
+            "write_volume": bool(write_volume),
+            "tmp_folder": tmp_folder,
+            "config_dir": config_dir,
+            "configs": configs or {},
+            "tenant": tenant,
+            "priority": priority,
+        })
+        return out["job_id"]
+
     def status(self, job_id: str) -> Dict[str, Any]:
         return self._request("GET", f"/api/v1/jobs/{job_id}")
 

@@ -18,6 +18,32 @@ reference beside the heartbeat/lease schemas in ``obs/trace.py``)::
       "priority": 0                              # higher claims first
     }
 
+ctt-hier sugar — the ``resegment`` job type, the proofreading-sweep wire
+shape.  A client that built a hierarchy once (``HierarchyWorkflow``)
+sweeps merge thresholds against one warm daemon without knowing the task
+wiring::
+
+    {
+      "type":        "resegment",
+      "hierarchy":   "/.../seg_hierarchy.npz",   # the build's artifact
+      "labels_path": ..., "labels_key": ...,     # the GLOBAL-id labels
+      "output_path": ..., "output_key": ...,     # per-threshold output
+      "threshold":   0.3,                        # the merge level to cut at
+      "write_volume": false,                     # optional: persist only the
+                                                 # relabel table (_cut.npz) —
+                                                 # the millisecond sweep step
+      "tmp_folder":  ..., "config_dir": ...,
+      "configs":     {"global": {...}},          # optional (block_shape &c)
+      "tenant": ..., "priority": ...
+    }
+
+:func:`validate_submission` normalizes this into a plain workflow record
+over ``cluster_tools_tpu.tasks.hier:ResegmentTask`` (the threshold rides
+the ``resegment`` task config), so queueing, leases, quotas, and warm
+accounting are the ordinary job machinery — the type survives on the
+record for the ``hier.resegment_jobs`` counter, and ``job_signature``
+ignores the threshold: every sweep step after the first is a warm job.
+
 Every request except the bare ``/healthz`` liveness probe must carry the
 daemon's auth token (``X-CTT-Serve-Token: <token>`` or ``Authorization:
 Bearer <token>``), published only through the mode-0600 ``serve.json``
@@ -52,9 +78,59 @@ SCHEMA_VERSION = 1
 
 JOB_STATES = ("queued", "running", "done", "failed")
 
+JOB_TYPES = ("workflow", "resegment")
+
+# the task class a ``resegment`` submission resolves to (ctt-hier)
+RESEGMENT_TASK = "cluster_tools_tpu.tasks.hier:ResegmentTask"
+
 
 class ProtocolError(ValueError):
     """A submission that violates the schema (HTTP 400, never a retry)."""
+
+
+def _normalize_resegment(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Rewrite a ``resegment`` submission into the plain workflow shape
+    (see the module docstring): the sweep-specific fields become
+    ResegmentTask kwargs and the threshold lands in the ``resegment``
+    task config the daemon writes before building."""
+    for field in ("hierarchy", "labels_path", "labels_key",
+                  "output_path", "output_key", "tmp_folder", "config_dir"):
+        if not isinstance(payload.get(field), str) or not payload[field]:
+            raise ProtocolError(
+                f"resegment submission requires '{field}' (string)"
+            )
+    threshold = payload.get("threshold")
+    if not isinstance(threshold, (int, float)) or isinstance(threshold, bool):
+        raise ProtocolError(
+            "resegment submission requires a numeric 'threshold'"
+        )
+    configs = payload.get("configs") or {}
+    if not isinstance(configs, dict):
+        raise ProtocolError("'configs' must map config names to objects")
+    configs = dict(configs)
+    reseg_conf = dict(configs.get("resegment") or {})
+    reseg_conf["threshold"] = float(threshold)
+    if "write_volume" in payload:
+        # interactive sweep steps persist the relabel TABLE only
+        # (<output_key>_cut.npz); the volume gather is the commit job
+        reseg_conf["write_volume"] = bool(payload["write_volume"])
+    configs["resegment"] = reseg_conf
+    return {
+        "type": "resegment",
+        "workflow": RESEGMENT_TASK,
+        "kwargs": {
+            "tmp_folder": payload["tmp_folder"],
+            "config_dir": payload["config_dir"],
+            "input_path": payload["labels_path"],
+            "input_key": payload["labels_key"],
+            "output_path": payload["output_path"],
+            "output_key": payload["output_key"],
+            "hierarchy_path": payload["hierarchy"],
+        },
+        "configs": configs,
+        "tenant": payload.get("tenant", "default"),
+        "priority": payload.get("priority", 0),
+    }
 
 
 def validate_submission(payload: Any) -> Dict[str, Any]:
@@ -62,6 +138,13 @@ def validate_submission(payload: Any) -> Dict[str, Any]:
     a malformed submission is a client bug, not a degraded default."""
     if not isinstance(payload, dict):
         raise ProtocolError("submission must be a JSON object")
+    job_type = payload.get("type", "workflow")
+    if job_type not in JOB_TYPES:
+        raise ProtocolError(
+            f"unknown job type {job_type!r} (one of {JOB_TYPES})"
+        )
+    if job_type == "resegment":
+        payload = _normalize_resegment(payload)
     workflow = payload.get("workflow")
     if not isinstance(workflow, str) or not workflow.strip():
         raise ProtocolError("'workflow' must be a non-empty string")
@@ -91,6 +174,7 @@ def validate_submission(payload: Any) -> Dict[str, Any]:
         raise ProtocolError("'priority' must be an integer") from None
     return {
         "schema": SCHEMA_VERSION,
+        "type": payload.get("type", "workflow"),
         "workflow": workflow.strip(),
         "kwargs": kwargs,
         "configs": configs,

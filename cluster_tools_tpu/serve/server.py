@@ -326,6 +326,10 @@ class ServeDaemon:
         if ok:
             self._warm_signatures.add(sig)
             obs_metrics.inc("serve.jobs_done")
+            if rec.get("type") == "resegment":
+                # ctt-hier: the threshold-sweep accounting — a warm sweep
+                # is resegment jobs moving while upload bytes stand still
+                obs_metrics.inc("hier.resegment_jobs")
             obs_metrics.inc(
                 "serve.warm_compile_jobs" if warm
                 else "serve.cold_compile_jobs"

@@ -1,4 +1,13 @@
-"""Edge probabilities → multicut costs (reference costs/probs_to_costs.py:22)."""
+"""Edge probabilities → multicut costs (reference costs/probs_to_costs.py:22).
+
+Open seam (ctt-hier, ROADMAP item 2 follow-up): the hierarchy artifact
+(``ops/hier.py`` — per-region-pair minimum saddles over the flood's
+working input) is a natural merge PRIOR for this cost stack: a pair's
+saddle is exactly the boundary evidence the RAG feature path recomputes
+per edge, already globalized and sorted, so costs could blend
+``transform_probabilities_to_costs(saddle)`` for edges present in the
+artifact instead of re-reading boundary features for them.
+"""
 
 from __future__ import annotations
 

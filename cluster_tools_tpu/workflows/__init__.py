@@ -38,6 +38,7 @@ from .postprocessing import (
     SizeFilterAndGraphWatershedWorkflow,
     SizeFilterWorkflow,
 )
+from .hier import HierarchyWorkflow, ResegmentWorkflow
 from .stitching import MulticutStitchingWorkflow, SimpleStitchingWorkflow
 from .streaming import StreamingSegmentationWorkflow
 from .ilastik import IlastikCarvingWorkflow, IlastikPredictionWorkflow
@@ -86,7 +87,9 @@ __all__ = [
     "SizeFilterAndGraphWatershedWorkflow",
     "SizeFilterWorkflow",
     "TwoPassMwsWorkflow",
+    "HierarchyWorkflow",
     "MulticutStitchingWorkflow",
+    "ResegmentWorkflow",
     "SimpleStitchingWorkflow",
     "StreamingSegmentationWorkflow",
     "LinearTransformationWorkflow",

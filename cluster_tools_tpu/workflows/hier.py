@@ -1,0 +1,195 @@
+"""ctt-hier workflows: build the merge hierarchy once, re-cut at will.
+
+``HierarchyWorkflow`` runs the one-flood hierarchy build (tasks/hier.py):
+blocks → offsets → faces → build → write, producing a GLOBAL-id labels
+volume at ``output_key`` plus the sorted-by-saddle hierarchy artifact
+beside it.  A single-member fused chain (ctt-stream) lets the blocks task
+carry max ids and boundary planes slab-by-slab, covering the offsets and
+faces steps — the stitching never re-reads the labels volume.
+
+``ResegmentWorkflow`` wraps one :class:`~..tasks.hier.ResegmentTask` run
+(threshold in the ``resegment`` task config): the workflow a proofreading
+client submits per threshold — against a warm serve daemon (the
+``resegment`` job type, serve/protocol.py) each sweep step is one
+union-find pass + one gather per block batch, with the labels volume held
+resident in the ctt-hbm DeviceBufferCache.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..runtime.stream import FusedChain
+from ..runtime.workflow import WorkflowBase
+from ..tasks.hier import (
+    HIER_ASSIGNMENTS_NAME,
+    HIER_OFFSETS_NAME,
+    BuildHierarchyTask,
+    HierarchyBlocksTask,
+    HierarchyFacesTask,
+    HierarchyOffsetsTask,
+    ResegmentTask,
+    default_hierarchy_path,
+)
+from ..tasks.write import WriteTask
+
+
+class HierarchyWorkflow(WorkflowBase):
+    """One-flood hierarchy build over ``input_path/input_key``: global
+    watershed labels at ``output_key`` + the hierarchy artifact
+    (``hierarchy_path``, default ``<output_key>_hierarchy.npz`` beside the
+    labels volume)."""
+
+    task_name = "hierarchy_workflow"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        target: Optional[str] = None,
+        input_path: str = None,
+        input_key: str = None,
+        output_path: str = None,
+        output_key: str = None,
+        hierarchy_path: Optional[str] = None,
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.hierarchy_path = hierarchy_path or (
+            default_hierarchy_path(output_path, output_key)
+            if output_path and output_key else None
+        )
+
+    def _tasks(self):
+        """One definition of the member tasks: ``requires()`` and
+        ``fused_chains()`` must describe the SAME instances (the
+        streaming-workflow convention) or the chain would satisfy
+        different status files than the DAG runs."""
+        blocks_key = self.output_key + "_blocks"
+        blocks = HierarchyBlocksTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            input_path=self.input_path,
+            input_key=self.input_key,
+            output_path=self.output_path,
+            output_key=blocks_key,
+        )
+        offsets = HierarchyOffsetsTask(
+            self.tmp_folder,
+            self.config_dir,
+            dependencies=[blocks],
+            input_path=self.output_path,
+            input_key=blocks_key,
+        )
+        faces = HierarchyFacesTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            dependencies=[offsets],
+            input_path=self.output_path,
+            input_key=blocks_key,
+            heights_path=self.input_path,
+            heights_key=self.input_key,
+        )
+        build = BuildHierarchyTask(
+            self.tmp_folder,
+            self.config_dir,
+            dependencies=[faces],
+            input_path=self.output_path,
+            input_key=blocks_key,
+            hierarchy_path=self.hierarchy_path,
+        )
+        write = WriteTask(
+            self.tmp_folder,
+            self.config_dir,
+            self.max_jobs,
+            dependencies=[build],
+            input_path=self.output_path,
+            input_key=blocks_key,
+            output_path=self.output_path,
+            output_key=self.output_key,
+            assignment_path=os.path.join(
+                self.tmp_folder, HIER_ASSIGNMENTS_NAME
+            ),
+            offsets_path=os.path.join(self.tmp_folder, HIER_OFFSETS_NAME),
+            identifier="hierarchy",
+        )
+        return blocks, offsets, faces, build, write
+
+    def requires(self):
+        *_, write = self._tasks()
+        return [write]
+
+    def fused_chains(self):
+        blocks, offsets, faces, _build, _write = self._tasks()
+        return [
+            FusedChain(
+                name="hier_blocks",
+                members=[blocks],
+                covers=[offsets, faces],
+            )
+        ]
+
+    @classmethod
+    def get_config(cls):
+        conf = super().get_config()
+        conf["hierarchy_blocks"] = HierarchyBlocksTask.default_task_config()
+        conf["hierarchy_faces"] = HierarchyFacesTask.default_task_config()
+        conf["write"] = WriteTask.default_task_config()
+        return conf
+
+
+class ResegmentWorkflow(WorkflowBase):
+    """One threshold re-cut of a built hierarchy (the ``resegment`` task
+    config carries the threshold): labels volume + artifact in, merged
+    labels volume out — the per-sweep-step workflow."""
+
+    task_name = "resegment_workflow"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        target: Optional[str] = None,
+        labels_path: str = None,
+        labels_key: str = None,
+        output_path: str = None,
+        output_key: str = None,
+        hierarchy_path: Optional[str] = None,
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target)
+        self.labels_path = labels_path
+        self.labels_key = labels_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.hierarchy_path = hierarchy_path or (
+            default_hierarchy_path(labels_path, labels_key)
+            if labels_path and labels_key else None
+        )
+
+    def requires(self):
+        return [
+            ResegmentTask(
+                self.tmp_folder,
+                self.config_dir,
+                self.max_jobs,
+                input_path=self.labels_path,
+                input_key=self.labels_key,
+                output_path=self.output_path,
+                output_key=self.output_key,
+                hierarchy_path=self.hierarchy_path,
+            )
+        ]
+
+    @classmethod
+    def get_config(cls):
+        conf = super().get_config()
+        conf["resegment"] = ResegmentTask.default_task_config()
+        return conf

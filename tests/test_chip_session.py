@@ -90,3 +90,24 @@ def test_dtws_pallas_gate():
 
 def test_missing_measurements_pin_nothing():
     assert derive_modes({}) == {}
+
+
+def test_hbm_stack_pin_requires_measured_win():
+    # ctt-hbm aggregated dispatch: pinned only at >= 1.1x measured speedup
+    base = {
+        "dtws_assoc_ms": 1.0, "dtws_seq_ms": 2.0,
+        "cc_assoc_ms": 1.0, "cc_seq_ms": 2.0,
+    }
+    won = derive_modes(
+        {**base, "best_hbm_stack": 8, "hbm_stack_speedup": 1.35}
+    )
+    assert won["CTT_HBM_STACK"] == "8"
+    # below the 1.1x gate: no pin (the per-batch dispatch shape stays)
+    assert "CTT_HBM_STACK" not in derive_modes(
+        {**base, "best_hbm_stack": 8, "hbm_stack_speedup": 1.05}
+    )
+    # tpu_validate records best_hbm_stack=1 when stacking lost outright
+    assert "CTT_HBM_STACK" not in derive_modes(
+        {**base, "best_hbm_stack": 1, "hbm_stack_speedup": 0.9}
+    )
+    assert "CTT_HBM_STACK" not in derive_modes(base)

@@ -117,6 +117,118 @@ class TestDeviceBufferCache:
         assert arr.deleted and dc.nbytes == 0
 
 
+class TestEvictionGuard:
+    """ctt-hier follow-up to PR 11's hazard note: an eviction while any
+    dispatch guard is active must DEFER the ``.delete()`` until the last
+    guard exits — a concurrent job's in-flight dispatch can never lose
+    the buffers it is reading."""
+
+    def test_eviction_inside_guard_defers_delete(self, traced):
+        dc = hbm.DeviceBufferCache(50)
+        a_arr, a = _entry(40)
+        sa = hbm.BatchSource(key=("a",), sig=(1,))
+        dc.put(sa, a)
+        b_arr, b = _entry(40)
+        with hbm.use_guard():
+            hit = dc.get(sa)
+            assert hit is a
+            # concurrent job inserts and evicts `a` mid-"dispatch"
+            dc.put(hbm.BatchSource(key=("b",), sig=(1,)), b)
+            assert dc.get(sa) is None, "evicted from the cache immediately"
+            assert not a_arr.deleted, (
+                "evicted .delete() must wait for the active dispatch guard"
+            )
+        assert a_arr.deleted, "the last guard exit drains deferred deletes"
+        assert not b_arr.deleted
+        assert _counters().get("device.deferred_deletes", 0) >= 1
+
+    def test_nested_guards_drain_on_last_exit(self, traced):
+        dc = hbm.DeviceBufferCache(50)
+        a_arr, a = _entry(40)
+        sa = hbm.BatchSource(key=("a",), sig=(1,))
+        dc.put(sa, a)
+        _, b = _entry(40)
+        with hbm.use_guard():
+            with hbm.use_guard():
+                dc.put(hbm.BatchSource(key=("b",), sig=(1,)), b)
+                assert not a_arr.deleted
+            assert not a_arr.deleted, "inner exit must not drain"
+        assert a_arr.deleted
+
+    def test_delete_immediate_without_guard(self, traced):
+        dc = hbm.DeviceBufferCache(50)
+        a_arr, a = _entry(40)
+        dc.put(hbm.BatchSource(key=("a",), sig=(1,)), a)
+        dc.put(hbm.BatchSource(key=("b",), sig=(1,)), _entry(40)[1])
+        assert a_arr.deleted, "no guard active: eviction frees immediately"
+
+    def test_two_serve_jobs_one_entry_budget(self, tmp_path, rng):
+        """Regression for the PR 11 race window: two concurrent serve
+        jobs over DIFFERENT volumes at a budget that holds only one
+        entry — every upload of one job evicts the other's, so without
+        the guard an in-flight dispatch could lose its buffers (silent
+        per-block fallback).  Both jobs must produce bytes identical to
+        their cold-process runs, with zero block failures."""
+        from cluster_tools_tpu.runtime.workflow import ExecutionContext
+        from cluster_tools_tpu.serve import ServeClient, ServeDaemon
+
+        was_on = obs_trace.enabled()
+        if not was_on:
+            obs_trace.enable(str(tmp_path / "trace"), "hbm_guard",
+                             export_env=False)
+        prev_ctx = ExecutionContext._PROCESS
+        paths = {}
+        for tag in ("a", "b"):
+            p = str(tmp_path / f"vol_{tag}.n5")
+            data = rng.random((8, 32, 32)).astype("float32")
+            file_reader(p).create_dataset("bnd", data=data, chunks=(4, 8, 8))
+            paths[tag] = p
+        # one 4x8x8 float32 block batch is 8 KB: a ~0.02 MB budget holds
+        # one entry (plus slack) — back-to-back uploads evict each other
+        d = ServeDaemon(
+            str(tmp_path / "state"),
+            config={"concurrency": 2, "hbm_cache_mb": 0.02},
+        )
+        d.start()
+        try:
+            client = ServeClient(state_dir=str(tmp_path / "state"))
+            jobs = {
+                tag: client.submit(
+                    "cluster_tools_tpu.tasks.threshold:ThresholdTask",
+                    {
+                        "tmp_folder": str(tmp_path / f"tmp_{tag}"),
+                        "config_dir": str(tmp_path / f"configs_{tag}"),
+                        "input_path": paths[tag], "input_key": "bnd",
+                        "output_path": paths[tag], "output_key": "thr",
+                    },
+                    configs={"global": {"block_shape": [4, 8, 8],
+                                        "target": "tpu", "devices": [0],
+                                        "device_batch_size": 1,
+                                        "pipeline_depth": 3}},
+                )
+                for tag in ("a", "b")
+            }
+            for tag, jid in jobs.items():
+                state = client.wait(jid, timeout_s=300)
+                assert state["result"]["ok"], (tag, state)
+        finally:
+            d.request_drain()
+            if d._httpd is not None:
+                d._httpd.shutdown()
+                d._httpd.server_close()
+            for t in d._threads:
+                if t.name.startswith("ctt-serve-exec"):
+                    t.join(timeout=30)
+            ExecutionContext._PROCESS = prev_ctx
+            if not was_on:
+                obs_trace.disable()
+            obs_metrics.reset()
+        for tag in ("a", "b"):
+            f = file_reader(paths[tag], "r")
+            expect = (f["bnd"][:] > 0.5).astype("uint8")
+            np.testing.assert_array_equal(f["thr"][:], expect, err_msg=tag)
+
+
 # ---------------------------------------------------------------------------
 # store-rewrite invalidation (POSIX + remote), via the real source probe
 

@@ -1,0 +1,565 @@
+"""ctt-hier tests: one-flood hierarchical segmentation.
+
+Covers the PR acceptance contract:
+  * merge-table determinism across the flood sweep modes (flat assoc/seq
+    + the Pallas path where available) — bit-exact tables, not just
+    labels (the flood_merge_table saddle-semantics satellite);
+  * global hierarchy vs brute force: re-segmenting at k thresholds
+    equals the full-adjacency union-find oracle (label-partition
+    equality, RI == 1.0);
+  * monotonicity: segment count non-increasing in the threshold;
+  * block-face stitching on the serpentine fixture (a region snaking
+    across many blocks must merge through face edges);
+  * warm sweep: a second re-cut in one process reads NO input chunks and
+    uploads NO bytes (the ctt-hbm DeviceBufferCache holds the labels);
+  * serve ``resegment`` job e2e byte parity vs an in-process run, plus
+    the protocol normalization/validation;
+  * disabled/fallback paths: unfused build (CTT_STREAM_FUSION=0) and the
+    local target produce byte-identical artifacts and volumes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.obs import metrics as obs_metrics
+from cluster_tools_tpu.obs import trace as obs_trace
+from cluster_tools_tpu.ops import _backend
+from cluster_tools_tpu.ops import hier as hier_ops
+from cluster_tools_tpu.ops import watershed as ws_ops
+from cluster_tools_tpu.ops.evaluation import rand_scores
+from cluster_tools_tpu.ops.segment import contingency_table
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import HierarchyWorkflow, ResegmentWorkflow
+
+BLOCK_SHAPE = [4, 16, 16]
+GCONF = {
+    "block_shape": BLOCK_SHAPE, "target": "tpu",
+    "device_batch_size": 1, "devices": [0], "pipeline_depth": 2,
+}
+BLOCKS_CONF = {"threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10}
+
+
+def _volume(rng, shape=(8, 32, 32)):
+    from scipy import ndimage
+
+    raw = ndimage.gaussian_filter(rng.random(shape), (1.0, 2.0, 2.0))
+    return (
+        (raw - raw.min()) / (raw.max() - raw.min())
+    ).astype("float32")
+
+
+def _build_hierarchy(tmp_path, raw, tag="h", gconf=None, blocks_conf=None):
+    path = str(tmp_path / f"{tag}.n5")
+    file_reader(path).create_dataset(
+        "bnd", data=raw, chunks=tuple(BLOCK_SHAPE)
+    )
+    config_dir = str(tmp_path / f"configs_{tag}")
+    cfg.write_global_config(config_dir, gconf or GCONF)
+    cfg.write_config(
+        config_dir, "hierarchy_blocks", blocks_conf or BLOCKS_CONF
+    )
+    wf = HierarchyWorkflow(
+        str(tmp_path / f"tmp_{tag}"), config_dir,
+        input_path=path, input_key="bnd",
+        output_path=path, output_key="seg",
+    )
+    assert build([wf])
+    return path, config_dir
+
+
+def _resegment(tmp_path, path, config_dir, threshold, tag):
+    rs_dir = str(tmp_path / f"configs_rs_{tag}")
+    cfg.write_global_config(rs_dir, GCONF)
+    cfg.write_config(rs_dir, "resegment", {"threshold": float(threshold)})
+    wf = ResegmentWorkflow(
+        str(tmp_path / f"tmp_rs_{tag}"), rs_dir,
+        labels_path=path, labels_key="seg",
+        output_path=path, output_key=f"seg_{tag}",
+    )
+    assert build([wf])
+    return file_reader(path, "r")[f"seg_{tag}"][:]
+
+
+def _partition_ri(a, b) -> float:
+    ids_a, ids_b, counts = contingency_table(
+        np.asarray(a, np.uint64), np.asarray(b, np.uint64)
+    )
+    return rand_scores(ids_a, ids_b, counts)["rand_index"]
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    """ONE hierarchy build shared by the read-only tests (the build is
+    the expensive part; re-cuts are cheap)."""
+    rng = np.random.default_rng(42)
+    tmp_path = tmp_path_factory.mktemp("hier")
+    raw = _volume(rng)
+    path, config_dir = _build_hierarchy(tmp_path, raw)
+    return tmp_path, path, config_dir, raw
+
+
+# ---------------------------------------------------------------------------
+# merge-table semantics across flood paths (the sweep-mode satellite)
+
+
+class TestMergeTableSweepModes:
+    def test_hier_table_bit_exact_across_sweep_modes(self, rng):
+        """seeded_watershed_hier under CTT_SWEEP_MODE=assoc vs seq: the
+        labels are bit-identical by the flood contract — the merge table
+        must be too (it is a pure function of (labels, heights), so any
+        drift means the saddle semantics leaked backend state)."""
+        from scipy import ndimage
+
+        raw = ndimage.gaussian_filter(
+            rng.random((4, 32, 32)), (0.5, 2.0, 2.0)
+        ).astype(np.float32)
+        seeds = np.zeros(raw.shape, np.int32)
+        seeds[0, 4, 4] = 1
+        seeds[1, 16, 24] = 2
+        seeds[3, 28, 8] = 3
+        mask = raw < np.quantile(raw, 0.8)
+        outs = {}
+        for mode in ("assoc", "seq"):
+            with _backend.force_sweep_mode(mode):
+                labels, (a, b, s), _ = ws_ops.seeded_watershed_hier(
+                    raw, seeds, mask, coarse_tile=(2, 8, 8)
+                )
+                outs[mode] = (
+                    np.asarray(labels), np.asarray(a), np.asarray(b),
+                    np.asarray(s),
+                )
+        for part in range(4):
+            np.testing.assert_array_equal(
+                outs["assoc"][part], outs["seq"][part],
+                err_msg=f"part {part} differs between sweep modes",
+            )
+
+    def test_block_merge_table_matches_host_adjacency(self, rng):
+        """The device full-adjacency table reduces to exactly the host
+        oracle's edge set with identical min saddles."""
+        labels = np.zeros((4, 8, 8), np.int32)
+        labels[:, :4, :] = 1
+        labels[:, 5:, :] = 2
+        labels[2:, 4:5, :4] = 3  # a region touching both
+        h = rng.random((4, 8, 8)).astype(np.float32)
+        a, b, s = hier_ops.block_merge_table(labels, h)
+        pairs, saddles = hier_ops.reduce_merge_table(a, b, s)
+        # host reference
+        ref = {}
+        from cluster_tools_tpu.ops.cc import _canonical_offsets
+
+        for off in _canonical_offsets(3, 1, False):
+            src = tuple(
+                slice(None, -o) if o > 0 else slice(-o, None) for o in off
+            )
+            dst = tuple(
+                slice(o, None) if o > 0 else slice(None, o or None)
+                for o in off
+            )
+            la, lb = labels[src], labels[dst]
+            ok = (la > 0) & (lb > 0) & (la != lb)
+            sad = np.maximum(h[src], h[dst])
+            for pa, pb, ps in zip(la[ok], lb[ok], sad[ok]):
+                key = (min(pa, pb), max(pa, pb))
+                ref[key] = min(ref.get(key, np.inf), ps)
+        got = {tuple(p): s for p, s in zip(pairs, saddles)}
+        assert set(got) == set(ref)
+        for k in ref:
+            assert np.isclose(got[k], ref[k]), k
+
+
+# ---------------------------------------------------------------------------
+# artifact schema
+
+
+class TestArtifact:
+    def test_roundtrip_sorted_and_schema_guard(self, tmp_path):
+        pairs = np.array([[3, 5], [1, 2], [2, 7]], np.int64)
+        saddles = np.array([0.9, 0.1, 0.5], np.float32)
+        p = str(tmp_path / "h.npz")
+        hier_ops.save_hierarchy(p, pairs, saddles, 7, (8, 8, 8), (4, 4, 4))
+        art = hier_ops.load_hierarchy(p)
+        assert (np.diff(art["saddle"]) >= 0).all()
+        assert art["a"].tolist() == [1, 2, 3]
+        assert int(art["n_labels"]) == 7
+        # schema guard: a foreign npz is refused loudly
+        bad = str(tmp_path / "bad.npz")
+        np.savez(bad, a=pairs[:, 0], b=pairs[:, 1], saddle=saddles)
+        with pytest.raises(ValueError, match="schema"):
+            hier_ops.load_hierarchy(bad)
+
+
+# ---------------------------------------------------------------------------
+# global hierarchy vs brute force + monotonicity (module-shared build)
+
+
+class TestHierarchyCorrectness:
+    def test_recut_matches_bruteforce_at_k_thresholds(self, built,
+                                                      tmp_path):
+        _, path, config_dir, raw = built
+        f = file_reader(path, "r")
+        seg = f["seg"][:].astype(np.int64)
+        art = hier_ops.load_hierarchy(
+            os.path.join(path, "seg_hierarchy.npz")
+        )
+        qs = np.quantile(art["saddle"], [0.15, 0.5, 0.85])
+        for i, t in enumerate(qs):
+            out = _resegment(tmp_path, path, config_dir, t, f"bf{i}")
+            oracle = hier_ops.resegment_np(seg, raw, float(t))
+            ri = _partition_ri(out, oracle)
+            assert ri == 1.0, f"threshold {t}: RI {ri} != 1.0"
+
+    def test_segment_count_monotone_in_threshold(self, built, tmp_path):
+        _, path, config_dir, _ = built
+        art = hier_ops.load_hierarchy(
+            os.path.join(path, "seg_hierarchy.npz")
+        )
+        ts = np.quantile(art["saddle"], [0.1, 0.35, 0.6, 0.95])
+        counts = [
+            np.unique(
+                _resegment(tmp_path, path, config_dir, t, f"mono{i}")
+            ).size
+            for i, t in enumerate(ts)
+        ]
+        assert counts == sorted(counts, reverse=True), counts
+        # the top cut must actually merge something
+        assert counts[-1] < counts[0]
+
+    def test_table_mode_matches_volume_mode(self, built, tmp_path):
+        """``write_volume: false`` persists only the relabel table; the
+        client-side application of that table must equal the volume-mode
+        gather bit for bit (and no output volume is created)."""
+        _, path, config_dir, _ = built
+        art = hier_ops.load_hierarchy(
+            os.path.join(path, "seg_hierarchy.npz")
+        )
+        t = float(np.quantile(art["saddle"], 0.5))
+        vol_out = _resegment(tmp_path, path, config_dir, t, "tm_vol")
+        rs_dir = str(tmp_path / "configs_tm")
+        cfg.write_global_config(rs_dir, GCONF)
+        cfg.write_config(
+            rs_dir, "resegment",
+            {"threshold": t, "write_volume": False},
+        )
+        wf = ResegmentWorkflow(
+            str(tmp_path / "tmp_tm"), rs_dir,
+            labels_path=path, labels_key="seg",
+            output_path=path, output_key="seg_tm",
+        )
+        assert build([wf])
+        assert not os.path.exists(os.path.join(path, "seg_tm")), (
+            "table mode must not create an output volume"
+        )
+        cut = hier_ops.load_cut_table(
+            os.path.join(path, "seg_tm_cut.npz")
+        )
+        assert float(cut["threshold"]) == t
+        seg = file_reader(path, "r")["seg"][:]
+        applied = hier_ops.apply_cut_np(seg, cut["vals"], cut["roots"])
+        np.testing.assert_array_equal(applied.astype(np.uint64), vol_out)
+
+    def test_identity_cut_below_all_saddles(self, built, tmp_path):
+        _, path, config_dir, _ = built
+        f = file_reader(path, "r")
+        out = _resegment(tmp_path, path, config_dir, -1.0, "ident")
+        np.testing.assert_array_equal(out, f["seg"][:])
+
+
+# ---------------------------------------------------------------------------
+# block-face stitching (serpentine fixture)
+
+
+class TestFaceStitching:
+    def test_serpentine_region_merges_across_blocks(self, tmp_path):
+        """A serpentine low-boundary corridor spanning every block: at a
+        threshold above the corridor's values all its watershed fragments
+        (which the halo-less block flood split at every block border)
+        must merge into ONE segment — pure face-edge stitching."""
+        from cluster_tools_tpu.ops.cc import serpentine_mask
+
+        shape = (4, 32, 32)
+        corridor = np.asarray(serpentine_mask((32, 32)))
+        raw = np.full(shape, 0.9, np.float32)
+        raw[:, corridor] = 0.1
+        path = str(tmp_path / "serp.n5")
+        file_reader(path).create_dataset(
+            "bnd", data=raw, chunks=tuple(BLOCK_SHAPE)
+        )
+        config_dir = str(tmp_path / "configs_serp")
+        cfg.write_global_config(config_dir, GCONF)
+        cfg.write_config(
+            config_dir, "hierarchy_blocks",
+            {"threshold": 0.5, "sigma_seeds": 1.0, "size_filter": 0},
+        )
+        wf = HierarchyWorkflow(
+            str(tmp_path / "tmp_serp"), config_dir,
+            input_path=path, input_key="bnd",
+            output_path=path, output_key="seg",
+        )
+        assert build([wf])
+        f = file_reader(path, "r")
+        seg = f["seg"][:]
+        # the block flood fragments the corridor across block borders
+        assert np.unique(seg[seg > 0]).size > 1
+        out = _resegment(tmp_path, path, config_dir, 0.2, "serp")
+        assert np.unique(out[out > 0]).size == 1, (
+            "face edges must merge the serpentine corridor at t above "
+            "its boundary values"
+        )
+        # and the merged support is exactly the fragmented support
+        np.testing.assert_array_equal(out > 0, seg > 0)
+
+    def test_face_saddle_height_decides_the_merge(self, tmp_path):
+        """Two flat regions in z-adjacent blocks touching only through
+        the block face: the face saddle is the max of the two touching
+        planes — merged strictly above it, separate strictly below.
+        3d flood mode, so each block is ONE region and the only
+        hierarchy edge is the face edge."""
+        shape = (8, 16, 16)
+        raw = np.full(shape, 0.30, np.float32)
+        raw[3, :, :] = 0.40   # lower block's face plane
+        raw[4, :, :] = 0.45   # upper block's face plane -> saddle 0.45
+        path = str(tmp_path / "face.n5")
+        file_reader(path).create_dataset(
+            "bnd", data=raw, chunks=(4, 16, 16)
+        )
+        config_dir = str(tmp_path / "configs_face")
+        cfg.write_global_config(config_dir, GCONF)
+        cfg.write_config(
+            config_dir, "hierarchy_blocks",
+            {"threshold": 0.5, "sigma_seeds": 1.0, "size_filter": 0,
+             "apply_dt_2d": False, "apply_ws_2d": False},
+        )
+        wf = HierarchyWorkflow(
+            str(tmp_path / "tmp_face"), config_dir,
+            input_path=path, input_key="bnd",
+            output_path=path, output_key="seg",
+        )
+        assert build([wf])
+        art = hier_ops.load_hierarchy(
+            os.path.join(path, "seg_hierarchy.npz")
+        )
+        assert art["saddle"].size >= 1
+        below = _resegment(tmp_path, path, config_dir, 0.44, "below")
+        above = _resegment(tmp_path, path, config_dir, 0.46, "above")
+        assert np.unique(below[below > 0]).size == 2
+        assert np.unique(above[above > 0]).size == 1
+
+
+# ---------------------------------------------------------------------------
+# warm sweep: zero input reads, zero upload bytes
+
+
+class TestWarmSweep:
+    def test_second_cut_zero_reads_zero_uploads(self, tmp_path, rng):
+        from cluster_tools_tpu.runtime import hbm
+
+        obs_metrics.reset()
+        obs_trace.enable(str(tmp_path / "trace"), "hier_warm",
+                         export_env=False)
+        try:
+            raw = _volume(rng)
+            path, config_dir = _build_hierarchy(tmp_path, raw, tag="warm")
+            hbm.set_cache_budget(256 * 1024 * 1024)
+            art = hier_ops.load_hierarchy(
+                os.path.join(path, "seg_hierarchy.npz")
+            )
+            t_lo, t_hi = np.quantile(art["saddle"], [0.3, 0.7])
+
+            def counters():
+                return dict(obs_metrics.snapshot()["counters"])
+
+            def one_cut(t, tag):
+                # no output readback inside the measured window — the
+                # verification reads happen after c2
+                rs_dir = str(tmp_path / f"configs_rs_{tag}")
+                cfg.write_global_config(rs_dir, GCONF)
+                cfg.write_config(
+                    rs_dir, "resegment", {"threshold": float(t)}
+                )
+                wf = ResegmentWorkflow(
+                    str(tmp_path / f"tmp_rs_{tag}"), rs_dir,
+                    labels_path=path, labels_key="seg",
+                    output_path=path, output_key=f"seg_{tag}",
+                )
+                assert build([wf])
+
+            one_cut(t_lo, "w0")
+            c1 = counters()
+            one_cut(t_hi, "w1")
+            c2 = counters()
+            out = file_reader(path, "r")["seg_w1"][:]
+
+            def delta(name):
+                return c2.get(name, 0) - c1.get(name, 0)
+
+            assert delta("device.upload_bytes") == 0, (
+                "warm sweep must not re-upload the labels volume"
+            )
+            assert delta("device.uploads_skipped") > 0
+            assert delta("store.chunks_read") == 0, (
+                "warm sweep must not re-read input chunks"
+            )
+            # and it still computed the right thing
+            seg = file_reader(path, "r")["seg"][:].astype(np.int64)
+            ri = _partition_ri(
+                out, hier_ops.resegment_np(seg, raw, float(t_hi))
+            )
+            assert ri == 1.0
+        finally:
+            obs_trace.disable()
+            obs_metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# serve `resegment` job type
+
+
+class TestServeResegment:
+    def test_protocol_normalization_and_validation(self):
+        from cluster_tools_tpu.serve import protocol
+
+        rec = protocol.validate_submission({
+            "type": "resegment",
+            "hierarchy": "/x/seg_hierarchy.npz",
+            "labels_path": "/x/d.n5", "labels_key": "seg",
+            "output_path": "/x/d.n5", "output_key": "seg_t",
+            "threshold": 0.25,
+            "tmp_folder": "/x/tmp", "config_dir": "/x/cfg",
+            "configs": {"global": {"block_shape": [4, 8, 8]}},
+        })
+        assert rec["type"] == "resegment"
+        assert rec["workflow"] == protocol.RESEGMENT_TASK
+        assert rec["kwargs"]["hierarchy_path"] == "/x/seg_hierarchy.npz"
+        assert rec["kwargs"]["input_key"] == "seg"
+        assert rec["configs"]["resegment"]["threshold"] == 0.25
+        # the sweep signature ignores the threshold: every step after the
+        # first is a warm job
+        rec2 = protocol.validate_submission({
+            "type": "resegment",
+            "hierarchy": "/x/seg_hierarchy.npz",
+            "labels_path": "/x/d.n5", "labels_key": "seg",
+            "output_path": "/x/d.n5", "output_key": "seg_t2",
+            "threshold": 0.75,
+            "tmp_folder": "/x/tmp2", "config_dir": "/x/cfg2",
+            "configs": {"global": {"block_shape": [4, 8, 8]}},
+        })
+        assert protocol.job_signature(rec) == protocol.job_signature(rec2)
+        # validation is loud
+        with pytest.raises(protocol.ProtocolError, match="threshold"):
+            protocol.validate_submission({
+                "type": "resegment", "hierarchy": "h",
+                "labels_path": "p", "labels_key": "k",
+                "output_path": "p", "output_key": "o",
+                "tmp_folder": "t", "config_dir": "c",
+            })
+        with pytest.raises(protocol.ProtocolError, match="hierarchy"):
+            protocol.validate_submission({
+                "type": "resegment", "threshold": 0.5,
+                "labels_path": "p", "labels_key": "k",
+                "output_path": "p", "output_key": "o",
+                "tmp_folder": "t", "config_dir": "c",
+            })
+        with pytest.raises(protocol.ProtocolError, match="job type"):
+            protocol.validate_submission({"type": "sweep", "workflow": "X"})
+
+    def test_serve_resegment_e2e_byte_parity(self, tmp_path, rng):
+        from cluster_tools_tpu.runtime.workflow import ExecutionContext
+        from cluster_tools_tpu.serve import ServeClient, ServeDaemon
+
+        was_on = obs_trace.enabled()
+        if not was_on:
+            obs_trace.enable(str(tmp_path / "trace"), "hier_serve",
+                             export_env=False)
+        prev_ctx = ExecutionContext._PROCESS
+        raw = _volume(rng)
+        path, config_dir = _build_hierarchy(tmp_path, raw, tag="srv")
+        art = os.path.join(path, "seg_hierarchy.npz")
+        t = float(np.quantile(
+            hier_ops.load_hierarchy(art)["saddle"], 0.5
+        ))
+        local = _resegment(tmp_path, path, config_dir, t, "srv_local")
+        d = ServeDaemon(str(tmp_path / "state"),
+                        config={"concurrency": 1})
+        d.start()
+        try:
+            client = ServeClient(state_dir=str(tmp_path / "state"))
+            c0 = dict(obs_metrics.snapshot()["counters"])
+            job = client.resegment(
+                hierarchy=art, labels_path=path, labels_key="seg",
+                output_path=path, output_key="seg_srv",
+                threshold=t,
+                tmp_folder=str(tmp_path / "tmp_srv_job"),
+                config_dir=str(tmp_path / "configs_srv_job"),
+                configs={"global": dict(GCONF)},
+            )
+            state = client.wait(job, timeout_s=300)
+            assert state["result"]["ok"], state
+            c1 = dict(obs_metrics.snapshot()["counters"])
+            assert c1.get("hier.resegment_jobs", 0) > c0.get(
+                "hier.resegment_jobs", 0
+            )
+        finally:
+            d.request_drain()
+            if d._httpd is not None:
+                d._httpd.shutdown()
+                d._httpd.server_close()
+            for th in d._threads:
+                if th.name.startswith("ctt-serve-exec"):
+                    th.join(timeout=30)
+            ExecutionContext._PROCESS = prev_ctx
+            if not was_on:
+                obs_trace.disable()
+            obs_metrics.reset()
+        f = file_reader(path, "r")
+        np.testing.assert_array_equal(f["seg_srv"][:], local)
+
+
+# ---------------------------------------------------------------------------
+# disabled / fallback paths
+
+
+class TestFallbacks:
+    def test_unfused_build_byte_identical(self, tmp_path, rng,
+                                          monkeypatch):
+        raw = _volume(rng)
+        path_f, _ = _build_hierarchy(tmp_path, raw, tag="fused")
+        monkeypatch.setenv("CTT_STREAM_FUSION", "0")
+        path_u, _ = _build_hierarchy(tmp_path, raw, tag="unfused")
+        fa = file_reader(path_f, "r")
+        fb = file_reader(path_u, "r")
+        np.testing.assert_array_equal(fa["seg"][:], fb["seg"][:])
+        aa = hier_ops.load_hierarchy(
+            os.path.join(path_f, "seg_hierarchy.npz")
+        )
+        ab = hier_ops.load_hierarchy(
+            os.path.join(path_u, "seg_hierarchy.npz")
+        )
+        for k in ("a", "b", "saddle", "n_labels"):
+            np.testing.assert_array_equal(aa[k], ab[k], err_msg=k)
+
+    def test_local_target_recut_parity(self, built, tmp_path):
+        _, path, config_dir, _ = built
+        art = hier_ops.load_hierarchy(
+            os.path.join(path, "seg_hierarchy.npz")
+        )
+        t = float(np.quantile(art["saddle"], 0.5))
+        tpu_out = _resegment(tmp_path, path, config_dir, t, "fb_tpu")
+        loc_dir = str(tmp_path / "configs_fb_local")
+        cfg.write_global_config(
+            loc_dir, {"block_shape": BLOCK_SHAPE, "target": "local",
+                      "max_jobs": 1}
+        )
+        cfg.write_config(loc_dir, "resegment", {"threshold": t})
+        wf = ResegmentWorkflow(
+            str(tmp_path / "tmp_fb_local"), loc_dir,
+            labels_path=path, labels_key="seg",
+            output_path=path, output_key="seg_fb_local",
+        )
+        assert build([wf])
+        np.testing.assert_array_equal(
+            file_reader(path, "r")["seg_fb_local"][:], tpu_out
+        )

@@ -114,6 +114,16 @@ def derive_modes(results: dict) -> dict:
         modes["CTT_DTWS_MODE"] = "pallas"
     if "best_device_batch" in results:
         modes["CTT_DEVICE_BATCH"] = str(results["best_device_batch"])
+    # ctt-hbm aggregated dispatch: pin a measured stack depth only where
+    # stacking k payloads into one dispatch won by >= 1.1x on this backend
+    # (work-bound backends keep the per-batch dispatch shape); the pin
+    # makes aggregation the DEFAULT via runtime/hbm.py::hbm_stack, same
+    # precedence as CTT_DEVICE_BATCH (env > pin file > off)
+    if (
+        results.get("best_hbm_stack", 1) > 1
+        and results.get("hbm_stack_speedup", 0.0) >= 1.1
+    ):
+        modes["CTT_HBM_STACK"] = str(results["best_hbm_stack"])
     # graph-domain MWS: route to the device kernel only when it measurably
     # beats the host C++ on this backend; pin host explicitly otherwise so
     # the measured default is recorded either way (VERDICT r4 item 4)

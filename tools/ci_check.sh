@@ -1077,6 +1077,133 @@ if [ "$hbm_rc" -ne 0 ]; then
     exit "$hbm_rc"
 fi
 
+echo "== ctt-hier smoke (daemon hierarchy build, 3-threshold warm sweep, parity vs fresh re-runs, zero warm upload bytes) =="
+hier_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$hier_tmp" <<'PY'
+import os, signal, subprocess, sys, time
+
+td = sys.argv[1]
+state_dir = os.path.join(td, "state")
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+from scipy import ndimage
+from cluster_tools_tpu.ops import hier as hier_ops
+from cluster_tools_tpu.serve import ServeClient
+from cluster_tools_tpu.utils import file_reader
+
+path = os.path.join(td, "d.n5")
+rng = np.random.default_rng(0)
+raw = ndimage.gaussian_filter(rng.random((8, 32, 32)), (1.0, 2.0, 2.0))
+raw = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+file_reader(path).create_dataset("bnd", data=raw, chunks=(4, 16, 16))
+gconf = {"block_shape": [4, 16, 16], "target": "tpu",
+         "device_batch_size": 1, "pipeline_depth": 2}
+bconf = {"threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10}
+
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "cluster_tools_tpu.serve",
+     "--state-dir", state_dir],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+)
+try:
+    client = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        assert daemon.poll() is None, daemon.stderr.read()
+        try:
+            client = ServeClient(state_dir=state_dir)
+            client.healthz()
+            break
+        except Exception:
+            time.sleep(0.1)
+    assert client is not None, "daemon never became healthy"
+
+    def scrape():
+        return {
+            ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+            for ln in client.metrics_text().splitlines()
+            if ln and not ln.startswith("#")
+        }
+
+    def build_job(tag, out_key):
+        return client.submit_and_wait(
+            "HierarchyWorkflow",
+            {"tmp_folder": os.path.join(td, f"tmp_{tag}"),
+             "config_dir": os.path.join(td, f"configs_{tag}"),
+             "input_path": path, "input_key": "bnd",
+             "output_path": path, "output_key": out_key},
+            configs={"global": dict(gconf), "hierarchy_blocks": dict(bconf)},
+            timeout_s=600,
+        )
+
+    def reseg(tag, labels_key, out_key, t, write_volume):
+        job = client.resegment(
+            hierarchy=os.path.join(path, f"{labels_key}_hierarchy.npz"),
+            labels_path=path, labels_key=labels_key,
+            output_path=path, output_key=out_key,
+            threshold=t, write_volume=write_volume,
+            tmp_folder=os.path.join(td, f"tmp_{tag}"),
+            config_dir=os.path.join(td, f"configs_{tag}"),
+            configs={"global": dict(gconf)},
+        )
+        st = client.wait(job, timeout_s=600)
+        assert st["result"]["ok"], st
+        return st
+
+    s = build_job("build", "seg")
+    assert s["result"]["ok"], s
+    art = hier_ops.load_hierarchy(os.path.join(path, "seg_hierarchy.npz"))
+    ts = [float(t) for t in np.quantile(art["saddle"], (0.25, 0.5, 0.75))]
+    # warm the HBM cache + compiles, then the measured sweep window
+    reseg("warm", "seg", "seg_warm", ts[0], True)
+    m1 = scrape()
+    for i, t in enumerate(ts):
+        reseg(f"sweep{i}", "seg", f"cut{i}", t, False)
+    reseg("commit", "seg", "seg_commit", ts[1], True)
+    m2 = scrape()
+    up = "ctt_device_upload_bytes_total"
+    delta = m2.get(up, 0.0) - m1.get(up, 0.0)
+    assert delta == 0, f"warm sweep uploaded {delta} bytes"
+    assert m2.get("ctt_hier_resegment_jobs_total", 0) >= 5
+
+    # parity vs fresh full re-runs at every swept threshold
+    from cluster_tools_tpu.ops.evaluation import rand_scores
+    from cluster_tools_tpu.ops.segment import contingency_table
+
+    f = file_reader(path, "r")
+    seg = f["seg"][:]
+    for i, t in enumerate(ts):
+        assert build_job(f"full{i}", f"seg_f{i}")["result"]["ok"]
+        reseg(f"fullcut{i}", f"seg_f{i}", f"seg_f{i}_t", t, True)
+        cut = hier_ops.load_cut_table(
+            os.path.join(path, f"cut{i}_cut.npz"))
+        swept = hier_ops.apply_cut_np(seg, cut["vals"], cut["roots"])
+        ia, ib, counts = contingency_table(
+            swept.astype(np.uint64), f[f"seg_f{i}_t"][:])
+        ri = rand_scores(ia, ib, counts)["rand_index"]
+        assert ri == 1.0, (t, ri)
+    print("hier smoke ok: 3-threshold warm sweep, zero upload bytes,",
+          "RI == 1.0 vs fresh full re-runs at every threshold")
+finally:
+    daemon.send_signal(signal.SIGTERM)
+    try:
+        daemon.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        daemon.kill()
+        daemon.wait(timeout=30)
+PY
+hier_rc=$?
+rm -rf "$hier_tmp"
+if [ "$hier_rc" -ne 0 ]; then
+    echo "hier smoke failed (rc=$hier_rc): hierarchy build, warm sweep" \
+         "upload accounting, or re-cut parity regressed" >&2
+    exit "$hier_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

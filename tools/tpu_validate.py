@@ -383,6 +383,56 @@ def main():
 
     save()
 
+    # -- aggregated dispatch sweep (CTT_HBM_STACK pin, ctt-hbm) -------------
+    # k read payloads stacked into ONE (k*B, ...) dispatch vs k separate
+    # dispatches of the same vmapped kernel: aggregation amortizes
+    # dispatch/tunnel latency on a compute-light (dispatch-bound) kernel —
+    # the threshold shape, the workload hbm_stack targets.  Pinned (by
+    # chip_session.derive_modes) only where the measured win is >= 1.1x,
+    # so work-bound backends keep the per-batch dispatch shape.
+    try:
+        thr_block = raw[:8, :64, :64]
+        thr_fn = jax.jit(jax.vmap(lambda v: (v > 0.5).astype(jnp.uint8)))
+        stack_k, stack_b = 8, 4
+        singles = [
+            [
+                jnp.asarray(np.stack([
+                    np.roll(v, 3 * j + k + 1, axis=1)
+                    for j in range(stack_b)
+                ]))
+                for k in range(stack_k)
+            ]
+            for v in _rolled(thr_block, SPAN)
+        ]
+        stacks = [
+            jnp.concatenate(parts, axis=0) for parts in singles
+        ]
+        t_single = timeit(
+            None, REPEATS,
+            sync=lambda r: r[-1].block_until_ready(),
+            variants=[
+                (lambda parts: lambda: [thr_fn(p) for p in parts])(parts)
+                for parts in singles
+            ],
+        )
+        t_stacked = timeit(
+            None, REPEATS,
+            sync=lambda r: r.block_until_ready(),
+            variants=[(lambda s: lambda: thr_fn(s))(s) for s in stacks],
+        )
+        results["hbm_single_ms"] = round(t_single * 1e3, 2)
+        results["hbm_stacked_ms"] = round(t_stacked * 1e3, 2)
+        speedup = t_single / max(t_stacked, 1e-9)
+        results["hbm_stack_speedup"] = round(speedup, 2)
+        results["best_hbm_stack"] = stack_k if speedup >= 1.1 else 1
+        print(f"hbm stack x{stack_k}: {t_single*1e3:.2f} ms separate -> "
+              f"{t_stacked*1e3:.2f} ms stacked ({speedup:.2f}x)")
+    except Exception as e:
+        results["hbm_stack_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"hbm stack sweep FAILED: {e}")
+
+    save()
+
     # -- verdicts ------------------------------------------------------------
     results["flood_assoc_wins"] = results["dtws_assoc_ms"] < results["dtws_seq_ms"]
     results["cc_assoc_wins"] = results["cc_assoc_ms"] < results["cc_seq_ms"]
