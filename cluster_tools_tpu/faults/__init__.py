@@ -51,6 +51,10 @@ Sites (each named where the corresponding code path lives):
       the lease JSON — readers age it from file mtime, so a torn lease
       still expires) / ``sched.requeue`` (the expired-lease takeover —
       stale-requeue storms)  — runtime/queue.py (ctt-steal).
+  ``fleet.write`` (ctx ``id``: daemon id; fleet heartbeat payloads —
+      ``torn`` truncates the ``daemon.<id>.json`` beat, and peer liveness
+      readers must degrade to mtime ageing instead of crashing or
+      misdeclaring the writer dead)  — serve/fleet.py (ctt-fleet).
 
 Actions: ``io_error`` (OSError EIO), ``fail`` (FaultInjected), ``kill``
 (``os._exit(KILL_EXIT_CODE)`` — a hard crash, no cleanup), ``stall``
@@ -113,6 +117,7 @@ KNOWN_SITES = frozenset({
     "task.barrier",
     "collective.init", "collective.execute",
     "sched.claim", "sched.write", "sched.requeue",
+    "fleet.write",
 })
 
 KNOWN_ACTIONS = frozenset({"io_error", "fail", "kill", "stall", "torn"})

@@ -597,6 +597,29 @@ def format_watch(snap: Dict[str, Any]) -> str:
             if isinstance(val, (int, float)):
                 parts.append(f"{label} {int(val)}")
         lines.append("  serve: " + ", ".join(parts))
+    gauges = snap.get("gauges", {})
+    if (
+        "serve.peers" in gauges
+        or "fleet.queue_depth" in gauges
+        or any(
+            k in counters
+            for k in ("serve.jobs_reclaimed", "serve.jobs_quarantined")
+        )
+    ):
+        # ctt-fleet: one line of fleet health — live daemons over the
+        # shared state dir, the fleet-wide backlog, and the failure-
+        # recovery ledger (fast-path reclaims + quarantined poison jobs)
+        parts = []
+        for label, key, store in (
+            ("peers", "serve.peers", gauges),
+            ("queue depth", "fleet.queue_depth", gauges),
+            ("reclaimed", "serve.jobs_reclaimed", counters),
+            ("quarantined", "serve.jobs_quarantined", counters),
+        ):
+            val = store.get(key)
+            if isinstance(val, (int, float)):
+                parts.append(f"{label} {int(val)}")
+        lines.append("  fleet: " + ", ".join(parts))
     if any(k.startswith("device.") for k in counters):
         # ctt-hbm: one line of device-pipeline health — bytes that crossed
         # to HBM vs uploads the warm buffer cache absorbed, dispatch

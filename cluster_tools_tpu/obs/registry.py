@@ -122,6 +122,14 @@ COUNTERS = frozenset({
     "serve.cold_compile_jobs",  # first job of a signature: pays compiles
     "serve.leases_requeued",    # stale job leases taken over at gen+1
                                 # (a predecessor daemon died mid-job)
+    "serve.jobs_reclaimed",     # ctt-fleet fast-path takeovers: the
+                                # owner's fleet heartbeat proved it dead,
+                                # so the lease expired at heartbeat (not
+                                # lease) staleness — a subset of
+                                # serve.leases_requeued
+    "serve.jobs_quarantined",   # jobs parked as failed results after
+                                # exhausting max_job_gens generations
+                                # (the poison-job retry budget)
 })
 
 # -- gauges (metrics.set_gauge) ---------------------------------------------
@@ -142,6 +150,12 @@ GAUGES = frozenset({
     # currently executing
     "serve.queue_depth",
     "serve.running_jobs",
+    # ctt-fleet: live (beating, non-exiting) daemons sharing the state
+    # dir, and the fleet-wide queued-job backlog (the shared-dir count —
+    # identical on every daemon, unlike per-daemon serve.queue_depth
+    # history before the fleet)
+    "serve.peers",
+    "fleet.queue_depth",
 })
 
 # dynamic name families: one series per <suffix>, allowed by prefix

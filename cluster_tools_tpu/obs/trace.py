@@ -86,7 +86,8 @@ grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
 
     serve.json                  the endpoint record, atomically replaced
                                 at daemon start with mode 0600: {"host",
-                                "port", "pid", "started_wall", "run_id",
+                                "port", "pid", "daemon_id",
+                                "started_wall", "run_id",
                                 "token"} — clients discover the daemon by
                                 file, not by port convention, and
                                 "token" (required on every request
@@ -101,15 +102,45 @@ grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
     jobs/lease.<id>.g<g>.json   generation-g execution ownership,
                                 re-stamped every lease_s by the running
                                 daemon: {"job", "gen", "owner_pid",
-                                "claim_wall", "wall", "mono"}.  Stale
-                                beyond 3 x lease_s = the daemon died
-                                mid-job; the next daemon on the same
-                                state dir claims gen g+1.
+                                "daemon" (the claiming daemon's fleet id,
+                                stamped at claim time so peers can judge
+                                the lease even if the owner dies before
+                                its first renewal), "claim_wall", "wall",
+                                "mono"}.  Stale beyond 3 x lease_s = the
+                                daemon died mid-job; the next daemon on
+                                the same state dir claims gen g+1 — or
+                                immediately, if the owner's fleet beat
+                                (below) already proves it dead.
+    jobs/admit.<id>.json        ctt-fleet two-phase admission marker,
+                                exclusive link: {"id", "wall", "daemon"}.
+                                A record published with "admitted": false
+                                is claimable only once this lands; a
+                                rejected submission is retracted as a
+                                result with "rejected": true instead.
     jobs/result.<id>.json       terminal record, first writer wins:
                                 {"id", "gen", "ok", "error", "seconds",
                                 "warm", "compile_cache": {"hits",
-                                "misses"}, "tenant", "pid",
-                                "finished_wall"}.
+                                "misses"}, "tenant", "pid", "daemon",
+                                "finished_wall"}.  A quarantined poison
+                                job (retry budget exhausted) parks here
+                                with {"ok": false, "quarantined": true,
+                                "failure_log": [each burned generation's
+                                last lease stamp], "gen" = max_job_gens};
+                                an admission retraction with {"ok":
+                                false, "rejected": true, "gen": -1}.
+    daemon.<id>.json            ctt-fleet heartbeat, atomically replaced
+                                every CTT_HEARTBEAT_S (the ctt-watch
+                                cadence — NOT lease_s: failover latency
+                                is bounded by this beat): {"id", "pid",
+                                "host", "port", "wall", "mono",
+                                "interval_s" (the promised cadence),
+                                "seq", "draining", "exiting",
+                                "running_jobs", "queued", "concurrency"}.
+                                A beat older than 3 x its interval_s, or
+                                stamped "exiting": true, marks the daemon
+                                dead: peers expire its job leases on the
+                                spot (serve.jobs_reclaimed) instead of
+                                waiting out lease staleness.
 
 Hierarchy artifact (ctt-hier; lives BESIDE the labels volume —
 ``<output_path>/<output_key>_hierarchy.npz`` by default — because it is

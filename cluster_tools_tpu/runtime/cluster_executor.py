@@ -25,13 +25,13 @@ from __future__ import annotations
 import json
 import os
 import pickle
-import shutil
 import subprocess
 import sys
 import time
 from typing import Any, Dict, List, Sequence, Set
 
 from ..obs import metrics as obs_metrics
+from ..utils import store_backend
 from ..utils.blocking import Blocking
 from . import queue as workq
 from .cluster_worker import job_paths
@@ -134,12 +134,28 @@ class ClusterExecutor(BaseExecutor):
 
     def _create_queue(self, task, job_dir: str, ids: List[int],
                       config, n_jobs: int) -> "workq.WorkQueue":
-        queue_dir = os.path.join(job_dir, "queue")
-        if os.path.isdir(queue_dir):
+        base = config.get("steal_queue_url")
+        if base:
+            # ctt-fleet: queue on an object store — workers on hosts with
+            # no shared mount pull/steal through the StoreBackend seam
+            # (conditional-PUT claims); keyed by the job-dir leaf so
+            # multi-host drivers keep their per-process namespaces
+            backend = store_backend.backend_for(str(base))
+            queue_dir = backend.join(
+                str(base), os.path.basename(job_dir) + "_queue"
+            )
+        else:
+            backend = store_backend.backend_for(job_dir)
+            queue_dir = os.path.join(job_dir, "queue")
+        try:
+            stale = backend.isdir(queue_dir)
+        except OSError:
+            stale = False
+        if stale:
             # one queue per dispatch: a retry round (or a resumed driver)
             # re-publishes exactly its todo list — stale leases/results
             # from a previous round must not satisfy it
-            shutil.rmtree(queue_dir)
+            backend.rmtree(queue_dir)
         return workq.WorkQueue.create(
             queue_dir, task.identifier, ids,
             workq.steal_batch_size(config, len(ids), n_jobs),

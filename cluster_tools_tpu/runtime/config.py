@@ -106,6 +106,14 @@ DEFAULT_SERVE_CONFIG: Dict[str, Any] = {
     # SIGTERM drain: how long to wait for in-flight jobs before dying
     # anyway (queued jobs are durable either way)
     "drain_timeout_s": 300.0,
+    # ctt-fleet: retry budget per job — a job may burn this many lease
+    # generations (daemon deaths / crashes mid-job) before the next
+    # would-be claimant quarantines it as a failed result instead of
+    # re-executing (<= 0 restores unbounded retries)
+    "max_job_gens": 3,
+    # fleet identity (None = <host>-<pid>-<n>); stamps leases and names
+    # the daemon.<id>.json fleet heartbeat in the state dir
+    "daemon_id": None,
     # ctt-hbm warm device-buffer cache budget (MB) for the daemon's
     # ExecutionContext: back-to-back jobs on the same volume reuse the
     # HBM-resident uploads instead of re-transferring.  0 disables (the

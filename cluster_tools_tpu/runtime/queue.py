@@ -10,7 +10,11 @@ stragglers (per-task ETA, in-flight block age, heartbeat staleness) —
 this module is the control loop that *acts* on them.
 
 Instead of a frozen split, the driver publishes one **work queue** on the
-shared filesystem (``<job_dir>/queue/``) and workers *pull* block batches
+shared filesystem (``<job_dir>/queue/``) — or, when the global config
+sets ``steal_queue_url``, on an ``http(s)://`` object store (ctt-fleet:
+every queue file routes through the :class:`StoreBackend` seam, with the
+exclusive link becoming a create-only conditional PUT, so workers with no
+shared mount steal across hosts) — and workers *pull* block batches
 under expiring **leases**:
 
   * ``manifest.json`` — the immutable item list (block-id batches, formed
@@ -76,7 +80,7 @@ from .. import faults
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
-from ..utils.store import atomic_write_bytes
+from ..utils.store_backend import backend_for
 
 __all__ = [
     "WorkQueue", "Claim", "drain", "resolve_sched", "sched_label",
@@ -102,24 +106,17 @@ _RESULT_RE = re.compile(r"^result\.(\d+)\.json$")
 def publish_once(path: str, payload: bytes) -> bool:
     """Atomically publish ``payload`` at ``path`` iff nothing is there yet.
 
-    Stage to a pid+thread-unique tmp file (fsync'd, the store convention)
-    and ``os.link`` it into place: the link either creates ``path`` with
-    the full payload visible — no reader can observe a partial file — or
-    fails with EEXIST.  Returns True when this caller won the slot.  The
-    cross-process-exclusive cousin of ``atomic_write_bytes`` (which
-    last-writer-wins replaces)."""
-    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
-    atomic_write_bytes(tmp, payload)
-    try:
-        os.link(tmp, path)
-        return True
-    except FileExistsError:
-        return False
-    finally:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+    Routed through the owning :class:`StoreBackend` (ctt-fleet): POSIX
+    stages to a pid+thread-unique tmp file (fsync'd, the store
+    convention) and ``os.link``s it into place — the link either creates
+    ``path`` with the full payload visible (no reader can observe a
+    partial file) or fails with EEXIST; an ``http(s)://`` path becomes a
+    create-only conditional PUT (``If-None-Match: *``, 412 = lost race)
+    so leases and results arbitrate identically on an object store.
+    Returns True when this caller won the slot.  The cross-process-
+    exclusive cousin of ``atomic_write_bytes`` (which last-writer-wins
+    replaces)."""
+    return backend_for(path).publish_once(path, payload)
 
 
 def resolve_sched(config: Dict[str, Any], task=None,
@@ -204,9 +201,18 @@ class WorkQueue:
     from it concurrently through :meth:`claim` / :meth:`complete`."""
 
     def __init__(self, queue_dir: str):
+        # the queue dir may be a POSIX path (one shared filesystem) or an
+        # http(s) object-store URL (ctt-fleet: cross-host stealing with
+        # no shared mount) — every file operation routes through the
+        # owning backend, and claims stay exclusive either way
         self.dir = queue_dir
-        with open(os.path.join(queue_dir, MANIFEST_NAME)) as f:
-            m = json.load(f)
+        self._backend = backend_for(queue_dir)
+        self._join = self._backend.join
+        m = json.loads(
+            self._backend.read_bytes(
+                self._join(queue_dir, MANIFEST_NAME)
+            ).decode()
+        )
         self.task = m.get("task", "unknown")
         self.items: List[List[int]] = [list(map(int, it)) for it in m["items"]]
         self.lease_s = float(m.get("lease_s", 5.0))
@@ -229,10 +235,11 @@ class WorkQueue:
                duplicate: bool = True) -> "WorkQueue":
         from ..parallel.dispatch import form_batches
 
-        os.makedirs(queue_dir, exist_ok=True)
+        backend = backend_for(queue_dir)
+        backend.makedirs(queue_dir)
         items = form_batches(block_ids, batch_size)
-        atomic_write_bytes(
-            os.path.join(queue_dir, MANIFEST_NAME),
+        backend.write_bytes(
+            backend.join(queue_dir, MANIFEST_NAME),
             json.dumps({
                 "task": task_id,
                 "items": items,
@@ -252,7 +259,7 @@ class WorkQueue:
         results: Dict[int, bool] = {}
         leases: Dict[int, Tuple[int, str]] = {}
         try:
-            names = os.listdir(self.dir)
+            names = self._backend.listdir(self.dir)
         except OSError:
             names = []
         for name in names:
@@ -265,20 +272,20 @@ class WorkQueue:
                 k, g = int(m.group(1)), int(m.group(2))
                 cur = leases.get(k)
                 if cur is None or g > cur[0]:
-                    leases[k] = (g, os.path.join(self.dir, name))
+                    leases[k] = (g, self._join(self.dir, name))
         return results, leases
 
     def _read_json(self, path: str) -> Optional[dict]:
         try:
-            with open(path) as f:
-                rec = json.load(f)
+            rec = json.loads(self._backend.read_bytes(path).decode())
             return rec if isinstance(rec, dict) else None
-        except (OSError, json.JSONDecodeError):
+        except (OSError, ValueError):
             return None
 
     def _lease_age_s(self, path: str, now: float) -> float:
         """Wall age of a lease's last stamp; a torn/unparsable lease ages
-        from its file mtime — it still expires, just without attribution."""
+        from its storage mtime — it still expires, just without
+        attribution."""
         rec = self._read_json(path)
         stamp = None
         if rec is not None:
@@ -287,9 +294,8 @@ class WorkQueue:
             except (KeyError, TypeError, ValueError):
                 stamp = None
         if stamp is None:
-            try:
-                stamp = os.path.getmtime(path)
-            except OSError:
+            stamp = self._backend.mtime(path)
+            if stamp is None:
                 return 0.0
         return max(0.0, now - stamp)
 
@@ -314,7 +320,7 @@ class WorkQueue:
 
     def _try_claim(self, item: int, gen: int, job_id) -> Optional[Claim]:
         claim_wall = time.time()
-        path = os.path.join(self.dir, f"lease.{item}.g{gen}.json")
+        path = self._join(self.dir, f"lease.{item}.g{gen}.json")
         if not publish_once(
             path, self._lease_payload(item, gen, job_id, claim_wall)
         ):
@@ -330,7 +336,7 @@ class WorkQueue:
         decided at link time, renewal only refreshes the staleness clock)."""
         if claim.lease_path is None:
             return
-        atomic_write_bytes(
+        self._backend.write_bytes(
             claim.lease_path,
             self._lease_payload(claim.item, claim.gen, job_id,
                                 claim.claim_wall),
@@ -414,7 +420,7 @@ class WorkQueue:
         seconds = []
         for k in results:
             rec = self._read_json(
-                os.path.join(self.dir, f"result.{k}.json")
+                self._join(self.dir, f"result.{k}.json")
             )
             if rec is not None and isinstance(rec.get("seconds"), (int, float)):
                 seconds.append(float(rec["seconds"]))
@@ -495,7 +501,7 @@ class WorkQueue:
             "wall": time.time(),
         }
         return publish_once(
-            os.path.join(self.dir, f"result.{claim.item}.json"),
+            self._join(self.dir, f"result.{claim.item}.json"),
             json.dumps(record).encode(),
         )
 
@@ -517,7 +523,7 @@ class WorkQueue:
         results, leases = self._scan()
         for k, ids in enumerate(self.items):
             rec = (
-                self._read_json(os.path.join(self.dir, f"result.{k}.json"))
+                self._read_json(self._join(self.dir, f"result.{k}.json"))
                 if k in results else None
             )
             if rec is not None:

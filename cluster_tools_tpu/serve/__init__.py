@@ -18,20 +18,28 @@ Layout:
   * :mod:`serve.jobs`      — the durable on-disk job queue (the ctt-steal
     ``publish_once`` lease/result idiom over job granularity: queued jobs
     survive daemon death, stale leases requeue on restart);
-  * :mod:`serve.admission` — queue-depth + per-tenant quota gate;
+  * :mod:`serve.admission` — queue-depth + per-tenant quota gate (held
+    fleet-wide via the two-phase shared-dir recount);
+  * :mod:`serve.fleet`     — multi-daemon fault tolerance (ctt-fleet):
+    fleet heartbeats, peer liveness, fast-path lease failover, elastic
+    capacity advice;
   * :mod:`serve.server`    — the daemon (HTTP endpoints, executor
     threads, SIGTERM drain);
   * :mod:`serve.client`    — the local submission client.
 """
 
 from .client import QuotaRejected, ServeClient, read_endpoint
+from .fleet import FleetView, read_peers, scale_advice
 from .jobs import JobQueue
 from .server import ServeDaemon
 
 __all__ = [
+    "FleetView",
     "JobQueue",
     "QuotaRejected",
     "ServeClient",
     "ServeDaemon",
     "read_endpoint",
+    "read_peers",
+    "scale_advice",
 ]

@@ -3,12 +3,16 @@
     python -m cluster_tools_tpu.serve --state-dir DIR [--port P]
         [--host H] [--concurrency N] [--max-queue-depth N]
         [--tenant-quota N] [--lease-s S] [--drain-timeout-s S]
+        [--max-job-gens N] [--daemon-id ID]
 
 The daemon binds loopback (ephemeral port by default), publishes its
 endpoint to ``<state_dir>/serve.json``, and serves until SIGTERM/SIGINT,
 which triggers a drain: in-flight jobs finish, queued jobs stay durable
 in ``<state_dir>/jobs/`` for the next daemon over the same state dir.
-Flags override ``<state_dir>/serve.config`` which overrides
+Run SEVERAL against one state dir for a fault-tolerant fleet (ctt-fleet):
+they share the queue, enforce admission limits jointly, and fail over a
+dead peer's jobs within one heartbeat staleness window.  Flags override
+``<state_dir>/serve.config`` which overrides
 ``runtime.config.DEFAULT_SERVE_CONFIG``.
 """
 
@@ -35,6 +39,11 @@ def main(argv=None) -> int:
     parser.add_argument("--tenant-quota", type=int, default=None)
     parser.add_argument("--lease-s", type=float, default=None)
     parser.add_argument("--drain-timeout-s", type=float, default=None)
+    parser.add_argument("--max-job-gens", type=int, default=None,
+                        help="per-job retry budget: lease generations "
+                        "before quarantine (<= 0 = unbounded)")
+    parser.add_argument("--daemon-id", default=None,
+                        help="fleet identity (default <host>-<pid>-<n>)")
     args = parser.parse_args(argv)
 
     from .server import ServeDaemon
@@ -47,6 +56,8 @@ def main(argv=None) -> int:
         "tenant_quota": args.tenant_quota,
         "lease_s": args.lease_s,
         "drain_timeout_s": args.drain_timeout_s,
+        "max_job_gens": args.max_job_gens,
+        "daemon_id": args.daemon_id,
     })
     daemon.install_signal_handlers()
     endpoint = daemon.start()

@@ -15,6 +15,14 @@ Two gates, both evaluated against the durable queue's live accounting
 Rejections count as ``serve.quota_rejections`` (the lease-budget analog
 of the steal queue's admission role: here a *job* lease you cannot take
 yet is simply a job the daemon refuses to enqueue).
+
+The controller itself is stateless — it judges whatever ``stats`` dict
+it is handed.  Fleet consistency (ctt-fleet) therefore lives entirely in
+*which* stats the daemon passes: the two-phase flow publishes the record
+provisionally, recounts the **shared state dir** restricted to
+earlier-sequence jobs (``JobQueue.stats(before_seq=...)``), and only then
+admits — so k daemons over one state dir enforce ONE queue-depth and ONE
+per-tenant ceiling between them, instead of each admitting a full quota.
 """
 
 from __future__ import annotations
@@ -47,6 +55,16 @@ class AdmissionController:
 
     def quota_for(self, tenant: str) -> Optional[int]:
         return self.tenant_quotas.get(tenant, self.tenant_quota)
+
+    def describe(self) -> Dict[str, Any]:
+        """The configured limits, for ``/healthz`` — alongside the live
+        decision inputs (queued / in_flight / per-tenant counts) an
+        operator needs to see *why* a submission was rejected."""
+        return {
+            "max_queue_depth": self.max_queue_depth,
+            "tenant_quota": self.tenant_quota,
+            "tenant_quotas": dict(self.tenant_quotas),
+        }
 
     def admit(self, tenant: str,
               stats: Dict[str, Any]) -> Tuple[bool, Optional[str]]:

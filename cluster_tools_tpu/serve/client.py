@@ -189,6 +189,13 @@ class ServeClient:
     def healthz(self) -> Dict[str, Any]:
         return self._request("GET", "/healthz")
 
+    def fleet(self) -> Dict[str, Any]:
+        """The daemon's fleet view (ctt-fleet): its id, live peer count
+        and ids, the fleet-wide queue depth, and the elastic-capacity
+        ``scale_advice`` — what an external supervisor polls to decide
+        whether to spawn or drain daemons."""
+        return self.healthz().get("fleet", {})
+
     def metrics_text(self) -> str:
         req = urllib.request.Request(
             self.base + "/metrics", headers=self._headers()

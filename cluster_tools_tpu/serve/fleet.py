@@ -1,0 +1,340 @@
+"""ctt-fleet: peer liveness + capacity advice for a multi-daemon fleet.
+
+N serve daemons over ONE shared state dir are already *correct* — the
+durable job queue's exclusive leases arbitrate who runs what — but a
+dead daemon's leases only expire through the slow staleness rule
+(3 x ``lease_s``, which an operator may set to minutes for long jobs).
+This module adds the fast path: each daemon publishes a **fleet
+heartbeat** into the state dir on the ctt-watch cadence,
+
+    <state_dir>/daemon.<id>.json
+      {"id", "pid", "host", "port", "wall", "mono", "interval_s",
+       "seq", "draining", "exiting", "running_jobs", "queued",
+       "concurrency"}
+
+and a peer that finds a job lease owned by a daemon whose beat says it
+is gone — an ``exiting`` stamp, or a beat older than
+``STALE_INTERVALS`` x its *promised* cadence (the ctt-watch rule: every
+beat carries its own ``interval_s``, so readers never guess) — expires
+that lease **immediately** instead of waiting out the lease window.
+Recovery latency is then bounded by the heartbeat cadence, not by
+``lease_s``.
+
+Liveness is deliberately three-valued (:meth:`FleetView.is_dead`):
+``True`` only on positive evidence of death; ``None`` when the owner
+never published a beat (a pre-fleet daemon, or one killed inside the
+claim-to-first-beat window — the daemon closes that window by beating
+*before* its executors start, but a reader still must not guess).
+``None`` falls back to the slow lease-staleness rule, so the fast path
+can only ever be an *optimization*, never a new way to steal a live
+daemon's job.
+
+Chaos: beat payloads pass through the ``fleet.write`` torn-write site —
+a truncated ``daemon.<id>.json`` must degrade to mtime ageing (the
+runtime/queue.py torn-lease convention), not crash a peer or misdeclare
+the writer dead.
+
+:func:`scale_advice` is the elastic-capacity hook: advice only (spawn /
+drain / hold from fleet-wide backlog vs live capacity), for an external
+supervisor to act on — the fleet itself never forks daemons.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .. import faults
+from ..obs import heartbeat as obs_heartbeat
+from ..obs import trace as obs_trace
+from ..runtime.queue import STALE_INTERVALS
+from ..utils.store import atomic_write_bytes
+
+__all__ = [
+    "FleetBeat",
+    "FleetView",
+    "beat_path",
+    "default_daemon_id",
+    "read_peers",
+    "scale_advice",
+]
+
+_BEAT_RE = re.compile(r"^daemon\.([A-Za-z0-9_.-]+)\.json$")
+_ID_SAFE_RE = re.compile(r"[^A-Za-z0-9_.-]+")
+_instance_seq = itertools.count()
+
+
+def default_daemon_id() -> str:
+    """``<host>-<pid>-<n>``: unique per daemon *instance*, not just per
+    process — the test harness runs several in-process daemons over one
+    state dir, and two daemons sharing an id would shadow each other's
+    beats.  ``CTT_DAEMON_ID`` overrides (sanitized to filename-safe)."""
+    env = os.environ.get("CTT_DAEMON_ID")
+    if env:
+        return _ID_SAFE_RE.sub("-", env.strip()) or "daemon"
+    host = socket.gethostname().split(".")[0] or "host"
+    return _ID_SAFE_RE.sub(
+        "-", f"{host}-{os.getpid()}-{next(_instance_seq)}"
+    )
+
+
+def beat_path(state_dir: str, daemon_id: str) -> str:
+    return os.path.join(state_dir, f"daemon.{daemon_id}.json")
+
+
+class FleetBeat:
+    """One daemon's fleet heartbeat publisher.
+
+    ``start()`` stamps the first beat *synchronously* before returning —
+    the daemon calls it before its executor threads exist, so by the
+    time any lease carries this daemon's id there is already a beat for
+    peers to judge it by (no claim-to-first-beat blind window).  Then a
+    thread re-stamps every ``interval_s``; ``stop(final=True)`` stamps a
+    terminal ``exiting`` beat so peers fail over in one cadence instead
+    of three."""
+
+    def __init__(
+        self,
+        state_dir: str,
+        daemon_id: str,
+        interval_s: Optional[float] = None,
+        info_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+    ):
+        self.state_dir = state_dir
+        self.id = daemon_id
+        self.path = beat_path(state_dir, daemon_id)
+        try:
+            self.interval_s = float(interval_s) if interval_s else 0.0
+        except (TypeError, ValueError):
+            self.interval_s = 0.0
+        if self.interval_s <= 0:
+            self.interval_s = obs_heartbeat.interval_s()
+        self._info_fn = info_fn
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self, exiting: bool = False) -> None:
+        """Stamp one beat (atomic replace, the lease convention)."""
+        with self._lock:
+            rec = {
+                "id": self.id,
+                "pid": os.getpid(),
+                "wall": time.time(),
+                "mono": obs_trace.monotonic(),
+                "interval_s": self.interval_s,
+                "seq": self._seq,
+                "exiting": bool(exiting),
+            }
+            if self._info_fn is not None:
+                try:
+                    rec.update(self._info_fn() or {})
+                except Exception as e:
+                    # a beat must land even if the stats scan hiccups —
+                    # record the failure in the beat itself
+                    rec["info_error"] = repr(e)
+            self._seq += 1
+            payload = json.dumps(rec, sort_keys=True).encode()
+        torn = faults.mangle("fleet.write", payload, id=self.id)
+        try:
+            atomic_write_bytes(self.path, torn if torn is not None else
+                               payload)
+        except OSError:
+            # best-effort, the heartbeat convention: a full disk costs a
+            # spurious fast-path miss (peers fall back to lease ageing)
+            pass
+
+    def start(self) -> "FleetBeat":
+        self.beat()  # synchronous first stamp: no blind window
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ctt-fleet-beat", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def stop(self, final: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final:
+            self.beat(exiting=True)
+
+
+def read_peers(state_dir: str) -> Dict[str, Dict[str, Any]]:
+    """All published fleet beats, id -> record.  A torn/unreadable beat
+    degrades to ``{"id": ..., "torn": True}`` with no ``wall`` stamp —
+    callers age it from file mtime (:meth:`FleetView.is_dead` does)."""
+    peers: Dict[str, Dict[str, Any]] = {}
+    try:
+        names = os.listdir(state_dir)
+    except OSError:
+        return peers
+    for name in names:
+        m = _BEAT_RE.match(name)
+        if not m:
+            continue
+        pid = m.group(1)
+        path = os.path.join(state_dir, name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if not isinstance(rec, dict):
+                rec = {"torn": True}
+        except (OSError, ValueError):
+            rec = {"torn": True}
+        rec.setdefault("id", pid)
+        peers[pid] = rec
+    return peers
+
+
+class FleetView:
+    """Peer liveness over the shared state dir, with a tiny TTL cache so
+    a claim scan over many candidate leases costs one directory read,
+    not one per lease."""
+
+    def __init__(self, state_dir: str, self_id: Optional[str] = None,
+                 cache_ttl_s: float = 0.2):
+        self.state_dir = state_dir
+        self.self_id = self_id
+        self.cache_ttl_s = float(cache_ttl_s)
+        self._lock = threading.Lock()
+        self._cached: Optional[Dict[str, Dict[str, Any]]] = None
+        self._cached_mono = -1.0
+
+    def peers(self, refresh: bool = False) -> Dict[str, Dict[str, Any]]:
+        now = obs_trace.monotonic()
+        with self._lock:
+            if (
+                not refresh
+                and self._cached is not None
+                and now - self._cached_mono <= self.cache_ttl_s
+            ):
+                return self._cached
+        fresh = read_peers(self.state_dir)
+        with self._lock:
+            self._cached = fresh
+            self._cached_mono = now
+        return fresh
+
+    def _beat_age_s(self, daemon_id: str, rec: Dict[str, Any],
+                    now: float) -> Optional[float]:
+        stamp = None
+        try:
+            stamp = float(rec["wall"])
+        except (KeyError, TypeError, ValueError):
+            pass
+        if stamp is None:
+            # torn beat: age from mtime, the torn-lease convention
+            try:
+                stamp = os.path.getmtime(
+                    beat_path(self.state_dir, daemon_id)
+                )
+            except OSError:
+                return None
+        return max(0.0, now - stamp)
+
+    def is_dead(self, daemon_id: str,
+                now: Optional[float] = None) -> Optional[bool]:
+        """Three-valued liveness: ``True`` = positive evidence the
+        daemon is gone (``exiting`` stamp, or beat age over
+        ``STALE_INTERVALS`` x its promised cadence), ``False`` = provably
+        beating, ``None`` = no beat published (unknown — callers MUST
+        fall back to the slow lease-staleness rule).  A daemon never
+        declares itself dead."""
+        if self.self_id is not None and daemon_id == self.self_id:
+            return False
+        rec = self.peers().get(daemon_id)
+        if rec is None:
+            return None
+        if rec.get("exiting"):
+            return True
+        if now is None:
+            now = time.time()
+        age = self._beat_age_s(daemon_id, rec, now)
+        if age is None:
+            return None  # beat vanished between scan and stat: unknown
+        try:
+            interval = float(rec.get("interval_s") or 0.0)
+        except (TypeError, ValueError):
+            interval = 0.0
+        if interval <= 0:
+            interval = obs_heartbeat.interval_s()
+        return age > STALE_INTERVALS * interval
+
+    def live(self, now: Optional[float] = None) -> Dict[str, Dict[str, Any]]:
+        """The beating (non-dead, non-exiting) peers, id -> record."""
+        if now is None:
+            now = time.time()
+        return {
+            pid: rec for pid, rec in self.peers().items()
+            if self.is_dead(pid, now=now) is False
+        }
+
+
+def scale_advice(state_dir: str,
+                 stats: Optional[Dict[str, Any]] = None,
+                 view: Optional[FleetView] = None) -> Dict[str, Any]:
+    """Elastic-capacity hook: ``{"action": "spawn"|"drain"|"hold", ...}``
+    from fleet-wide backlog vs live capacity.  **Advice only** — the
+    fleet never forks daemons; an external supervisor polls this (via
+    ``/healthz``) and acts.  ``stats`` is a ``JobQueue.stats()`` dict
+    (the caller usually has one in hand); without it only the peer-side
+    numbers are reported and the action is ``hold``."""
+    if view is None:
+        view = FleetView(state_dir)
+    now = time.time()
+    live = view.live(now=now)
+    capacity = 0
+    draining = 0
+    for rec in live.values():
+        if rec.get("draining"):
+            draining += 1
+            continue
+        try:
+            capacity += max(int(rec.get("concurrency", 1)), 1)
+        except (TypeError, ValueError):
+            capacity += 1
+    advice: Dict[str, Any] = {
+        "daemons": len(live),
+        "draining": draining,
+        "capacity": capacity,
+        "action": "hold",
+    }
+    if stats is None:
+        advice["reason"] = "no queue stats supplied"
+        return advice
+    queued = int(stats.get("queued", 0))
+    running = int(stats.get("running", 0))
+    advice["queued"] = queued
+    advice["running"] = running
+    if queued > capacity:
+        advice["action"] = "spawn"
+        advice["reason"] = (
+            f"{queued} queued job(s) exceed fleet capacity {capacity}"
+        )
+    elif (
+        len(live) - draining > 1
+        and queued == 0
+        and running < max(capacity - 1, 0)
+    ):
+        advice["action"] = "drain"
+        advice["reason"] = (
+            f"idle headroom: {running} running over {capacity} capacity "
+            f"across {len(live) - draining} active daemon(s)"
+        )
+    else:
+        advice["reason"] = "backlog within capacity"
+    return advice

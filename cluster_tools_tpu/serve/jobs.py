@@ -9,12 +9,40 @@ growing directory instead of a fixed manifest:
 
     <state_dir>/jobs/
       job.<id>.json          the submission record (published exactly once)
+      admit.<id>.json        fleet admission marker (ctt-fleet): a record
+                             submitted provisionally (``admitted: false``)
+                             becomes claimable only once its submitter
+                             recounts the shared dir and publishes this —
+                             the two-phase step that makes queue depth and
+                             tenant quotas hold across k daemons instead
+                             of per daemon
       lease.<id>.g<g>.json   generation-g execution ownership, re-stamped
-                             every ``lease_s`` by the running daemon; a
-                             stamp older than 3 x lease_s means the owner
-                             died mid-job — the next daemon on the same
-                             state dir claims gen g+1 (requeue)
+                             every ``lease_s`` by the running daemon and
+                             stamped with the owner's **daemon id at claim
+                             time**; a stamp older than 3 x lease_s means
+                             the owner died mid-job — the next daemon on
+                             the same state dir claims gen g+1 (requeue)
       result.<id>.json       terminal record, first writer wins
+
+ctt-fleet hardening on top of the base queue:
+
+  * **fast-path expiry** — with a :class:`serve.fleet.FleetView`, a lease
+    whose owning daemon's fleet heartbeat says it is gone (``exiting``
+    stamp, or beat age > 3 x its cadence) expires *immediately*; recovery
+    latency is bounded by the heartbeat cadence, not ``lease_s``.  Such
+    takeovers count as ``serve.jobs_reclaimed`` (a subset of
+    ``serve.leases_requeued``, which counts every gen>0 takeover).
+  * **retry budget + quarantine** — a job may burn at most
+    ``max_job_gens`` generations (takeover of gen g additionally waits
+    out ``utils.retry.backoff_delay_s(g)``, so a poison job decelerates);
+    the claim that would start generation ``max_job_gens`` instead parks
+    the job as a first-writer-wins failed result with ``quarantined:
+    true`` and a ``failure_log`` of every generation's last lease stamp
+    (``serve.jobs_quarantined``).  Daemons survive; the job does not.
+  * **limbo reaping** — a provisional record whose submitter died before
+    publishing the admit marker is retracted (rejected result) once its
+    submitter is fleet-dead or the record outlives the stale window, so
+    it stops occupying admission headroom.
 
 Everything a client submitted is therefore durable: daemon death loses
 nothing (queued jobs sit untouched, a leased job's stale lease requeues),
@@ -30,19 +58,23 @@ import os
 import re
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..runtime.queue import STALE_INTERVALS, publish_once
+from ..utils.retry import backoff_delay_s
 from ..utils.store import atomic_write_bytes
 
 __all__ = ["JobClaim", "JobQueue"]
 
 _JOB_RE = re.compile(r"^job\.(j\d{6})\.json$")
+_ADMIT_RE = re.compile(r"^admit\.(j\d{6})\.json$")
 _LEASE_RE = re.compile(r"^lease\.(j\d{6})\.g(\d+)\.json$")
 _RESULT_RE = re.compile(r"^result\.(j\d{6})\.json$")
+
+DEFAULT_MAX_JOB_GENS = 3
 
 
 @dataclass
@@ -57,7 +89,9 @@ class JobClaim:
 
 
 class JobQueue:
-    def __init__(self, root: str, lease_s: Optional[float] = None):
+    def __init__(self, root: str, lease_s: Optional[float] = None,
+                 daemon_id: Optional[str] = None, fleet=None,
+                 max_job_gens: Optional[int] = None):
         os.makedirs(root, exist_ok=True)
         self.dir = root
         try:
@@ -67,13 +101,25 @@ class JobQueue:
         if self.lease_s <= 0:
             self.lease_s = obs_heartbeat.interval_s()
         self.stale_after_s = STALE_INTERVALS * self.lease_s
+        self.daemon_id = daemon_id
+        self.fleet = fleet  # serve.fleet.FleetView (or None: no fast path)
+        try:
+            self.max_job_gens = (
+                int(max_job_gens) if max_job_gens is not None
+                else DEFAULT_MAX_JOB_GENS
+            )
+        except (TypeError, ValueError):
+            self.max_job_gens = DEFAULT_MAX_JOB_GENS
+        # <= 0 disables the budget (unbounded retries, the pre-fleet rule)
 
     # -- directory scan ------------------------------------------------------
 
     def _scan(self):
-        """(jobs, leases, results): job ids present, highest-generation
-        lease path per job, and terminal-record presence."""
+        """(jobs, admits, leases, results): job ids present, admit-marker
+        presence, highest-generation lease path per job, and terminal-
+        record presence."""
         jobs: List[str] = []
+        admits: set = set()
         leases: Dict[str, tuple] = {}
         results: set = set()
         try:
@@ -89,13 +135,17 @@ class JobQueue:
             if m:
                 results.add(m.group(1))
                 continue
+            m = _ADMIT_RE.match(name)
+            if m:
+                admits.add(m.group(1))
+                continue
             m = _LEASE_RE.match(name)
             if m:
                 jid, g = m.group(1), int(m.group(2))
                 cur = leases.get(jid)
                 if cur is None or g > cur[0]:
                     leases[jid] = (g, os.path.join(self.dir, name))
-        return sorted(jobs), leases, results
+        return sorted(jobs), admits, leases, results
 
     def _read_json(self, path: str) -> Optional[dict]:
         try:
@@ -108,8 +158,16 @@ class JobQueue:
     def _record(self, job_id: str) -> Optional[dict]:
         return self._read_json(os.path.join(self.dir, f"job.{job_id}.json"))
 
-    def _lease_age_s(self, path: str, now: float) -> float:
-        rec = self._read_json(path)
+    def _owner_dead(self, owner: Optional[str]) -> bool:
+        """Fast-path liveness (ctt-fleet): True only on positive evidence
+        from the owner's fleet heartbeat.  No view, no owner stamp, or an
+        unknown verdict all mean False — fall back to the slow rule."""
+        if not owner or self.fleet is None or owner == self.daemon_id:
+            return False
+        return self.fleet.is_dead(owner) is True
+
+    def _stamp_age_s(self, path: str, rec: Optional[dict],
+                     now: float) -> float:
         stamp = None
         if rec is not None:
             try:
@@ -117,26 +175,53 @@ class JobQueue:
             except (KeyError, TypeError, ValueError):
                 stamp = None
         if stamp is None:
-            # torn lease: age from mtime, the runtime/queue.py convention
+            # torn record: age from mtime, the runtime/queue.py convention
             try:
                 stamp = os.path.getmtime(path)
             except OSError:
                 return 0.0
         return max(0.0, now - stamp)
 
+    def _lease_age_s(self, path: str, now: float) -> float:
+        return self._stamp_age_s(path, self._read_json(path), now)
+
+    def _lease_state(self, path: str, gen: int,
+                     now: float) -> Tuple[str, bool]:
+        """Classify one lease: (``"live"`` | ``"backoff"`` |
+        ``"expired"``, owner-was-fleet-dead).  Expiry is EITHER the slow
+        stale rule (no stamp for 3 x lease_s) OR the fleet fast path (the
+        owner's heartbeat proves it gone); either way the takeover of
+        generation ``gen`` must additionally wait out
+        ``backoff_delay_s(gen)`` — the between-generation backoff that
+        makes a poison job burn its budget at a decelerating rate."""
+        rec = self._read_json(path)
+        dead = self._owner_dead((rec or {}).get("daemon"))
+        age = self._stamp_age_s(path, rec, now)
+        if not dead and age <= self.stale_after_s:
+            return "live", False
+        if age <= backoff_delay_s(gen):
+            return "backoff", dead
+        return "expired", dead
+
     # -- submission ----------------------------------------------------------
 
-    def submit(self, record: Dict[str, Any]) -> str:
+    def submit(self, record: Dict[str, Any], admitted: bool = True) -> str:
         """Durably publish one job; returns its id.  Ids are a dense
         sequence (claim order ties break on it), allocated by probing the
         next free slot with the exclusive link — concurrent submitters
-        cannot collide."""
-        jobs, _, _ = self._scan()
+        cannot collide.  ``admitted=False`` publishes a *provisional*
+        record (ctt-fleet two-phase admission): unclaimable until
+        :meth:`admit` lands, retractable via :meth:`retract`."""
+        jobs, _, _, _ = self._scan()
         seq = (int(jobs[-1][1:]) + 1) if jobs else 1
         while True:
             job_id = f"j{seq:06d}"
             rec = dict(record)
             rec.update({"id": job_id, "seq": seq, "submit_wall": time.time()})
+            if self.daemon_id is not None:
+                rec.setdefault("daemon", self.daemon_id)
+            if not admitted:
+                rec["admitted"] = False
             if publish_once(
                 os.path.join(self.dir, f"job.{job_id}.json"),
                 json.dumps(rec, sort_keys=True).encode(),
@@ -145,43 +230,107 @@ class JobQueue:
                 return job_id
             seq += 1
 
+    def admit(self, job_id: str) -> bool:
+        """Publish the admit marker for a provisional record (first
+        writer wins; a duplicate admit is a no-op)."""
+        return publish_once(
+            os.path.join(self.dir, f"admit.{job_id}.json"),
+            json.dumps({
+                "id": job_id,
+                "wall": time.time(),
+                "daemon": self.daemon_id,
+            }, sort_keys=True).encode(),
+        )
+
+    def retract(self, job_id: str, reason: str) -> bool:
+        """Park a provisional record as a rejected terminal result (the
+        429 path of two-phase admission, and the limbo reaper's verdict
+        for a submitter that died between the two phases)."""
+        return publish_once(
+            os.path.join(self.dir, f"result.{job_id}.json"),
+            json.dumps({
+                "id": job_id,
+                "ok": False,
+                "rejected": True,
+                "error": reason,
+                "gen": -1,
+                "pid": os.getpid(),
+                "daemon": self.daemon_id,
+                "finished_wall": time.time(),
+            }, sort_keys=True).encode(),
+        )
+
+    def _admitted(self, jid: str, rec: Optional[dict],
+                  admits: set) -> bool:
+        if rec is None:
+            return False
+        return rec.get("admitted", True) is not False or jid in admits
+
+    def _reap_limbo(self, jid: str, rec: dict, now: float) -> bool:
+        """Retract a provisional record whose submitter will never admit
+        it: the submitting daemon is fleet-dead, or the record has
+        outlived the stale window with neither marker nor result.  Until
+        reaped it (conservatively) occupies admission headroom."""
+        dead = self._owner_dead(rec.get("daemon"))
+        try:
+            age = max(0.0, now - float(rec.get("submit_wall", now)))
+        except (TypeError, ValueError):
+            age = 0.0
+        if not dead and age <= self.stale_after_s:
+            return False
+        return self.retract(
+            jid, "admission abandoned: submitter died between publishing "
+                 "the record and the admit marker"
+        )
+
     # -- claiming ------------------------------------------------------------
 
     def pending(self) -> List[dict]:
-        """Unfinished jobs with no live lease, in claim order
-        (-priority, seq)."""
-        jobs, leases, results = self._scan()
+        """Admitted, unfinished jobs with no live (or in-backoff) lease,
+        in claim order (-priority, seq)."""
+        jobs, admits, leases, results = self._scan()
         now = time.time()
         out = []
         for jid in jobs:
             if jid in results:
                 continue
-            if jid in leases and (
-                self._lease_age_s(leases[jid][1], now) <= self.stale_after_s
-            ):
-                continue
             rec = self._record(jid)
-            if rec is not None:
-                out.append(rec)
+            if rec is None or not self._admitted(jid, rec, admits):
+                continue
+            if jid in leases:
+                state, _ = self._lease_state(
+                    leases[jid][1], leases[jid][0], now
+                )
+                if state != "expired":
+                    continue
+            out.append(rec)
         out.sort(key=lambda r: (-int(r.get("priority", 0)), int(r["seq"])))
         return out
 
-    def stats(self) -> Dict[str, Any]:
+    def stats(self, before_seq: Optional[int] = None) -> Dict[str, Any]:
         """Queue accounting for admission + gauges: per-tenant and total
-        unfinished (queued + running) job counts."""
-        jobs, leases, results = self._scan()
+        unfinished (queued + running) job counts.  With ``before_seq``,
+        only jobs submitted earlier in the dense sequence count — the
+        fleet-admission recount: every submitter judges its own record
+        against the same prefix order, so k daemons admitting
+        concurrently cannot jointly overshoot a limit.  Provisional
+        records count until admitted or retracted (conservative: they
+        can under-admit briefly, never overshoot)."""
+        jobs, _, leases, results = self._scan()
         now = time.time()
         per_tenant: Dict[str, int] = {}
         queued = running = 0
         for jid in jobs:
             if jid in results:
                 continue
+            if before_seq is not None and int(jid[1:]) >= before_seq:
+                continue
             rec = self._record(jid) or {}
             tenant = rec.get("tenant", "default")
             per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
-            if jid in leases and (
-                self._lease_age_s(leases[jid][1], now) <= self.stale_after_s
-            ):
+            if jid in leases and self._lease_state(
+                leases[jid][1], leases[jid][0], now
+            )[0] == "live":
                 running += 1
             else:
                 queued += 1
@@ -195,31 +344,95 @@ class JobQueue:
 
     def _lease_payload(self, job_id: str, gen: int,
                        claim_wall: float) -> bytes:
+        # the daemon id rides the very first (claim-time) stamp, not just
+        # renewals: a daemon SIGKILLed inside the claim-to-first-renewal
+        # window still leaves a lease peers can fast-path expire
         return json.dumps({
             "job": job_id,
             "gen": gen,
             "owner_pid": os.getpid(),
+            "daemon": self.daemon_id,
             "claim_wall": claim_wall,
             "wall": time.time(),
             "mono": obs_trace.monotonic(),
         }).encode()
 
+    def _quarantine(self, jid: str, gens: int, rec: dict) -> None:
+        """Park a job that exhausted its retry budget: first-writer-wins
+        failed result carrying every burned generation's last lease
+        stamp, so the post-mortem (which daemons died on it, when) is in
+        one durable record."""
+        failure_log = []
+        for g in range(gens):
+            lease = self._read_json(
+                os.path.join(self.dir, f"lease.{jid}.g{g}.json")
+            )
+            failure_log.append(lease or {"gen": g, "torn": True})
+        published = publish_once(
+            os.path.join(self.dir, f"result.{jid}.json"),
+            json.dumps({
+                "id": jid,
+                "ok": False,
+                "quarantined": True,
+                "error": (
+                    f"retry budget exhausted: {gens} generation(s) claimed "
+                    "this job and none published a result (poison job)"
+                ),
+                "failure_log": failure_log,
+                "gen": gens,
+                "pid": os.getpid(),
+                "daemon": self.daemon_id,
+                "tenant": rec.get("tenant"),
+                "finished_wall": time.time(),
+            }, sort_keys=True).encode(),
+        )
+        if published:
+            obs_metrics.inc("serve.jobs_quarantined")
+
     def claim_next(self) -> Optional[JobClaim]:
         """Lease the highest-priority claimable job: unleased first; a
-        job whose lease went stale (a daemon died mid-job) requeues at
-        gen+1 — restart recovery, the runtime/queue.py expiry rule."""
-        _, leases, _ = self._scan()
-        for rec in self.pending():
-            jid = rec["id"]
-            gen = 0
+        job whose lease went stale — or whose owner's fleet heartbeat
+        proves it dead (the fast path) — requeues at gen+1.  A job whose
+        next generation would be ``max_job_gens`` is quarantined instead
+        of claimed; daemons never crash on a poison job, the job parks."""
+        jobs, admits, leases, results = self._scan()
+        now = time.time()
+        candidates = []  # (record, next_gen, fleet_reclaim)
+        for jid in jobs:
+            if jid in results:
+                continue
+            rec = self._record(jid)
+            if rec is None:
+                continue
+            if not self._admitted(jid, rec, admits):
+                self._reap_limbo(jid, rec, now)
+                continue
+            gen, reclaim = 0, False
             if jid in leases:
-                # stale lease (pending() already aged it): take over
-                gen = leases[jid][0] + 1
+                state, dead = self._lease_state(
+                    leases[jid][1], leases[jid][0], now
+                )
+                if state != "expired":
+                    continue
+                gen, reclaim = leases[jid][0] + 1, dead
+            candidates.append((rec, gen, reclaim))
+        candidates.sort(
+            key=lambda c: (-int(c[0].get("priority", 0)), int(c[0]["seq"]))
+        )
+        for rec, gen, reclaim in candidates:
+            jid = rec["id"]
+            if self.max_job_gens > 0 and gen >= self.max_job_gens:
+                self._quarantine(jid, gen, rec)
+                continue
             claim_wall = time.time()
             path = os.path.join(self.dir, f"lease.{jid}.g{gen}.json")
             if publish_once(path, self._lease_payload(jid, gen, claim_wall)):
                 if gen > 0:
                     obs_metrics.inc("serve.leases_requeued")
+                    if reclaim:
+                        # fleet fast path: recovered from a heartbeat-
+                        # proven dead peer, not mere lease staleness
+                        obs_metrics.inc("serve.jobs_reclaimed")
                 return JobClaim(
                     job_id=jid, record=rec, gen=gen, lease_path=path,
                     claim_wall=claim_wall,
@@ -241,6 +454,7 @@ class JobQueue:
             "id": claim.job_id,
             "gen": claim.gen,
             "pid": os.getpid(),
+            "daemon": self.daemon_id,
             "finished_wall": time.time(),
         })
         return publish_once(
@@ -261,12 +475,11 @@ class JobQueue:
         if result is not None:
             state = "done" if result.get("ok") else "failed"
         else:
-            _, leases, _ = self._scan()
+            _, _, leases, _ = self._scan()
             now = time.time()
-            if job_id in leases and (
-                self._lease_age_s(leases[job_id][1], now)
-                <= self.stale_after_s
-            ):
+            if job_id in leases and self._lease_state(
+                leases[job_id][1], leases[job_id][0], now
+            )[0] == "live":
                 state = "running"
             else:
                 state = "queued"
@@ -274,7 +487,7 @@ class JobQueue:
                 "result": result}
 
     def list(self) -> List[Dict[str, Any]]:
-        jobs, _, _ = self._scan()
+        jobs, _, _, _ = self._scan()
         out = []
         for jid in jobs:
             st = self.get(jid)

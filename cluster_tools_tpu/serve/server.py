@@ -20,6 +20,17 @@ wiring) and runs three kinds of threads over the durable
     so a daemon killed mid-job leaves a lease that goes stale and
     requeues on the next daemon over the same state dir.
 
+Daemons are **fleet-native** (ctt-fleet): every daemon publishes a fleet
+heartbeat ``daemon.<id>.json`` into the state dir (first beat lands
+*before* the executor threads start, so a lease can never precede its
+owner's beat), stamps its id into every job lease at claim time, and
+judges peers' leases through :class:`serve.fleet.FleetView` — a peer
+that dies mid-job is failed over within one heartbeat staleness window
+(3 x cadence) instead of the full lease window.  Admission is two-phase
+over the shared dir (provisional record → earlier-sequence recount →
+admit marker or 429 retraction), so queue depth and tenant quotas hold
+across the whole fleet, not per daemon.
+
 Shutdown is a **drain** (rides ``obs.heartbeat.install_sigterm_flush``:
 the chained SIGTERM handler flushes telemetry, then triggers the drain
 instead of dying): submissions start answering 503, heartbeats carry
@@ -48,6 +59,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..runtime import config as cfg
 from ..runtime.workflow import ExecutionContext, build
+from . import fleet as fleet_mod
 from . import protocol
 from .admission import AdmissionController
 from .jobs import JobClaim, JobQueue
@@ -101,8 +113,24 @@ class ServeDaemon:
         self.context = ExecutionContext(
             role="serve", hbm_cache_mb=conf.get("hbm_cache_mb"),
         ).install()
+        # ctt-fleet identity + peer view: the daemon id rides every lease
+        # this daemon claims, the view judges every lease it considers
+        # stealing
+        self.daemon_id = str(
+            conf.get("daemon_id") or fleet_mod.default_daemon_id()
+        )
+        self.fleet = fleet_mod.FleetView(state_dir, self_id=self.daemon_id)
+        # the beat rides the ctt-watch cadence (CTT_HEARTBEAT_S), NOT
+        # lease_s: an operator sets lease_s to bound long jobs' renewal
+        # period, but failover latency must stay bounded by the (much
+        # shorter) heartbeat rule — that is the whole fast path
+        self._fleet_beat = fleet_mod.FleetBeat(
+            state_dir, self.daemon_id, info_fn=self._beat_info,
+        )
         self.jobs = JobQueue(
-            os.path.join(state_dir, "jobs"), lease_s=conf.get("lease_s")
+            os.path.join(state_dir, "jobs"), lease_s=conf.get("lease_s"),
+            daemon_id=self.daemon_id, fleet=self.fleet,
+            max_job_gens=conf.get("max_job_gens"),
         )
         self.admission = AdmissionController(
             conf.get("max_queue_depth"), conf.get("tenant_quota"),
@@ -143,6 +171,11 @@ class ServeDaemon:
         self._httpd = _Server((host, port), _Handler)
         self._httpd.ctt_daemon = daemon
         self.port = self._httpd.server_address[1]
+        # first fleet beat BEFORE any executor thread exists: a lease
+        # stamped with this daemon's id can then never be orphaned in a
+        # no-beat blind window — SIGKILL at any later instant leaves a
+        # beat for peers to age (satellite: claim-to-first-heartbeat)
+        self._fleet_beat.start()
         http_thread = threading.Thread(
             target=self._httpd.serve_forever, name="ctt-serve-http",
             daemon=True,
@@ -160,6 +193,7 @@ class ServeDaemon:
             "host": host,
             "port": self.port,
             "pid": os.getpid(),
+            "daemon_id": self.daemon_id,
             "started_wall": time.time(),
             "run_id": obs_trace.current_run_id(),
             "token": self.token,
@@ -200,6 +234,7 @@ class ServeDaemon:
         obs_heartbeat.ensure_started(role="serve")
         obs_heartbeat.note_draining()
         obs_heartbeat.beat()  # readers see the flag now, not next cadence
+        self._fleet_beat.beat()  # peers see ``draining: true`` now too
         self._wake.set()
         self._stop.set()
 
@@ -217,7 +252,10 @@ class ServeDaemon:
                 self._httpd.shutdown()
                 self._httpd.server_close()
             # stop the (possibly drain-restarted) beat thread and stamp
-            # the final ``exiting`` heartbeat in one move
+            # the final ``exiting`` heartbeat in one move; same for the
+            # fleet beat — the ``exiting`` stamp lets peers fail over in
+            # one cadence instead of aging the beat out over three
+            self._fleet_beat.stop(final=True)
             obs_heartbeat.stop(final=True)
             obs_trace.flush()
 
@@ -249,17 +287,23 @@ class ServeDaemon:
         record = protocol.validate_submission(payload)
         if self.draining:
             raise Draining("daemon is draining; resubmit to its successor")
-        # admit + enqueue must be one atomic step across the HTTP handler
-        # threads: check-then-act on stats() would let concurrent
-        # submissions all see the same headroom and overshoot the queue
-        # depth / tenant quota together
+        # two-phase fleet admission (ctt-fleet): publish the record
+        # provisionally, recount the SHARED dir restricted to jobs that
+        # precede it in the dense sequence, then admit or retract.  The
+        # sequence gives every concurrent submitter — across all daemons
+        # on this state dir — the same total order to judge against, so
+        # k daemons cannot each admit a full quota's worth together (the
+        # per-daemon lock alone only serializes this daemon's handlers)
         with self._submit_lock:
+            job_id = self.jobs.submit(record, admitted=False)
             ok, reason = self.admission.admit(
-                record["tenant"], self.jobs.stats()
+                record["tenant"],
+                self.jobs.stats(before_seq=int(job_id[1:])),
             )
             if not ok:
+                self.jobs.retract(job_id, reason)
                 raise Rejected(reason)
-            job_id = self.jobs.submit(record)
+            self.jobs.admit(job_id)
         self._publish_gauges()
         self._wake.set()
         return {"job_id": job_id, "state": "queued"}
@@ -374,9 +418,27 @@ class ServeDaemon:
 
     # -- observability -------------------------------------------------------
 
+    def _beat_info(self) -> Dict[str, Any]:
+        """The capacity/load fields riding each fleet beat — what
+        :func:`serve.fleet.scale_advice` and ``obs watch`` read."""
+        with self._state_lock:
+            running = self._running_jobs
+        return {
+            "host": str(self.config.get("host", "127.0.0.1")),
+            "port": self.port,
+            "draining": self.draining,
+            "concurrency": max(int(self.config.get("concurrency", 1)), 1),
+            "running_jobs": running,
+            "queued": self.jobs.stats()["queued"],
+        }
+
     def _publish_gauges(self) -> None:
         stats = self.jobs.stats()
         obs_metrics.set_gauge("serve.queue_depth", stats["queued"])
+        # fleet-wide mirrors: the shared-dir scan already IS fleet-wide,
+        # and the live-peer count makes a lost daemon visible on watch
+        obs_metrics.set_gauge("fleet.queue_depth", stats["queued"])
+        obs_metrics.set_gauge("serve.peers", len(self.fleet.live()))
         with self._state_lock:
             obs_metrics.set_gauge("serve.running_jobs", self._running_jobs)
 
@@ -407,11 +469,32 @@ class ServeDaemon:
         return obs_live.render_openmetrics(snap)
 
     def healthz(self) -> Dict[str, Any]:
+        stats = self.jobs.stats()
+        live = self.fleet.live()
         return {
             "ok": True,
             "draining": self.draining,
             "pid": os.getpid(),
-            "queue": self.jobs.stats(),
+            "daemon_id": self.daemon_id,
+            "queue": stats,
+            # the admission decision inputs AND limits, verbatim: an
+            # operator (or the overshoot regression test) reads off
+            # exactly what the next submission will be judged against
+            "admission": {
+                **self.admission.describe(),
+                "queued": stats["queued"],
+                "in_flight": stats["in_flight"],
+                "per_tenant": stats["per_tenant"],
+            },
+            "fleet": {
+                "id": self.daemon_id,
+                "peers": len(live),
+                "daemons": sorted(live),
+                "queue_depth": stats["queued"],
+                "scale_advice": fleet_mod.scale_advice(
+                    self.state_dir, stats=stats, view=self.fleet,
+                ),
+            },
             "context": self.context.describe(),
             "run_id": obs_trace.current_run_id(),
         }

@@ -39,7 +39,7 @@ from typing import Callable, Tuple, Type, TypeVar
 
 from ..obs import metrics as obs_metrics
 
-__all__ = ["io_retry", "retry_attempts"]
+__all__ = ["backoff_delay_s", "io_retry", "retry_attempts"]
 
 T = TypeVar("T")
 
@@ -59,6 +59,19 @@ def _env_float(name: str, default: float) -> float:
 
 def retry_attempts() -> int:
     return int(_env_float("CTT_IO_RETRIES", _DEF_RETRIES))
+
+
+def backoff_delay_s(attempt: int) -> float:
+    """The deterministic (un-jittered) backoff delay for retry number
+    ``attempt`` (0-based) under the same env knobs as :func:`io_retry`.
+    Exposed for retry policies that gate on *elapsed time* rather than
+    sleeping — e.g. the serve fleet's between-generation backoff, where a
+    job lease may not be reclaimed at generation g+1 until the previous
+    generation's expiry is at least this much in the past (a poison job
+    burns its retry budget at a decelerating rate instead of instantly)."""
+    base_s = _env_float("CTT_IO_BACKOFF_BASE_S", _DEF_BASE_S)
+    max_s = _env_float("CTT_IO_BACKOFF_MAX_S", _DEF_MAX_S)
+    return min(base_s * (2.0 ** max(int(attempt), 0)), max_s)
 
 
 def io_retry(

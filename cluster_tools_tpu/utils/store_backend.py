@@ -33,6 +33,11 @@ S3/GCS HTTP gateway can serve:
                        express this as a delimiter list query; the stub
                        keeps it a plain GET).
   ``PUT <key>``      → store bytes atomically, create parents; 200/201.
+                       With ``If-None-Match: *`` the PUT is *create-only*:
+                       412 Precondition Failed when the key already
+                       exists — the object-store analog of the
+                       ``publish_once`` exclusive link (ctt-fleet lifts
+                       the work-queue lease/result claims onto this).
   ``HEAD <key>``     → existence + freshness headers (``ETag``,
                        ``Last-Modified``, ``Content-Length``,
                        ``X-CTT-Dir`` for directories).
@@ -178,6 +183,35 @@ class StoreBackend:
         any rewrite (in- or cross-process) is a miss."""
         st = os.stat(path)
         return (st.st_ino, st.st_mtime_ns, st.st_size)
+
+    def publish_once(self, path: str, payload: bytes) -> bool:
+        """Atomically publish ``payload`` at ``path`` iff nothing is there
+        yet — the lease/result claim arbiter (ctt-steal, ctt-serve).
+        POSIX stages to a pid+thread-unique tmp file and ``os.link``s it
+        into place: the link either creates the name with the full payload
+        visible or fails with EEXIST.  Returns True when this caller won
+        the slot."""
+        tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
+        atomic_write_bytes(tmp, payload)
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def mtime(self, path: str) -> Optional[float]:
+        """Last-modified wall stamp, or None when absent/unknown — the
+        torn-lease ageing fallback (a lease whose JSON never parses still
+        expires, from its storage timestamp)."""
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return None
 
     def remove(self, path: str) -> None:
         os.unlink(path)
@@ -517,6 +551,50 @@ class HttpBackend(StoreBackend):
         status, _, _, _ = self._request("PUT", path, body=payload)
         if status not in (200, 201, 204):
             self._raise_for(status, "PUT", path)
+
+    def publish_once(self, path: str, payload: bytes) -> bool:
+        """Create-only PUT: ``If-None-Match: *`` makes the object store
+        the claim arbiter — 412 Precondition Failed means the slot was
+        already taken (the remote analog of the POSIX ``os.link``
+        EEXIST).  Transient trouble retries internally; a retry that
+        lands after its own first attempt actually stored the object
+        reads as a lost race, which costs at worst a spurious
+        requeue-later, never two owners."""
+        from .retry import io_retry
+
+        def _put() -> bool:
+            status, _, _, _ = self._request(
+                "PUT", path, body=payload,
+                headers={"If-None-Match": "*"},
+            )
+            if status == 412:
+                return False
+            if status not in (200, 201, 204):
+                self._raise_for(status, "PUT", path)
+            return True
+
+        return io_retry(
+            _put, what=f"publish {path}", counter=self.retry_counter
+        )
+
+    def mtime(self, path: str) -> Optional[float]:
+        """Wall stamp from the ``Last-Modified`` header (HEAD), or None —
+        the torn-lease ageing fallback over an object store."""
+        try:
+            status, hdrs = self._head(path)
+        except OSError:
+            return None
+        if status != 200:
+            return None
+        value = hdrs.get("Last-Modified")
+        if not value:
+            return None
+        try:
+            import email.utils
+
+            return email.utils.parsedate_to_datetime(value).timestamp()
+        except (TypeError, ValueError):
+            return None
 
     def signature(self, path: str):
         """``(ETag, Last-Modified, Content-Length)`` from a HEAD — the

@@ -13,6 +13,10 @@ Serves a directory tree over the small object-store HTTP subset the
                      every atomic replace), ``Last-Modified``,
                      ``Content-Length``, ``X-CTT-Dir`` for directories.
   ``PUT /key``     → atomic write (tmp + rename), parents created; 201.
+                     With ``If-None-Match: *`` the PUT is create-only
+                     (hard link, first writer wins): 412 when the key
+                     already exists — the ``publish_once`` analog the
+                     cross-host work-stealing leases ride.
   ``DELETE /key``  → unlink file / remove tree; 204 (404 if absent).
 
 Chaos injection (hermetic flaky-network simulation, seeded so CI runs
@@ -236,6 +240,23 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b""
+        if self.headers.get("If-None-Match", "").strip() == "*":
+            # create-only PUT: the publish_once analog — first writer
+            # stores, every later writer gets 412 (body already drained,
+            # keep-alive hygiene)
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            tmp = p + f".put{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(body)
+            try:
+                os.link(tmp, p)
+            except FileExistsError:
+                self._send(412)
+                return
+            finally:
+                os.unlink(tmp)
+            self._send(201)
+            return
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = p + f".put{threading.get_ident()}"
         with open(tmp, "wb") as f:

@@ -1204,6 +1204,218 @@ if [ "$hier_rc" -ne 0 ]; then
     exit "$hier_rc"
 fi
 
+echo "== ctt-fleet chaos smoke (2 daemons over the stub object store, SIGKILL one mid-job -> zero loss, fast reclaim) =="
+# the fleet gate: two serve daemons share one state dir, executing a
+# 6-job burst whose volumes live in the stub object store; one daemon is
+# SIGKILLed mid-job.  Every job must still publish an ok result, the
+# recovered job's output must be byte-identical to a single-daemon
+# reference run, recovery must ride the fleet-heartbeat fast path (not
+# the 3 x lease_s staleness window), and the survivor's /metrics must
+# parse as OpenMetrics with ctt_serve_jobs_reclaimed_total >= 1.
+fleet_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$fleet_tmp" <<'PY'
+import hashlib, json, os, re, subprocess, sys, time
+
+td = sys.argv[1]
+repo_root = os.environ.get("PYTHONPATH", "").split(os.pathsep)[0] or "."
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+       "CTT_HEARTBEAT_S": "0.2"}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+
+from cluster_tools_tpu.serve import ServeClient
+from cluster_tools_tpu.utils import file_reader
+
+
+def digest(root):
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def sleep_job(root_td, data_root, tag, sleep_s, phase):
+    # the ctt-steal calibrated-cost fixture task: one block,
+    # deterministic output (input * 2 + 1), every block costs sleep_s —
+    # so the reference run can be fast while staying byte-identical.
+    # tmp/config dirs are per phase: a shared checkpoint folder would let
+    # the fleet run skip blocks the reference run already marked done
+    return {
+        "workflow": "bench_e2e_lib:SkewedCostTask",
+        "kwargs": {
+            "tmp_folder": os.path.join(root_td, f"tmp_{phase}_{tag}"),
+            "config_dir": os.path.join(root_td, f"configs_{phase}_{tag}"),
+            "input_path": f"{data_root}/{tag}.n5", "input_key": "x",
+            "output_path": f"{data_root}/{tag}.n5", "output_key": "y",
+        },
+        "configs": {
+            "global": {"block_shape": [2, 8, 8]},
+            "skewed_cost": {
+                "hot_z_end": 0, "base_s": float(sleep_s), "hot_s": 99.0,
+            },
+        },
+        "tenant": tag,
+    }
+
+
+def spawn(state_dir, daemon_id):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.serve",
+         "--state-dir", state_dir, "--lease-s", "5",
+         "--daemon-id", daemon_id],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    proc.stdout.readline()  # listening banner
+    ep_line = proc.stdout.readline()  # per-daemon endpoint JSON
+    assert ep_line, f"{daemon_id} died at startup:\n{proc.stderr.read()}"
+    ep = json.loads(ep_line)
+    client = ServeClient(endpoint=f"http://{ep['host']}:{ep['port']}",
+                         token=ep["token"])
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            client.healthz()
+            return proc, client
+        except Exception:
+            assert proc.poll() is None, (
+                f"{daemon_id} died:\n{proc.stderr.read()}")
+            time.sleep(0.1)
+    raise AssertionError(f"{daemon_id} never became healthy")
+
+
+tags = [f"k{i}" for i in range(6)]
+
+# single-daemon reference run (POSIX volumes, zero job sleep)
+ref_root = os.path.join(td, "ref")
+os.makedirs(ref_root)
+for tag in tags:
+    file_reader(os.path.join(ref_root, f"{tag}.n5")).create_dataset(
+        "x", data=np.ones((2, 8, 8), dtype="float32"), chunks=(2, 8, 8))
+ref, ref_client = spawn(os.path.join(td, "state_ref"), "ref")
+try:
+    jobs = [ref_client.submit(**sleep_job(td, ref_root, t, 0.01, "ref"))
+            for t in tags]
+    for jid in jobs:
+        assert ref_client.wait(jid, timeout_s=300)["result"]["ok"]
+finally:
+    ref.kill()
+    ref.wait(timeout=30)
+
+# the fleet run: volumes on the stub object store, two daemons, SIGKILL
+objroot = os.path.join(td, "objroot")
+os.makedirs(objroot)
+for tag in tags:
+    file_reader(os.path.join(objroot, f"{tag}.n5")).create_dataset(
+        "x", data=np.ones((2, 8, 8), dtype="float32"), chunks=(2, 8, 8))
+port_file = os.path.join(td, "stub.port")
+stub = subprocess.Popen([
+    sys.executable, os.path.join(repo_root, "tests", "objstub.py"),
+    "--root", objroot, "--port-file", port_file,
+], env=env)
+proc_a = proc_b = None
+try:
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file):
+        assert stub.poll() is None, "objstub died on startup"
+        assert time.monotonic() < deadline, "objstub never came up"
+        time.sleep(0.05)
+    url = f"http://127.0.0.1:{open(port_file).read().strip()}"
+
+    state_dir = os.path.join(td, "state_fleet")
+    proc_a, client_a = spawn(state_dir, "dA")
+    proc_b, client_b = spawn(state_dir, "dB")
+    jobs = []
+    for i, tag in enumerate(tags):
+        cl = client_a if i % 2 == 0 else client_b
+        jobs.append(cl.submit(**sleep_job(td, url, tag, 2.0, "fleet")))
+
+    # SIGKILL dA once its own fleet beat reports a job in flight
+    beat = os.path.join(state_dir, "daemon.dA.json")
+    deadline = time.monotonic() + 60
+    running = 0
+    while time.monotonic() < deadline and running < 1:
+        try:
+            running = json.load(open(beat)).get("running_jobs", 0)
+        except Exception:
+            pass
+        time.sleep(0.05)
+    assert running >= 1, "dA never started executing"
+    proc_a.kill()
+    proc_a.wait(timeout=30)
+    t_kill = time.time()
+
+    # zero loss: every job publishes an ok result via the survivor
+    for jid in jobs:
+        assert client_b.wait(jid, timeout_s=300)["result"]["ok"], jid
+    from cluster_tools_tpu.serve import JobQueue
+    q = JobQueue(os.path.join(state_dir, "jobs"), lease_s=5.0)
+    results = [q.get(j)["result"] for j in jobs]
+    requeued = [r for r in results if r["gen"] > 0]
+    assert requeued, "the killed daemon's job never requeued"
+    for r in requeued:
+        # heartbeat-bounded recovery (3 x 0.2s detection + one 2s
+        # re-execution), far inside the 15s lease-staleness window
+        assert r["finished_wall"] - t_kill < 12.0, r
+
+    # byte-identity vs the single-daemon reference, recovered job included
+    for tag in tags:
+        assert digest(os.path.join(objroot, f"{tag}.n5", "y")) == digest(
+            os.path.join(ref_root, f"{tag}.n5", "y")
+        ), f"{tag} output differs from the single-daemon run"
+
+    # the survivor's ledger: fast-path reclaim counted, /metrics parses
+    text = client_b.metrics_text()
+    lines = text.splitlines()
+    assert lines and lines[-1] == "# EOF", "exposition must end with # EOF"
+    try:
+        from prometheus_client.openmetrics.parser import (
+            text_string_to_metric_families,
+        )
+        assert list(text_string_to_metric_families(text))
+    except ImportError:
+        sample = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.+eEinfa]+$")
+        meta = re.compile(
+            r"^# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* \w+|HELP .+|EOF)$")
+        for line in lines:
+            assert sample.match(line) or meta.match(line), line
+    vals = {
+        ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+        for ln in lines if ln and not ln.startswith("#")
+    }
+    assert vals.get("ctt_serve_jobs_reclaimed_total", 0) >= 1, vals
+    assert vals.get("ctt_serve_jobs_quarantined_total", 0) == 0, vals
+    print("fleet smoke ok:", json.dumps({
+        "requeued": len(requeued),
+        "reclaim_latency_s": round(
+            min(r["finished_wall"] for r in requeued) - t_kill, 2),
+        "jobs_reclaimed": vals.get("ctt_serve_jobs_reclaimed_total"),
+    }))
+finally:
+    for proc in (proc_a, proc_b):
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    stub.terminate()
+    stub.wait(timeout=30)
+PY
+fleet_rc=$?
+rm -rf "$fleet_tmp"
+if [ "$fleet_rc" -ne 0 ]; then
+    echo "fleet smoke failed (rc=$fleet_rc): the two-daemon fleet lost a" \
+         "job, recovered slower than the heartbeat bound, or broke" \
+         "byte-identity after a SIGKILL" >&2
+    exit "$fleet_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
