@@ -961,6 +961,27 @@ def bench_ws_e2e(x, block_shape):
         except Exception as e:
             log(f"[ws-e2e] ctt-hier bench failed: {e}")
         try:
+            # ctt-events: batched frame-CC event building vs the
+            # per-frame scipy baseline, plus the serve soak at the
+            # admission edge (clean 429s, zero leaked threads/fds)
+            from bench_e2e_lib import run_events_pipeline
+
+            ev_res = run_events_pipeline()
+            res.update(ev_res)
+            log(
+                "[ws-e2e] ctt-events frame-CC: "
+                f"{ev_res['ws_e2e_events_frames_per_s']} frames/s vs "
+                f"scipy {ev_res['ws_e2e_events_scipy_frames_per_s']} "
+                f"({ev_res['ws_e2e_events_speedup']}x), parity "
+                f"{ev_res['ws_e2e_events_parity']}; soak "
+                f"{ev_res['ws_e2e_events_soak_submissions']} submissions"
+                f" -> {ev_res['ws_e2e_events_soak_rejections']} clean "
+                f"429s, leaks clean="
+                f"{ev_res['ws_e2e_events_soak_thread_parity']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] ctt-events bench failed: {e}")
+        try:
             # ctt-cloud: the same watershed against the stub object store
             # (subprocess HTTP server) vs POSIX — remote walls, IO hidden
             # behind compute, and chunk-digest parity

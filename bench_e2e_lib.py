@@ -1099,6 +1099,188 @@ def run_hier_pipeline(shape=(48, 384, 384), block_shape=(8, 64, 64),
     }
 
 
+def run_events_pipeline(n_frames=64, frame_shape=(512, 512),
+                        soak_submissions=1000):
+    """ctt-events contract, both legs of the acceptance gate.
+
+    Throughput: ONE batched ``build_events`` dispatch over an
+    ``(n_frames, h, w)`` detector stack vs the per-frame host baseline
+    (``scipy.ndimage.label`` + numpy property reduction — exactly what a
+    pre-batching event builder runs per frame).  Gate: >= 10x frames/s
+    with EXACT label/count parity and close props.
+
+    Soak: an in-process serve daemon at a deliberately tiny admission
+    envelope (tenant_quota 2, queue depth 4) takes a burst of
+    ``soak_submissions`` ``event_batch`` submissions — the "millions of
+    users" request shape scaled to CI.  Past-capacity submissions must
+    be CLEAN 429s, every accepted job must finish ok, /metrics must stay
+    parseable mid-burst, and the process must return to its pre-burst
+    thread/fd baseline with zero lease-renewer threads left — the
+    serve-path per-request allocation audit, benched."""
+    import threading
+
+    from scipy import ndimage
+
+    from cluster_tools_tpu.ops import events as events_ops
+
+    rng = np.random.default_rng(0)
+    raw = ndimage.gaussian_filter(
+        rng.random((n_frames,) + tuple(frame_shape)), (0.0, 1.0, 1.0)
+    ).astype("float32")
+    # ~1% occupancy of compact blobs — the Timepix-like regime the
+    # throughput gate is specified against
+    frames = np.where(raw > np.quantile(raw, 0.99), raw, 0.0).astype(
+        "float32"
+    )
+    hits = rng.random(frames.shape) > 0.999
+    frames[hits] = (rng.random(int(hits.sum())) + 1.0).astype("float32")
+
+    # -- throughput leg ----------------------------------------------------
+    compiles0 = events_ops.kernel_cache_size()
+    labels, counts, props = events_ops.build_events(frames)  # warm/compile
+    compiles = events_ops.kernel_cache_size() - compiles0
+    dev_walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        labels, counts, props = events_ops.build_events(frames)
+        dev_walls.append(time.perf_counter() - t0)
+    dev_wall = float(np.median(dev_walls))
+
+    t0 = time.perf_counter()
+    ref_l, ref_c, ref_p = events_ops.build_events_np(frames)
+    scipy_wall = time.perf_counter() - t0
+
+    parity = bool(
+        np.array_equal(counts, ref_c) and np.array_equal(labels, ref_l)
+    )
+    if parity:
+        for f in range(n_frames):
+            k = int(counts[f])
+            if not np.allclose(props[f, :k], ref_p[f, :k],
+                               rtol=1e-4, atol=1e-4):
+                parity = False
+                break
+
+    res = {
+        "ws_e2e_events_frames": int(n_frames),
+        "ws_e2e_events_frame_shape": list(frame_shape),
+        "ws_e2e_events_clusters": int(counts.sum()),
+        "ws_e2e_events_compiles": int(compiles),
+        "ws_e2e_events_frames_per_s": round(n_frames / dev_wall, 1),
+        "ws_e2e_events_scipy_frames_per_s": round(
+            n_frames / scipy_wall, 1
+        ),
+        "ws_e2e_events_speedup": round(scipy_wall / dev_wall, 1),
+        "ws_e2e_events_parity": parity,
+    }
+
+    # -- serve soak leg ----------------------------------------------------
+    from cluster_tools_tpu.serve import (
+        QuotaRejected, ServeClient, ServeDaemon,
+    )
+    from cluster_tools_tpu.utils import file_reader
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "soak.n5")
+        file_reader(path).create_dataset(
+            "frames", data=frames[:4, :16, :16].copy(),
+            chunks=(2, 16, 16),
+        )
+        gconf = {"block_shape": [2, 16, 16], "target": "tpu",
+                 "device_batch_size": 2, "devices": [0],
+                 "pipeline_depth": 2}
+        daemon = ServeDaemon(
+            os.path.join(td, "state"),
+            config={"tenant_quota": 2, "max_queue_depth": 4},
+        )
+        daemon.start()
+        try:
+            client = ServeClient(state_dir=os.path.join(td, "state"))
+
+            def submit(i):
+                return client.event_batch(
+                    input_path=path, input_key="frames",
+                    output_path=path, output_key=f"ev_{i}",
+                    tmp_folder=os.path.join(td, f"tmp_{i}"),
+                    config_dir=os.path.join(td, f"configs_{i}"),
+                    configs={"global": dict(gconf)},
+                )
+
+            # warm-up job: compiles + pool threads + store handles, so
+            # the baseline below is steady state, not cold start
+            client.wait(submit(0), timeout_s=600)
+
+            def renewers():
+                return [t for t in threading.enumerate()
+                        if t.name == "ctt-serve-lease" and t.is_alive()]
+
+            deadline = time.monotonic() + 10
+            while renewers() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            threads_before = threading.active_count()
+            fds_before = len(os.listdir("/proc/self/fd"))
+
+            accepted, rejected, metrics_ok = [], 0, True
+            t0 = time.perf_counter()
+            for i in range(1, soak_submissions + 1):
+                try:
+                    accepted.append(submit(i))
+                except QuotaRejected:
+                    rejected += 1
+                if i % 200 == 0:  # /metrics must answer mid-burst
+                    try:
+                        if "# EOF" not in client.metrics_text():
+                            metrics_ok = False
+                    except Exception:
+                        metrics_ok = False
+            for jid in accepted:
+                state = client.wait(jid, timeout_s=600)
+                if not state["result"]["ok"]:
+                    metrics_ok = False
+            soak_wall = time.perf_counter() - t0
+
+            leases_clean = True
+            deadline = time.monotonic() + 15
+            while renewers() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if renewers():
+                leases_clean = False
+            thread_parity = fd_parity = False
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                thread_parity = (
+                    threading.active_count() <= threads_before
+                )
+                fd_parity = (
+                    len(os.listdir("/proc/self/fd")) <= fds_before
+                )
+                if thread_parity and fd_parity:
+                    break
+                time.sleep(0.1)
+            if "# EOF" not in client.metrics_text():
+                metrics_ok = False
+        finally:
+            daemon.request_drain()
+            if daemon._httpd is not None:
+                daemon._httpd.shutdown()
+                daemon._httpd.server_close()
+            for t in daemon._threads:
+                if t.name.startswith("ctt-serve-exec"):
+                    t.join(timeout=60)
+
+    res.update({
+        "ws_e2e_events_soak_submissions": int(soak_submissions),
+        "ws_e2e_events_soak_accepted": len(accepted) + 1,  # + warm-up
+        "ws_e2e_events_soak_rejections": int(rejected),
+        "ws_e2e_events_soak_wall_s": round(soak_wall, 2),
+        "ws_e2e_events_soak_thread_parity": bool(thread_parity),
+        "ws_e2e_events_soak_fd_parity": bool(fd_parity),
+        "ws_e2e_events_soak_leases_clean": bool(leases_clean),
+        "ws_e2e_events_soak_metrics_ok": bool(metrics_ok),
+    })
+    return res
+
+
 def run_remote_pipeline(vol_path, shape, block_shape, target):
     """ctt-cloud contract: the WatershedWorkflow run against the local
     stub object server (tests/objstub.py, spawned as a SUBPROCESS so its

@@ -87,6 +87,12 @@ COUNTERS = frozenset({
     "hier.cut_edges",           # edges selected (saddle <= t) across cuts
     "hier.resegment_jobs",      # serve `resegment` jobs run to success
 
+    # ops/events.py + tasks/events.py — ctt-events high-rate event
+    # building (host-side emission from the build_events wrapper)
+    "events.frames",            # detector frames labeled + summarized
+    "events.clusters",          # clusters (events) extracted across frames
+    "events.batches",           # batched (n_frames, h, w) device dispatches
+
     # ops/cc.py — ctt-cc coarse-to-fine kernel stats (host-side emission
     # from the connected_components_coarse wrapper, never inside jit)
     "cc.fixpoint_iters",

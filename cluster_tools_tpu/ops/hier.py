@@ -276,6 +276,30 @@ def cut_table(
     return np.asarray(vals), np.asarray(roots)
 
 
+def cut_table_np(
+    a: np.ndarray, b: np.ndarray, saddle: np.ndarray, threshold: float
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Host-side :func:`cut_table`: same ``(vals, roots)`` contract and
+    min-member-id semantics, but int64 value-space union-find on the
+    host — the re-cut path for hierarchies beyond 2^31 regions, where
+    the device gather's int32 ids would overflow.  Memory scales with
+    the SELECTED edges (the table is value-space: only ids touched by a
+    merge appear), never with ``n_labels``.  Apply with
+    :func:`apply_cut_np`."""
+    k = int(np.searchsorted(saddle, np.float32(threshold), side="right"))
+    if k == 0:
+        return None
+    a_sel = np.asarray(a[:k], np.int64)
+    b_sel = np.asarray(b[:k], np.int64)
+    vals = np.unique(np.concatenate([a_sel, b_sel]))
+    uf = UnionFindNp(vals.size)
+    # vals is sorted, so merging dense ids toward the smaller id IS
+    # merging toward the smaller label value — the device semantics.
+    uf.merge(np.searchsorted(vals, a_sel), np.searchsorted(vals, b_sel))
+    roots = vals[uf.compress()]
+    return vals, roots
+
+
 @jax.jit
 def recut_labels(
     labels: jnp.ndarray, vals: jnp.ndarray, roots: jnp.ndarray
@@ -312,8 +336,10 @@ def save_cut_table(
         buf,
         schema=np.int64(CUT_SCHEMA_VERSION),
         threshold=np.float64(threshold),
-        vals=np.asarray(vals, np.int32),
-        roots=np.asarray(roots, np.int32),
+        # dtype preserved: device tables are int32, the host-relabel
+        # fallback's are int64 (ids past 2^31 must not be truncated)
+        vals=np.asarray(vals),
+        roots=np.asarray(roots),
         n_labels=np.int64(n_labels),
     )
     atomic_write_bytes(path, buf.getvalue())

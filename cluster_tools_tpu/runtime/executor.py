@@ -314,6 +314,22 @@ class TpuExecutor(BaseExecutor):
 
         chunks = form_batches(ids, batch_size)
 
+        # ctt-hbm aggregated dispatch for MONOLITHIC tasks: the staged
+        # pipeline fuses hbm_stack read payloads into one device program
+        # (_run_staged), but a task exposing only process_block_batch (the
+        # inference path) used to be stuck at batch_size blocks/dispatch.
+        # Its batch fn stacks whatever id list it is handed, so handing it
+        # hbm_stack consecutive chunks IS the aggregated dispatch — same
+        # blocks, same order, fewer, larger programs.  The per-block
+        # fallback grain is unchanged (a failed fused batch degrades block
+        # by block, exactly like an unfused one).
+        stack_n = hbm.hbm_stack(config)
+        if self._staged_fns(task) is None and stack_n > 1 and len(chunks) > 1:
+            chunks = [
+                [bid for chunk in chunks[i: i + stack_n] for bid in chunk]
+                for i in range(0, len(chunks), stack_n)
+            ]
+
         batch_seconds: List[float] = []  # list.append: safe from pool threads
 
         def _one_batch(chunk):
@@ -334,6 +350,10 @@ class TpuExecutor(BaseExecutor):
                 ), hbm.use_guard():
                     batch_fn(chunk, blocking, config)
                 obs_metrics.inc("device.dispatches")
+                if len(chunk) > batch_size:
+                    # blocks that rode a fused (aggregated) dispatch —
+                    # the hbm_stack economics, monolithic-path edition
+                    obs_metrics.inc("device.fused_blocks", len(chunk))
                 dt = time.perf_counter() - t0
                 batch_seconds.append(dt)
                 _record(

@@ -151,6 +151,48 @@ class ServeClient:
         })
         return out["job_id"]
 
+    def event_batch(
+        self,
+        input_path: str,
+        input_key: str,
+        output_path: str,
+        output_key: str,
+        tmp_folder: str,
+        config_dir: str,
+        threshold: Optional[float] = None,
+        connectivity: Optional[int] = None,
+        max_clusters: Optional[int] = None,
+        configs: Optional[Dict[str, dict]] = None,
+        tenant: str = "default",
+        priority: int = 0,
+    ) -> str:
+        """ctt-events front-end step: submit one ``event_batch`` job
+        (label + summarize every frame of the ``(n_frames, h, w)`` stack
+        at ``input_path/input_key``); returns the job id.  Against a warm
+        daemon every batch after the first reuses the compiled kernels —
+        the job signature is frame-count-blind — so a sustained stream
+        pays submission + IO, not compiles."""
+        payload = {
+            "type": "event_batch",
+            "input_path": input_path,
+            "input_key": input_key,
+            "output_path": output_path,
+            "output_key": output_key,
+            "tmp_folder": tmp_folder,
+            "config_dir": config_dir,
+            "configs": configs or {},
+            "tenant": tenant,
+            "priority": priority,
+        }
+        if threshold is not None:
+            payload["threshold"] = float(threshold)
+        if connectivity is not None:
+            payload["connectivity"] = int(connectivity)
+        if max_clusters is not None:
+            payload["max_clusters"] = int(max_clusters)
+        out = self._request("POST", "/api/v1/jobs", payload)
+        return out["job_id"]
+
     def status(self, job_id: str) -> Dict[str, Any]:
         return self._request("GET", f"/api/v1/jobs/{job_id}")
 

@@ -56,6 +56,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -89,6 +90,13 @@ class JobClaim:
 
 
 class JobQueue:
+    # ctt-events: how long cached result/lease classifications may serve
+    # ``stats()`` before being re-probed.  Staleness in this window only
+    # ever OVER-counts in_flight (a just-finished job still counted), so
+    # admission under-admits briefly — the documented conservative
+    # direction — and never overshoots a limit.
+    STATS_TTL_S = 0.05
+
     def __init__(self, root: str, lease_s: Optional[float] = None,
                  daemon_id: Optional[str] = None, fleet=None,
                  max_job_gens: Optional[int] = None):
@@ -111,6 +119,93 @@ class JobQueue:
         except (TypeError, ValueError):
             self.max_job_gens = DEFAULT_MAX_JOB_GENS
         # <= 0 disables the budget (unbounded retries, the pre-fleet rule)
+
+        # -- dense-seq stats index (ctt-events) ------------------------------
+        # Sustained high-rate submission runs ``stats()`` under the submit
+        # lock for EVERY request (two-phase admission) plus per heartbeat
+        # and gauge publish; the full ``_scan()`` there is O(every job +
+        # result + lease file ever written), which grows without bound
+        # over a daemon's life.  Job ids are a dense sequence (publish_once
+        # probing guarantees job.jN exists only after job.jN-1 does), so
+        # new-record discovery is O(new) forward probes from the frontier,
+        # and the unfinished set — bounded by queue depth, not history —
+        # carries everything stats needs (tenant, seq, running/queued).
+        self._idx_lock = threading.Lock()
+        self._idx_max_seq = 0
+        # jid -> {"seq", "tenant", "running"} for records with no result
+        # file seen yet (provisional records count until retracted —
+        # conservative, same as the scan-based accounting)
+        self._idx_unfinished: Dict[str, Dict[str, Any]] = {}
+        self._idx_lease_gen: Dict[str, int] = {}  # highest gen seen per jid
+        self._idx_refreshed = -1e30  # monotonic stamp of the last refresh
+
+    def _index_advance_locked(self) -> None:
+        """Advance the dense-id frontier: probe job.j<seq+1>.json forward
+        until the first missing record.  Exact (no TTL): density means a
+        missing record proves nothing beyond it exists yet, and a record
+        published before ours is always at a lower seq — the fleet
+        recount stays sound on records."""
+        while True:
+            jid = f"j{self._idx_max_seq + 1:06d}"
+            rec = self._record(jid)
+            if rec is None:
+                # distinguish "not published yet" (stop: the frontier)
+                # from "present but unreadable" (advance with defaults —
+                # a stalled frontier would hide every later job forever)
+                if not os.path.exists(
+                    os.path.join(self.dir, f"job.{jid}.json")
+                ):
+                    return
+                rec = {}
+            self._idx_max_seq += 1
+            if not os.path.exists(
+                os.path.join(self.dir, f"result.{jid}.json")
+            ):
+                self._idx_unfinished[jid] = {
+                    "seq": int(rec.get("seq", self._idx_max_seq)),
+                    "tenant": rec.get("tenant", "default"),
+                    "running": False,
+                }
+
+    def _index_classify_locked(self, now_mono: float) -> None:
+        """TTL-gated refresh of the unfinished set: drop jobs whose result
+        landed (one exists() per unfinished job), reclassify the rest as
+        running/queued from their highest-generation lease (lease gens are
+        dense from 0, so discovery is forward exists()-probes from the
+        cached gen).  Work is bounded by the admission queue depth."""
+        if now_mono - self._idx_refreshed < self.STATS_TTL_S:
+            return
+        now = time.time()
+        for jid in list(self._idx_unfinished):
+            if os.path.exists(
+                os.path.join(self.dir, f"result.{jid}.json")
+            ):
+                del self._idx_unfinished[jid]
+                self._idx_lease_gen.pop(jid, None)
+                continue
+            gen = self._idx_lease_gen.get(jid, -1)
+            while os.path.exists(
+                os.path.join(self.dir, f"lease.{jid}.g{gen + 1}.json")
+            ):
+                gen += 1
+            running = False
+            if gen >= 0:
+                self._idx_lease_gen[jid] = gen
+                state, _ = self._lease_state(
+                    os.path.join(self.dir, f"lease.{jid}.g{gen}.json"),
+                    gen, now,
+                )
+                running = state == "live"
+            self._idx_unfinished[jid]["running"] = running
+        self._idx_refreshed = now_mono
+
+    def _index_discard(self, job_id: str) -> None:
+        """Drop a job this process just finished/retracted — its result is
+        on disk, so the next refresh would drop it anyway; discarding now
+        frees the admission headroom without waiting out the TTL."""
+        with self._idx_lock:
+            self._idx_unfinished.pop(job_id, None)
+            self._idx_lease_gen.pop(job_id, None)
 
     # -- directory scan ------------------------------------------------------
 
@@ -212,8 +307,10 @@ class JobQueue:
         cannot collide.  ``admitted=False`` publishes a *provisional*
         record (ctt-fleet two-phase admission): unclaimable until
         :meth:`admit` lands, retractable via :meth:`retract`."""
-        jobs, _, _, _ = self._scan()
-        seq = (int(jobs[-1][1:]) + 1) if jobs else 1
+        with self._idx_lock:
+            # O(new records) frontier probe, not the O(history) dir scan
+            self._index_advance_locked()
+            seq = self._idx_max_seq + 1
         while True:
             job_id = f"j{seq:06d}"
             rec = dict(record)
@@ -226,6 +323,8 @@ class JobQueue:
                 os.path.join(self.dir, f"job.{job_id}.json"),
                 json.dumps(rec, sort_keys=True).encode(),
             ):
+                with self._idx_lock:
+                    self._index_advance_locked()
                 obs_metrics.inc("serve.submissions")
                 return job_id
             seq += 1
@@ -246,7 +345,7 @@ class JobQueue:
         """Park a provisional record as a rejected terminal result (the
         429 path of two-phase admission, and the limbo reaper's verdict
         for a submitter that died between the two phases)."""
-        return publish_once(
+        published = publish_once(
             os.path.join(self.dir, f"result.{job_id}.json"),
             json.dumps({
                 "id": job_id,
@@ -259,6 +358,9 @@ class JobQueue:
                 "finished_wall": time.time(),
             }, sort_keys=True).encode(),
         )
+        if published:
+            self._index_discard(job_id)
+        return published
 
     def _admitted(self, jid: str, rec: Optional[dict],
                   admits: set) -> bool:
@@ -315,32 +417,34 @@ class JobQueue:
         against the same prefix order, so k daemons admitting
         concurrently cannot jointly overshoot a limit.  Provisional
         records count until admitted or retracted (conservative: they
-        can under-admit briefly, never overshoot)."""
-        jobs, _, leases, results = self._scan()
-        now = time.time()
-        per_tenant: Dict[str, int] = {}
-        queued = running = 0
-        for jid in jobs:
-            if jid in results:
-                continue
-            if before_seq is not None and int(jid[1:]) >= before_seq:
-                continue
-            rec = self._record(jid) or {}
-            tenant = rec.get("tenant", "default")
-            per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
-            if jid in leases and self._lease_state(
-                leases[jid][1], leases[jid][0], now
-            )[0] == "live":
-                running += 1
-            else:
-                queued += 1
-        return {
-            "queued": queued,
-            "running": running,
-            "in_flight": queued + running,
-            "per_tenant": per_tenant,
-            "total_jobs": len(jobs),
-        }
+        can under-admit briefly, never overshoot).
+
+        Served from the dense-seq index (ctt-events): record discovery is
+        an exact forward probe from the frontier, result/lease state
+        refreshes under :data:`STATS_TTL_S` — so the per-submit recount is
+        O(unfinished jobs), not an O(history) dir scan, and staleness can
+        only over-count in_flight (under-admit), never overshoot."""
+        with self._idx_lock:
+            self._index_advance_locked()
+            self._index_classify_locked(obs_trace.monotonic())
+            per_tenant: Dict[str, int] = {}
+            queued = running = 0
+            for info in self._idx_unfinished.values():
+                if before_seq is not None and info["seq"] >= before_seq:
+                    continue
+                tenant = info["tenant"]
+                per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
+                if info["running"]:
+                    running += 1
+                else:
+                    queued += 1
+            return {
+                "queued": queued,
+                "running": running,
+                "in_flight": queued + running,
+                "per_tenant": per_tenant,
+                "total_jobs": self._idx_max_seq,
+            }
 
     def _lease_payload(self, job_id: str, gen: int,
                        claim_wall: float) -> bytes:
@@ -457,10 +561,13 @@ class JobQueue:
             "daemon": self.daemon_id,
             "finished_wall": time.time(),
         })
-        return publish_once(
+        published = publish_once(
             os.path.join(self.dir, f"result.{claim.job_id}.json"),
             json.dumps(rec, sort_keys=True).encode(),
         )
+        if published:
+            self._index_discard(claim.job_id)
+        return published
 
     # -- read-side -----------------------------------------------------------
 

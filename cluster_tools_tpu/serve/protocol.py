@@ -44,6 +44,26 @@ accounting are the ordinary job machinery — the type survives on the
 record for the ``hier.resegment_jobs`` counter, and ``job_signature``
 ignores the threshold: every sweep step after the first is a warm job.
 
+ctt-events sugar — the ``event_batch`` job type, the high-rate detector
+front-end wire shape.  One submission = one batch of frames to label and
+summarize (``(n_frames, h, w)`` stack at ``input_path/input_key``)::
+
+    {
+      "type":         "event_batch",
+      "input_path":   ..., "input_key": ...,      # the frame stack
+      "output_path":  ..., "output_key": ...,     # labels volume (+ the
+                                                  # ragged _events tables)
+      "threshold":    0.0,                        # optional kernel knobs →
+      "connectivity": 2,                          # the "events" task config
+      "max_clusters": 16,
+      "tmp_folder":   ..., "config_dir": ...,
+      "configs":      {...}, "tenant": ..., "priority": ...
+    }
+
+Normalizes over ``cluster_tools_tpu.tasks.events:EventBuildingTask``;
+``job_signature`` for this type is frame-count- and block-shape-blind
+(the kernel pow2-pads both), so every batch after the first is warm.
+
 Every request except the bare ``/healthz`` liveness probe must carry the
 daemon's auth token (``X-CTT-Serve-Token: <token>`` or ``Authorization:
 Bearer <token>``), published only through the mode-0600 ``serve.json``
@@ -78,10 +98,13 @@ SCHEMA_VERSION = 1
 
 JOB_STATES = ("queued", "running", "done", "failed")
 
-JOB_TYPES = ("workflow", "resegment")
+JOB_TYPES = ("workflow", "resegment", "event_batch")
 
 # the task class a ``resegment`` submission resolves to (ctt-hier)
 RESEGMENT_TASK = "cluster_tools_tpu.tasks.hier:ResegmentTask"
+
+# the task class an ``event_batch`` submission resolves to (ctt-events)
+EVENTS_TASK = "cluster_tools_tpu.tasks.events:EventBuildingTask"
 
 
 class ProtocolError(ValueError):
@@ -133,6 +156,57 @@ def _normalize_resegment(payload: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _normalize_event_batch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Rewrite an ``event_batch`` submission (ctt-events — one detector
+    frame batch: label + summarize every frame) into the plain workflow
+    shape over :data:`EVENTS_TASK`.  The kernel knobs (threshold /
+    connectivity / max_clusters) land in the ``events`` task config the
+    daemon writes before building."""
+    for field in ("input_path", "input_key", "output_path", "output_key",
+                  "tmp_folder", "config_dir"):
+        if not isinstance(payload.get(field), str) or not payload[field]:
+            raise ProtocolError(
+                f"event_batch submission requires '{field}' (string)"
+            )
+    configs = payload.get("configs") or {}
+    if not isinstance(configs, dict):
+        raise ProtocolError("'configs' must map config names to objects")
+    configs = dict(configs)
+    ev_conf = dict(configs.get("events") or {})
+    if "threshold" in payload:
+        threshold = payload["threshold"]
+        if (not isinstance(threshold, (int, float))
+                or isinstance(threshold, bool)):
+            raise ProtocolError(
+                "event_batch 'threshold' must be numeric"
+            )
+        ev_conf["threshold"] = float(threshold)
+    for field in ("connectivity", "max_clusters"):
+        if field in payload:
+            value = payload[field]
+            if not isinstance(value, int) or isinstance(value, bool):
+                raise ProtocolError(
+                    f"event_batch '{field}' must be an integer"
+                )
+            ev_conf[field] = value
+    configs["events"] = ev_conf
+    return {
+        "type": "event_batch",
+        "workflow": EVENTS_TASK,
+        "kwargs": {
+            "tmp_folder": payload["tmp_folder"],
+            "config_dir": payload["config_dir"],
+            "input_path": payload["input_path"],
+            "input_key": payload["input_key"],
+            "output_path": payload["output_path"],
+            "output_key": payload["output_key"],
+        },
+        "configs": configs,
+        "tenant": payload.get("tenant", "default"),
+        "priority": payload.get("priority", 0),
+    }
+
+
 def validate_submission(payload: Any) -> Dict[str, Any]:
     """Normalize + validate one submission JSON into a job record.  Loud:
     a malformed submission is a client bug, not a degraded default."""
@@ -145,6 +219,8 @@ def validate_submission(payload: Any) -> Dict[str, Any]:
         )
     if job_type == "resegment":
         payload = _normalize_resegment(payload)
+    elif job_type == "event_batch":
+        payload = _normalize_event_batch(payload)
     workflow = payload.get("workflow")
     if not isinstance(workflow, str) or not workflow.strip():
         raise ProtocolError("'workflow' must be a non-empty string")
@@ -234,6 +310,17 @@ def job_signature(record: Dict[str, Any]) -> Tuple:
     per-job persistent-cache hit/miss deltas are recorded alongside in
     the job result; in-memory cache hits emit no jax events, which is
     precisely why they need their own accounting)."""
+    if record.get("type") == "event_batch":
+        # ctt-events: the kernel pads frame counts AND frame shapes to
+        # pow2 buckets, so compiled programs key on connectivity (the only
+        # compile-static knob), not on block geometry or how many frames a
+        # batch carries — a sustained stream of ragged batches is warm
+        # from the second submission on
+        ev_conf = record.get("configs", {}).get("events")
+        connectivity = 2
+        if isinstance(ev_conf, dict):
+            connectivity = int(ev_conf.get("connectivity", 2))
+        return (record["workflow"], "event_batch", connectivity)
     block_shape = None
     gconf = record.get("configs", {}).get("global")
     if isinstance(gconf, dict):

@@ -509,14 +509,24 @@ class Draining(RuntimeError):
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # soak-hardening (ctt-events): HTTP/1.1 keep-alive — a front-end
+    # submitting at rate reuses one connection instead of paying a socket
+    # + handler thread per request (every reply carries Content-Length,
+    # the framing 1.1 persistence needs); idle kept-alive connections
+    # close after ``timeout`` so a silent client cannot pin a thread
+    protocol_version = "HTTP/1.1"
+    timeout = 30.0
+
     # one daemon serves many short local requests; default request logging
     # to stderr would drown the job logs
     def log_message(self, format, *args):  # noqa: A002 - stdlib signature
         pass
 
-    @property
-    def daemon(self) -> ServeDaemon:
-        return self.server.ctt_daemon
+    def setup(self):
+        super().setup()
+        # resolve the daemon once per CONNECTION, not per routed call —
+        # with keep-alive a connection spans many requests
+        self.daemon: ServeDaemon = self.server.ctt_daemon
 
     def _authorized(self) -> bool:
         """The per-daemon token from serve.json (mode 0600), via

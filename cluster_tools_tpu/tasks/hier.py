@@ -574,12 +574,17 @@ class ResegmentTask(VolumeTask):
     task_name = "resegment"
     output_dtype = "uint64"
 
+    # ids at/above this overflow the device gather's int32 — class-level
+    # so tests can fake a tiny limit to exercise the host fallback
+    INT32_LIMIT = int(np.iinfo(np.int32).max)
+
     def __init__(self, *args, hierarchy_path: str = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.hierarchy_path = hierarchy_path
         self._cut = None
         self._cut_ready = False
         self._n_labels = 0
+        self._host_relabel = False
 
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
@@ -598,22 +603,37 @@ class ResegmentTask(VolumeTask):
             return []  # table mode: no volume pass at all
         return super().get_block_list(blocking, gconf)
 
+    def _resolve_cut(self, art, threshold: float):
+        """Pick the cut path from the hierarchy size: device value-space
+        union-find (int32 gather) below :attr:`INT32_LIMIT`, host int64
+        union-find + numpy gather at/above it — a LOUD downgrade, never a
+        silent wrong answer (int32 ids past 2^31 wrap negative)."""
+        self._n_labels = int(art["n_labels"])
+        self._host_relabel = self._n_labels >= self.INT32_LIMIT
+        if self._host_relabel:
+            import warnings
+
+            msg = (
+                f"hierarchy holds {self._n_labels} regions (>= "
+                f"{self.INT32_LIMIT}): int32 device gather would "
+                "overflow — downgrading to the HOST relabel path "
+                "(int64 numpy gather, no HBM cache)"
+            )
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+            self.log(f"resegment: {msg}")
+            return hier_ops.cut_table_np(
+                art["a"], art["b"], art["saddle"], threshold
+            )
+        return hier_ops.cut_table(
+            art["a"], art["b"], art["saddle"], threshold
+        )
+
     def prepare(self, blocking: Blocking, config) -> None:
         if config.get("write_volume", True):
             super().prepare(blocking, config)  # the output dataset
         art = hier_ops.load_hierarchy(self.hierarchy_path)
-        n_labels = int(art["n_labels"])
-        if n_labels >= np.iinfo(np.int32).max:
-            raise NotImplementedError(
-                f"hierarchy holds {n_labels} regions — the device re-cut "
-                "gathers int32 ids; volumes beyond 2^31 regions need the "
-                "(not yet built) host relabel fallback"
-            )
-        self._n_labels = n_labels
         threshold = float(config["threshold"])
-        self._cut = hier_ops.cut_table(
-            art["a"], art["b"], art["saddle"], threshold
-        )
+        self._cut = self._resolve_cut(art, threshold)
         self._cut_ready = True
         k = int(np.searchsorted(
             art["saddle"], np.float32(threshold), side="right"
@@ -637,16 +657,20 @@ class ResegmentTask(VolumeTask):
         # blockwise run() having called prepare on THIS instance state
         if not self._cut_ready:
             art = hier_ops.load_hierarchy(self.hierarchy_path)
-            self._cut = hier_ops.cut_table(
-                art["a"], art["b"], art["saddle"],
-                float(config["threshold"]),
-            )
+            self._cut = self._resolve_cut(art, float(config["threshold"]))
             self._cut_ready = True
         return self._cut
 
     # -- split batch protocol ------------------------------------------------
 
     def read_batch(self, block_ids: List[int], blocking: Blocking, config):
+        self._require_cut(config)  # mode decided before the read dtype
+        if self._host_relabel:
+            # int64 ids, no device_source: the host path never uploads
+            return read_block_batch(
+                self.input_ds(), blocking, block_ids, dtype="int64",
+                n_threads=read_threads(config),
+            )
         return read_block_batch(
             self.input_ds(), blocking, block_ids, dtype="int32",
             n_threads=read_threads(config),
@@ -655,10 +679,20 @@ class ResegmentTask(VolumeTask):
         )
 
     def upload_batch(self, batch, blocking: Blocking, config):
-        hbm.batch_device(batch, config)
+        if not self._host_relabel:
+            hbm.batch_device(batch, config)
         return batch
 
     def stack_payloads(self, payloads, blocking: Blocking, config):
+        if self._host_relabel:
+            if len(payloads) == 1:
+                return payloads[0]
+            return BlockBatch(
+                data=np.concatenate([p.data for p in payloads], axis=0),
+                valid=np.concatenate([p.valid for p in payloads], axis=0),
+                blocks=[bh for p in payloads for bh in p.blocks],
+                block_ids=[i for p in payloads for i in p.block_ids],
+            )
         return hbm.stack_block_batches(payloads, config)
 
     def unstack_results(self, result, counts, blocking: Blocking, config):
@@ -672,6 +706,12 @@ class ResegmentTask(VolumeTask):
         import jax.numpy as jnp
 
         cut = self._require_cut(config)
+        if self._host_relabel:
+            labels = np.asarray(batch.data, np.int64)
+            if cut is None:
+                return batch, labels
+            vals, roots = cut
+            return batch, hier_ops.apply_cut_np(labels, vals, roots)
         db = hbm.batch_device(batch, config)
         labels = db.arrays[0]
         if cut is None:  # identity cut: nothing below the threshold

@@ -38,6 +38,7 @@ from .postprocessing import (
     SizeFilterAndGraphWatershedWorkflow,
     SizeFilterWorkflow,
 )
+from .events import EventBuildingWorkflow
 from .hier import HierarchyWorkflow, ResegmentWorkflow
 from .stitching import MulticutStitchingWorkflow, SimpleStitchingWorkflow
 from .streaming import StreamingSegmentationWorkflow
@@ -87,6 +88,7 @@ __all__ = [
     "SizeFilterAndGraphWatershedWorkflow",
     "SizeFilterWorkflow",
     "TwoPassMwsWorkflow",
+    "EventBuildingWorkflow",
     "HierarchyWorkflow",
     "MulticutStitchingWorkflow",
     "ResegmentWorkflow",

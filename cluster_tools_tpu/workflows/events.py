@@ -1,0 +1,57 @@
+"""ctt-events workflow: high-rate event building over a frame stream.
+
+``EventBuildingWorkflow`` wraps one :class:`~..tasks.events.EventBuildingTask`
+run: an ``(n_frames, h, w)`` frame stack in, a per-frame labels volume plus
+ragged per-block event tables out.  This is the workflow the serve
+``event_batch`` job type (serve/protocol.py) resolves — a detector
+front-end submitting frame batches at rate hits the same warm daemon
+path as every other workflow, with a frame-count-blind job signature so
+every batch after the first reuses the compiled kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..runtime.workflow import WorkflowBase
+from ..tasks.events import EventBuildingTask
+
+
+class EventBuildingWorkflow(WorkflowBase):
+    task_name = "events_workflow"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        target: Optional[str] = None,
+        input_path: str = None,
+        input_key: str = None,
+        output_path: str = None,
+        output_key: str = None,
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, target)
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+
+    def requires(self):
+        return [
+            EventBuildingTask(
+                self.tmp_folder,
+                self.config_dir,
+                self.max_jobs,
+                input_path=self.input_path,
+                input_key=self.input_key,
+                output_path=self.output_path,
+                output_key=self.output_key,
+            )
+        ]
+
+    @classmethod
+    def get_config(cls):
+        conf = super().get_config()
+        conf["events"] = EventBuildingTask.default_task_config()
+        return conf

@@ -1,0 +1,337 @@
+"""ctt-events: high-rate event building tests.
+
+Covers the PR acceptance contract:
+
+  * kernel parity vs the scipy oracle (``ndimage.label`` + numpy
+    reductions): EXACT label equality (the device kernel reproduces
+    scipy's raster first-encounter order), exact counts, close props —
+    across connectivity 1/2, empty frames, single hot pixels, a blob
+    spanning a whole frame (frames must stay independent), ragged
+    per-frame cluster counts, and capacity overflow auto-growth;
+  * pow2 bucketing: a ragged stream of frame counts compiles one program
+    per shape BUCKET, not per shape (``kernel_cache_size`` deltas);
+  * serve ``event_batch`` e2e: daemon output byte-identical to an
+    in-process ``build()`` run, event tables match the oracle, and
+    ``ctt_events_frames_total`` shows up nonzero in /metrics;
+  * mini-soak at the tenant-quota edge ("millions of users" shape): a
+    burst of ~1k submissions gets clean 429s past capacity, every
+    accepted job completes, no lease-renewer threads leak, and the
+    process returns to thread/fd baseline (the per-request allocation
+    audit's assertion).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.obs import metrics as obs_metrics
+from cluster_tools_tpu.obs import trace as obs_trace
+from cluster_tools_tpu.ops import events as events_ops
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.serve import QuotaRejected, ServeClient, ServeDaemon
+from cluster_tools_tpu.tasks.events import read_event_tables
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import EventBuildingWorkflow
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _frame_stack(rng, n=10, h=24, w=20, density=0.9):
+    """Detector-like frames: smooth blobs + isolated hot pixels, ragged
+    cluster counts across frames."""
+    from scipy import ndimage
+
+    raw = ndimage.gaussian_filter(
+        rng.random((n, h, w)), (0.0, 1.0, 1.0)
+    ).astype("float32")
+    frames = np.where(
+        raw > np.quantile(raw, density), raw, 0.0
+    ).astype("float32")
+    # sprinkle single-pixel hits
+    hits = rng.random((n, h, w)) > 0.99
+    frames[hits] = (rng.random(int(hits.sum())) + 1.0).astype("float32")
+    return frames
+
+
+def _assert_parity(frames, threshold=0.0, connectivity=2, **kw):
+    labels, counts, props = events_ops.build_events(
+        frames, threshold=threshold, connectivity=connectivity, **kw
+    )
+    ref_l, ref_c, ref_p = events_ops.build_events_np(
+        frames, threshold=threshold, connectivity=connectivity
+    )
+    np.testing.assert_array_equal(counts, ref_c)
+    np.testing.assert_array_equal(labels, ref_l)
+    for f in range(len(counts)):
+        k = int(counts[f])
+        np.testing.assert_allclose(
+            props[f, :k], ref_p[f, :k], rtol=1e-4, atol=1e-4,
+            err_msg=f"frame {f}",
+        )
+    return labels, counts, props
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("connectivity", [1, 2])
+    def test_random_frames(self, rng, connectivity):
+        frames = _frame_stack(rng)
+        _assert_parity(frames, connectivity=connectivity)
+
+    def test_empty_frames(self):
+        frames = np.zeros((5, 16, 16), np.float32)
+        labels, counts, props = _assert_parity(frames)
+        assert counts.sum() == 0 and labels.max() == 0
+        assert props.shape == (5, 0, events_ops.N_PROPS)
+
+    def test_single_hot_pixel(self):
+        frames = np.zeros((3, 16, 16), np.float32)
+        frames[1, 7, 9] = 2.5
+        labels, counts, props = _assert_parity(frames)
+        assert counts.tolist() == [0, 1, 0]
+        size, energy, cy, cx = props[1, 0, :4]
+        assert (size, energy, cy, cx) == (1.0, 2.5, 7.0, 9.0)
+
+    def test_frame_spanning_blob_stays_per_frame(self):
+        # every pixel above threshold: ONE cluster per frame, and
+        # adjacent frames must NOT merge (frames are independent events)
+        frames = np.ones((4, 8, 8), np.float32)
+        labels, counts, _ = _assert_parity(frames)
+        assert counts.tolist() == [1, 1, 1, 1]
+        assert (labels == 1).all()
+
+    def test_ragged_counts_and_nonsquare(self, rng):
+        frames = _frame_stack(rng, n=7, h=17, w=33, density=0.85)
+        frames[3] = 0.0  # one empty frame mid-stack
+        _, counts, _ = _assert_parity(frames)
+        assert counts[3] == 0 and len(set(counts.tolist())) > 1
+
+    def test_capacity_overflow_grows_and_matches(self, rng):
+        frames = _frame_stack(rng, n=4, density=0.8)  # dense: many clusters
+        _, counts, _ = _assert_parity(frames, max_clusters=2)
+        assert counts.max() > 2  # growth actually happened
+
+    def test_zero_frames(self):
+        labels, counts, props = events_ops.build_events(
+            np.zeros((0, 8, 8), np.float32)
+        )
+        assert labels.shape == (0, 8, 8) and counts.size == 0
+
+    def test_2d_promotes_to_single_frame(self, rng):
+        frame = _frame_stack(rng, n=1)[0]
+        labels, counts, _ = events_ops.build_events(frame)
+        assert labels.shape == (1,) + frame.shape and counts.shape == (1,)
+
+
+class TestCompileBuckets:
+    def test_ragged_stream_compiles_per_bucket(self, rng):
+        """Frame counts 3..8 over a (16, 64) frame pad to TWO pow2
+        buckets (4 and 8 frames) — two compiles, and a repeat of the
+        whole ragged stream compiles nothing."""
+        stacks = {
+            n: _frame_stack(rng, n=n, h=16, w=64, density=0.97)
+            for n in (3, 4, 5, 7, 8)
+        }
+        before = events_ops.kernel_cache_size()
+        for n, frames in stacks.items():
+            events_ops.build_events(frames, max_clusters=32)
+        first = events_ops.kernel_cache_size() - before
+        assert first == 2, f"expected 2 shape buckets, compiled {first}"
+        for n, frames in stacks.items():
+            events_ops.build_events(frames, max_clusters=32)
+        assert events_ops.kernel_cache_size() - before == first
+
+
+# ---------------------------------------------------------------------------
+# serve: event_batch jobs
+
+
+GCONF = {
+    "block_shape": [2, 16, 16], "target": "tpu",
+    "device_batch_size": 2, "devices": [0], "pipeline_depth": 2,
+}
+
+
+@pytest.fixture
+def daemon_factory(tmp_path):
+    """In-process daemons with tracing scoped to this test (mirrors
+    tests/test_serve.py — the serve counters need the trace switch)."""
+    was_on = obs_trace.enabled()
+    if not was_on:
+        obs_trace.enable(str(tmp_path / "trace"), "events_test",
+                         export_env=False)
+    daemons = []
+
+    def make(state_dir, **conf):
+        d = ServeDaemon(str(state_dir), config=conf)
+        d.start()
+        daemons.append(d)
+        return d
+
+    yield make
+    for d in daemons:
+        d.request_drain()
+        if d._httpd is not None:
+            d._httpd.shutdown()
+            d._httpd.server_close()
+        for t in d._threads:
+            if t.name.startswith("ctt-serve-exec"):
+                t.join(timeout=30)
+    if not was_on:
+        obs_trace.disable()
+
+
+def _write_frames(tmp_path, rng, n=10, h=16, w=16, tag="frames"):
+    path = str(tmp_path / f"{tag}.n5")
+    frames = _frame_stack(rng, n=n, h=h, w=w)
+    file_reader(path).create_dataset(
+        "frames", data=frames, chunks=(2, h, w)
+    )
+    return path, frames
+
+
+def _no_leaked_renewers(timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "ctt-serve-lease" and t.is_alive()]
+        if not alive:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestServeEvents:
+    def test_event_batch_e2e_byte_parity(self, tmp_path, daemon_factory,
+                                         rng):
+        path, frames = _write_frames(tmp_path, rng)
+        t = float(np.quantile(frames[frames > 0], 0.2)) if (
+            frames > 0).any() else 0.0
+
+        # in-process reference build
+        ref_cfg = str(tmp_path / "configs_ref")
+        cfg.write_global_config(ref_cfg, GCONF)
+        cfg.write_config(ref_cfg, "events", {"threshold": t})
+        wf = EventBuildingWorkflow(
+            str(tmp_path / "tmp_ref"), ref_cfg,
+            input_path=path, input_key="frames",
+            output_path=path, output_key="ev_ref",
+        )
+        assert build([wf])
+
+        daemon_factory(tmp_path / "state")
+        client = ServeClient(state_dir=str(tmp_path / "state"))
+        job = client.event_batch(
+            input_path=path, input_key="frames",
+            output_path=path, output_key="ev_srv",
+            tmp_folder=str(tmp_path / "tmp_srv"),
+            config_dir=str(tmp_path / "configs_srv"),
+            threshold=t,
+            configs={"global": GCONF},
+        )
+        state = client.wait(job, timeout_s=300)
+        assert state["result"]["ok"]
+
+        f = file_reader(path, "r")
+        srv_labels = f["ev_srv"][:]
+        np.testing.assert_array_equal(srv_labels, f["ev_ref"][:])
+        n_blocks = (len(frames) + GCONF["block_shape"][0] - 1) \
+            // GCONF["block_shape"][0]
+        srv_tab = read_event_tables(path, "ev_srv", n_blocks)
+        ref_tab = read_event_tables(path, "ev_ref", n_blocks)
+        np.testing.assert_array_equal(srv_tab, ref_tab)
+
+        # oracle: per-frame labels and per-frame table row counts
+        ora_labels, ora_counts, _ = events_ops.build_events_np(
+            frames, threshold=t
+        )
+        np.testing.assert_array_equal(srv_labels, ora_labels)
+        assert len(srv_tab) == int(ora_counts.sum())
+
+        # the events counters surface on the daemon's /metrics
+        text = client.metrics_text()
+        lines = {
+            parts[0]: float(parts[1])
+            for parts in (ln.split() for ln in text.splitlines())
+            if len(parts) == 2 and not parts[0].startswith("#")
+        }
+        assert lines.get("ctt_events_frames_total", 0) >= len(frames)
+        assert lines.get("ctt_events_clusters_total", 0) > 0
+        try:
+            from prometheus_client.openmetrics.parser import (
+                text_string_to_metric_families,
+            )
+            assert list(text_string_to_metric_families(text))
+        except ImportError:
+            pass
+
+    def test_soak_quota_edge_no_leaks(self, tmp_path, daemon_factory,
+                                      rng):
+        """Sustained submission well past capacity: clean 429s, every
+        accepted job finishes, and the process holds thread/fd baseline
+        across ~1k requests — the serve-path allocation audit."""
+        path, frames = _write_frames(tmp_path, rng, n=4, tag="soak")
+        daemon_factory(
+            tmp_path / "state", tenant_quota=2, max_queue_depth=4
+        )
+        client = ServeClient(state_dir=str(tmp_path / "state"))
+
+        def submit(i):
+            return client.event_batch(
+                input_path=path, input_key="frames",
+                output_path=path, output_key=f"soak_{i}",
+                tmp_folder=str(tmp_path / f"tmp_soak_{i}"),
+                config_dir=str(tmp_path / f"configs_soak_{i}"),
+                configs={"global": GCONF},
+            )
+
+        # warm-up: one full job (compiles, pool threads, store handles)
+        # so the baseline below measures steady state, not cold start
+        assert client.wait(submit(0), timeout_s=300)["result"]["ok"]
+        assert _no_leaked_renewers()
+        threads_before = threading.active_count()
+        fds_before = len(os.listdir("/proc/self/fd"))
+
+        accepted, rejected = [], 0
+        for i in range(1, 1001):
+            try:
+                accepted.append(submit(i))
+            except QuotaRejected:
+                rejected += 1
+        assert rejected >= 500, f"only {rejected} rejections in the burst"
+        assert accepted, "the burst starved ALL submissions"
+        for job in accepted:
+            assert client.wait(job, timeout_s=300)["result"]["ok"]
+
+        # the 429s are accounted, not silent
+        obs_metrics.flush()
+        text = client.metrics_text()
+        assert any(
+            ln.split()[0] == "ctt_serve_quota_rejections_total"
+            and float(ln.split()[1]) >= rejected
+            for ln in text.splitlines() if ln and not ln.startswith("#")
+        )
+
+        # zero leaks: lease renewers dead, thread + fd baseline restored
+        assert _no_leaked_renewers()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            threads_ok = threading.active_count() <= threads_before
+            fds_ok = len(os.listdir("/proc/self/fd")) <= fds_before
+            if threads_ok and fds_ok:
+                break
+            time.sleep(0.1)
+        assert threading.active_count() <= threads_before, (
+            f"thread growth: {threads_before} -> "
+            f"{threading.active_count()}: "
+            f"{[t.name for t in threading.enumerate()]}"
+        )
+        assert len(os.listdir("/proc/self/fd")) <= fds_before, (
+            f"fd growth: {fds_before} -> "
+            f"{len(os.listdir('/proc/self/fd'))}"
+        )

@@ -399,6 +399,54 @@ class TestStackedDispatchParity:
         assert 0 < dispatches < 5 * n_blocks
         assert _delta(before, after, "device.fused_blocks") > 0
 
+    def test_monolithic_task_aggregated_chunks(self, tmp_path, traced,
+                                               rng):
+        """A task WITHOUT the split protocol (inference-style monolithic
+        ``process_block_batch``) must still profit from ``hbm_stack``:
+        the executor merges consecutive chunks and hands the monolithic
+        fn one bigger id list.  Byte parity vs the unstacked run, fewer
+        dispatches, and ``device.fused_blocks`` counts the merge."""
+        from cluster_tools_tpu.tasks.threshold import ThresholdTask
+
+        class MonoThreshold(ThresholdTask):
+            read_batch = None  # hides the split protocol from _staged_fns
+
+            def _run_batch(self, block_ids, blocking, config):
+                ThresholdTask.write_batch(
+                    self,
+                    ThresholdTask.compute_batch(
+                        self,
+                        ThresholdTask.read_batch(
+                            self, block_ids, blocking, config
+                        ),
+                        blocking, config,
+                    ),
+                    blocking, config,
+                )
+
+        path = _write_vol(tmp_path, rng)
+
+        def run(key, **over):
+            config_dir = _gconf(tmp_path, key, device_batch_size=2,
+                                **over)
+            cfg.write_config(config_dir, "threshold", {"threshold": 0.5})
+            t = MonoThreshold(
+                str(tmp_path / f"tmp_{key}"), config_dir,
+                input_path=path, input_key="bnd",
+                output_path=path, output_key=f"mono_{key}",
+            )
+            assert build([t])
+            return file_reader(path, "r")[f"mono_{key}"][:]
+
+        base = run("mono_plain")
+        before = _counters()
+        fused = run("mono_stacked", hbm_stack=2)
+        after = _counters()
+        np.testing.assert_array_equal(fused, base)
+        # 32 blocks / batch 2 = 16 chunks, merged 2-at-a-time -> 8
+        assert 0 < _delta(before, after, "device.dispatches") <= 8
+        assert _delta(before, after, "device.fused_blocks") > 0
+
 
 def _key_of(cases, name):
     return cases[name].output_key

@@ -563,3 +563,28 @@ class TestFallbacks:
         np.testing.assert_array_equal(
             file_reader(path, "r")["seg_fb_local"][:], tpu_out
         )
+
+    def test_host_relabel_fallback_parity(self, built, tmp_path,
+                                          monkeypatch):
+        """Hierarchies past 2^31 regions downgrade LOUDLY to the host
+        int64 relabel path (the int32 device gather would wrap) — faked
+        small here via the class-level limit.  The device cut builder is
+        stubbed to explode so the test proves the host path really ran,
+        and the output must be byte-identical to the device re-cut."""
+        from cluster_tools_tpu.tasks.hier import ResegmentTask
+
+        _, path, config_dir, _ = built
+        art = hier_ops.load_hierarchy(
+            os.path.join(path, "seg_hierarchy.npz")
+        )
+        t = float(np.quantile(art["saddle"], 0.5))
+        ref = _resegment(tmp_path, path, config_dir, t, "hrl_dev")
+        monkeypatch.setattr(ResegmentTask, "INT32_LIMIT", 1)
+
+        def _no_device_cut(*a, **kw):
+            raise AssertionError("device cut_table ran in host mode")
+
+        monkeypatch.setattr(hier_ops, "cut_table", _no_device_cut)
+        with pytest.warns(RuntimeWarning, match="HOST relabel"):
+            out = _resegment(tmp_path, path, config_dir, t, "hrl_host")
+        np.testing.assert_array_equal(out, ref)

@@ -1416,6 +1416,123 @@ if [ "$fleet_rc" -ne 0 ]; then
     exit "$fleet_rc"
 fi
 
+echo "== ctt-events smoke (daemon event_batch, scipy parity, quota 429 under burst, OpenMetrics events counters) =="
+# the events gate: one serve daemon at a tiny admission envelope builds
+# events for a frame stack (must match scipy.ndimage.label + numpy
+# property reduction exactly), a submission burst past the envelope must
+# draw CLEAN 429s, and /metrics must still parse as OpenMetrics with a
+# nonzero ctt_events_frames_total afterwards.
+events_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$events_tmp" <<'PY'
+import os, signal, subprocess, sys, time
+
+td = sys.argv[1]
+state_dir = os.path.join(td, "state")
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+from cluster_tools_tpu.ops import events as events_ops
+from cluster_tools_tpu.serve import QuotaRejected, ServeClient
+from cluster_tools_tpu.utils import file_reader
+
+path = os.path.join(td, "d.n5")
+rng = np.random.default_rng(0)
+frames = np.where(rng.random((6, 32, 32)) > 0.97,
+                  rng.random((6, 32, 32)) + 1.0, 0.0).astype("float32")
+file_reader(path).create_dataset("frames", data=frames,
+                                 chunks=(2, 32, 32))
+gconf = {"block_shape": [2, 32, 32], "target": "tpu",
+         "device_batch_size": 2, "pipeline_depth": 2}
+
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "cluster_tools_tpu.serve",
+     "--state-dir", state_dir, "--concurrency", "1",
+     "--tenant-quota", "2", "--max-queue-depth", "4"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+)
+try:
+    client = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        assert daemon.poll() is None, daemon.stderr.read()
+        try:
+            client = ServeClient(state_dir=state_dir)
+            client.healthz()
+            break
+        except Exception:
+            time.sleep(0.1)
+    assert client is not None, "daemon never became healthy"
+
+    def submit(tag):
+        return client.event_batch(
+            input_path=path, input_key="frames",
+            output_path=path, output_key=f"ev_{tag}",
+            tmp_folder=os.path.join(td, f"tmp_{tag}"),
+            config_dir=os.path.join(td, f"configs_{tag}"),
+            threshold=0.5, configs={"global": dict(gconf)},
+        )
+
+    job = submit("main")
+    st = client.wait(job, timeout_s=300)
+    assert st["result"]["ok"], st
+
+    # scipy parity: daemon labels volume == per-frame host oracle
+    ref_l, ref_c, _ = events_ops.build_events_np(frames, threshold=0.5)
+    srv = file_reader(path, "r")["ev_main"][:]
+    assert np.array_equal(srv, ref_l), "daemon labels != scipy oracle"
+    from cluster_tools_tpu.tasks.events import read_event_tables
+    rows = read_event_tables(path, "ev_main", 3)
+    assert len(rows) == int(ref_c.sum()), (len(rows), int(ref_c.sum()))
+
+    # burst past the admission envelope: CLEAN 429s, no socket errors
+    accepted, rejected = [], 0
+    for i in range(32):
+        try:
+            accepted.append(submit(f"burst{i}"))
+        except QuotaRejected:
+            rejected += 1
+    assert rejected > 0, "no 429 observed under a 32-submission burst"
+    for j in accepted:
+        assert client.wait(j, timeout_s=300)["result"]["ok"]
+
+    text = client.metrics_text()
+    lines = {
+        parts[0]: float(parts[1])
+        for parts in (ln.split() for ln in text.splitlines())
+        if len(parts) == 2 and not parts[0].startswith("#")
+    }
+    assert lines.get("ctt_events_frames_total", 0) >= len(frames)
+    assert lines.get("ctt_events_clusters_total", 0) > 0
+    assert lines.get("ctt_serve_quota_rejections_total", 0) >= rejected
+    try:
+        from prometheus_client.openmetrics.parser import (
+            text_string_to_metric_families,
+        )
+        fams = {f.name for f in text_string_to_metric_families(text)}
+        assert any(n.startswith("ctt_events_frames") for n in fams), fams
+    except ImportError:
+        assert text.rstrip().endswith("# EOF"), "metrics lost # EOF"
+    print("events smoke ok: scipy parity exact,",
+          f"{rejected} clean 429s in burst, events counters on /metrics")
+finally:
+    daemon.send_signal(signal.SIGTERM)
+    try:
+        daemon.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        daemon.kill()
+        daemon.wait(timeout=30)
+PY
+events_rc=$?
+rm -rf "$events_tmp"
+if [ "$events_rc" -ne 0 ]; then
+    echo "events smoke failed (rc=$events_rc): daemon event_batch parity," \
+         "quota 429 behaviour, or the events /metrics counters regressed" >&2
+    exit "$events_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
