@@ -1,25 +1,33 @@
 """ctt-lint: static analysis for the TPU pipeline.
 
-Two families of checks (see COMPONENTS.md, "Static analysis"):
+Three families of checks (see COMPONENTS.md, "Static analysis"):
 
   * AST invariant lints (CTT0xx) over ``ops/``, ``parallel/``,
     ``runtime/``, ``tasks/``, ``workflows/``, ``utils/`` and the marker /
     noqa hygiene rules over ``tests/`` — ``ast_rules.py``;
   * workflow-graph validation (CTT1xx) over every workflow's task DAG,
     built by instantiation with sentinel arguments, never executed —
-    ``graph.py``.
+    ``graph.py``;
+  * shared-state protocol rules (CTT2xx) over the lease/heartbeat/result
+    file protocols, against the artifact registry in ``protocols.py`` —
+    ``proto_rules.py`` — plus the ``conformance`` CLI verb that validates
+    a *real* state/run dir against the same registry.
 
-CLI: ``python -m cluster_tools_tpu.analysis [--fail-on-findings]``.
+CLI: ``python -m cluster_tools_tpu.analysis [--fail-on-findings]`` and
+``python -m cluster_tools_tpu.analysis conformance <dir>``.
 Suppression: ``# ctt: noqa[CTT003] reason``.
 """
 
 from .core import Finding, REGISTRY, filter_suppressed, parse_suppressions
 from .ast_rules import lint_paths, lint_source, registered_markers
+from .conformance import conformance_report, run_conformance
 from .graph import (
     validate_workflow_class,
     validate_workflow_file,
     validate_workflows_dir,
 )
+from .protocols import SCHEMAS, check_docstring_sync, schema_for_filename
+from .proto_rules import check_fault_site_coverage
 
 __all__ = [
     "Finding",
@@ -29,6 +37,12 @@ __all__ = [
     "lint_paths",
     "lint_source",
     "registered_markers",
+    "conformance_report",
+    "run_conformance",
+    "SCHEMAS",
+    "check_docstring_sync",
+    "schema_for_filename",
+    "check_fault_site_coverage",
     "validate_workflow_class",
     "validate_workflow_file",
     "validate_workflows_dir",

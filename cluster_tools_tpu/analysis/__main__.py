@@ -28,6 +28,23 @@ def _force_cpu_backend() -> None:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "conformance":
+        # `analysis conformance <dir>`: validate a real state/run dir
+        # against the artifact registry (exit 0 clean / 1 empty / 2
+        # malformed) — the chaos smokes' post-run protocol gate
+        sub = argparse.ArgumentParser(
+            prog="python -m cluster_tools_tpu.analysis conformance",
+            description="validate a state/run dir against the "
+            "analysis/protocols.py artifact registry",
+        )
+        sub.add_argument("dir", help="state/queue/run directory to validate")
+        sub_args = sub.parse_args(argv[1:])
+        from .conformance import run_conformance
+
+        return run_conformance(sub_args.dir)
+
     parser = argparse.ArgumentParser(
         prog="python -m cluster_tools_tpu.analysis",
         description="ctt-lint: AST invariant checks + workflow-graph "
@@ -58,9 +75,10 @@ def main(argv=None) -> int:
 
     from .core import REGISTRY
 
-    # make sure both rule families are registered before --list-rules
+    # make sure every rule family is registered before --list-rules
     from . import ast_rules  # noqa: F401
     from . import graph as graph_rules  # noqa: F401
+    from . import proto_rules  # noqa: F401
 
     if args.list_rules:
         for info in REGISTRY.items():
@@ -87,6 +105,15 @@ def main(argv=None) -> int:
     from .ast_rules import lint_paths
 
     findings = lint_paths(paths, pyproject if os.path.exists(pyproject) else None)
+
+    if args.paths is None:
+        # full-tree runs also get the reverse CTT205 check: every
+        # faults.KNOWN_SITES entry must keep >= 1 call site in the
+        # package source (tests excluded — chaos specs there are data)
+        from .proto_rules import check_fault_site_coverage
+
+        pkg_paths = [p for p in paths if not p.endswith("tests")]
+        findings.extend(check_fault_site_coverage(pkg_paths))
 
     if not args.no_graph:
         workflows_dir = args.workflows

@@ -669,6 +669,11 @@ def lint_source(
         _check_resilience_hygiene(tree, path, findings)
         _check_metric_names(tree, path, findings)
         _SetIterVisitor(path, findings).visit(tree)
+        # shared-state protocol rules (CTT2xx) — imported lazily so the
+        # two rule modules can share helpers without an import cycle
+        from .proto_rules import check_proto_rules
+
+        check_proto_rules(tree, path, findings)
     _check_noqa_hygiene(source, path, findings)
 
     if apply_suppressions:

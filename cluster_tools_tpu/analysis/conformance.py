@@ -1,0 +1,196 @@
+"""``analysis conformance <dir>``: validate a real state/run dir against
+the artifact registry.
+
+The chaos smokes leave behind exactly the dirs this checks — a
+SIGKILL-recovered serve state dir, a drained work queue — and protocol
+conformance is what "recovered" means: every surviving file matches a
+registered pattern, parses, carries its schema's required keys with the
+right JSON types, and the serve job sequence stays dense (the fleet
+recount is only sound on dense ids).
+
+Exit codes (the CI contract):
+
+  0  every recognized artifact conforms (torn tails of ``torn_ok``
+     artifacts degrade to warnings — a killed writer is exactly the
+     case the protocol is designed around)
+  1  the dir holds no recognized artifact at all (nothing to judge —
+     almost always a wrong path)
+  2  malformed: unknown files, unparsable non-torn records, missing
+     required keys, wrong types, or serve-job seq gaps
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Tuple
+
+from .protocols import (
+    ArtifactSchema,
+    check_value_type,
+    schema_for_filename,
+)
+
+__all__ = ["conformance_report", "run_conformance"]
+
+_SERVE_JOB_ID_RE = re.compile(r"^job\.j(\d{6})\.json$")
+
+
+def _check_record(
+    rel: str, rec, schema: ArtifactSchema, problems: List[str]
+) -> None:
+    if not isinstance(rec, dict):
+        problems.append(f"{rel}: top-level JSON is not an object")
+        return
+    for key, spec in schema.required.items():
+        if key not in rec:
+            problems.append(
+                f"{rel}: missing required key \"{key}\" "
+                f"({schema.name})"
+            )
+        elif not check_value_type(rec[key], spec):
+            problems.append(
+                f"{rel}: key \"{key}\" = {rec[key]!r} is not {spec} "
+                f"({schema.name})"
+            )
+    for key, spec in schema.optional.items():
+        if key in rec and not check_value_type(rec[key], spec):
+            problems.append(
+                f"{rel}: key \"{key}\" = {rec[key]!r} is not {spec} "
+                f"({schema.name})"
+            )
+    if schema.closed:
+        for key in sorted(set(rec) - set(schema.key_types())):
+            problems.append(
+                f"{rel}: unknown key \"{key}\" in closed schema "
+                f"{schema.name}"
+            )
+
+
+def _check_jsonl(
+    path: str, rel: str, schema: ArtifactSchema,
+    problems: List[str], warnings: List[str],
+) -> None:
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        problems.append(f"{rel}: unreadable: {e}")
+        return
+    lines = raw.decode("utf-8", errors="replace").splitlines()
+    if not lines:
+        warnings.append(f"{rel}: empty span shard (writer died pre-header)")
+        return
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            if i == len(lines) - 1 and schema.torn_ok:
+                warnings.append(
+                    f"{rel}: torn tail line (killed writer) — tolerated"
+                )
+            else:
+                problems.append(f"{rel}: unparsable line {i + 1}")
+            continue
+        if i == 0:
+            _check_record(rel, rec, schema, problems)
+            if isinstance(rec, dict) and rec.get("type") != "header":
+                problems.append(f"{rel}: line 1 is not the header record")
+        elif not (isinstance(rec, dict) and isinstance(rec.get("type"), str)):
+            problems.append(f"{rel}: line {i + 1} has no \"type\"")
+
+
+def conformance_report(
+    root: str,
+) -> Tuple[List[str], List[str], int]:
+    """(problems, warnings, recognized_artifact_count) for one dir tree."""
+    problems: List[str] = []
+    warnings: List[str] = []
+    recognized = 0
+    job_seqs: Dict[int, int] = {}  # filename seq -> record seq (or -1)
+    if not os.path.isdir(root):
+        return [f"{root}: not a directory"], warnings, 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if ".tmp" in name:
+                continue  # staging debris of a killed atomic writer
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            schema = schema_for_filename(name)
+            if schema is None:
+                problems.append(
+                    f"{rel}: unknown file — no registered artifact "
+                    "pattern matches (analysis/protocols.py)"
+                )
+                continue
+            recognized += 1
+            path = os.path.join(dirpath, name)
+            if schema.jsonl:
+                _check_jsonl(path, rel, schema, problems, warnings)
+                continue
+            try:
+                with open(path, "rb") as f:
+                    rec = json.loads(f.read().decode("utf-8"))
+            except OSError as e:
+                problems.append(f"{rel}: unreadable: {e}")
+                continue
+            except ValueError:
+                if schema.torn_ok:
+                    warnings.append(
+                        f"{rel}: torn record (killed writer) — readers "
+                        "age it from mtime; tolerated"
+                    )
+                else:
+                    problems.append(f"{rel}: unparsable JSON")
+                continue
+            _check_record(rel, rec, schema, problems)
+            m = _SERVE_JOB_ID_RE.match(name)
+            if m and isinstance(rec, dict):
+                seq = rec.get("seq")
+                job_seqs[int(m.group(1))] = (
+                    int(seq) if isinstance(seq, int) else -1
+                )
+    # serve-job density: ids are a dense sequence from j000001 — the fleet
+    # admission recount and the stats index frontier both rely on it
+    if job_seqs:
+        ids = sorted(job_seqs)
+        expected = list(range(ids[0], ids[0] + len(ids)))
+        if ids != expected:
+            gaps = sorted(set(expected) - set(ids))
+            problems.append(
+                "serve job sequence has gaps at "
+                + ", ".join(f"j{g:06d}" for g in gaps)
+                + " — dense ids are the admission-recount invariant"
+            )
+        for fid, seq in sorted(job_seqs.items()):
+            if seq != fid:
+                problems.append(
+                    f"job.j{fid:06d}.json: record seq {seq} does not "
+                    "match its filename id"
+                )
+    return problems, warnings, recognized
+
+
+def run_conformance(root: str) -> int:
+    problems, warnings, recognized = conformance_report(root)
+    for msg in warnings:
+        print(f"warning: {msg}")
+    for msg in problems:
+        print(f"FAIL: {msg}")
+    if problems:
+        print(
+            f"conformance: {root}: {len(problems)} problem(s), "
+            f"{len(warnings)} warning(s), {recognized} artifact(s)"
+        )
+        return 2
+    if recognized == 0:
+        print(f"conformance: {root}: no recognized artifacts")
+        return 1
+    print(
+        f"conformance: {root}: OK — {recognized} artifact(s), "
+        f"{len(warnings)} warning(s)"
+    )
+    return 0

@@ -1,0 +1,538 @@
+"""ctt-proto AST rules (CTT2xx): shared-state protocol hygiene.
+
+The filesystem IS the communication backend (leases, first-writer-wins
+results, heartbeats) — these rules lint the writer/reader discipline that
+keeps it race-free, against the artifact declarations in
+``protocols.py``:
+
+  CTT201  writes into state/queue/run dirs must ride the atomic helpers
+          (``publish_once``, ``atomic_write_bytes``, or an inline
+          tmp+``os.replace``) — a bare ``open(..., "w")`` in a producer
+          module is a torn-write race: a concurrent reader sees a
+          half-written record as protocol data.  Append mode stays legal
+          (span shards, task logs).
+  CTT202  check-then-act races: an ``exists()`` test followed by a write
+          to the *same* path inside the guarded branch — between the two
+          calls any peer may publish; use ``publish_once`` (exclusive
+          link) or an unconditional atomic replace.
+  CTT203  a ``publish_once``-family call whose won/lost return value is
+          discarded — the lost-race branch is the protocol (a peer
+          already parked a record there); every caller must branch on it.
+  CTT204  clock-contract drift: staleness comparisons against a numeric
+          multiple of a cadence (``age > 3 * interval``) must use the
+          shared constants (``STALE_INTERVALS``/``STRAGGLER_K``), and
+          ``stale_intervals``-style parameters must not re-declare the
+          constant as a fresh literal default (extends CTT008 to the
+          lease/beat grain).
+  CTT205  ``faults.check``/``mangle`` site literals must be in
+          ``faults.KNOWN_SITES`` — a typo'd site silently never fires —
+          and (whole-tree, :func:`check_fault_site_coverage`) every
+          KNOWN_SITES entry must keep >= 1 call site.
+  CTT206  producer/consumer key drift against the artifact registry: a
+          producer function's statically-written keys must cover its
+          schema's required keys, and a consumer function's literal reads
+          must stay inside the schema's key set.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, register_rule
+from .protocols import (
+    LEASE_MODULES,
+    PRODUCER_MODULES,
+    PUBLISH_WRAPPERS,
+    _module_suffix,
+    schemas_for_module,
+)
+
+register_rule(
+    "CTT201", "bare open(..., 'w') into a shared state/queue/run dir"
+)
+register_rule(
+    "CTT202", "exists()-then-write race on the same shared path"
+)
+register_rule(
+    "CTT203", "publish_once-family return value discarded (lost race unhandled)"
+)
+register_rule(
+    "CTT204", "staleness math re-declares the cadence constants as literals"
+)
+register_rule(
+    "CTT205", "faults.check/mangle site literal not in faults.KNOWN_SITES"
+)
+register_rule(
+    "CTT206", "artifact keys drift from the analysis/protocols.py registry"
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    from .ast_rules import dotted_name
+
+    return dotted_name(node)
+
+
+def _leaf(node: ast.AST) -> str:
+    name = _dotted(node)
+    if name:
+        return name.split(".")[-1]
+    if isinstance(node, ast.Attribute):
+        return node.attr  # method on a computed receiver: x[0].get(...)
+    return ""
+
+
+def _enclosing_functions(
+    tree: ast.Module,
+) -> Dict[int, ast.FunctionDef]:
+    """id(node) -> nearest enclosing function def, for every node."""
+    out: Dict[int, ast.FunctionDef] = {}
+
+    def visit(node: ast.AST, current) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            current = node
+        if current is not None:
+            out[id(node)] = current
+        for child in ast.iter_child_nodes(node):
+            visit(child, current)
+
+    visit(tree, None)
+    return out
+
+
+# --------------------------------------------------------------------------
+# CTT201: bare write-mode open() in producer modules
+
+_WRITE_MODES = {"w", "wb", "w+", "wb+", "w+b", "xt"}
+_ATOMIC_LEAVES = {"replace", "link", "rename"}
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    if _dotted(node.func) not in {"open", "io.open"}:
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value in _WRITE_MODES
+    return False
+
+
+def _fn_has_atomic_commit(fn: Optional[ast.AST], tree: ast.Module) -> bool:
+    """True when the open()'s enclosing scope also calls os.replace /
+    os.link / os.rename — the inline tmp-then-commit idiom (heartbeat,
+    metrics flush, atomic_write_bytes itself)."""
+    scope = fn if fn is not None else tree
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            parts = name.split(".")
+            if parts[0] == "os" and parts[-1] in _ATOMIC_LEAVES:
+                return True
+    return False
+
+
+def _check_atomic_writes(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    if _module_suffix(path) not in PRODUCER_MODULES:
+        return
+    enclosing = _enclosing_functions(tree)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _open_write_mode(node)):
+            continue
+        fn = enclosing.get(id(node))
+        if _fn_has_atomic_commit(fn, tree):
+            continue  # tmp + os.replace/link: atomic by construction
+        findings.append(Finding(
+            "CTT201", path, node.lineno,
+            "bare write-mode open() in a shared-state producer module — "
+            "a concurrent reader can see the half-written record; use "
+            "atomic_write_bytes / publish_once (or commit a tmp file "
+            "with os.replace)",
+        ))
+
+
+# --------------------------------------------------------------------------
+# CTT202: exists() check then write to the same path
+
+_EXISTS_LEAVES = {"exists", "isfile", "lexists"}
+_WRITE_CALL_LEAVES = {"atomic_write_bytes", "write_bytes"}
+
+
+def _exists_args(test: ast.expr) -> List[str]:
+    """ast.dump of every path tested for existence inside an if-test."""
+    out = []
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and _leaf(node.func) in _EXISTS_LEAVES:
+            if node.args:
+                out.append(ast.dump(node.args[0]))
+    return out
+
+
+def _branch_writes(body: List[ast.stmt]) -> List[Tuple[str, int]]:
+    """(ast.dump(path-arg), lineno) for every write call in a branch."""
+    out = []
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if _open_write_mode(node) and node.args:
+                out.append((ast.dump(node.args[0]), node.lineno))
+            elif _leaf(node.func) in _WRITE_CALL_LEAVES and node.args:
+                out.append((ast.dump(node.args[0]), node.lineno))
+    return out
+
+
+def _check_check_then_act(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    if _module_suffix(path) not in PRODUCER_MODULES:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If):
+            continue
+        tested = set(_exists_args(node.test))
+        if not tested:
+            continue
+        for dump, lineno in _branch_writes(node.body) + _branch_writes(
+            node.orelse
+        ):
+            if dump in tested:
+                findings.append(Finding(
+                    "CTT202", path, lineno,
+                    "exists()-guarded write to the same path — a peer can "
+                    "publish between the check and the write; use "
+                    "publish_once (exclusive link) or an unconditional "
+                    "atomic replace",
+                ))
+
+
+# --------------------------------------------------------------------------
+# CTT203: discarded publish_once-family returns
+
+
+def _check_publish_branching(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    wrappers_active = _module_suffix(path) in LEASE_MODULES
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+            continue
+        leaf = _leaf(node.value.func)
+        if leaf == "publish_once" or (wrappers_active and leaf in PUBLISH_WRAPPERS):
+            findings.append(Finding(
+                "CTT203", path, node.value.lineno,
+                f"`{leaf}(...)` return value discarded — the False branch "
+                "IS the protocol (a peer already parked a record there); "
+                "branch on won/lost",
+            ))
+
+
+# --------------------------------------------------------------------------
+# CTT204: staleness/cadence literals outside the shared constants
+
+_CADENCE_TOKENS = ("lease", "interval", "cadence", "beat")
+_CADENCE_PARAMS = ("stale_intervals", "straggler_k")
+
+
+def _names_cadence(node: ast.AST) -> bool:
+    name = _dotted(node)
+    if not name:
+        return False
+    leaf = name.split(".")[-1].lower()
+    return any(tok in leaf for tok in _CADENCE_TOKENS)
+
+
+def _check_clock_contract(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    # (a) `age > 3 * interval`-style comparisons: the multiplier must be
+    # the shared constant, or staleness policy forks per call site
+    flagged: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Mult)):
+                continue
+            left, right = sub.left, sub.right
+            for const, other in ((left, right), (right, left)):
+                if (
+                    isinstance(const, ast.Constant)
+                    and isinstance(const.value, (int, float))
+                    and not isinstance(const.value, bool)
+                    and const.value >= 2
+                    and _names_cadence(other)
+                    and id(sub) not in flagged
+                ):
+                    flagged.add(id(sub))
+                    findings.append(Finding(
+                        "CTT204", path, sub.lineno,
+                        f"staleness comparison multiplies a cadence by the "
+                        f"literal {const.value!r} — use STALE_INTERVALS/"
+                        "STRAGGLER_K (runtime/queue.py) so the expiry "
+                        "policy cannot fork per call site",
+                    ))
+    # (b) re-declaring the constant as a parameter default
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = node.args
+        all_args = args.posonlyargs + args.args + args.kwonlyargs
+        defaults = ([None] * (len(args.posonlyargs) + len(args.args)
+                              - len(args.defaults))
+                    + list(args.defaults) + list(args.kw_defaults))
+        for arg, default in zip(all_args, defaults):
+            if default is None:
+                continue
+            if not any(tok in arg.arg.lower() for tok in _CADENCE_PARAMS):
+                continue
+            if (
+                isinstance(default, ast.Constant)
+                and isinstance(default.value, (int, float))
+                and not isinstance(default.value, bool)
+            ):
+                findings.append(Finding(
+                    "CTT204", path, default.lineno,
+                    f"parameter `{arg.arg}` re-declares the staleness "
+                    f"constant as the literal {default.value!r} — default "
+                    "to the shared constant (runtime/queue.py) instead",
+                ))
+
+
+# --------------------------------------------------------------------------
+# CTT205: fault-site literals vs faults.KNOWN_SITES
+
+_FAULT_CALL_LEAVES = {"check", "mangle"}
+
+
+def _fault_site_literal(node: ast.Call) -> Optional[str]:
+    """The site string of a ``faults.check("x")``-style call, else None."""
+    name = _dotted(node.func) or ""
+    parts = name.split(".")
+    if parts[-1] not in _FAULT_CALL_LEAVES:
+        return None
+    if len(parts) < 2 or "faults" not in parts[-2]:
+        return None  # only faults-module receivers; dict.get etc. stay out
+    if not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _check_fault_sites(
+    tree: ast.Module, path: str, findings: List[Finding]
+) -> None:
+    # import inside the check (the CTT010 idiom): the registry is the
+    # faults module's own KNOWN_SITES constant
+    from .. import faults
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        site = _fault_site_literal(node)
+        if site is None:
+            continue
+        if site not in faults.KNOWN_SITES:
+            findings.append(Finding(
+                "CTT205", path, node.lineno,
+                f"fault site '{site}' is not in faults.KNOWN_SITES — a "
+                "typo'd site silently never fires; add it to SITE_DOCS "
+                "or fix the literal",
+            ))
+
+
+def check_fault_site_coverage(paths) -> List[Finding]:
+    """Whole-tree reverse check: every ``faults.KNOWN_SITES`` entry must
+    keep >= 1 ``faults.check``/``mangle`` call site in the package source,
+    or the documented chaos surface is dead weight.  Findings anchor at
+    the site's SITE_DOCS line in ``faults/__init__.py``."""
+    from .. import faults
+    from .ast_rules import _iter_py_files
+
+    seen: Set[str] = set()
+    for path in _iter_py_files(paths):
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        has_fault_call = False
+        site_literals: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                site = _fault_site_literal(node)
+                if site is not None:
+                    seen.add(site)
+                name = _dotted(node.func) or ""
+                parts = name.split(".")
+                if (
+                    len(parts) >= 2
+                    and parts[-1] in _FAULT_CALL_LEAVES
+                    and "faults" in parts[-2]
+                ):
+                    has_fault_call = True
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if node.value in faults.KNOWN_SITES:
+                    site_literals.add(node.value)
+        if has_fault_call:
+            # the conditional-site idiom: `site = "a" if ... else "b";
+            # faults.check(site)` — any KNOWN_SITES literal in a module
+            # that fires injections counts as a live call site
+            seen.update(site_literals)
+    findings: List[Finding] = []
+    faults_path = os.path.abspath(faults.__file__)
+    try:
+        with open(faults_path) as f:
+            faults_lines = f.read().splitlines()
+    except OSError:
+        faults_lines = []
+    for site in sorted(faults.KNOWN_SITES - seen):
+        lineno = 1
+        for i, text in enumerate(faults_lines, start=1):
+            if f'"{site}"' in text:
+                lineno = i
+                break
+        findings.append(Finding(
+            "CTT205", faults_path, lineno,
+            f"KNOWN_SITES entry '{site}' has no faults.check/mangle call "
+            "site left in the package — remove it from SITE_DOCS or "
+            "restore the injection point",
+        ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# CTT206: producer/consumer key drift against the registry
+
+
+def _function_defs(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # first definition wins (shadowed nested defs are unlikely and
+            # harmless for key collection)
+            out.setdefault(node.name, node)
+    return out
+
+
+def _written_keys(fn: ast.FunctionDef) -> Set[str]:
+    """String keys the function statically writes: dict-literal keys,
+    ``d["k"] = v`` stores, and ``.setdefault("k", ...)`` calls."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for key in node.keys:
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    keys.add(key.value)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Store):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                keys.add(sl.value)
+        elif isinstance(node, ast.Call) and _leaf(node.func) == "setdefault":
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                keys.add(node.args[0].value)
+    return keys
+
+
+def _read_keys(fn: ast.FunctionDef) -> Dict[str, int]:
+    """String keys the function statically reads (first lineno each):
+    ``d["k"]`` loads and ``.get("k")`` calls."""
+    keys: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                keys.setdefault(sl.value, node.lineno)
+        elif isinstance(node, ast.Call) and _leaf(node.func) == "get":
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                keys.setdefault(node.args[0].value, node.lineno)
+    return keys
+
+
+def _check_key_drift(
+    tree: ast.Module, path: str, findings: List[Finding], schemas=None
+) -> None:
+    if schemas is None:
+        sites = schemas_for_module(path)
+    else:
+        sites = schemas_for_module(path, schemas)
+    if not sites:
+        return
+    defs = _function_defs(tree)
+    # a consumer shared by several schemas is judged against their union
+    consumer_allowed: Dict[str, Set[str]] = {}
+    for schema, role, fn_name in sites:
+        if role == "consumer":
+            consumer_allowed.setdefault(fn_name, set()).update(
+                schema.key_types()
+            )
+    for schema, role, fn_name in sites:
+        if role != "producer":
+            continue
+        fn = defs.get(fn_name)
+        if fn is None:
+            findings.append(Finding(
+                "CTT206", path, 1,
+                f"registry names `{fn_name}` as the producer of "
+                f"'{schema.name}' but no such function exists here — "
+                "update analysis/protocols.py with the rename",
+            ))
+            continue
+        missing = set(schema.required) - _written_keys(fn)
+        for key in sorted(missing):
+            findings.append(Finding(
+                "CTT206", path, fn.lineno,
+                f"producer `{fn_name}` never writes required key "
+                f"\"{key}\" of '{schema.name}' — every consumer of "
+                "the artifact expects it",
+            ))
+    for fn_name, allowed in sorted(consumer_allowed.items()):
+        fn = defs.get(fn_name)
+        if fn is None:
+            continue  # consumers may be refactored away harmlessly
+        for key, lineno in sorted(_read_keys(fn).items()):
+            if key not in allowed:
+                findings.append(Finding(
+                    "CTT206", path, lineno,
+                    f"consumer `{fn_name}` reads key \"{key}\" outside "
+                    "every schema it consumes — add the key to "
+                    "analysis/protocols.py or fix the read",
+                ))
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen: Set[Tuple[str, str, int, str]] = set()
+    out = []
+    for f in findings:
+        key = (f.rule_id, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def check_proto_rules(
+    tree: ast.Module, path: str, findings: List[Finding], schemas=None
+) -> None:
+    """Entry point called from ``ast_rules.lint_source`` on non-test
+    files.  ``schemas`` overrides the artifact registry (fixture tests
+    exercise the CTT206 machinery against synthetic declarations)."""
+    pre = len(findings)
+    _check_atomic_writes(tree, path, findings)
+    _check_check_then_act(tree, path, findings)
+    _check_publish_branching(tree, path, findings)
+    _check_clock_contract(tree, path, findings)
+    _check_fault_sites(tree, path, findings)
+    _check_key_drift(tree, path, findings, schemas=schemas)
+    findings[pre:] = _dedupe(findings[pre:])

@@ -1,0 +1,415 @@
+"""ctt-proto: the machine-readable registry of shared-state artifacts.
+
+Every file two processes communicate through — queue manifests, leases,
+results, heartbeats, fleet beats, serve-daemon job records — is declared
+here once: filename pattern, key schema (required + optional, with JSON
+types), the functions that statically produce and consume it, and its
+torn-read semantics.  The prose twin of this registry is the
+``obs/trace.py`` module docstring ("Run-directory file formats" etc.);
+:func:`check_docstring_sync` keeps the two from drifting, and the CTT2xx
+rules in ``proto_rules.py`` plus the ``analysis conformance <dir>`` CLI
+verb enforce the declarations against the code and against real state
+dirs.
+
+Vocabulary:
+
+* **producers** — ``(module_suffix, function_name)`` pairs whose dict
+  literals / subscript stores must statically cover the artifact's
+  required keys (CTT206 producer side).  Producers that assemble the
+  record by merging a caller-supplied dict (``serve/jobs.py complete``,
+  ``submit``) cannot be checked statically and are listed under
+  ``merge_producers`` for documentation; the conformance verb checks
+  their output at runtime instead.
+* **consumers** — ``(module_suffix, function_name)`` pairs whose literal
+  ``rec["k"]`` / ``rec.get("k")`` reads must stay inside the schema's
+  key set (CTT206 consumer side).  A function consuming several
+  artifacts (``runtime/queue.py aggregate`` reads leases *and* results)
+  is judged against the union of every schema that names it.
+* **torn_ok** — readers of this artifact already tolerate a torn/partial
+  record (the mtime-ageing convention for leases and beats, the tail
+  line of an append-only span shard); conformance degrades a torn file
+  to a warning instead of a failure.
+* **closed** — the key set is exhaustive: conformance flags unknown keys.
+  Open schemas (fleet beats carry ``info_fn`` extras, job records carry
+  workflow kwargs) only get required/optional keys type-checked.
+
+Type grammar for key specs: ``str int number bool list dict any``,
+``|``-joined for alternatives, with ``null`` allowing None
+(``"str|null"``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "ArtifactSchema",
+    "SCHEMAS",
+    "PRODUCER_MODULES",
+    "LEASE_MODULES",
+    "PUBLISH_WRAPPERS",
+    "schema_for_filename",
+    "schemas_for_module",
+    "check_value_type",
+    "check_docstring_sync",
+]
+
+Site = Tuple[str, str]  # (module path suffix, function name)
+
+
+@dataclass(frozen=True)
+class ArtifactSchema:
+    name: str
+    pattern: str  # regex over the file's basename
+    description: str
+    required: Dict[str, str] = field(default_factory=dict)
+    optional: Dict[str, str] = field(default_factory=dict)
+    producers: Tuple[Site, ...] = ()
+    merge_producers: Tuple[Site, ...] = ()
+    consumers: Tuple[Site, ...] = ()
+    torn_ok: bool = False
+    closed: bool = False
+    jsonl: bool = False  # span shards: header line + record lines
+    # schemas whose prose lives elsewhere (obs/heartbeat.py defers its
+    # field list) are skipped by the docstring-sync check
+    doc_in_trace: bool = True
+
+    def matches(self, basename: str) -> bool:
+        return re.match(self.pattern, basename) is not None
+
+    def key_types(self) -> Dict[str, str]:
+        out = dict(self.required)
+        out.update(self.optional)
+        return out
+
+
+SCHEMAS: Tuple[ArtifactSchema, ...] = (
+    # -- obs run dir (everything obs.live tails) ----------------------------
+    ArtifactSchema(
+        name="trace_spans",
+        pattern=r"^spans\.p\d+\.t\d+\.jsonl$",
+        description="append-only span shard: header line then span records",
+        required={  # the header record; span lines are checked separately
+            "type": "str", "run": "str|null", "pid": "int", "tid": "int",
+            "host": "str", "wall": "number", "mono": "number",
+        },
+        producers=(("obs/trace.py", "_shard"),),
+        consumers=(),
+        torn_ok=True,  # a SIGKILL mid-line tears exactly the tail line
+        jsonl=True,
+    ),
+    ArtifactSchema(
+        name="metrics_snapshot",
+        pattern=r"^metrics\.p\d+\.json$",
+        description="per-process counter/gauge snapshot, atomically replaced",
+        required={"counters": "dict", "gauges": "dict"},
+        # snapshot() builds the record; flush() commits it verbatim
+        producers=(("obs/metrics.py", "snapshot"),),
+        merge_producers=(("obs/metrics.py", "flush"),),
+        consumers=(("obs/live.py", "_read_metrics"),),
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="heartbeat",
+        pattern=r"^hb\.p\d+\.json$",
+        description="ctt-watch per-process liveness/progress beat",
+        required={
+            "pid": "int", "host": "str", "role": "str", "job_id": "any",
+            "process_id": "any", "run": "str|null", "wall": "number",
+            "mono": "number", "interval_s": "number", "seq": "int",
+            "exiting": "bool", "task": "str|null", "blocks_total": "int",
+            "blocks_done": "int", "blocks_failed": "int",
+            "blocks_retried": "int", "grid": "any", "current_blocks": "list",
+            "queue_depth": "int|null", "draining": "bool",
+            "device_mem_peak_bytes": "number|null",
+        },
+        producers=(("obs/heartbeat.py", "_write_beat"),),
+        consumers=(("obs/live.py", "_worker_rows"),),
+        doc_in_trace=False,  # trace.py defers to obs/heartbeat.py for fields
+    ),
+    # -- ctt-steal work queue (<job_dir>/queue/) ----------------------------
+    ArtifactSchema(
+        name="queue_manifest",
+        pattern=r"^manifest\.json$",
+        description="work-queue item list, written once by the driver",
+        required={
+            "task": "str", "items": "list", "lease_s": "number",
+            "duplicate": "bool", "created_wall": "number",
+        },
+        producers=(("runtime/queue.py", "create"),),
+        consumers=(("runtime/queue.py", "__init__"),),
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="queue_lease",
+        pattern=r"^lease\.\d+\.g\d+\.json$",
+        description="generation-g item ownership, re-stamped every lease_s",
+        required={
+            "item": "int", "gen": "int", "blocks": "list",
+            "owner_pid": "int", "job_id": "any", "host": "str",
+            "claim_wall": "number", "wall": "number", "mono": "number",
+        },
+        producers=(("runtime/queue.py", "_lease_payload"),),
+        consumers=(
+            ("runtime/queue.py", "_lease_age_s"),
+            ("runtime/queue.py", "_claim_duplicate"),
+            ("runtime/queue.py", "aggregate"),
+        ),
+        torn_ok=True,  # torn stamp ages from mtime (documented convention)
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="queue_result",
+        pattern=r"^result\.\d+\.json$",
+        description="item terminal record, published first-writer-wins",
+        required={
+            "item": "int", "gen": "int", "done": "list", "failed": "list",
+            "errors": "dict", "pid": "int", "job_id": "any",
+            "duplicate": "bool", "seconds": "number", "wall": "number",
+        },
+        producers=(("runtime/queue.py", "complete"),),
+        consumers=(
+            ("runtime/queue.py", "aggregate"),
+            ("runtime/queue.py", "_item_median_s"),
+        ),
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="config_file",
+        pattern=r"^[A-Za-z0-9_.-]+\.config$",
+        description="merged-over-defaults config JSON (global/task/serve)",
+        required={},  # free-form dict; the defaults tables own the keys
+        producers=(("runtime/config.py", "write_config"),),
+        consumers=(),
+        doc_in_trace=False,
+    ),
+    # -- ctt-serve daemon state dir -----------------------------------------
+    ArtifactSchema(
+        name="serve_endpoint",
+        pattern=r"^serve\.json$",
+        description="daemon endpoint + auth token, mode 0600",
+        required={
+            "host": "str", "port": "int", "pid": "int", "daemon_id": "str",
+            "started_wall": "number", "run_id": "str|null", "token": "str",
+        },
+        producers=(("serve/server.py", "start"),),
+        consumers=(),  # clients read via serve/client.py read_endpoint
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="serve_job",
+        pattern=r"^job\.j\d{6}\.json$",
+        description="one submission, published exactly once (dense seq)",
+        required={
+            "id": "str", "seq": "int", "schema": "int|str",
+            "workflow": "str", "tenant": "str", "submit_wall": "number",
+        },
+        optional={
+            "type": "str", "kwargs": "dict", "configs": "dict",
+            "priority": "int", "daemon": "str|null", "admitted": "bool",
+        },
+        merge_producers=(
+            # submit() stamps id/seq/submit_wall/daemon/admitted over the
+            # validate_submission record — the union is only visible at
+            # runtime, so the conformance verb owns this contract
+            ("serve/jobs.py", "submit"),
+            ("serve/protocol.py", "validate_submission"),
+        ),
+        consumers=(
+            # server._run_job also reads the record ("tenant"/"workflow"/
+            # "type") but mixes in metric-snapshot reads — function-granular
+            # key checking would false-positive, so it stays undeclared
+            ("serve/jobs.py", "_index_advance_locked"),
+            ("serve/jobs.py", "_reap_limbo"),
+            ("serve/jobs.py", "pending"),
+            ("serve/jobs.py", "claim_next"),
+        ),
+    ),
+    ArtifactSchema(
+        name="serve_lease",
+        pattern=r"^lease\.j\d{6}\.g\d+\.json$",
+        description="generation-g job ownership, re-stamped every lease_s",
+        required={
+            "job": "str", "gen": "int", "owner_pid": "int",
+            "daemon": "str|null", "claim_wall": "number", "wall": "number",
+            "mono": "number",
+        },
+        producers=(("serve/jobs.py", "_lease_payload"),),
+        consumers=(
+            ("serve/jobs.py", "_stamp_age_s"),
+            ("serve/jobs.py", "_lease_state"),
+        ),
+        torn_ok=True,
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="serve_admit",
+        pattern=r"^admit\.j\d{6}\.json$",
+        description="ctt-fleet two-phase admission marker, exclusive link",
+        required={"id": "str", "wall": "number", "daemon": "str|null"},
+        producers=(("serve/jobs.py", "admit"),),
+        consumers=(),  # presence-only reads (the _scan admit set)
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="serve_result",
+        pattern=r"^result\.j\d{6}\.json$",
+        description="job terminal record, first writer wins",
+        required={
+            "id": "str", "gen": "int", "ok": "bool", "pid": "int",
+            "daemon": "str|null", "finished_wall": "number",
+        },
+        optional={
+            "error": "str|null", "seconds": "number", "warm": "bool",
+            "compile_cache": "dict", "tenant": "str|null",
+            "rejected": "bool", "quarantined": "bool", "failure_log": "list",
+        },
+        producers=(
+            ("serve/jobs.py", "retract"),
+            ("serve/jobs.py", "_quarantine"),
+        ),
+        merge_producers=(
+            # complete() stamps identity keys over the server-built result
+            ("serve/jobs.py", "complete"),
+            ("serve/server.py", "_run_job"),
+        ),
+        consumers=(("serve/jobs.py", "get"),),
+    ),
+    ArtifactSchema(
+        name="fleet_beat",
+        pattern=r"^daemon\.[A-Za-z0-9_.-]+\.json$",
+        description="ctt-fleet daemon heartbeat, atomically replaced",
+        required={
+            "id": "str", "pid": "int", "wall": "number", "mono": "number",
+            "interval_s": "number", "seq": "int", "exiting": "bool",
+        },
+        optional={
+            "host": "str", "port": "int", "draining": "bool",
+            "running_jobs": "int", "queued": "int", "concurrency": "int",
+            "info_error": "str",
+        },
+        producers=(("serve/fleet.py", "beat"),),
+        consumers=(
+            ("serve/fleet.py", "_beat_age_s"),
+            ("serve/fleet.py", "is_dead"),
+        ),
+        torn_ok=True,  # read_peers degrades a torn beat to {"torn": True}
+    ),
+)
+
+
+# -- module scoping for the CTT2xx rules ------------------------------------
+
+# modules that write into shared state/queue/run dirs: bare open(..., "w")
+# here is a torn-write race (CTT201) and exists()->write a TOCTOU (CTT202)
+PRODUCER_MODULES = frozenset({
+    "runtime/queue.py",
+    "runtime/cluster_executor.py",
+    "runtime/cluster_worker.py",
+    "runtime/config.py",
+    "runtime/task.py",
+    "serve/jobs.py",
+    "serve/fleet.py",
+    "serve/server.py",
+    "serve/admission.py",
+    "obs/heartbeat.py",
+    "obs/metrics.py",
+    "obs/trace.py",
+    "utils/store_backend.py",
+})
+
+# modules where a discarded publish_once-family return value loses the
+# lost-race branch (CTT203)
+LEASE_MODULES = frozenset({
+    "runtime/queue.py",
+    "runtime/cluster_executor.py",
+    "serve/jobs.py",
+    "serve/server.py",
+})
+
+# methods that return publish_once's won/lost bool and must be branched on
+# inside LEASE_MODULES (publish_once itself is checked everywhere)
+PUBLISH_WRAPPERS = frozenset({
+    "admit", "retract", "complete", "_reap_limbo", "_try_claim",
+})
+
+
+def _module_suffix(path: str) -> str:
+    """Last two path components, normalized — the registry's module key."""
+    parts = os.path.normpath(path).replace(os.sep, "/").split("/")
+    return "/".join(parts[-2:])
+
+
+def schema_for_filename(basename: str) -> Optional[ArtifactSchema]:
+    for schema in SCHEMAS:
+        if schema.matches(basename):
+            return schema
+    return None
+
+
+def schemas_for_module(path: str, schemas=SCHEMAS):
+    """(schema, role, function) triples whose producer/consumer site lives
+    in ``path`` — the per-file work list for CTT206."""
+    suffix = _module_suffix(path)
+    out = []
+    for schema in schemas:
+        for mod, fn in schema.producers:
+            if mod == suffix:
+                out.append((schema, "producer", fn))
+        for mod, fn in schema.consumers:
+            if mod == suffix:
+                out.append((schema, "consumer", fn))
+    return out
+
+
+# -- JSON type grammar -------------------------------------------------------
+
+def check_value_type(value, spec: str) -> bool:
+    """True when ``value`` satisfies a ``"str|int|null"``-style spec."""
+    for alt in spec.split("|"):
+        alt = alt.strip()
+        if alt == "any":
+            return True
+        if alt == "null" and value is None:
+            return True
+        if alt == "str" and isinstance(value, str):
+            return True
+        if alt == "bool" and isinstance(value, bool):
+            return True
+        if alt == "int" and isinstance(value, int) and not isinstance(value, bool):
+            return True
+        if alt == "number" and isinstance(value, (int, float)) \
+                and not isinstance(value, bool):
+            return True
+        if alt == "list" and isinstance(value, list):
+            return True
+        if alt == "dict" and isinstance(value, dict):
+            return True
+    return False
+
+
+# -- docstring sync ----------------------------------------------------------
+
+def check_docstring_sync() -> list:
+    """The ``obs/trace.py`` docstring documents every registered artifact:
+    each schema's required keys must appear as quoted names in the prose
+    (schemas with ``doc_in_trace=False`` defer their field list to their
+    own module and are skipped).  Returns human-readable drift messages —
+    empty means the prose and the registry agree."""
+    from ..obs import trace as trace_mod
+
+    doc = trace_mod.__doc__ or ""
+    problems = []
+    for schema in SCHEMAS:
+        if not schema.doc_in_trace:
+            continue
+        for key in schema.required:
+            if f'"{key}"' not in doc:
+                problems.append(
+                    f"{schema.name}: required key \"{key}\" is not "
+                    "documented in the obs/trace.py docstring"
+                )
+    return problems

@@ -88,7 +88,7 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "FaultInjected", "FaultSpecError", "check", "mangle", "enabled",
     "configure", "reset", "decision_log", "KILL_EXIT_CODE",
-    "ENV_SPEC", "ENV_STATE",
+    "ENV_SPEC", "ENV_STATE", "SITE_DOCS", "sites_markdown_table",
 ]
 
 ENV_SPEC = "CTT_FAULTS"
@@ -108,17 +108,52 @@ class FaultSpecError(ValueError):
     """Malformed ``CTT_FAULTS`` spec — always loud, never silently disarmed."""
 
 
-KNOWN_SITES = frozenset({
-    "store.read", "store.write", "store.decode",
-    "store.remote_read", "store.remote_write",
-    "executor.block", "executor.batch",
-    "executor.stage_read", "executor.stage_compute", "executor.stage_write",
-    "worker.job", "worker.exit",
-    "task.barrier",
-    "collective.init", "collective.execute",
-    "sched.claim", "sched.write", "sched.requeue",
-    "fleet.write",
-})
+# site -> one-line meaning.  The single source of truth for the injection
+# surface: KNOWN_SITES derives from it, README's fault-site table is
+# generated from it (sites_markdown_table), and lint rule CTT205 holds
+# every entry to >= 1 live call site (and every call-site literal to an
+# entry) — the three views cannot drift.
+SITE_DOCS: Dict[str, str] = {
+    "store.read": "utils/store.py chunk read IO",
+    "store.write":
+        "utils/store.py chunk write IO (also `torn`: truncated payload)",
+    "store.decode": "utils/store.py chunk decompress/decode",
+    "store.remote_read":
+        "utils/store_backend.py object-store GET/HEAD round trip",
+    "store.remote_write":
+        "utils/store_backend.py object-store PUT/DELETE round trip",
+    "executor.block": "runtime/executor.py per-block dispatch (ctx `id`)",
+    "executor.batch": "runtime/executor.py block-batch dispatch",
+    "executor.stage_read": "runtime/executor.py pipelined read stage",
+    "executor.stage_compute": "runtime/executor.py pipelined compute stage",
+    "executor.stage_write": "runtime/executor.py pipelined write stage",
+    "worker.job":
+        "runtime/cluster_worker.py before the status write "
+        "(`kill`: job dies with no status)",
+    "worker.exit": "runtime/cluster_worker.py after the status write",
+    "task.barrier": "runtime/task.py peer-wait loop (`stall`: slow peer)",
+    "collective.init":
+        "parallel/sharded.py mesh init (failures take the local fallback)",
+    "collective.execute": "parallel/sharded.py collective execution",
+    "sched.claim":
+        "runtime/queue.py between candidate pick and the lease link",
+    "sched.write":
+        "runtime/queue.py lease payloads (`torn`: reader ages from mtime)",
+    "sched.requeue": "runtime/queue.py expired-lease takeover",
+    "fleet.write":
+        "serve/fleet.py daemon beat payloads (`torn`: mtime ageing)",
+}
+
+KNOWN_SITES = frozenset(SITE_DOCS)
+
+
+def sites_markdown_table() -> str:
+    """The README fault-site table, generated so prose cannot drift from
+    the registry (asserted byte-identical by tests/test_ctt_proto.py)."""
+    lines = ["| site | where it fires |", "| --- | --- |"]
+    for site in sorted(SITE_DOCS):
+        lines.append(f"| `{site}` | {SITE_DOCS[site]} |")
+    return "\n".join(lines)
 
 KNOWN_ACTIONS = frozenset({"io_error", "fail", "kill", "stall", "torn"})
 

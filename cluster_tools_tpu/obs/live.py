@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .heartbeat import FILE_PREFIX as HB_PREFIX
 from .metrics import METRICS_FILE_PREFIX
+from ..runtime.queue import STALE_INTERVALS, STRAGGLER_K
 
 __all__ = [
     "LiveRun", "resolve_live_dir", "format_watch", "format_heatmap",
@@ -87,8 +88,10 @@ class LiveRun:
     def __init__(
         self,
         run_dir: str,
-        straggler_k: float = 4.0,
-        stale_intervals: float = 3.0,
+        # defaults are THE shared clock-contract constants (CTT204): the
+        # live view must age leases/beats exactly like the scheduler does
+        straggler_k: float = STRAGGLER_K,
+        stale_intervals: float = STALE_INTERVALS,
     ):
         self.run_dir = run_dir
         self.straggler_k = float(straggler_k)

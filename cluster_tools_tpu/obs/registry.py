@@ -136,6 +136,11 @@ COUNTERS = frozenset({
     "serve.jobs_quarantined",   # jobs parked as failed results after
                                 # exhausting max_job_gens generations
                                 # (the poison-job retry budget)
+    # ctt-proto: the publish_once lost-race branches made observable —
+    # each counts a benign first-writer-wins collision with a peer
+    "serve.jobs_admitted",      # two-phase admissions this daemon won
+    "serve.retract_races",      # retractions a peer's limbo reaper beat
+    "serve.result_races",       # job results where a gen+1 re-run won
 })
 
 # -- gauges (metrics.set_gauge) ---------------------------------------------

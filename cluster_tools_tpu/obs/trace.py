@@ -37,6 +37,14 @@ CTT008): ``time.time()`` is for *timestamps* only; durations and deadlines
 use ``obs.trace.monotonic()`` (= ``time.monotonic()``) so a host clock
 jump can never fire or stall a timeout.
 
+The artifact formats below are REGISTRY-DERIVED: the machine-readable
+source of truth is ``analysis/protocols.py`` (one ``ArtifactSchema`` per
+file kind — required/optional keys, producers, consumers, torn-write
+tolerance), and ``analysis.check_docstring_sync()`` asserts every
+registered required key still appears in this docstring (whole-tree test
+in tests/test_ctt_proto.py).  Edit the registry first; this prose
+follows it.
+
 Run-directory file formats (everything ``obs.live`` tails)::
 
     spans.p<pid>.t<tid>.jsonl   append-only; line 1 a header record

@@ -76,9 +76,10 @@ class ClusterExecutor(BaseExecutor):
         ids = list(block_ids)
         n_jobs = max(min(max_jobs, len(ids)), 1)
 
+        # workers unpickle this as soon as their job starts — publish it
+        # atomically so an early starter never reads a partial pickle
         task_path = os.path.join(job_dir, "task.pkl")
-        with open(task_path, "wb") as f:
-            pickle.dump(task, f)
+        store_backend.atomic_write_bytes(task_path, pickle.dumps(task))
 
         job_name = f"ctt_{task.identifier}_{os.getpid()}"
         # the driver may hold cached writable h5 handles (dataset creation in
@@ -112,8 +113,9 @@ class ClusterExecutor(BaseExecutor):
                     "block_shape": list(blocking.block_shape),
                     "config": _jsonable(config),
                 }
-            with open(config_path, "w") as f:
-                json.dump(job_conf, f)
+            store_backend.atomic_write_bytes(
+                config_path, json.dumps(job_conf).encode()
+            )
             script = self._write_job_script(job_dir, job_id, config)
             log = os.path.join(job_dir, f"job_{job_id}.log")
             err = os.path.join(job_dir, f"job_{job_id}.err")
@@ -248,8 +250,9 @@ class ClusterExecutor(BaseExecutor):
             f"{sys.executable} -m cluster_tools_tpu.runtime.cluster_worker "
             f"{job_dir} {job_id}"
         )
-        with open(script, "w") as f:
-            f.write("\n".join(lines) + "\n")
+        store_backend.atomic_write_bytes(
+            script, ("\n".join(lines) + "\n").encode()
+        )
         os.chmod(script, 0o755)
         return script
 

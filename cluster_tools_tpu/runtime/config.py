@@ -146,10 +146,15 @@ def _config_path(config_dir: str, name: str) -> str:
 
 
 def write_config(config_dir: str, name: str, conf: Dict[str, Any]) -> str:
+    from ..utils.store_backend import atomic_write_bytes
+
     os.makedirs(config_dir, exist_ok=True)
     path = _config_path(config_dir, name)
-    with open(path, "w") as f:
-        json.dump(conf, f, indent=2, sort_keys=True)
+    # config dirs are shared state (serve daemons rewrite configs between
+    # jobs, workers re-read them) — a reader must never see a torn file
+    atomic_write_bytes(
+        path, json.dumps(conf, indent=2, sort_keys=True).encode()
+    )
     return path
 
 def write_global_config(config_dir: str, conf: Optional[Dict[str, Any]] = None) -> str:

@@ -219,13 +219,24 @@ class WorkQueue:
         self.duplicate_enabled = bool(m.get("duplicate", True))
         self.stale_after_s = STALE_INTERVALS * self.lease_s
         self._live = None  # lazy obs.live reader (lease-aware stragglers)
+        # CTT_SCHED_CLOCK_SKEW_S shifts the READER clock only (stamps stay
+        # real): a worker subprocess started with a skew beyond
+        # stale_after_s sees every already-dead lease as instantly expired
+        # — the injected-clock seam reaching processes a test cannot
+        # monkeypatch.  Malformed/unset degrades to 0 (the CTT_* rule).
+        try:
+            self._clock_skew = float(
+                os.getenv("CTT_SCHED_CLOCK_SKEW_S") or 0.0
+            )
+        except (TypeError, ValueError):
+            self._clock_skew = 0.0
 
     def _now(self) -> float:
         """Reader-side wall clock for lease/claim ageing — a seam so tests
         inject time instead of sleeping real fractions of the cadence
         (expiry decisions become deterministic under arbitrary CI load;
         writer-side stamps stay on the real clock)."""
-        return time.time()
+        return time.time() + self._clock_skew  # ctt: noqa[CTT008] wall by design: lease stamps are cross-process wall times (mtime-ageing contract), not durations
 
     # -- driver side --------------------------------------------------------
 

@@ -509,7 +509,9 @@ class JobQueue:
             if rec is None:
                 continue
             if not self._admitted(jid, rec, admits):
-                self._reap_limbo(jid, rec, now)
+                # win or lose, the job is terminal either way: retract()
+                # inside the reaper already branches on the publish race
+                self._reap_limbo(jid, rec, now)  # ctt: noqa[CTT203] terminal both ways
                 continue
             gen, reclaim = 0, False
             if jid in leases:

@@ -301,9 +301,14 @@ class ServeDaemon:
                 self.jobs.stats(before_seq=int(job_id[1:])),
             )
             if not ok:
-                self.jobs.retract(job_id, reason)
+                if not self.jobs.retract(job_id, reason):
+                    # lost the result race: a peer's limbo reaper already
+                    # parked a terminal record for this provisional job —
+                    # same outcome (rejected), different author
+                    obs_metrics.inc("serve.retract_races")
                 raise Rejected(reason)
-            self.jobs.admit(job_id)
+            if self.jobs.admit(job_id):
+                obs_metrics.inc("serve.jobs_admitted")
         self._publish_gauges()
         self._wake.set()
         return {"job_id": job_id, "state": "queued"}
@@ -380,7 +385,7 @@ class ServeDaemon:
             )
         else:
             obs_metrics.inc("serve.jobs_failed")
-        self.jobs.complete(claim, {
+        won = self.jobs.complete(claim, {
             "ok": ok,
             "error": (error or "")[-4000:] or None,
             "seconds": seconds,
@@ -391,6 +396,11 @@ class ServeDaemon:
             },
             "tenant": rec.get("tenant"),
         })
+        if not won:
+            # a peer presumed us dead mid-run (stale lease or dead fleet
+            # beat) and re-ran the job at gen+1; first writer won and ours
+            # is the duplicate — correct by design, but worth counting
+            obs_metrics.inc("serve.result_races")
         obs_metrics.flush()  # results readable => counters scrapeable
 
     def _instantiate(self, rec: Dict[str, Any]):

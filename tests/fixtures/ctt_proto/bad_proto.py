@@ -1,0 +1,23 @@
+"""CTT2xx protocol-rule fixture: every construct below violates a
+shared-state protocol rule (module-scope-independent subset — CTT201/202/
+206 need a producer-module path and are exercised inline in
+tests/test_ctt_proto.py).  Linted by the CLI contract test; never
+imported."""
+
+from cluster_tools_tpu import faults
+
+
+def park(path, payload):
+    publish_once(path, payload)  # CTT203: won/lost return discarded
+
+
+def is_stale(age, lease_s):
+    return age > 3.0 * lease_s  # CTT204: literal multiple of a cadence
+
+
+def retry_policy(stale_intervals=3.0):  # CTT204: constant re-declared
+    return stale_intervals
+
+
+def fire():
+    faults.check("sched.not_a_site")  # CTT205: typo'd site never fires

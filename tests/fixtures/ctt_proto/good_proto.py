@@ -1,0 +1,19 @@
+"""CTT2xx negative fixture: the same protocol shapes written correctly —
+the CLI contract test asserts this file lints clean.  Never imported."""
+
+from cluster_tools_tpu import faults
+from cluster_tools_tpu.runtime.queue import STALE_INTERVALS
+
+
+def park(path, payload):
+    if publish_once(path, payload):
+        return True
+    return False  # a peer already parked a record there
+
+
+def is_stale(age, lease_s):
+    return age > STALE_INTERVALS * lease_s
+
+
+def fire():
+    faults.check("sched.claim")

@@ -1,0 +1,666 @@
+"""ctt-proto: positive + negative coverage for every CTT2xx shared-state
+protocol rule (exact rule id + file:line), the artifact registry and its
+non-drift contracts (obs/trace.py docstring, README fault-site table,
+KNOWN_SITES coverage), and the ``analysis conformance <dir>`` exit-code
+contract (0 clean / 1 empty / 2 malformed)."""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cluster_tools_tpu import faults
+from cluster_tools_tpu.analysis import (
+    REGISTRY,
+    SCHEMAS,
+    check_docstring_sync,
+    check_fault_site_coverage,
+    conformance_report,
+    lint_source,
+    run_conformance,
+    schema_for_filename,
+)
+from cluster_tools_tpu.analysis.proto_rules import check_proto_rules
+from cluster_tools_tpu.analysis.protocols import ArtifactSchema
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "ctt_proto")
+PKG = os.path.join(REPO, "cluster_tools_tpu")
+
+# a producer module with no registry producer/consumer sites of its own:
+# CTT201/202 scoping is active, CTT206 stays silent
+PRODUCER_PATH = "cluster_tools_tpu/runtime/task.py"
+# a LEASE_MODULES member with no registry sites: wrapper CTT203 is active
+LEASE_PATH = "cluster_tools_tpu/runtime/cluster_executor.py"
+NEUTRAL_PATH = "cluster_tools_tpu/ops/fake.py"
+
+
+def lint(src, path=NEUTRAL_PATH, **kw):
+    return lint_source(src, path, **kw)
+
+
+def only(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+def line_of(path, needle):
+    with open(path) as f:
+        for lineno, text in enumerate(f, start=1):
+            if needle in text:
+                return lineno
+    raise AssertionError(f"{needle!r} not found in {path}")
+
+
+def run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "cluster_tools_tpu.analysis", *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+
+
+# --------------------------------------------------------------------------
+# registry / meta
+
+
+class TestRegistry:
+    def test_ctt2xx_rules_registered(self):
+        expect = {"CTT201", "CTT202", "CTT203", "CTT204", "CTT205",
+                  "CTT206"}
+        assert expect <= REGISTRY.known_ids()
+
+    def test_schema_patterns_disambiguate(self):
+        # queue vs serve artifacts share prefixes; the j-id keeps them apart
+        assert schema_for_filename("lease.3.g1.json").name == "queue_lease"
+        assert schema_for_filename("lease.j000001.g0.json").name \
+            == "serve_lease"
+        assert schema_for_filename("result.12.json").name == "queue_result"
+        assert schema_for_filename("result.j000012.json").name \
+            == "serve_result"
+        assert schema_for_filename("spans.p9.t140.jsonl").name \
+            == "trace_spans"
+        assert schema_for_filename("daemon.host-1.json").name == "fleet_beat"
+        assert schema_for_filename("global.config").name == "config_file"
+        assert schema_for_filename("not_an_artifact.bin") is None
+
+    def test_every_schema_site_names_an_existing_function(self):
+        """The registry must not rot: every declared producer/consumer
+        (and merge producer) function still exists in its module."""
+        for schema in SCHEMAS:
+            sites = (schema.producers + schema.merge_producers
+                     + schema.consumers)
+            for mod, fn in sites:
+                src_path = os.path.join(PKG, *mod.split("/")[-2:])
+                with open(src_path) as f:
+                    tree = ast.parse(f.read())
+                names = {
+                    n.name for n in ast.walk(tree)
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                assert fn in names, (
+                    f"{schema.name}: {mod} has no function `{fn}`"
+                )
+
+    def test_finding_format_is_path_line_rule(self):
+        src = (
+            "import json\n"
+            "def write_thing(path, rec):\n"
+            "    with open(path, \"w\") as f:\n"
+            "        json.dump(rec, f)\n"
+        )
+        (f,) = only(lint(src, path=PRODUCER_PATH), "CTT201")
+        assert f.format().startswith(f"{PRODUCER_PATH}:3: CTT201 ")
+
+
+# --------------------------------------------------------------------------
+# CTT201: bare write-mode open() in producer modules
+
+
+class TestCTT201:
+    def test_bare_write_open_in_producer_module(self):
+        src = (
+            "import json\n"
+            "def write_thing(path, rec):\n"
+            "    with open(path, \"w\") as f:\n"
+            "        json.dump(rec, f)\n"
+        )
+        (f,) = only(lint(src, path=PRODUCER_PATH), "CTT201")
+        assert f.line == 3
+
+    def test_mode_keyword_and_binary(self):
+        src = (
+            "def write_thing(path, data):\n"
+            "    f = open(path, mode=\"wb\")\n"
+            "    f.write(data)\n"
+        )
+        (f,) = only(lint(src, path=PRODUCER_PATH), "CTT201")
+        assert f.line == 2
+
+    def test_negative_inline_tmp_replace_idiom(self):
+        src = (
+            "import json, os\n"
+            "def write_thing(path, rec):\n"
+            "    tmp = path + \".tmp\"\n"
+            "    with open(tmp, \"w\") as f:\n"
+            "        json.dump(rec, f)\n"
+            "    os.replace(tmp, path)\n"
+        )
+        assert only(lint(src, path=PRODUCER_PATH), "CTT201") == []
+
+    def test_negative_append_mode(self):
+        src = (
+            "def log_line(path, line):\n"
+            "    with open(path, \"a\") as f:\n"
+            "        f.write(line)\n"
+        )
+        assert only(lint(src, path=PRODUCER_PATH), "CTT201") == []
+
+    def test_negative_outside_producer_modules(self):
+        src = (
+            "def write_thing(path, text):\n"
+            "    with open(path, \"w\") as f:\n"
+            "        f.write(text)\n"
+        )
+        assert only(lint(src, path=NEUTRAL_PATH), "CTT201") == []
+
+
+# --------------------------------------------------------------------------
+# CTT202: exists()-then-write on the same path
+
+
+class TestCTT202:
+    def test_exists_guarded_write_same_path(self):
+        src = (
+            "import os\n"
+            "def publish(path, data):\n"
+            "    if not os.path.exists(path):\n"
+            "        atomic_write_bytes(path, data)\n"
+        )
+        (f,) = only(lint(src, path=PRODUCER_PATH), "CTT202")
+        assert f.line == 4
+
+    def test_else_branch_is_checked_too(self):
+        src = (
+            "import os\n"
+            "def publish(path, data):\n"
+            "    if os.path.isfile(path):\n"
+            "        pass\n"
+            "    else:\n"
+            "        write_bytes(path, data)\n"
+        )
+        (f,) = only(lint(src, path=PRODUCER_PATH), "CTT202")
+        assert f.line == 6
+
+    def test_negative_write_to_other_path(self):
+        src = (
+            "import os\n"
+            "def publish(path, marker, data):\n"
+            "    if os.path.exists(marker):\n"
+            "        atomic_write_bytes(path, data)\n"
+        )
+        assert only(lint(src, path=PRODUCER_PATH), "CTT202") == []
+
+    def test_negative_unconditional_atomic_write(self):
+        src = (
+            "def publish(path, data):\n"
+            "    atomic_write_bytes(path, data)\n"
+        )
+        assert only(lint(src, path=PRODUCER_PATH), "CTT202") == []
+
+
+# --------------------------------------------------------------------------
+# CTT203: discarded publish_once-family returns
+
+
+class TestCTT203:
+    def test_publish_once_return_discarded(self):
+        src = (
+            "def park(path, payload):\n"
+            "    publish_once(path, payload)\n"
+        )
+        (f,) = only(lint(src), "CTT203")
+        assert f.line == 2
+        assert "publish_once" in f.message
+
+    def test_wrapper_discarded_in_lease_module(self):
+        src = (
+            "def reap(self, jid):\n"
+            "    self._try_claim(jid, 0)\n"
+        )
+        (f,) = only(lint(src, path=LEASE_PATH), "CTT203")
+        assert f.line == 2
+
+    def test_negative_branched_return(self):
+        src = (
+            "def park(path, payload):\n"
+            "    won = publish_once(path, payload)\n"
+            "    if not won:\n"
+            "        return False\n"
+            "    return True\n"
+        )
+        assert only(lint(src), "CTT203") == []
+
+    def test_negative_wrapper_outside_lease_modules(self):
+        src = (
+            "def reap(self, jid):\n"
+            "    self.complete(jid)\n"
+        )
+        assert only(lint(src, path=NEUTRAL_PATH), "CTT203") == []
+
+    def test_noqa_suppresses_with_reason(self):
+        src = (
+            "def park(path, payload):\n"
+            "    publish_once(path, payload)"
+            "  # ctt: noqa[CTT203] fixture: terminal either way\n"
+        )
+        assert only(lint(src), "CTT203") == []
+
+
+# --------------------------------------------------------------------------
+# CTT204: staleness literals outside the shared constants
+
+
+class TestCTT204:
+    def test_literal_cadence_multiple_in_comparison(self):
+        src = (
+            "def is_stale(age, lease_s):\n"
+            "    return age > 3.0 * lease_s\n"
+        )
+        (f,) = only(lint(src), "CTT204")
+        assert f.line == 2
+        assert "STALE_INTERVALS" in f.message
+
+    def test_reversed_operands_and_interval_token(self):
+        src = (
+            "def is_dead(age, beat_interval_s):\n"
+            "    return beat_interval_s * 4 < age\n"
+        )
+        (f,) = only(lint(src), "CTT204")
+        assert f.line == 2
+
+    def test_parameter_redeclares_constant(self):
+        src = (
+            "def policy(stale_intervals=3.0):\n"
+            "    return stale_intervals\n"
+        )
+        (f,) = only(lint(src), "CTT204")
+        assert "stale_intervals" in f.message
+
+    def test_negative_shared_constant_multiplier(self):
+        src = (
+            "from cluster_tools_tpu.runtime.queue import STALE_INTERVALS\n"
+            "def is_stale(age, lease_s):\n"
+            "    return age > STALE_INTERVALS * lease_s\n"
+        )
+        assert only(lint(src), "CTT204") == []
+
+    def test_negative_non_cadence_names_and_scaling(self):
+        src = (
+            "def grow(n_retries, backoff_s):\n"
+            "    if n_retries > 5 * 2:\n"
+            "        return backoff_s\n"
+            "    return 2 * backoff_s\n"
+        )
+        assert only(lint(src), "CTT204") == []
+
+    def test_negative_constant_default_from_import(self):
+        src = (
+            "from cluster_tools_tpu.runtime.queue import STRAGGLER_K\n"
+            "def policy(straggler_k=STRAGGLER_K):\n"
+            "    return straggler_k\n"
+        )
+        assert only(lint(src), "CTT204") == []
+
+
+# --------------------------------------------------------------------------
+# CTT205: fault-site literals vs faults.KNOWN_SITES
+
+
+class TestCTT205:
+    def test_unknown_site_literal(self):
+        src = (
+            "from cluster_tools_tpu import faults\n"
+            "def fire():\n"
+            "    faults.check(\"sched.not_a_site\")\n"
+        )
+        (f,) = only(lint(src), "CTT205")
+        assert f.line == 3
+        assert "sched.not_a_site" in f.message
+
+    def test_mangle_is_checked_too(self):
+        src = (
+            "from cluster_tools_tpu import faults\n"
+            "def mangle(payload):\n"
+            "    return faults.mangle(\"store.nope\", payload)\n"
+        )
+        (f,) = only(lint(src), "CTT205")
+        assert f.line == 3
+
+    def test_negative_known_site_and_foreign_check(self):
+        src = (
+            "from cluster_tools_tpu import faults\n"
+            "def fire(validator):\n"
+            "    faults.check(\"sched.claim\", id=3)\n"
+            "    validator.check(\"not.a.fault.site\")\n"
+        )
+        assert only(lint(src), "CTT205") == []
+
+    def test_coverage_clean_on_real_package(self):
+        assert check_fault_site_coverage([PKG]) == []
+
+    def test_coverage_flags_dead_sites(self, tmp_path):
+        # a tree with no call sites at all: every KNOWN_SITES entry is
+        # dead weight, anchored at its SITE_DOCS line
+        (tmp_path / "empty.py").write_text("x = 1\n")
+        findings = check_fault_site_coverage([str(tmp_path)])
+        assert {f.rule_id for f in findings} == {"CTT205"}
+        assert len(findings) == len(faults.KNOWN_SITES)
+        faults_path = os.path.abspath(faults.__file__)
+        by_site = {f.message.split("'")[1]: f for f in findings}
+        f = by_site["store.read"]
+        assert f.path == faults_path
+        assert f.line == line_of(faults_path, '"store.read"')
+
+    def test_coverage_counts_conditional_site_idiom(self, tmp_path):
+        # `site = "a" if ... else "b"; faults.check(site)` — the literals
+        # count as live call sites because the module fires injections
+        src = (
+            "from cluster_tools_tpu import faults\n"
+            "def roundtrip(method):\n"
+            "    site = (\"store.remote_write\" if method == \"PUT\"\n"
+            "            else \"store.remote_read\")\n"
+            "    faults.check(site)\n"
+        )
+        (tmp_path / "remote.py").write_text(src)
+        findings = check_fault_site_coverage([str(tmp_path)])
+        missing = {f.message.split("'")[1] for f in findings}
+        assert "store.remote_write" not in missing
+        assert "store.remote_read" not in missing
+        assert "store.read" in missing  # everything else is still dead
+
+
+# --------------------------------------------------------------------------
+# CTT206: producer/consumer key drift against the registry
+
+
+FAKE_SCHEMA = ArtifactSchema(
+    name="fake_rec",
+    pattern=r"^fake\.json$",
+    description="fixture artifact",
+    required={"a": "int", "b": "str"},
+    optional={"c": "bool"},
+    producers=(("ops/fake.py", "make"),),
+    consumers=(("ops/fake.py", "read"),),
+)
+
+
+def drift(src, schemas=(FAKE_SCHEMA,), path=NEUTRAL_PATH):
+    findings = []
+    check_proto_rules(ast.parse(src), path, findings, schemas=list(schemas))
+    return only(findings, "CTT206")
+
+
+class TestCTT206:
+    def test_producer_missing_required_key(self):
+        src = (
+            "def make():\n"
+            "    return {\"a\": 1}\n"
+        )
+        (f,) = drift(src)
+        assert f.line == 1
+        assert '"b"' in f.message and "fake_rec" in f.message
+
+    def test_producer_renamed_away(self):
+        src = "def build():\n    return {\"a\": 1, \"b\": \"x\"}\n"
+        (f,) = drift(src)
+        assert "`make`" in f.message and f.line == 1
+
+    def test_consumer_reads_undeclared_key(self):
+        src = (
+            "def read(rec):\n"
+            "    return rec[\"a\"], rec.get(\"z\")\n"
+        )
+        src = "def make():\n    d = {}\n    d[\"a\"] = 1\n" \
+              "    d.setdefault(\"b\", \"x\")\n    return d\n" + src
+        (f,) = drift(src)
+        assert f.line == 7
+        assert '"z"' in f.message
+
+    def test_negative_clean_producer_and_consumer(self):
+        src = (
+            "def make():\n"
+            "    return {\"a\": 1, \"b\": \"x\", \"c\": True}\n"
+            "def read(rec):\n"
+            "    return rec[\"a\"] if rec.get(\"c\") else rec[\"b\"]\n"
+        )
+        assert drift(src) == []
+
+    def test_negative_module_without_registry_sites(self):
+        src = "def make():\n    return {}\n"
+        assert drift(src, path="cluster_tools_tpu/ops/other.py") == []
+
+    def test_real_tree_has_no_key_drift(self):
+        """Every registry-declared producer/consumer in the live package
+        agrees with its schema (the drift the rule exists to catch)."""
+        modules = {mod for schema in SCHEMAS
+                   for mod, _ in schema.producers + schema.consumers}
+        for mod in sorted(modules):
+            src_path = os.path.join(PKG, *mod.split("/"))
+            with open(src_path) as f:
+                findings = []
+                check_proto_rules(
+                    ast.parse(f.read()), src_path, findings
+                )
+            assert only(findings, "CTT206") == [], mod
+
+
+# --------------------------------------------------------------------------
+# non-drift contracts: docstring, README table
+
+
+class TestNonDrift:
+    def test_trace_docstring_matches_registry(self):
+        assert check_docstring_sync() == []
+
+    def test_readme_fault_table_is_generated(self):
+        with open(os.path.join(REPO, "README.md")) as f:
+            readme = f.read()
+        begin = "<!-- ctt-fault-sites:begin -->"
+        end = "<!-- ctt-fault-sites:end -->"
+        assert begin in readme and end in readme
+        table = readme.split(begin)[1].split(end)[0].strip()
+        assert table == faults.sites_markdown_table()
+
+
+# --------------------------------------------------------------------------
+# conformance: exit-code contract over synthetic state dirs
+
+
+def _write(dirpath, name, obj):
+    path = os.path.join(str(dirpath), name)
+    with open(path, "w") as f:
+        if isinstance(obj, str):
+            f.write(obj)
+        else:
+            json.dump(obj, f)
+    return path
+
+
+def _valid_queue_dir(dirpath):
+    _write(dirpath, "manifest.json", {
+        "task": "t", "items": [[0, 1]], "lease_s": 1.0,
+        "duplicate": True, "created_wall": 1.0,
+    })
+    _write(dirpath, "lease.0.g0.json", {
+        "item": 0, "gen": 0, "blocks": [0, 1], "owner_pid": 1,
+        "job_id": "0", "host": "h", "claim_wall": 1.0, "wall": 1.0,
+        "mono": 2.0,
+    })
+    _write(dirpath, "result.0.json", {
+        "item": 0, "gen": 0, "done": [0, 1], "failed": [], "errors": {},
+        "pid": 1, "job_id": "0", "duplicate": False, "seconds": 0.1,
+        "wall": 1.0,
+    })
+    _write(dirpath, "metrics.p1.json", {"counters": {"x": 1}, "gauges": {}})
+    _write(dirpath, "spans.p1.t2.jsonl", (
+        '{"type": "header", "run": "r", "pid": 1, "tid": 2,'
+        ' "host": "h", "wall": 1.0, "mono": 2.0}\n'
+        '{"type": "span", "id": 1, "name": "n", "t0": 0.0, "t1": 1.0}\n'
+    ))
+
+
+def _valid_serve_dir(dirpath):
+    _write(dirpath, "serve.json", {
+        "host": "h", "port": 1, "pid": 2, "daemon_id": "d",
+        "started_wall": 1.0, "run_id": None, "token": "x",
+    })
+    _write(dirpath, "job.j000001.json", {
+        "id": "j000001", "seq": 1, "schema": 1, "workflow": "w",
+        "tenant": "t", "submit_wall": 1.0, "admitted": True,
+    })
+    _write(dirpath, "admit.j000001.json",
+           {"id": "j000001", "wall": 1.0, "daemon": "d"})
+    _write(dirpath, "lease.j000001.g0.json", {
+        "job": "j000001", "gen": 0, "owner_pid": 2, "daemon": "d",
+        "claim_wall": 1.0, "wall": 1.0, "mono": 2.0,
+    })
+    _write(dirpath, "result.j000001.json", {
+        "id": "j000001", "gen": 0, "ok": True, "pid": 2, "daemon": "d",
+        "finished_wall": 1.0,
+    })
+    _write(dirpath, "daemon.d1.json", {
+        "id": "d1", "pid": 2, "wall": 1.0, "mono": 2.0,
+        "interval_s": 1.0, "seq": 1, "exiting": False, "queued": 0,
+    })
+
+
+class TestConformance:
+    def test_clean_queue_dir_exits_0(self, tmp_path, capsys):
+        _valid_queue_dir(tmp_path)
+        assert run_conformance(str(tmp_path)) == 0
+        problems, warnings, recognized = conformance_report(str(tmp_path))
+        assert problems == [] and warnings == [] and recognized == 5
+
+    def test_clean_serve_dir_exits_0(self, tmp_path):
+        _valid_serve_dir(tmp_path)
+        problems, warnings, recognized = conformance_report(str(tmp_path))
+        assert problems == [], problems
+        assert recognized == 6
+        assert run_conformance(str(tmp_path)) == 0
+
+    def test_empty_dir_exits_1(self, tmp_path):
+        assert run_conformance(str(tmp_path)) == 1
+
+    def test_missing_dir_exits_2(self, tmp_path):
+        assert run_conformance(str(tmp_path / "nope")) == 2
+
+    def test_unknown_file_exits_2(self, tmp_path):
+        _valid_queue_dir(tmp_path)
+        _write(tmp_path, "garbage.bin", "not an artifact")
+        problems, _, _ = conformance_report(str(tmp_path))
+        assert any("unknown file" in p for p in problems)
+        assert run_conformance(str(tmp_path)) == 2
+
+    def test_missing_required_key_and_wrong_type(self, tmp_path):
+        _valid_queue_dir(tmp_path)
+        _write(tmp_path, "result.1.json", {
+            "item": "one", "gen": 0, "done": [], "failed": [],
+            "errors": {}, "pid": 1, "job_id": None, "duplicate": False,
+            "seconds": 0.1,  # "wall" missing; "item" is a str
+        })
+        problems, _, _ = conformance_report(str(tmp_path))
+        assert any('missing required key "wall"' in p for p in problems)
+        assert any('"item"' in p and "is not int" in p for p in problems)
+        assert run_conformance(str(tmp_path)) == 2
+
+    def test_closed_schema_rejects_unknown_keys(self, tmp_path):
+        _valid_queue_dir(tmp_path)
+        _write(tmp_path, "metrics.p2.json",
+               {"counters": {}, "gauges": {}, "histograms": {}})
+        problems, _, _ = conformance_report(str(tmp_path))
+        assert any('unknown key "histograms"' in p for p in problems)
+
+    def test_torn_lease_degrades_to_warning(self, tmp_path):
+        _valid_queue_dir(tmp_path)
+        _write(tmp_path, "lease.1.g0.json", '{"item": 1, "gen"')
+        problems, warnings, _ = conformance_report(str(tmp_path))
+        assert problems == []
+        assert any("torn record" in w for w in warnings)
+        assert run_conformance(str(tmp_path)) == 0
+
+    def test_torn_non_torn_ok_record_is_a_problem(self, tmp_path):
+        _valid_queue_dir(tmp_path)
+        _write(tmp_path, "result.1.json", '{"item": 1, "gen"')
+        problems, _, _ = conformance_report(str(tmp_path))
+        assert any("unparsable JSON" in p for p in problems)
+        assert run_conformance(str(tmp_path)) == 2
+
+    def test_torn_span_tail_line_is_a_warning(self, tmp_path):
+        _valid_queue_dir(tmp_path)
+        _write(tmp_path, "spans.p3.t4.jsonl", (
+            '{"type": "header", "run": null, "pid": 3, "tid": 4,'
+            ' "host": "h", "wall": 1.0, "mono": 2.0}\n'
+            '{"type": "span", "id": 2, "t0": 0.0, "t'
+        ))
+        problems, warnings, _ = conformance_report(str(tmp_path))
+        assert problems == []
+        assert any("torn tail line" in w for w in warnings)
+
+    def test_tmp_staging_debris_is_skipped(self, tmp_path):
+        _valid_queue_dir(tmp_path)
+        _write(tmp_path, "metrics.p9.json.tmp12345", "{half a rec")
+        assert run_conformance(str(tmp_path)) == 0
+
+    def test_serve_job_gap_and_seq_mismatch(self, tmp_path):
+        _valid_serve_dir(tmp_path)
+        _write(tmp_path, "job.j000003.json", {
+            "id": "j000003", "seq": 2, "schema": 1, "workflow": "w",
+            "tenant": "t", "submit_wall": 1.0,
+        })
+        problems, _, _ = conformance_report(str(tmp_path))
+        assert any("gaps at j000002" in p for p in problems)
+        assert any("seq 2 does not match" in p for p in problems)
+        assert run_conformance(str(tmp_path)) == 2
+
+    def test_cli_verb_exit_codes(self, tmp_path):
+        clean = tmp_path / "clean"
+        clean.mkdir()
+        _valid_queue_dir(clean)
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        _write(bad, "garbage.bin", "x")
+        assert run_cli("conformance", str(clean)).returncode == 0
+        assert run_cli("conformance", str(empty)).returncode == 1
+        proc = run_cli("conformance", str(bad))
+        assert proc.returncode == 2
+        assert "unknown file" in proc.stdout
+
+
+# --------------------------------------------------------------------------
+# CLI contract: fixtures fail, the real tree is clean
+
+
+class TestCli:
+    def test_bad_proto_fixture_fails(self):
+        proc = run_cli(
+            "--fail-on-findings", "--no-graph",
+            "--paths", os.path.join(FIXTURES, "bad_proto.py"),
+        )
+        assert proc.returncode == 1
+        for rid in ("CTT203", "CTT204", "CTT205"):
+            assert rid in proc.stdout, rid
+
+    def test_good_proto_fixture_is_clean(self):
+        proc = run_cli(
+            "--fail-on-findings", "--no-graph",
+            "--paths", os.path.join(FIXTURES, "good_proto.py"),
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_real_tree_is_clean_with_proto_rules(self):
+        proc = run_cli("--fail-on-findings")
+        assert proc.returncode == 0, proc.stdout + proc.stderr

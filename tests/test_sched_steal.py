@@ -560,14 +560,31 @@ class TestStubSchedulerIntegration:
         first writer wins), so with it enabled the ``leases_expired >= 1``
         assertion was a coin flip under load — the PR 9 tier-1 flake.
         With ``steal_duplicate: false`` the expiry path is the only
-        recovery route and the assertion is deterministic."""
+        recovery route and the assertion is deterministic.
+
+        The remaining flake was the expiry wait itself: the surviving
+        worker must age the dead lease past ``3 x steal_lease_s`` of REAL
+        time, racing its own drain give-up against CI load.  The chaos
+        workers therefore run with ``CTT_SCHED_CLOCK_SKEW_S`` (the
+        injected-clock seam from the PR 10 review) beyond the staleness
+        horizon, so a dead lease is expired on the very first scan.  The
+        skew shifts only the reader clock of those subprocesses; stamps
+        stay real, and a worker never scans while holding a live lease
+        (``drain`` is claim->execute->complete, jobs are sequential under
+        the stub scheduler), so no live lease can be mis-expired."""
         out_ref, _, _ = _threshold_run(tmp_path, vol, "ref", sched="steal")
         out_chaos, status, tmp_chaos = _threshold_run(
             tmp_path, vol, "chaos", sched="steal",
             faults_spec="executor.block:kill:ids=5,once;seed=11",
             state_dir=str(tmp_path / "fault_state"),
             trace_run="steal_chaos",
-            extra_global={"steal_duplicate": False},
+            extra_global={
+                "steal_duplicate": False,
+                # > stale_after_s = 3 * steal_lease_s (1.0 s above)
+                "worker_env": dict(
+                    WORKER_ENV, CTT_SCHED_CLOCK_SKEW_S="4.0"
+                ),
+            },
         )
         assert _digest_tree(out_ref) == _digest_tree(out_chaos)
         # the kill really fired (cross-process latch)

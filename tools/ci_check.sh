@@ -1408,6 +1408,20 @@ finally:
     stub.wait(timeout=30)
 PY
 fleet_rc=$?
+if [ "$fleet_rc" -eq 0 ]; then
+    # ctt-proto: the SIGKILL-survivor state dir is exactly what the
+    # artifact registry describes — every surviving file must match a
+    # registered schema (protocol conformance IS the recovery contract)
+    echo "== ctt-proto conformance (fleet-chaos state dir vs the artifact registry) =="
+    JAX_PLATFORMS=cpu python -m cluster_tools_tpu.analysis conformance \
+        "$fleet_tmp/state_fleet"
+    fleet_rc=$?
+    if [ "$fleet_rc" -ne 0 ]; then
+        echo "conformance failed (rc=$fleet_rc): the fleet smoke left" \
+             "behind files the registry does not describe — update" \
+             "analysis/protocols.py or fix the writer" >&2
+    fi
+fi
 rm -rf "$fleet_tmp"
 if [ "$fleet_rc" -ne 0 ]; then
     echo "fleet smoke failed (rc=$fleet_rc): the two-daemon fleet lost a" \
