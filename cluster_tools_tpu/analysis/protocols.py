@@ -237,10 +237,16 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
             "daemon": "str|null", "claim_wall": "number", "wall": "number",
             "mono": "number",
         },
+        # released=true: the owner gave the job back voluntarily (drain
+        # suspend of a long-lived ingest stream) — stamped with wall=0 so
+        # the lease classifies expired immediately, and excluded from the
+        # generation budget on quarantine accounting
+        optional={"released": "bool"},
         producers=(("serve/jobs.py", "_lease_payload"),),
         consumers=(
             ("serve/jobs.py", "_stamp_age_s"),
             ("serve/jobs.py", "_lease_state"),
+            ("serve/jobs.py", "_released_gens"),
         ),
         torn_ok=True,
         closed=True,
@@ -298,6 +304,58 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
         ),
         torn_ok=True,  # read_peers degrades a torn beat to {"torn": True}
     ),
+    # -- ctt-ingest control dir (the growing source's prefix) ---------------
+    ArtifactSchema(
+        name="ingest_manifest",
+        pattern=r"^ingest\.manifest\.json$",
+        description="stream geometry, published once by the writer",
+        required={
+            "schema": "int", "domain": "str", "shape": "list",
+            "slab_depth": "int", "slabs_total": "int",
+            "created_wall": "number",
+        },
+        producers=(("ingest/source.py", "publish_manifest"),),
+        consumers=(("ingest/source.py", "manifest"),),
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="ingest_slab_marker",
+        pattern=r"^slab\.\d{6}\.json$",
+        description="per-slab landing marker, create-only after data lands",
+        required={"slab": "int", "wall": "number"},
+        optional={"digest": "str"},
+        producers=(("ingest/source.py", "publish_slab"),),
+        consumers=(("ingest/source.py", "poll"),),
+        torn_ok=True,  # a torn marker is skipped until a later poll
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="ingest_carry",
+        pattern=r"^ingest\.carry\.s\d{6}\.json$",
+        description="per-slab carry snapshot, create-only after commit",
+        required={
+            "schema": "int", "chain": "str", "slab": "int",
+            "slabs_done": "int", "carry": "str", "carry_bytes": "int",
+            "cap_hint": "dict", "wall": "number",
+        },
+        producers=(("ingest/runner.py", "_persist_carry"),),
+        consumers=(("ingest/runner.py", "_load_carry"),),
+        torn_ok=True,  # an unreadable record falls back to the previous one
+        closed=True,
+    ),
+    ArtifactSchema(
+        name="ingest_frontier",
+        pattern=r"^ingest\.frontier\.json$",
+        description="commit frontier, atomically replaced per slab",
+        required={
+            "schema": "int", "slabs_done": "int", "slabs_total": "int",
+            "resumes": "int", "wall": "number",
+        },
+        producers=(("ingest/runner.py", "_publish_frontier"),),
+        consumers=(("ingest/runner.py", "_read_frontier"),),
+        torn_ok=True,  # advisory progress record; carry records are truth
+        closed=True,
+    ),
 )
 
 
@@ -319,6 +377,8 @@ PRODUCER_MODULES = frozenset({
     "obs/metrics.py",
     "obs/trace.py",
     "utils/store_backend.py",
+    "ingest/source.py",
+    "ingest/runner.py",
 })
 
 # modules where a discarded publish_once-family return value loses the

@@ -35,6 +35,10 @@ Sites (each named where the corresponding code path lives):
       — utils/store_backend.py object-store requests (ctt-cloud): one check
       per HTTP round trip, so ``p=`` chaos models a flaky gateway at
       request grain (the request-level retry must absorb it).
+  ``store.remote_list``  — utils/store_backend.py listing GETs, one check
+      per continuation page: the ctt-ingest watcher's poll primitive —
+      chaos here models eventually-visible listings, which the per-page
+      retry and the watcher's monotone frontier must absorb.
   ``executor.block`` (ctx ``id``: block id) / ``executor.batch`` /
       ``executor.stage_read`` / ``executor.stage_compute`` /
       ``executor.stage_write``  — runtime/executor.py dispatch paths.
@@ -122,6 +126,8 @@ SITE_DOCS: Dict[str, str] = {
         "utils/store_backend.py object-store GET/HEAD round trip",
     "store.remote_write":
         "utils/store_backend.py object-store PUT/DELETE round trip",
+    "store.remote_list":
+        "utils/store_backend.py listing GET page (the ctt-ingest poll)",
     "executor.block": "runtime/executor.py per-block dispatch (ctx `id`)",
     "executor.batch": "runtime/executor.py block-batch dispatch",
     "executor.stage_read": "runtime/executor.py pipelined read stage",

@@ -1,0 +1,30 @@
+"""ctt-ingest: streaming ingest — segment data while it is being acquired.
+
+Every other pipeline in the repo assumes a *finished* dataset; acquisition
+reality for both workload domains is data landing incrementally (3D EM
+volumes slab-by-slab, detector frame stacks growing mid-run).  This
+package connects a growing source to the fused chain runner:
+
+  * :mod:`.source` — :class:`~.source.GrowingSource`, a watcher over a
+    POSIX directory or object-store prefix that detects newly landed
+    slabs, tolerates torn/partial landings and out-of-order arrival, and
+    emits a monotone ready-frontier;
+  * :mod:`.runner` — :class:`~.runner.IngestRunner`, the incremental
+    driver that feeds each ready slab through an existing fused chain,
+    persisting the carry window via ``publish_once`` after every slab so
+    the stream is resumable (and byte-identical to the batch run), plus
+    :class:`~.runner.IngestTask`, the serve-hosted long-lived job.
+"""
+
+from .source import GrowingSource, publish_manifest, publish_slab
+from .runner import IngestRunner, IngestSuspended, IngestTask, install_suspend_check
+
+__all__ = [
+    "GrowingSource",
+    "IngestRunner",
+    "IngestSuspended",
+    "IngestTask",
+    "install_suspend_check",
+    "publish_manifest",
+    "publish_slab",
+]

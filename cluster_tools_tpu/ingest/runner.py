@@ -1,0 +1,403 @@
+"""Incremental ingest driver: feed ready slabs through a fused chain.
+
+:class:`IngestRunner` is the streaming twin of one ``try_run_chain`` pass
+(``runtime/stream.py``): it plans the SAME chain against the stream's
+*final* geometry (from the manifest), then walks the plan's chunks in
+order, gating each chunk on the :class:`~.source.GrowingSource` frontier —
+a chunk runs only once every voxel/frame it reads (block extent plus the
+chain-max halo along axis 0) has landed.  Because the chunk sequence, the
+serialized compute order and the carry updates are identical to the batch
+pass, the finished ingest run is **byte-identical** to the batch run over
+the finished volume.
+
+Resumability: after every chunk commit the carried merge state
+(``_ChainRunner.export_carry()`` — max-id offsets, face-edge tables — plus
+the ``ops.events._CAP_HINT`` warm-capacity hint for the frame domain) is
+persisted create-only (``publish_once``) as ``ingest.carry.sNNNNNN.json``
+in the control directory, and ``ingest.frontier.json`` is atomically
+replaced with the commit frontier.  A successor process (serve gen+1
+takeover after a SIGKILL, or a drain-suspended job re-claimed later) loads
+the highest readable carry record, restores it, skips the committed
+chunks and continues the stream — still byte-identical, because committed
+chunks' writes are already on the store and the carry replays nothing.
+
+Serve integration: :class:`IngestTask` is the long-lived ``ingest`` job
+the daemon hosts.  The daemon installs a suspend probe
+(:func:`install_suspend_check`) at startup; a drain request surfaces here
+as :class:`IngestSuspended` between slabs, the daemon releases the lease
+(``JobQueue.release``) and a peer picks the stream up where the carry
+says it stopped.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import pickle
+import re
+import time
+import zlib
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..obs import heartbeat as obs_heartbeat
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..ops import events as events_ops
+from ..runtime import stream
+from ..runtime.task import SimpleTask
+from ..utils import store_backend
+from .source import GrowingSource
+
+FRONTIER_NAME = "ingest.frontier.json"
+CARRY_RE = re.compile(r"^ingest\.carry\.s(\d{6})\.json$")
+
+
+def carry_record_name(chunk_index: int) -> str:
+    return f"ingest.carry.s{int(chunk_index):06d}.json"
+
+
+class IngestSuspended(RuntimeError):
+    """Raised between slabs when the host asks the stream to yield (serve
+    drain).  The carry for every committed slab is already persisted, so
+    suspension loses no work — a successor resumes from the last commit."""
+
+
+# The host-installed suspend probe (the serve daemon wires its draining
+# flag here at startup).  Module-level on purpose: the probe must reach an
+# IngestRunner constructed deep inside a task's run_impl.
+_suspend_check: Optional[Callable[[], bool]] = None
+
+
+def install_suspend_check(fn: Optional[Callable[[], bool]]) -> None:
+    global _suspend_check
+    _suspend_check = fn
+
+
+def _suspend_requested() -> bool:
+    return bool(_suspend_check is not None and _suspend_check())
+
+
+# ---------------------------------------------------------------------------
+# carry codec: the carried state is numpy-heavy with tuple dict keys
+# ((block_id, axis) face planes), so the JSON record holds a
+# pickle→zlib→base64 blob.  Output byte-identity never depends on these
+# bytes — the carry is replayed state, not output.
+
+
+def encode_carry(state: Dict[str, Any]) -> Tuple[str, int]:
+    raw = pickle.dumps(state, protocol=4)
+    return base64.b64encode(zlib.compress(raw)).decode("ascii"), len(raw)
+
+
+def decode_carry(blob: str) -> Dict[str, Any]:
+    return pickle.loads(zlib.decompress(base64.b64decode(blob.encode("ascii"))))
+
+
+# ---------------------------------------------------------------------------
+
+
+class IngestRunner:
+    """Drive one fused chain incrementally over a growing source.
+
+    ``chain`` must be fusion-eligible (``plan_chain`` raising
+    ``ChainFallback`` is an error here, not a fallback — there is no
+    task-at-a-time path over half-landed data)."""
+
+    def __init__(
+        self,
+        chain: "stream.FusedChain",
+        source: GrowingSource,
+        poll_s: float = 0.2,
+        timeout_s: float = 600.0,
+    ):
+        self.chain = chain
+        self.source = source
+        self.poll_s = float(poll_s)
+        self.timeout_s = float(timeout_s)
+        self.control_dir = source.control_dir
+        self.backend = source.backend
+        self._resumes = 0
+        self._ingested = 0
+
+    # -- control-dir records -------------------------------------------------
+
+    def _publish_frontier(self, done: int, total: int) -> None:
+        record = {
+            "schema": 1,
+            "slabs_done": int(done),
+            "slabs_total": int(total),
+            "resumes": int(self._resumes),
+            "wall": time.time(),
+        }
+        self.backend.write_json(
+            self.backend.join(self.control_dir, FRONTIER_NAME), record
+        )
+
+    def _persist_carry(self, runner: "stream._ChainRunner",
+                       chunk_index: int, total: int) -> None:
+        blob, nraw = encode_carry(runner.export_carry())
+        record = {
+            "schema": 1,
+            "chain": self.chain.name,
+            "slab": int(chunk_index),
+            "slabs_done": int(chunk_index) + 1,
+            "carry": blob,
+            "carry_bytes": int(nraw),
+            "cap_hint": {
+                str(k): int(v) for k, v in events_ops._CAP_HINT.items()
+            },
+            "wall": time.time(),
+        }
+        payload = json.dumps(record, sort_keys=True).encode("utf-8")
+        published = self.backend.publish_once(
+            self.backend.join(self.control_dir,
+                              carry_record_name(chunk_index)),
+            payload,
+        )
+        if published:
+            obs_metrics.inc("ingest.carry_bytes_persisted", len(payload))
+        # a lost publish race means a concurrent successor committed the
+        # same slab from the same carry — identical record, nothing to do
+
+    def _load_carry(self) -> Optional[Dict[str, Any]]:
+        """Highest readable carry record for this chain, or None.  An
+        unreadable/torn record falls back to the previous one — resuming a
+        few slabs early only re-runs idempotent block writes."""
+        try:
+            names = self.backend.listdir(self.control_dir)
+        except (OSError, ValueError):
+            names = []
+        indexed = sorted(
+            (int(m.group(1)), n)
+            for n in names
+            if (m := CARRY_RE.match(n)) is not None
+        )
+        for _, name in reversed(indexed):
+            try:
+                rec = self.backend.read_json(
+                    self.backend.join(self.control_dir, name)
+                )
+            except (OSError, ValueError):
+                continue
+            if (isinstance(rec, dict) and rec.get("chain") == self.chain.name
+                    and isinstance(rec.get("carry"), str)):
+                return rec
+        return None
+
+    # -- gating --------------------------------------------------------------
+
+    def _check_suspend(self) -> None:
+        if _suspend_requested():
+            raise IngestSuspended(
+                f"ingest of {self.chain.name!r} suspended at slab "
+                f"{self._ingested} (carry persisted; resume re-claims here)"
+            )
+
+    def _wait_manifest(self) -> Dict[str, Any]:
+        deadline = obs_trace.monotonic() + self.timeout_s
+        while True:
+            self._check_suspend()
+            man = self.source.manifest()
+            if man is not None:
+                return man
+            if obs_trace.monotonic() > deadline:
+                raise RuntimeError(
+                    f"ingest: no readable manifest in {self.control_dir} "
+                    f"after {self.timeout_s:.0f}s"
+                )
+            time.sleep(self.poll_s)
+
+    def _wait_ready(self, need_z: int, slab_depth: int, total_z: int) -> None:
+        """Block until the landed frontier covers ``need_z`` voxels/frames
+        along axis 0 (a quiet source parks the stream here; a drain
+        request surfaces between polls)."""
+        need_z = min(int(need_z), int(total_z))
+        deadline = obs_trace.monotonic() + self.timeout_s
+        while True:
+            self._check_suspend()
+            frontier = self.source.poll()
+            obs_metrics.set_gauge(
+                "ingest.slabs_pending",
+                max(self.source.landed() - self._ingested, 0),
+            )
+            if frontier * slab_depth >= need_z:
+                return
+            if obs_trace.monotonic() > deadline:
+                raise RuntimeError(
+                    f"ingest: source quiet — frontier {frontier} "
+                    f"(need z>={need_z}, slab_depth {slab_depth}) after "
+                    f"{self.timeout_s:.0f}s"
+                )
+            time.sleep(self.poll_s)
+
+    # -- main ----------------------------------------------------------------
+
+    def run(self) -> None:
+        man = self._wait_manifest()
+        plan = stream.plan_chain(self.chain)  # ChainFallback = hard error
+        shape = tuple(plan.blocking.shape)
+        if tuple(int(s) for s in man["shape"]) != shape:
+            raise RuntimeError(
+                f"ingest: manifest shape {man['shape']} != chain shape "
+                f"{list(shape)}"
+            )
+        slab_depth = int(man["slab_depth"])
+        chunks = plan.chunks
+        # chain-max read halo along axis 0: a chunk is ready only when the
+        # halo rows of its last block have landed too
+        halo_z = max((h[0] for h in plan.prefetch.values()), default=0)
+
+        obs_metrics.inc("stream.chains")
+        obs_heartbeat.note_task(
+            f"ingest:{self.chain.name}", len(plan.block_ids),
+            grid=plan.blocking.grid_shape,
+        )
+        runner = stream._ChainRunner(plan)
+        runner.prepare()
+
+        # resume: restore the newest committed carry and skip its chunks
+        start = 0
+        prior = self._read_frontier()
+        if prior is not None:
+            self._resumes = int(prior.get("resumes", 0))
+        rec = self._load_carry()
+        if rec is not None:
+            runner.import_carry(decode_carry(rec["carry"]))
+            for k, v in (rec.get("cap_hint") or {}).items():
+                events_ops._CAP_HINT[int(k)] = max(
+                    events_ops._CAP_HINT.get(int(k), 1), int(v)
+                )
+            start = int(rec["slabs_done"])
+            self._resumes += 1
+            self._ingested = start
+            obs_metrics.inc("ingest.resumes")
+            for chunk in chunks[:start]:
+                obs_heartbeat.note_blocks_done(len(chunk))
+
+        t0 = obs_trace.monotonic()
+        with obs_trace.span(
+            "ingest", kind="dispatch", task=f"ingest:{self.chain.name}",
+            chain=self.chain.name, blocks=len(plan.block_ids),
+            resumed=start,
+        ):
+            for ci in range(start, len(chunks)):
+                chunk = chunks[ci]
+                self._check_suspend()
+                need_z = max(
+                    plan.blocking.block(b).end[0] for b in chunk
+                ) + halo_z
+                self._wait_ready(need_z, slab_depth, shape[0])
+                runner.run_chunk(chunk)
+                self._persist_carry(runner, ci, len(chunks))
+                self._ingested = ci + 1
+                obs_metrics.inc("ingest.slabs_ingested")
+                obs_metrics.set_gauge(
+                    "ingest.slabs_pending",
+                    max(self.source.landed() - self._ingested, 0),
+                )
+                self._publish_frontier(ci + 1, len(chunks))
+        runner.finalize(obs_trace.monotonic() - t0)
+        self._publish_frontier(len(chunks), len(chunks))
+
+    def _read_frontier(self) -> Optional[Dict[str, Any]]:
+        try:
+            rec = self.backend.read_json(
+                self.backend.join(self.control_dir, FRONTIER_NAME)
+            )
+        except (OSError, ValueError):
+            return None
+        return rec if isinstance(rec, dict) else None
+
+
+# ---------------------------------------------------------------------------
+# the serve-hosted job
+
+
+class IngestTask(SimpleTask):
+    """Long-lived ``ingest`` job: watch ``control_dir``, stream every slab
+    through the domain's chain, finish the non-fused tail (volume domain:
+    assignments + label write), stamp complete.
+
+    ``domain="volume"`` ingests through the streaming segmentation chain
+    (threshold → CC[→ watershed], offsets/faces covered by the carry);
+    ``domain="frames"`` ingests through a single-member events chain —
+    each landed frame batch folds into the labels volume and ragged event
+    tables exactly as the batch ``EventBuildingTask`` run would."""
+
+    task_name = "ingest"
+
+    def __init__(
+        self,
+        tmp_folder: str,
+        control_dir: str,
+        config_dir: Optional[str] = None,
+        max_jobs: Optional[int] = None,
+        domain: str = "volume",
+        input_path: Optional[str] = None,
+        input_key: Optional[str] = None,
+        output_path: Optional[str] = None,
+        output_key: Optional[str] = None,
+        watershed: bool = False,
+        poll_s: float = 0.2,
+        timeout_s: float = 600.0,
+        dependencies=(),
+    ):
+        super().__init__(tmp_folder, config_dir, max_jobs, dependencies)
+        if domain not in ("volume", "frames"):
+            raise ValueError(f"unknown ingest domain {domain!r}")
+        self.control_dir = control_dir
+        self.domain = domain
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.watershed = bool(watershed)
+        self.poll_s = float(poll_s)
+        self.timeout_s = float(timeout_s)
+
+    def _volume_workflow(self):
+        from ..workflows.streaming import StreamingSegmentationWorkflow
+
+        return StreamingSegmentationWorkflow(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+            input_path=self.input_path,
+            input_key=self.input_key,
+            output_path=self.output_path,
+            output_key=self.output_key,
+            watershed=self.watershed,
+        )
+
+    def _frames_chain(self):
+        from ..tasks.events import EventBuildingTask
+
+        task = EventBuildingTask(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+            input_path=self.input_path,
+            input_key=self.input_key,
+            output_path=self.output_path,
+            output_key=self.output_key,
+        )
+        return stream.FusedChain(name="ingest_events", members=[task])
+
+    def run_impl(self) -> None:
+        source = GrowingSource(self.control_dir)
+        if self.domain == "volume":
+            workflow = self._volume_workflow()
+            chain = list(workflow.fused_chains())[0]
+        else:
+            workflow, chain = None, self._frames_chain()
+        IngestRunner(
+            chain, source, poll_s=self.poll_s, timeout_s=self.timeout_s
+        ).run()
+        if workflow is not None:
+            # the non-fused tail (assignments + final label write): the
+            # chain members and covered tasks are already stamped
+            # complete, so this is exactly the batch run's tail
+            from ..runtime.workflow import build
+
+            if not build([workflow]):
+                raise RuntimeError("ingest: downstream workflow tail failed")

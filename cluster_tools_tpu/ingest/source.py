@@ -1,0 +1,163 @@
+"""Growing-source watcher: the acquisition side of ctt-ingest.
+
+Control-directory protocol (a POSIX directory or an object-store prefix —
+every access goes through ``utils.store_backend.backend_for``, so the
+ctt-cloud listing GET is the poll primitive on remote stores):
+
+  ``ingest.manifest.json``   published exactly once (``publish_once``) by
+      the acquisition writer before the first slab: the final geometry of
+      the stream (``shape``), the landing granularity (``slab_depth``
+      voxels/frames along axis 0) and the derived ``slabs_total``.
+
+  ``slab.NNNNNN.json``       per-slab landing marker, create-only,
+      published AFTER the slab's data is durably written to the input
+      dataset.  The marker — not the data — is the commit point: a torn
+      or in-progress data write is invisible to the watcher because its
+      marker does not exist yet, and a torn *marker* (half-uploaded JSON)
+      is skipped until a later poll sees it whole.
+
+The watcher's contract is a **monotone ready-frontier**: ``poll()`` returns
+the number of leading slabs (0..frontier-1) that have all landed.  Slabs
+arriving out of order park in the seen-set until the gap fills; duplicate
+re-landings are idempotent (create-only markers + set semantics); the
+frontier never regresses by construction — it only advances when the next
+consecutive marker becomes readable.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..obs import metrics as obs_metrics
+from ..utils import store_backend
+
+MANIFEST_NAME = "ingest.manifest.json"
+SLAB_RE = re.compile(r"^slab\.(\d{6})\.json$")
+
+
+def slab_marker_name(slab: int) -> str:
+    return f"slab.{int(slab):06d}.json"
+
+
+# ---------------------------------------------------------------------------
+# writer side: the two artifacts an acquisition process publishes
+
+
+def publish_manifest(
+    control_dir: str,
+    shape: Sequence[int],
+    slab_depth: int,
+    domain: str = "volume",
+) -> bool:
+    """Publish the stream manifest (create-only; False = already there)."""
+    backend = store_backend.backend_for(control_dir)
+    shape = [int(s) for s in shape]
+    slab_depth = int(slab_depth)
+    if slab_depth <= 0:
+        raise ValueError(f"slab_depth must be positive, got {slab_depth}")
+    record = {
+        "schema": 1,
+        "domain": str(domain),
+        "shape": shape,
+        "slab_depth": slab_depth,
+        "slabs_total": -(-shape[0] // slab_depth),
+        "created_wall": time.time(),
+    }
+    backend.makedirs(control_dir)
+    return backend.publish_once(
+        backend.join(control_dir, MANIFEST_NAME),
+        json.dumps(record, sort_keys=True).encode("utf-8"),
+    )
+
+
+def publish_slab(control_dir: str, slab: int) -> bool:
+    """Publish slab ``slab``'s landing marker — call AFTER the slab's data
+    is durably written.  Create-only: a duplicate re-landing returns False
+    and changes nothing the watcher can observe."""
+    backend = store_backend.backend_for(control_dir)
+    record = {"slab": int(slab), "wall": time.time()}
+    return backend.publish_once(
+        backend.join(control_dir, slab_marker_name(slab)),
+        json.dumps(record, sort_keys=True).encode("utf-8"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# watcher side
+
+
+class GrowingSource:
+    """Watch a control directory for landed slabs (see module docstring).
+
+    One ``poll()`` is one listing scan plus one marker read per *newly*
+    listed slab — already-seen markers are never re-read, so steady-state
+    polling of a quiet source costs exactly one listing GET."""
+
+    def __init__(self, control_dir: str):
+        self.control_dir = str(control_dir).rstrip("/")
+        self.backend = store_backend.backend_for(self.control_dir)
+        self._manifest: Optional[Dict[str, Any]] = None
+        self._seen: set = set()
+        self._frontier = 0
+
+    # -- manifest ------------------------------------------------------------
+
+    def manifest(self) -> Optional[Dict[str, Any]]:
+        """The stream manifest, or None while it is absent/torn (the next
+        call retries the read)."""
+        if self._manifest is None:
+            path = self.backend.join(self.control_dir, MANIFEST_NAME)
+            try:
+                rec = self.backend.read_json(path)
+            except (OSError, ValueError):
+                return None
+            if not isinstance(rec, dict) or "slabs_total" not in rec:
+                return None
+            self._manifest = rec
+        return self._manifest
+
+    # -- polling -------------------------------------------------------------
+
+    def poll(self) -> int:
+        """One listing scan; returns the ready frontier — slabs
+        ``0..frontier-1`` have all landed.  Monotone across polls."""
+        obs_metrics.inc("ingest.poll_rounds")
+        try:
+            names: List[str] = self.backend.listdir(self.control_dir)
+        except (OSError, ValueError):
+            names = []
+        for name in names:
+            m = SLAB_RE.match(name)
+            if m is None:
+                continue
+            slab = int(m.group(1))
+            if slab in self._seen:
+                continue
+            try:
+                rec = self.backend.read_json(
+                    self.backend.join(self.control_dir, name)
+                )
+            except (OSError, ValueError):
+                continue  # torn/partial marker: retry on a later poll
+            if not isinstance(rec, dict) or rec.get("slab") != slab:
+                continue
+            self._seen.add(slab)
+        while self._frontier in self._seen:
+            self._frontier += 1
+        return self._frontier
+
+    @property
+    def frontier(self) -> int:
+        return self._frontier
+
+    def landed(self) -> int:
+        """Slabs observed landed so far (including out-of-order ones parked
+        beyond a gap) — the ``ingest.slabs_pending`` gauge rides this."""
+        return len(self._seen)
+
+    def complete(self) -> bool:
+        man = self.manifest()
+        return man is not None and self._frontier >= int(man["slabs_total"])

@@ -661,6 +661,32 @@ def format_watch(snap: Dict[str, Any]) -> str:
              if isinstance(inflight, (int, float)) else None),
         ]
         lines.append("  remote: " + ", ".join(p for p in parts if p))
+    if any(k.startswith("ingest.") for k in counters):
+        # ctt-ingest: streaming-ingest health — the landed-vs-committed
+        # frontier, resumes survived, poll volume, carry bytes persisted,
+        # and the ingest task's ETA (the incremental driver's note_task
+        # row makes the standard rate/ETA machinery apply)
+        gauges = snap.get("gauges", {})
+        ingested = int(counters.get("ingest.slabs_ingested", 0))
+        pending = gauges.get("ingest.slabs_pending")
+        pending = int(pending) if isinstance(pending, (int, float)) else 0
+        eta = next(
+            (row.get("eta_s") for name, row in snap.get("tasks", {}).items()
+             if str(name).startswith("ingest")
+             and row.get("eta_s") is not None),
+            None,
+        )
+        parts = [
+            f"frontier {ingested + pending}",
+            f"ingested {ingested}",
+            f"pending {pending}",
+            f"resumes {int(counters.get('ingest.resumes', 0))}",
+            f"polls {int(counters.get('ingest.poll_rounds', 0))}",
+            "carry "
+            f"{counters.get('ingest.carry_bytes_persisted', 0) / 1e6:.1f} MB",
+            (f"eta {eta:.0f}s" if isinstance(eta, (int, float)) else None),
+        ]
+        lines.append("  ingest: " + ", ".join(p for p in parts if p))
     for w in snap["workers"]:
         if w.get("draining") and not w["exiting"]:
             lines.append(

@@ -141,6 +141,11 @@ COUNTERS = frozenset({
     "serve.jobs_admitted",      # two-phase admissions this daemon won
     "serve.retract_races",      # retractions a peer's limbo reaper beat
     "serve.result_races",       # job results where a gen+1 re-run won
+    # ingest/ — ctt-ingest streaming ingest of a growing source
+    "ingest.slabs_ingested",    # chunks committed through the chain
+    "ingest.resumes",           # streams resumed from a persisted carry
+    "ingest.poll_rounds",       # source listing scans (one per poll)
+    "ingest.carry_bytes_persisted",  # carry-record bytes published
 })
 
 # -- gauges (metrics.set_gauge) ---------------------------------------------
@@ -167,6 +172,9 @@ GAUGES = frozenset({
     # history before the fleet)
     "serve.peers",
     "fleet.queue_depth",
+    # ingest/ — slabs landed (incl. out-of-order parked) but not yet
+    # committed through the chain: the watcher/ingester gap
+    "ingest.slabs_pending",
 })
 
 # dynamic name families: one series per <suffix>, allowed by prefix

@@ -118,7 +118,12 @@ grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
                                 daemon died mid-job; the next daemon on
                                 the same state dir claims gen g+1 — or
                                 immediately, if the owner's fleet beat
-                                (below) already proves it dead.
+                                (below) already proves it dead.  A lease
+                                re-stamped with {"released": true, "wall":
+                                0} is a voluntary give-back (drain suspend
+                                of a long-lived ingest job): it classifies
+                                expired at once, and released generations
+                                do not count against the retry budget.
     jobs/admit.<id>.json        ctt-fleet two-phase admission marker,
                                 exclusive link: {"id", "wall", "daemon"}.
                                 A record published with "admitted": false
@@ -175,6 +180,46 @@ the other cross-process file contracts)::
                                 (k,) float32), ``face_pairs``/
                                 ``face_saddles`` (cross-block table,
                                 GLOBAL ids).
+
+Streaming-ingest control dir (ctt-ingest; a POSIX dir or object-store
+prefix the acquisition writer and the ingest daemon share — the watcher's
+poll primitive is one listing GET over it)::
+
+    ingest.manifest.json        stream geometry, published once
+                                (publish_once) by the writer before the
+                                first slab: {"schema", "domain"
+                                ("volume"/"frames"), "shape" (final),
+                                "slab_depth" (extent along axis 0 per
+                                landing), "slabs_total", "created_wall"}.
+    slab.NNNNNN.json            per-slab landing marker, create-only,
+                                published AFTER the slab's data is
+                                durably written: {"slab", "wall",
+                                optional "digest"}.  The marker is the
+                                commit point; a torn marker is skipped
+                                until a later poll reads it whole, and
+                                the watcher's ready-frontier (count of
+                                consecutive markers from 0) never
+                                regresses.
+    ingest.carry.sNNNNNN.json   carry snapshot after chunk N committed,
+                                create-only (a lost race = a concurrent
+                                successor committed the same slab):
+                                {"schema", "chain", "slab", "slabs_done",
+                                "carry" (pickle+zlib+base64 of the
+                                _ChainRunner carry: max-id offsets,
+                                face-edge tables), "carry_bytes"
+                                (raw pickle size), "cap_hint"
+                                (ops.events._CAP_HINT snapshot — the
+                                frame domain's zero-recompile warmup),
+                                "wall"}.  A resuming process loads the
+                                highest readable record and skips its
+                                chunks; an unreadable record falls back
+                                one slab (idempotent block writes make
+                                the re-run harmless).
+    ingest.frontier.json        advisory commit frontier, atomically
+                                replaced after every slab: {"schema",
+                                "slabs_done", "slabs_total", "resumes",
+                                "wall"}.  Torn reads degrade to the
+                                carry records, which are the truth.
 """
 
 from __future__ import annotations

@@ -483,6 +483,53 @@ class _ChainRunner:
                       f"{traceback.format_exc()}")
         return None  # pragma: no cover - loop always returns or raises
 
+    # -- ctt-ingest seam -----------------------------------------------------
+    #
+    # The incremental driver (ingest/runner.py) runs the SAME pass one
+    # chunk at a time, persisting the carry between chunks: prepare() +
+    # run_chunk()* + finalize() is run() with the pipelining removed —
+    # compute and carry application already happen on the calling thread
+    # in chunk order in both, which is what makes the outputs
+    # byte-identical.
+
+    def prepare(self) -> None:
+        """Output-dataset creation for every non-elided member + carry
+        init — the head of :meth:`run`, factored out for incremental
+        drivers.  Elided members' outputs intentionally never exist."""
+        plan = self.plan
+        for m in self.members:
+            if m.identifier not in self.elide:
+                m.prepare(plan.blocking, plan.mconfs[m.identifier])
+            self.carry[m.identifier] = m.fusion_carry_init(
+                plan.blocking, plan.mconfs[m.identifier]
+            )
+
+    def run_chunk(self, chunk: List[int]) -> None:
+        """One batch, serially (read → compute → carry → write) with the
+        full retry budget — the per-slab step of an incremental pass."""
+        self._attempt(
+            lambda: self._run_batch_synchronous(chunk, True),
+            chunk, "ingest batch",
+        )
+        obs_metrics.inc("stream.slabs")
+        obs_heartbeat.note_blocks_done(len(chunk))
+        obs_heartbeat.note_block_end(chunk[0])
+
+    def export_carry(self) -> Dict[str, Any]:
+        """Picklable snapshot of the carried merge state (per-member carry
+        + peak accounting) — what ctt-ingest persists after each slab
+        commit so a successor process can resume the stream."""
+        return {"carry": dict(self.carry), "carry_peak": int(self.carry_peak)}
+
+    def import_carry(self, state: Dict[str, Any]) -> None:
+        self.carry = dict(state["carry"])
+        self.carry_peak = max(self.carry_peak, int(state.get("carry_peak", 0)))
+
+    def finalize(self, wall: float) -> None:
+        """Member finalizers, carry finalizers and completion stamps —
+        the tail of :meth:`run`, public for incremental drivers."""
+        self._finish(wall)
+
     # -- main loop -----------------------------------------------------------
 
     def run(self) -> None:
@@ -494,15 +541,7 @@ class _ChainRunner:
             f"chain:{chain.name}", len(plan.block_ids),
             grid=plan.blocking.grid_shape,
         )
-
-        # prepare (output dataset creation) for every non-elided member;
-        # elided members' outputs intentionally never exist
-        for m in members:
-            if m.identifier not in self.elide:
-                m.prepare(plan.blocking, plan.mconfs[m.identifier])
-            self.carry[m.identifier] = m.fusion_carry_init(
-                plan.blocking, plan.mconfs[m.identifier]
-            )
+        self.prepare()
 
         t_wall0 = obs_trace.monotonic()
         reads: deque = deque()   # (chunk, Future[payloads])

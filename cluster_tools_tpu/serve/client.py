@@ -193,6 +193,52 @@ class ServeClient:
         out = self._request("POST", "/api/v1/jobs", payload)
         return out["job_id"]
 
+    def ingest(
+        self,
+        control_dir: str,
+        input_path: str,
+        input_key: str,
+        output_path: str,
+        output_key: str,
+        tmp_folder: str,
+        config_dir: str,
+        domain: str = "volume",
+        watershed: Optional[bool] = None,
+        poll_s: Optional[float] = None,
+        timeout_s: Optional[float] = None,
+        configs: Optional[Dict[str, dict]] = None,
+        tenant: str = "default",
+        priority: int = 0,
+    ) -> str:
+        """ctt-ingest front-end: submit one long-lived ``ingest`` job that
+        watches ``control_dir`` for slab markers and segments the volume
+        (or builds frame events, ``domain="frames"``) while it is still
+        being acquired; returns the job id.  The job is drain-safe — a
+        draining daemon releases it between slabs and a successor resumes
+        from the persisted carry, byte-identical to the batch run."""
+        payload = {
+            "type": "ingest",
+            "control_dir": control_dir,
+            "domain": domain,
+            "input_path": input_path,
+            "input_key": input_key,
+            "output_path": output_path,
+            "output_key": output_key,
+            "tmp_folder": tmp_folder,
+            "config_dir": config_dir,
+            "configs": configs or {},
+            "tenant": tenant,
+            "priority": priority,
+        }
+        if watershed is not None:
+            payload["watershed"] = bool(watershed)
+        if poll_s is not None:
+            payload["poll_s"] = float(poll_s)
+        if timeout_s is not None:
+            payload["timeout_s"] = float(timeout_s)
+        out = self._request("POST", "/api/v1/jobs", payload)
+        return out["job_id"]
+
     def status(self, job_id: str) -> Dict[str, Any]:
         return self._request("GET", f"/api/v1/jobs/{job_id}")
 

@@ -447,11 +447,11 @@ class JobQueue:
             }
 
     def _lease_payload(self, job_id: str, gen: int,
-                       claim_wall: float) -> bytes:
+                       claim_wall: float, released: bool = False) -> bytes:
         # the daemon id rides the very first (claim-time) stamp, not just
         # renewals: a daemon SIGKILLed inside the claim-to-first-renewal
         # window still leaves a lease peers can fast-path expire
-        return json.dumps({
+        payload = {
             "job": job_id,
             "gen": gen,
             "owner_pid": os.getpid(),
@@ -459,7 +459,27 @@ class JobQueue:
             "claim_wall": claim_wall,
             "wall": time.time(),
             "mono": obs_trace.monotonic(),
-        }).encode()
+        }
+        if released:
+            # voluntary give-back: wall=0 ages the lease past every
+            # staleness and backoff window, so it classifies "expired"
+            # the moment any daemon looks at it
+            payload.update({"released": True, "wall": 0.0, "mono": 0.0})
+        return json.dumps(payload).encode()
+
+    def _released_gens(self, jid: str, gens: int) -> int:
+        """Generations of ``jid`` that ended in a voluntary release
+        rather than a death.  A released lease is a clean hand-back (a
+        drain-suspended ingest stream, not a crash), so it does not
+        count against the poison-job retry budget."""
+        released = 0
+        for g in range(gens):
+            lease = self._read_json(
+                os.path.join(self.dir, f"lease.{jid}.g{g}.json")
+            )
+            if lease is not None and lease.get("released"):
+                released += 1
+        return released
 
     def _quarantine(self, jid: str, gens: int, rec: dict) -> None:
         """Park a job that exhausted its retry budget: first-writer-wins
@@ -497,8 +517,9 @@ class JobQueue:
         """Lease the highest-priority claimable job: unleased first; a
         job whose lease went stale — or whose owner's fleet heartbeat
         proves it dead (the fast path) — requeues at gen+1.  A job whose
-        next generation would be ``max_job_gens`` is quarantined instead
-        of claimed; daemons never crash on a poison job, the job parks."""
+        *burned* generations (claims that died, not voluntary releases)
+        would reach ``max_job_gens`` is quarantined instead of claimed;
+        daemons never crash on a poison job, the job parks."""
         jobs, admits, leases, results = self._scan()
         now = time.time()
         candidates = []  # (record, next_gen, fleet_reclaim)
@@ -527,7 +548,9 @@ class JobQueue:
         )
         for rec, gen, reclaim in candidates:
             jid = rec["id"]
-            if self.max_job_gens > 0 and gen >= self.max_job_gens:
+            if (self.max_job_gens > 0
+                    and gen - self._released_gens(jid, gen)
+                    >= self.max_job_gens):
                 self._quarantine(jid, gen, rec)
                 continue
             claim_wall = time.time()
@@ -550,6 +573,21 @@ class JobQueue:
         atomic_write_bytes(
             claim.lease_path,
             self._lease_payload(claim.job_id, claim.gen, claim.claim_wall),
+        )
+
+    def release(self, claim: JobClaim) -> None:
+        """Voluntarily hand a claimed job back (drain suspend of a
+        long-lived ingest stream).  The lease is re-stamped with
+        ``released: true`` and ``wall: 0`` — it classifies "expired"
+        immediately, skipping both the staleness window and the requeue
+        backoff, so any peer (or this daemon, post-drain) claims gen+1
+        at once and resumes from the persisted carry.  Released
+        generations are excluded from the quarantine budget."""
+        atomic_write_bytes(
+            claim.lease_path,
+            self._lease_payload(
+                claim.job_id, claim.gen, claim.claim_wall, released=True
+            ),
         )
 
     def complete(self, claim: JobClaim, result: Dict[str, Any]) -> bool:

@@ -64,6 +64,27 @@ Normalizes over ``cluster_tools_tpu.tasks.events:EventBuildingTask``;
 ``job_signature`` for this type is frame-count- and block-shape-blind
 (the kernel pow2-pads both), so every batch after the first is warm.
 
+ctt-ingest sugar — the ``ingest`` job type, the streaming-acquisition
+wire shape.  One submission = one long-lived stream: the daemon watches
+``control_dir`` (manifest + slab markers; see ``obs/trace.py``) and
+feeds every landed slab through the domain's fused chain, persisting the
+carry per slab so a drain suspend or daemon death resumes mid-stream::
+
+    {
+      "type":        "ingest",
+      "control_dir": ...,                         # POSIX dir or object-store
+                                                  # prefix being acquired into
+      "domain":      "volume" | "frames",
+      "input_path":  ..., "input_key": ...,       # the growing dataset
+      "output_path": ..., "output_key": ...,
+      "watershed":   false,                       # optional (volume domain)
+      "poll_s":      0.2, "timeout_s": 600,       # optional watcher knobs
+      "tmp_folder":  ..., "config_dir": ...,
+      "configs":     {...}, "tenant": ..., "priority": ...
+    }
+
+Normalizes over ``cluster_tools_tpu.ingest.runner:IngestTask``.
+
 Every request except the bare ``/healthz`` liveness probe must carry the
 daemon's auth token (``X-CTT-Serve-Token: <token>`` or ``Authorization:
 Bearer <token>``), published only through the mode-0600 ``serve.json``
@@ -98,13 +119,16 @@ SCHEMA_VERSION = 1
 
 JOB_STATES = ("queued", "running", "done", "failed")
 
-JOB_TYPES = ("workflow", "resegment", "event_batch")
+JOB_TYPES = ("workflow", "resegment", "event_batch", "ingest")
 
 # the task class a ``resegment`` submission resolves to (ctt-hier)
 RESEGMENT_TASK = "cluster_tools_tpu.tasks.hier:ResegmentTask"
 
 # the task class an ``event_batch`` submission resolves to (ctt-events)
 EVENTS_TASK = "cluster_tools_tpu.tasks.events:EventBuildingTask"
+
+# the task class an ``ingest`` submission resolves to (ctt-ingest)
+INGEST_TASK = "cluster_tools_tpu.ingest.runner:IngestTask"
 
 
 class ProtocolError(ValueError):
@@ -207,6 +231,59 @@ def _normalize_event_batch(payload: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _normalize_ingest(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Rewrite an ``ingest`` submission (ctt-ingest — a long-lived job
+    that watches a growing source and streams every landed slab through
+    the domain's fused chain) into the plain workflow shape over
+    :data:`INGEST_TASK`.  ``control_dir`` is the watcher's poll target (a
+    POSIX dir or object-store prefix holding the manifest + slab
+    markers); ``domain`` picks the chain ("volume": streaming
+    segmentation, "frames": event building)."""
+    for field in ("control_dir", "input_path", "input_key", "output_path",
+                  "output_key", "tmp_folder", "config_dir"):
+        if not isinstance(payload.get(field), str) or not payload[field]:
+            raise ProtocolError(
+                f"ingest submission requires '{field}' (string)"
+            )
+    domain = payload.get("domain", "volume")
+    if domain not in ("volume", "frames"):
+        raise ProtocolError(
+            f"ingest 'domain' must be 'volume' or 'frames', got {domain!r}"
+        )
+    configs = payload.get("configs") or {}
+    if not isinstance(configs, dict):
+        raise ProtocolError("'configs' must map config names to objects")
+    kwargs: Dict[str, Any] = {
+        "tmp_folder": payload["tmp_folder"],
+        "config_dir": payload["config_dir"],
+        "control_dir": payload["control_dir"],
+        "domain": domain,
+        "input_path": payload["input_path"],
+        "input_key": payload["input_key"],
+        "output_path": payload["output_path"],
+        "output_key": payload["output_key"],
+    }
+    if "watershed" in payload:
+        kwargs["watershed"] = bool(payload["watershed"])
+    for field in ("poll_s", "timeout_s"):
+        if field in payload:
+            value = payload[field]
+            if (not isinstance(value, (int, float))
+                    or isinstance(value, bool) or value <= 0):
+                raise ProtocolError(
+                    f"ingest '{field}' must be a positive number"
+                )
+            kwargs[field] = float(value)
+    return {
+        "type": "ingest",
+        "workflow": INGEST_TASK,
+        "kwargs": kwargs,
+        "configs": dict(configs),
+        "tenant": payload.get("tenant", "default"),
+        "priority": payload.get("priority", 0),
+    }
+
+
 def validate_submission(payload: Any) -> Dict[str, Any]:
     """Normalize + validate one submission JSON into a job record.  Loud:
     a malformed submission is a client bug, not a degraded default."""
@@ -221,6 +298,8 @@ def validate_submission(payload: Any) -> Dict[str, Any]:
         payload = _normalize_resegment(payload)
     elif job_type == "event_batch":
         payload = _normalize_event_batch(payload)
+    elif job_type == "ingest":
+        payload = _normalize_ingest(payload)
     workflow = payload.get("workflow")
     if not isinstance(workflow, str) or not workflow.strip():
         raise ProtocolError("'workflow' must be a non-empty string")
@@ -321,6 +400,20 @@ def job_signature(record: Dict[str, Any]) -> Tuple:
         if isinstance(ev_conf, dict):
             connectivity = int(ev_conf.get("connectivity", 2))
         return (record["workflow"], "event_batch", connectivity)
+    if record.get("type") == "ingest":
+        # ctt-ingest: the chain's compiled programs key on the domain
+        # (which chain runs) and block geometry; a takeover/resume of the
+        # same stream — or a second stream at the same geometry — is warm
+        kwargs = record.get("kwargs", {})
+        domain = kwargs.get("domain", "volume") if isinstance(
+            kwargs, dict) else "volume"
+        block_shape = None
+        gconf = record.get("configs", {}).get("global")
+        if isinstance(gconf, dict):
+            bs = gconf.get("block_shape")
+            if isinstance(bs, (list, tuple)):
+                block_shape = tuple(int(b) for b in bs)
+        return (record["workflow"], "ingest", domain, block_shape)
     block_shape = None
     gconf = record.get("configs", {}).get("global")
     if isinstance(gconf, dict):

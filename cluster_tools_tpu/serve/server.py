@@ -54,6 +54,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
+from ..ingest.runner import IngestSuspended, install_suspend_check
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
@@ -171,6 +172,11 @@ class ServeDaemon:
         self._httpd = _Server((host, port), _Handler)
         self._httpd.ctt_daemon = daemon
         self.port = self._httpd.server_address[1]
+        # ctt-ingest: a drain must also reach a long-lived ingest stream
+        # parked deep inside an executing job — the probe surfaces the
+        # draining flag between slabs as IngestSuspended, and _run_job
+        # releases the lease instead of publishing a result
+        install_suspend_check(lambda: self.draining)
         # first fleet beat BEFORE any executor thread exists: a lease
         # stamped with this daemon's id can then never be orphaned in a
         # no-beat blind window — SIGKILL at any later instant leaves a
@@ -348,7 +354,7 @@ class ServeDaemon:
         warm = sig in self._warm_signatures
         before = obs_metrics.snapshot()["counters"]
         t0 = obs_trace.monotonic()
-        ok, error = True, None
+        ok, error, suspended = True, None, False
         try:
             try:
                 with obs_trace.span(
@@ -358,6 +364,11 @@ class ServeDaemon:
                     task = self._instantiate(rec)
                     if not build([task], context=self.context):
                         ok, error = False, "build returned failure"
+            except IngestSuspended:
+                # drain reached a long-lived ingest stream between slabs;
+                # not a failure — the carry is persisted, the job goes
+                # back to the queue for a successor
+                suspended = True
             except Exception:
                 ok, error = False, traceback.format_exc()
         finally:
@@ -366,6 +377,15 @@ class ServeDaemon:
             # file forever) per executed job
             stop.set()
             renewer.join(timeout=5.0)
+        if suspended:
+            # release AFTER the renewer is down (a late renew would
+            # overwrite the released stamp): the lease classifies expired
+            # at once, no result is published, and the next claimer —
+            # this daemon post-drain or a peer — resumes from the carry
+            # at gen+1 without burning the retry budget
+            self.jobs.release(claim)
+            obs_metrics.flush()
+            return
         seconds = obs_trace.monotonic() - t0
         after = obs_metrics.snapshot()["counters"]
 

@@ -39,6 +39,13 @@ class EventBuildingTask(VolumeTask):
     task_name = "events"
     output_dtype = "uint32"
 
+    # ctt-stream/ctt-ingest: frames are independent (no cross-block state,
+    # no halo), so the task is fusable as-is — the fusion contract
+    # defaults (no carry, compute_batch doubling as fused compute) are
+    # exact.  ctt-ingest wraps it in a single-member chain to fold frame
+    # batches into event tables as they land.
+    fusable = True
+
     @classmethod
     def default_task_config(cls) -> Dict[str, Any]:
         conf = super().default_task_config()

@@ -371,6 +371,8 @@ class HttpBackend(StoreBackend):
         self, method: str, path: str,
         body: Optional[bytes] = None,
         headers: Optional[Dict[str, str]] = None,
+        query: Optional[str] = None,
+        site: Optional[str] = None,
     ) -> Tuple[int, Any, bytes, bool]:
         """One HTTP round trip: ``(status, headers, body, truncated)``.
 
@@ -379,11 +381,17 @@ class HttpBackend(StoreBackend):
         promised ``Content-Length`` (server hiccup mid-stream) comes back
         with ``truncated=True`` and the partial bytes so callers can
         classify it (chunk decode → ``CorruptChunk``) instead of hiding
-        it behind a generic error."""
-        site = (
-            "store.remote_write" if method in ("PUT", "DELETE")
-            else "store.remote_read"
-        )
+        it behind a generic error.
+
+        ``query`` is a pre-encoded query string appended AFTER key
+        quoting (``_key`` percent-escapes ``?``/``=``, so it cannot ride
+        in ``path``); ``site`` overrides the fault-injection site for
+        request kinds with their own chaos semantics (listing GETs)."""
+        if site is None:
+            site = (
+                "store.remote_write" if method in ("PUT", "DELETE")
+                else "store.remote_read"
+            )
         faults.check(site, path=path)
         obs_metrics.inc(
             "store.remote_writes" if site == "store.remote_write"
@@ -393,8 +401,9 @@ class HttpBackend(StoreBackend):
         try:
             conn = self._connection()
             try:
+                target = self._key(path) + (f"?{query}" if query else "")
                 conn.request(
-                    method, self._key(path), body=body,
+                    method, target, body=body,
                     headers=dict(headers or {}),
                 )
                 resp = conn.getresponse()
@@ -644,24 +653,47 @@ class HttpBackend(StoreBackend):
         status, hdrs = self._head(path)
         return status == 200 and hdrs.get("X-CTT-Dir") == "1"
 
+    # listing page size (``?limit=&marker=`` continuation; tests shrink it
+    # to exercise multi-page listings against the stub store).  A server
+    # that ignores the parameters returns everything in one page and the
+    # loop still terminates — pagination is an upper bound, not a contract.
+    list_page = 1000
+
     def listdir(self, path: str) -> List[str]:
         from .retry import io_retry
 
-        def _list() -> List[str]:
-            status, hdrs, data, truncated = self._request("GET", path)
+        def _page(marker: Optional[str]):
+            query = f"limit={int(self.list_page)}"
+            if marker is not None:
+                query += "&marker=" + urllib.parse.quote(marker, safe="")
+            status, hdrs, data, truncated = self._request(
+                "GET", path, query=query, site="store.remote_list"
+            )
             if status == 404:
-                return []
+                return [], None
             if status != 200 or truncated:
                 self._raise_for(status if status != 200 else 500,
                                 "GET", path)
             if hdrs.get("X-CTT-Dir") != "1":
-                return []
-            names = json.loads(data.decode())
-            return sorted(str(n) for n in names)
+                return [], None
+            names = [str(n) for n in json.loads(data.decode())]
+            return names, hdrs.get("X-CTT-List-Next")
 
-        return io_retry(
-            _list, what=f"list {path}", counter=self.retry_counter
-        )
+        # each page retries independently against the same marker (listing
+        # pages are idempotent) — an injected/transient listing failure
+        # mid-continuation never restarts the whole scan
+        names: List[str] = []
+        marker: Optional[str] = None
+        while True:
+            page, nxt = io_retry(
+                lambda m=marker: _page(m),
+                what=f"list {path}", counter=self.retry_counter,
+            )
+            names.extend(page)
+            if nxt is None or not page:
+                break
+            marker = nxt
+        return sorted(names)
 
     def makedirs(self, path: str) -> None:
         return None  # object namespaces have no directories to create

@@ -8,7 +8,10 @@ Serves a directory tree over the small object-store HTTP subset the
                      ``Content-Range``; ``If-None-Match`` matching the
                      current ETag → 304 with no body (the warm-hit
                      revalidation); a directory returns a JSON array
-                     of child names with ``X-CTT-Dir: 1``; 404 if absent.
+                     of child names with ``X-CTT-Dir: 1`` — paginated
+                     with ``?limit=&marker=`` (names strictly after
+                     ``marker``, ``X-CTT-List-Next`` on a clipped page);
+                     404 if absent.
   ``HEAD /key``    → headers only: ``ETag`` (mtime_ns-size, changes on
                      every atomic replace), ``Last-Modified``,
                      ``Content-Length``, ``X-CTT-Dir`` for directories.
@@ -53,6 +56,7 @@ import shutil
 import signal
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 _RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)$")
@@ -169,10 +173,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, b"not found")
             return
         if os.path.isdir(p):
-            body = json.dumps(sorted(os.listdir(p))).encode()
-            self._send(200, body, headers=[
+            # listing with ?limit=&marker= continuation: names strictly
+            # after ``marker``, at most ``limit`` per page, the last name
+            # of a clipped page echoed back as X-CTT-List-Next
+            params = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query
+            )
+            names = sorted(os.listdir(p))
+            marker = params.get("marker", [None])[0]
+            if marker is not None:
+                names = [n for n in names if n > marker]
+            headers = [
                 ("Content-Type", "application/json"), ("X-CTT-Dir", "1"),
-            ])
+            ]
+            try:
+                limit = int(params.get("limit", [0])[0])
+            except ValueError:
+                limit = 0
+            if limit > 0 and len(names) > limit:
+                names = names[:limit]
+                headers.append(("X-CTT-List-Next", names[-1]))
+            self._send(200, json.dumps(names).encode(), headers=headers)
             return
         headers = self._object_headers(p)
         # conditional GET: a matching If-None-Match answers 304 with no

@@ -1,0 +1,585 @@
+"""ctt-ingest: streaming ingest of a growing source.
+
+Covers the PR acceptance contract:
+
+  * watcher edge cases over the control-dir protocol: torn (half-written)
+    markers are invisible until whole, out-of-order landings park until
+    the gap fills, duplicate re-landings are idempotent, a quiet source
+    holds the frontier — which never regresses;
+  * live-volume byte identity: an ingest run that consumes slabs WHILE a
+    background writer lands them finishes byte-identical (array equality
+    AND chunk-file digests) to the batch fused run over the finished
+    volume;
+  * suspend/resume: a drain-style suspension between slabs loses no work —
+    a fresh runner restores the persisted carry, skips committed chunks,
+    and the finished stream is still byte-identical (``ingest.resumes``
+    counts the takeover);
+  * frame-domain ingest: event building over a growing frame stack at
+    exact batch/oracle parity with ZERO kernel recompiles after the batch
+    warmup (the ``_CAP_HINT`` snapshot in the carry record);
+  * ctt-cloud listing pagination: ``HttpBackend.listdir`` walks
+    ``limit=``/``marker=`` continuation pages against the stub object
+    server, and a seeded ``store.remote_list`` fault heals inside the
+    per-page retry;
+  * serve integration: a released lease (voluntary give-back) is
+    reclaimable immediately and does not burn the poison-job budget, and
+    a draining daemon releases a live ingest job mid-stream for a
+    successor daemon to finish — byte-identical, resumes counted.
+"""
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+from objstub import StubObjectStore
+from scipy import ndimage
+
+from cluster_tools_tpu import faults
+from cluster_tools_tpu.ingest import (
+    GrowingSource,
+    IngestRunner,
+    IngestSuspended,
+    IngestTask,
+    install_suspend_check,
+    publish_manifest,
+    publish_slab,
+)
+from cluster_tools_tpu.ingest.runner import FRONTIER_NAME, carry_record_name
+from cluster_tools_tpu.ingest.source import slab_marker_name
+from cluster_tools_tpu.obs import metrics as obs_metrics
+from cluster_tools_tpu.obs import trace as obs_trace
+from cluster_tools_tpu.ops import events as events_ops
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.serve import ServeClient, ServeDaemon
+from cluster_tools_tpu.serve.jobs import JobQueue
+from cluster_tools_tpu.tasks.events import read_event_tables
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import (
+    EventBuildingWorkflow,
+    StreamingSegmentationWorkflow,
+)
+
+THRESHOLD = 0.55
+WS_CONF = {
+    "threshold": 0.5, "sigma_seeds": 1.6, "size_filter": 10,
+    "halo": [2, 4, 4],
+}
+SHAPE = (24, 32, 32)
+SLAB_DEPTH = 8  # one z block-slice per slab
+GCONF_VOL = {
+    "block_shape": [8, 16, 16], "target": "tpu",
+    "device_batch_size": 4, "devices": [0], "max_num_retries": 0,
+}
+
+
+@pytest.fixture(autouse=True)
+def _traced(tmp_path):
+    """Counters drive most assertions; tracing scoped per test."""
+    obs_metrics.reset()
+    was_on = obs_trace.enabled()
+    if not was_on:
+        obs_trace.enable(str(tmp_path / "trace"), "ingest_test",
+                         export_env=False)
+    yield
+    install_suspend_check(None)
+    if not was_on:
+        obs_trace.disable()
+    obs_metrics.reset()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _counters():
+    return dict(obs_metrics.snapshot()["counters"])
+
+
+def _delta(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(after) | set(before)}
+
+
+def _volume(shape=SHAPE):
+    rng = np.random.default_rng(7)
+    raw = ndimage.gaussian_filter(rng.random(shape), 1.0)
+    return ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+
+
+def _digest_key(path, key):
+    """Chunk-file digest of one dataset (directory tree under the key):
+    the byte-identity gate compares stored bytes, not decoded arrays."""
+    root = os.path.join(path, key)
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def _vol_config_dir(tmp_path, tag, watershed=True):
+    config_dir = str(tmp_path / f"configs_{tag}")
+    cfg.write_global_config(config_dir, dict(GCONF_VOL))
+    cfg.write_config(config_dir, "threshold", {"threshold": THRESHOLD})
+    if watershed:
+        cfg.write_config(config_dir, "watershed", dict(WS_CONF))
+    return config_dir
+
+
+def _batch_reference(tmp_path, path, tag="batch", watershed=True):
+    wf = StreamingSegmentationWorkflow(
+        str(tmp_path / f"tmp_{tag}"), _vol_config_dir(tmp_path, tag,
+                                                      watershed),
+        input_path=path, input_key="raw",
+        output_path=path, output_key=f"cc_{tag}",
+        watershed=watershed,
+    )
+    assert build([wf]), f"batch reference failed ({tag})"
+    return wf
+
+
+def _stage_growing(tmp_path, vol, key="raw_live"):
+    """The acquisition side: full-geometry dataset shell + control dir,
+    no data landed yet."""
+    path = str(tmp_path / "data.n5")
+    f = file_reader(path)
+    if "raw" not in f:
+        f.create_dataset("raw", data=vol, chunks=(8, 16, 16))
+    f.create_dataset(key, shape=vol.shape, dtype=vol.dtype,
+                     chunks=(8, 16, 16))
+    control = str(tmp_path / "control")
+    assert publish_manifest(control, vol.shape, SLAB_DEPTH)
+    return path, control
+
+
+def _land(path, key, control, vol, slabs, slab_depth=SLAB_DEPTH):
+    """Write each slab's data, THEN its marker (the protocol's commit
+    order)."""
+    ds = file_reader(path)[key]
+    for s in slabs:
+        z0, z1 = s * slab_depth, (s + 1) * slab_depth
+        ds[z0:z1, :, :] = vol[z0:z1]
+        publish_slab(control, s)
+
+
+# ---------------------------------------------------------------------------
+# watcher edge cases
+
+
+class TestGrowingSource:
+    def test_out_of_order_and_duplicate_landings(self, tmp_path):
+        control = str(tmp_path / "ctl")
+        assert publish_manifest(control, (12, 4, 4), 2)
+        assert not publish_manifest(control, (12, 4, 4), 2)  # create-only
+        src = GrowingSource(control)
+        assert src.manifest()["slabs_total"] == 6
+        assert src.poll() == 0
+
+        publish_slab(control, 2)
+        publish_slab(control, 0)
+        assert src.poll() == 1          # slab 1 missing: 2 parks
+        assert src.landed() == 2
+        publish_slab(control, 1)
+        assert src.poll() == 3          # the gap filled, both advance
+        assert not publish_slab(control, 0)  # duplicate re-landing
+        assert src.poll() == 3 and src.landed() == 3
+        assert not src.complete()
+
+    def test_torn_marker_invisible_until_whole(self, tmp_path):
+        control = str(tmp_path / "ctl")
+        os.makedirs(control)
+        assert publish_manifest(control, (4, 4, 4), 2)
+        src = GrowingSource(control)
+        marker = os.path.join(control, slab_marker_name(0))
+        with open(marker, "w") as f:
+            f.write('{"slab": 0, "wa')  # half-uploaded JSON
+        assert src.poll() == 0
+        with open(marker, "w") as f:
+            json.dump({"slab": 0, "wall": 1.0}, f)
+        assert src.poll() == 1
+
+    def test_quiet_source_holds_frontier_then_resumes(self, tmp_path):
+        control = str(tmp_path / "ctl")
+        assert publish_manifest(control, (8, 4, 4), 2)
+        src = GrowingSource(control)
+        publish_slab(control, 0)
+        before = _counters()
+        frontiers = [src.poll() for _ in range(4)]
+        assert frontiers == [1, 1, 1, 1]  # quiet: held, never regressed
+        assert _delta(before, _counters()).get("ingest.poll_rounds") == 4
+        publish_slab(control, 1)
+        assert src.poll() == 2
+
+    def test_torn_manifest_retries(self, tmp_path):
+        control = str(tmp_path / "ctl")
+        os.makedirs(control)
+        src = GrowingSource(control)
+        assert src.manifest() is None
+        with open(os.path.join(control, "ingest.manifest.json"), "w") as f:
+            f.write('{"schema": 1, "sl')
+        assert src.manifest() is None
+        os.remove(os.path.join(control, "ingest.manifest.json"))
+        assert publish_manifest(control, (4, 4, 4), 4)
+        assert src.manifest() is not None
+
+
+# ---------------------------------------------------------------------------
+# volume-domain ingest: live writer, byte identity, suspend/resume
+
+
+class TestVolumeIngest:
+    def test_live_ingest_byte_identical_to_batch(self, tmp_path):
+        vol = _volume()
+        path, control = _stage_growing(tmp_path, vol)
+        _batch_reference(tmp_path, path, "batch")
+
+        writer = threading.Thread(
+            target=_land, args=(path, "raw_live", control, vol, range(3)),
+            kwargs={}, daemon=True,
+        )
+        task = IngestTask(
+            str(tmp_path / "tmp_live"),
+            control_dir=control,
+            config_dir=_vol_config_dir(tmp_path, "live"),
+            input_path=path, input_key="raw_live",
+            output_path=path, output_key="cc_live",
+            watershed=True, poll_s=0.02, timeout_s=120.0,
+        )
+        before = _counters()
+        writer.start()
+        try:
+            assert build([task])
+        finally:
+            writer.join(timeout=30)
+        d = _delta(before, _counters())
+
+        f = file_reader(path, "r")
+        np.testing.assert_array_equal(f["cc_live"][:], f["cc_batch"][:])
+        np.testing.assert_array_equal(
+            f["cc_live_ws"][:], f["cc_batch_ws"][:]
+        )
+        assert _digest_key(path, "cc_live") == _digest_key(path, "cc_batch")
+        assert _digest_key(path, "cc_live_ws") == _digest_key(
+            path, "cc_batch_ws"
+        )
+        assert d.get("ingest.slabs_ingested") == 3
+        assert d.get("ingest.poll_rounds", 0) >= 1
+        assert d.get("ingest.resumes", 0) == 0
+        assert d.get("stream.chains") == 1
+        frontier = json.load(open(os.path.join(control, FRONTIER_NAME)))
+        assert frontier["slabs_done"] == frontier["slabs_total"] == 3
+
+    def test_suspend_resume_mid_stream_byte_identical(self, tmp_path):
+        vol = _volume()
+        path, control = _stage_growing(tmp_path, vol)
+        _batch_reference(tmp_path, path, "batch")
+        _land(path, "raw_live", control, vol, range(3))  # fully landed
+
+        config_dir = _vol_config_dir(tmp_path, "sus")
+        wf = StreamingSegmentationWorkflow(
+            str(tmp_path / "tmp_sus"), config_dir,
+            input_path=path, input_key="raw_live",
+            output_path=path, output_key="cc_sus",
+            watershed=True,
+        )
+        chain = list(wf.fused_chains())[0]
+        # suspend as soon as the first chunk's carry is committed — the
+        # deterministic stand-in for a drain request landing mid-stream
+        first_carry = os.path.join(control, carry_record_name(0))
+        install_suspend_check(lambda: os.path.exists(first_carry))
+        with pytest.raises(IngestSuspended):
+            IngestRunner(chain, GrowingSource(control),
+                         poll_s=0.01, timeout_s=60.0).run()
+        install_suspend_check(None)
+        assert os.path.exists(first_carry)
+
+        before = _counters()
+        IngestRunner(chain, GrowingSource(control),
+                     poll_s=0.01, timeout_s=60.0).run()
+        d = _delta(before, _counters())
+        assert d.get("ingest.resumes") == 1
+        assert d.get("ingest.slabs_ingested") == 2  # chunk 0 never re-ran
+        assert build([wf])  # the non-fused tail (assignments + write)
+
+        f = file_reader(path, "r")
+        np.testing.assert_array_equal(f["cc_sus"][:], f["cc_batch"][:])
+        np.testing.assert_array_equal(
+            f["cc_sus_ws"][:], f["cc_batch_ws"][:]
+        )
+        assert _digest_key(path, "cc_sus") == _digest_key(path, "cc_batch")
+        frontier = json.load(open(os.path.join(control, FRONTIER_NAME)))
+        assert frontier["slabs_done"] == 3 and frontier["resumes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# frame-domain ingest
+
+
+GCONF_EV = {
+    "block_shape": [2, 16, 16], "target": "tpu",
+    "device_batch_size": 2, "devices": [0], "pipeline_depth": 2,
+}
+
+
+def _frame_stack(rng, n=10, h=16, w=16, density=0.9):
+    """Detector-like frames: smooth blobs + isolated hot pixels (the
+    tests/test_events.py generator, at the ingest block geometry)."""
+    raw = ndimage.gaussian_filter(
+        rng.random((n, h, w)), (0.0, 1.0, 1.0)
+    ).astype("float32")
+    frames = np.where(
+        raw > np.quantile(raw, density), raw, 0.0
+    ).astype("float32")
+    hits = rng.random((n, h, w)) > 0.99
+    frames[hits] = (rng.random(int(hits.sum())) + 1.0).astype("float32")
+    return frames
+
+
+class TestFramesIngest:
+    def test_frames_parity_and_zero_recompiles(self, tmp_path, rng):
+        frames = _frame_stack(rng)
+        t = float(np.quantile(frames[frames > 0], 0.2)) if (
+            frames > 0).any() else 0.0
+        path = str(tmp_path / "frames.n5")
+        f = file_reader(path)
+        f.create_dataset("frames", data=frames, chunks=(2, 16, 16))
+        f.create_dataset("frames_live", shape=frames.shape,
+                         dtype=frames.dtype, chunks=(2, 16, 16))
+
+        ref_cfg = str(tmp_path / "configs_ev_ref")
+        cfg.write_global_config(ref_cfg, dict(GCONF_EV))
+        cfg.write_config(ref_cfg, "events", {"threshold": t})
+        wf = EventBuildingWorkflow(
+            str(tmp_path / "tmp_ev_ref"), ref_cfg,
+            input_path=path, input_key="frames",
+            output_path=path, output_key="ev_ref",
+        )
+        assert build([wf])  # the warmup: compiles every frame bucket
+
+        control = str(tmp_path / "ctl_frames")
+        assert publish_manifest(control, frames.shape, 2, domain="frames")
+        live_cfg = str(tmp_path / "configs_ev_live")
+        cfg.write_global_config(live_cfg, dict(GCONF_EV))
+        cfg.write_config(live_cfg, "events", {"threshold": t})
+        task = IngestTask(
+            str(tmp_path / "tmp_ev_live"),
+            control_dir=control, config_dir=live_cfg, domain="frames",
+            input_path=path, input_key="frames_live",
+            output_path=path, output_key="ev_live",
+            poll_s=0.02, timeout_s=120.0,
+        )
+        warm = events_ops.kernel_cache_size()
+        writer = threading.Thread(
+            target=_land,
+            args=(path, "frames_live", control, frames, range(5)),
+            kwargs={"slab_depth": 2}, daemon=True,
+        )
+        before = _counters()
+        writer.start()
+        try:
+            assert build([task])
+        finally:
+            writer.join(timeout=30)
+        # the acceptance gate: streamed frames reuse the warmed kernels
+        assert events_ops.kernel_cache_size() == warm
+        assert _delta(before, _counters()).get("ingest.slabs_ingested",
+                                               0) >= 1
+
+        fr = file_reader(path, "r")
+        np.testing.assert_array_equal(fr["ev_live"][:], fr["ev_ref"][:])
+        n_blocks = 5
+        live_tab = read_event_tables(path, "ev_live", n_blocks)
+        np.testing.assert_array_equal(
+            live_tab, read_event_tables(path, "ev_ref", n_blocks)
+        )
+        ora_labels, ora_counts, _ = events_ops.build_events_np(
+            frames, threshold=t
+        )
+        np.testing.assert_array_equal(fr["ev_live"][:], ora_labels)
+        assert len(live_tab) == int(ora_counts.sum())
+
+
+# ---------------------------------------------------------------------------
+# ctt-cloud: listing pagination + the remote_list fault site
+
+
+class TestRemoteListing:
+    def test_paginated_listdir_and_remote_control_dir(self, tmp_path):
+        with StubObjectStore(str(tmp_path / "objroot")) as srv:
+            control = srv.url + "/ingest_ctl"
+            assert publish_manifest(control, (20, 4, 4), 2)
+            for s in (3, 1, 0, 2, 4, 9, 7, 8, 6, 5):  # shuffled landings
+                assert publish_slab(control, s)
+            src = GrowingSource(control)
+            prev = src.backend.list_page
+            src.backend.list_page = 3  # 11 entries -> 4 continuation pages
+            try:
+                assert src.poll() == 10
+                names = src.backend.listdir(control)
+            finally:
+                src.backend.list_page = prev
+            assert names == sorted(names)
+            assert "ingest.manifest.json" in names
+            assert sum(1 for n in names if n.startswith("slab.")) == 10
+
+    def test_remote_list_fault_heals_in_page_retry(self, tmp_path):
+        with StubObjectStore(str(tmp_path / "objroot")) as srv:
+            control = srv.url + "/ingest_ctl"
+            assert publish_manifest(control, (4, 4, 4), 2)
+            publish_slab(control, 0)
+            src = GrowingSource(control)
+            before = _counters()
+            faults.configure("store.remote_list:io_error:once;seed=2")
+            try:
+                assert src.poll() == 1  # the injected page fault healed
+            finally:
+                faults.reset()
+            d = _delta(before, _counters())
+            assert d.get("faults.injected", 0) >= 1
+            assert d.get("store.remote_retries", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# serve: released leases + drain-to-successor failover
+
+
+def _dead_lease(path):
+    """Backdate a lease stamp far past staleness AND backoff: the owner
+    'died' long ago."""
+    rec = json.load(open(path))
+    rec["wall"] = rec["wall"] - 1000.0
+    with open(path, "w") as f:
+        json.dump(rec, f)
+
+
+class TestServeRelease:
+    def test_release_requeues_immediately(self, tmp_path):
+        q = JobQueue(str(tmp_path / "jobs"), lease_s=30.0, daemon_id="dA",
+                     max_job_gens=2)
+        jid = q.submit({"workflow": "W", "tenant": "t"})
+        for gen in range(3):
+            claim = q.claim_next()
+            assert claim is not None and claim.gen == gen
+            q.release(claim)
+            rec = json.load(open(claim.lease_path))
+            assert rec["released"] is True and rec["wall"] == 0.0
+        # three voluntary give-backs: no backoff wait, no quarantine
+        claim = q.claim_next()
+        assert claim is not None and claim.gen == 3
+        assert not os.path.exists(
+            os.path.join(q.dir, f"result.{jid}.json")
+        )
+
+    def test_only_dead_generations_burn_the_budget(self, tmp_path):
+        q = JobQueue(str(tmp_path / "jobs"), lease_s=30.0, daemon_id="dA",
+                     max_job_gens=2)
+        jid = q.submit({"workflow": "W", "tenant": "t"})
+        c0 = q.claim_next()
+        q.release(c0)                     # gen 0: voluntary, free
+        c1 = q.claim_next()
+        assert c1.gen == 1
+        _dead_lease(c1.lease_path)        # gen 1: death #1
+        c2 = q.claim_next()
+        assert c2 is not None and c2.gen == 2  # 1 burned < budget 2
+        _dead_lease(c2.lease_path)        # gen 2: death #2 -> budget gone
+        assert q.claim_next() is None
+        result = json.load(
+            open(os.path.join(q.dir, f"result.{jid}.json"))
+        )
+        assert result["quarantined"] is True
+
+
+@pytest.fixture
+def daemon_factory(tmp_path):
+    daemons = []
+
+    def make(state_dir, **conf):
+        d = ServeDaemon(str(state_dir), config=conf)
+        d.start()
+        daemons.append(d)
+        return d
+
+    yield make
+    for d in daemons:
+        d.request_drain()
+        if d._httpd is not None:
+            d._httpd.shutdown()
+            d._httpd.server_close()
+        for t in d._threads:
+            if t.name.startswith("ctt-serve-exec"):
+                t.join(timeout=30)
+
+
+def _wait_for(predicate, timeout_s=60.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+class TestServeIngest:
+    def test_drain_releases_and_successor_finishes(self, tmp_path,
+                                                   daemon_factory):
+        vol = _volume()
+        path, control = _stage_growing(tmp_path, vol)
+        _batch_reference(tmp_path, path, "batch", watershed=False)
+        _land(path, "raw_live", control, vol, range(2))  # slab 2 withheld
+
+        state = tmp_path / "state"
+        d1 = daemon_factory(state)
+        client = ServeClient(state_dir=str(state))
+        job = client.ingest(
+            control_dir=control,
+            input_path=path, input_key="raw_live",
+            output_path=path, output_key="cc_srv",
+            tmp_folder=str(tmp_path / "tmp_srv"),
+            config_dir=str(tmp_path / "configs_srv"),
+            watershed=False, poll_s=0.05, timeout_s=300.0,
+            configs={"global": dict(GCONF_VOL),
+                     "threshold": {"threshold": THRESHOLD}},
+        )
+        # mid-stream: at least one slab committed, the stream parked on
+        # the withheld slab
+        assert _wait_for(lambda: os.path.exists(
+            os.path.join(control, carry_record_name(0))
+        ))
+        d1.request_drain()
+        lease0 = os.path.join(str(state), "jobs", f"lease.{job}.g0.json")
+
+        def _released():
+            try:
+                return json.load(open(lease0)).get("released") is True
+            except (OSError, ValueError):
+                return False
+
+        assert _wait_for(_released), "drain did not release the lease"
+
+        _land(path, "raw_live", control, vol, [2])
+        daemon_factory(state)  # the successor; claims gen 1, resumes
+        client2 = ServeClient(state_dir=str(state))
+        result = client2.wait(job, timeout_s=300)
+        assert result["result"]["ok"]
+        assert result["result"]["gen"] == 1
+
+        f = file_reader(path, "r")
+        np.testing.assert_array_equal(f["cc_srv"][:], f["cc_batch"][:])
+        assert _digest_key(path, "cc_srv") == _digest_key(path, "cc_batch")
+        text = client2.metrics_text()
+        lines = {
+            parts[0]: float(parts[1])
+            for parts in (ln.split() for ln in text.splitlines())
+            if len(parts) == 2 and not parts[0].startswith("#")
+        }
+        assert lines.get("ctt_ingest_resumes_total", 0) >= 1
+        assert lines.get("ctt_ingest_slabs_ingested_total", 0) >= 3

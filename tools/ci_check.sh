@@ -1547,6 +1547,220 @@ if [ "$events_rc" -ne 0 ]; then
     exit "$events_rc"
 fi
 
+echo "== ctt-ingest chaos smoke (stream a growing volume through the daemon, SIGKILL mid-stream -> successor resumes from carry, byte-identical) =="
+# the ingest gate: the control plane (manifest, slab markers, carry
+# records, frontier) lives on the flaky stub object store while the
+# volume grows on POSIX; a serve daemon runs the long-lived ingest job,
+# is SIGKILLed after the first slab commits, and a successor daemon must
+# reclaim the burned generation, restore the persisted carry, finish the
+# stream byte-identical (chunk digests) to a batch run over the finished
+# volume, and report ctt_ingest_resumes_total >= 1 on /metrics.
+ingest_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$ingest_tmp" <<'PY'
+import hashlib, json, os, subprocess, sys, time
+
+td = sys.argv[1]
+repo_root = os.environ.get("PYTHONPATH", "").split(os.pathsep)[0] or "."
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+       "CTT_HEARTBEAT_S": "0.2"}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+from scipy import ndimage
+
+from cluster_tools_tpu.ingest import publish_manifest, publish_slab
+from cluster_tools_tpu.ingest.runner import FRONTIER_NAME, carry_record_name
+from cluster_tools_tpu.runtime import build, config as cfg
+from cluster_tools_tpu.serve import ServeClient
+from cluster_tools_tpu.utils import file_reader
+from cluster_tools_tpu.workflows import StreamingSegmentationWorkflow
+
+SHAPE, SLAB_DEPTH, THRESHOLD = (24, 32, 32), 8, 0.55
+GCONF = {"block_shape": [8, 16, 16], "target": "tpu",
+         "device_batch_size": 4, "devices": [0], "max_num_retries": 0}
+
+
+def digest(root):
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+rng = np.random.default_rng(7)
+raw = ndimage.gaussian_filter(rng.random(SHAPE), 1.0)
+vol = ((raw - raw.min()) / (raw.max() - raw.min())).astype("float32")
+
+path = os.path.join(td, "data.n5")
+f = file_reader(path)
+f.create_dataset("raw", data=vol, chunks=(8, 16, 16))
+f.create_dataset("raw_live", shape=vol.shape, dtype=vol.dtype,
+                 chunks=(8, 16, 16))
+
+# batch reference over the finished volume (in-process, same configs)
+config_dir = os.path.join(td, "configs_batch")
+cfg.write_global_config(config_dir, dict(GCONF))
+cfg.write_config(config_dir, "threshold", {"threshold": THRESHOLD})
+wf = StreamingSegmentationWorkflow(
+    os.path.join(td, "tmp_batch"), config_dir,
+    input_path=path, input_key="raw",
+    output_path=path, output_key="cc_batch", watershed=False,
+)
+assert build([wf]), "batch reference failed"
+
+objroot = os.path.join(td, "objroot")
+os.makedirs(objroot)
+port_file = os.path.join(td, "stub.port")
+stub = subprocess.Popen([
+    sys.executable, os.path.join(repo_root, "tests", "objstub.py"),
+    "--root", objroot, "--port-file", port_file,
+    "--fail-rate", "0.05", "--seed", "7",
+], env=env)
+daemons = []
+state_dir = os.path.join(td, "state")
+
+
+def spawn():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.serve",
+         "--state-dir", state_dir, "--lease-s", "0.5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    daemons.append(proc)
+    proc.stdout.readline()  # listening banner
+    ep_line = proc.stdout.readline()  # endpoint JSON
+    assert ep_line, f"daemon died at startup:\n{proc.stderr.read()}"
+    ep = json.loads(ep_line)
+    client = ServeClient(endpoint=f"http://{ep['host']}:{ep['port']}",
+                         token=ep["token"])
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            client.healthz()
+            return proc, client
+        except Exception:
+            assert proc.poll() is None, (
+                f"daemon died:\n{proc.stderr.read()}")
+            time.sleep(0.1)
+    raise AssertionError("daemon never became healthy")
+
+
+try:
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file):
+        assert stub.poll() is None, "objstub died on startup"
+        assert time.monotonic() < deadline, "objstub never came up"
+        time.sleep(0.05)
+    url = f"http://127.0.0.1:{open(port_file).read().strip()}"
+    control = url + "/ingest_ctl"
+    assert publish_manifest(control, SHAPE, SLAB_DEPTH)
+
+    d1, client1 = spawn()
+    job = client1.ingest(
+        control_dir=control,
+        input_path=path, input_key="raw_live",
+        output_path=path, output_key="cc_live",
+        tmp_folder=os.path.join(td, "tmp_live"),
+        config_dir=os.path.join(td, "configs_live"),
+        watershed=False, poll_s=0.05, timeout_s=300.0,
+        configs={"global": dict(GCONF),
+                 "threshold": {"threshold": THRESHOLD}},
+    )
+
+    # the acquisition: slab data to POSIX, THEN its marker to the stub
+    # store (the protocol's commit order); slab 2 withheld until after
+    # the kill so the takeover provably happens mid-stream
+    ds = file_reader(path)["raw_live"]
+    for s in (0, 1):
+        z0, z1 = s * SLAB_DEPTH, (s + 1) * SLAB_DEPTH
+        ds[z0:z1] = vol[z0:z1]
+        assert publish_slab(control, s)
+
+    # SIGKILL once the first carry record commits (the stub serves from
+    # objroot, so the remote control dir is observable on local disk)
+    carry0 = os.path.join(objroot, "ingest_ctl", carry_record_name(0))
+    deadline = time.monotonic() + 180
+    while not os.path.exists(carry0):
+        assert d1.poll() is None, f"daemon died:\n{d1.stderr.read()}"
+        assert time.monotonic() < deadline, "first carry never landed"
+        time.sleep(0.05)
+    d1.kill()
+    d1.wait(timeout=30)
+
+    # land the final slab; the successor reclaims the burned generation
+    # (lease staleness, 3 x 0.5s) and resumes from the persisted carry
+    ds[2 * SLAB_DEPTH:] = vol[2 * SLAB_DEPTH:]
+    assert publish_slab(control, 2)
+    d2, client2 = spawn()
+    st = client2.wait(job, timeout_s=300)
+    assert st["result"]["ok"], st
+    assert st["result"]["gen"] >= 1, st  # the takeover generation
+
+    f = file_reader(path, "r")
+    assert np.array_equal(f["cc_live"][:], f["cc_batch"][:]), (
+        "ingest labels differ from the batch run")
+    assert digest(os.path.join(path, "cc_live")) == digest(
+        os.path.join(path, "cc_batch")
+    ), "ingest chunk bytes differ from the batch run"
+
+    frontier = json.load(open(
+        os.path.join(objroot, "ingest_ctl", FRONTIER_NAME)))
+    assert frontier["slabs_done"] == frontier["slabs_total"] == 3, frontier
+    assert frontier["resumes"] >= 1, frontier
+
+    text = client2.metrics_text()
+    vals = {
+        ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+        for ln in text.splitlines() if ln and not ln.startswith("#")
+    }
+    assert vals.get("ctt_ingest_resumes_total", 0) >= 1, vals
+    assert vals.get("ctt_ingest_slabs_ingested_total", 0) >= 1, vals
+    print("ingest smoke ok:", json.dumps({
+        "gen": st["result"]["gen"],
+        "resumes": vals.get("ctt_ingest_resumes_total"),
+        "successor_slabs": vals.get("ctt_ingest_slabs_ingested_total"),
+    }))
+finally:
+    for proc in daemons:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    stub.terminate()
+    stub.wait(timeout=30)
+PY
+ingest_rc=$?
+if [ "$ingest_rc" -eq 0 ]; then
+    # ctt-proto: the stream's whole control plane (manifest, slab
+    # markers, carry records, frontier) plus the SIGKILL-survivor state
+    # dir must match the artifact registry — resumability IS the schema
+    echo "== ctt-proto conformance (ingest control + state dirs vs the artifact registry) =="
+    JAX_PLATFORMS=cpu python -m cluster_tools_tpu.analysis conformance \
+        "$ingest_tmp/objroot/ingest_ctl" \
+    && JAX_PLATFORMS=cpu python -m cluster_tools_tpu.analysis conformance \
+        "$ingest_tmp/state"
+    ingest_rc=$?
+    if [ "$ingest_rc" -ne 0 ]; then
+        echo "conformance failed (rc=$ingest_rc): the ingest smoke left" \
+             "behind files the registry does not describe — update" \
+             "analysis/protocols.py or fix the writer" >&2
+    fi
+fi
+rm -rf "$ingest_tmp"
+if [ "$ingest_rc" -ne 0 ]; then
+    echo "ingest smoke failed (rc=$ingest_rc): the streaming ingest lost" \
+         "byte-identity vs the batch run, the successor never resumed" \
+         "from the carry, or the control-plane artifacts drifted from" \
+         "the registry" >&2
+    exit "$ingest_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
