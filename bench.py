@@ -982,6 +982,30 @@ def bench_ws_e2e(x, block_shape):
         except Exception as e:
             log(f"[ws-e2e] ctt-events bench failed: {e}")
         try:
+            # ctt-microbatch: a mixed-tenant burst of small event_batch
+            # jobs through one daemon — aggregation window on vs window 0
+            # (per-job dispatch), byte-identical outputs, per-tenant
+            # accounting summing exactly to the control
+            from bench_e2e_lib import run_microbatch_pipeline
+
+            mb_res = run_microbatch_pipeline()
+            res.update(mb_res)
+            log(
+                "[ws-e2e] ctt-microbatch burst A/B: "
+                f"{mb_res['ws_e2e_microbatch_jobs']} jobs window-0 "
+                f"{mb_res['ws_e2e_microbatch_solo_wall_s']} s -> window-on "
+                f"{mb_res['ws_e2e_microbatch_wall_s']} s "
+                f"({mb_res['ws_e2e_microbatch_speedup']}x), "
+                f"{mb_res['ws_e2e_microbatch_jobs_per_dispatch']} jobs/"
+                f"dispatch over {mb_res['ws_e2e_microbatch_batches']} "
+                "stacked dispatches, p99 "
+                f"{mb_res['ws_e2e_microbatch_p99_s']} s (bounded "
+                f"{mb_res['ws_e2e_microbatch_p99_bounded']}), parity "
+                f"{mb_res['ws_e2e_microbatch_parity']}"
+            )
+        except Exception as e:
+            log(f"[ws-e2e] ctt-microbatch bench failed: {e}")
+        try:
             # ctt-cloud: the same watershed against the stub object store
             # (subprocess HTTP server) vs POSIX — remote walls, IO hidden
             # behind compute, and chunk-digest parity

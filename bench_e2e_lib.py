@@ -1281,6 +1281,195 @@ def run_events_pipeline(n_frames=64, frame_shape=(512, 512),
     return res
 
 
+def run_microbatch_pipeline(n_jobs=1000, n_tenants=4, window_s=0.25,
+                            max_jobs=32, frame_n=2, frame_hw=16):
+    """ctt-microbatch contract: a mixed-tenant burst of ``n_jobs`` small
+    ``event_batch`` jobs through ONE daemon, aggregation window on vs
+    window 0 (exact per-job dispatch).
+
+    Both legs pre-fill the durable queue, then start the daemon and
+    measure wall-to-last-result — so the comparison is pure executor
+    economics (per-job claim scans + builds + dispatches vs amortized
+    multi-claims and stacked dispatches), not HTTP submission overhead.
+    Gates: ``ws_e2e_microbatch_speedup`` >= 3; outputs byte-identical
+    per job (labels + event-table chunk digests); per-tenant ok counts
+    sum exactly to the window-0 control; p99 admission-to-result of the
+    aggregated leg bounded by the control's p99 + the window (the window
+    may delay a job, never by more than itself); zero splits (no member
+    failed out of a batch)."""
+    import hashlib
+
+    from cluster_tools_tpu.obs import metrics as obs_metrics
+    from cluster_tools_tpu.serve import JobQueue, ServeDaemon
+    from cluster_tools_tpu.serve import protocol as serve_protocol
+    from cluster_tools_tpu.utils import file_reader
+
+    gconf = {"block_shape": [2, frame_hw, frame_hw], "target": "tpu",
+             "device_batch_size": 2, "devices": [0], "pipeline_depth": 2}
+    rng = np.random.default_rng(0)
+    frames = rng.random((frame_n, frame_hw, frame_hw)).astype("float32")
+    frames[frames < 0.9] = 0.0
+
+    def _drain(daemon):
+        daemon.request_drain()
+        if daemon._httpd is not None:
+            daemon._httpd.shutdown()
+            daemon._httpd.server_close()
+        for t in daemon._threads:
+            if t.name.startswith("ctt-serve-exec"):
+                t.join(timeout=120)
+
+    def _digest(root):
+        h = hashlib.sha256()
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames.sort()
+            for name in sorted(filenames):
+                p = os.path.join(dirpath, name)
+                h.update(os.path.relpath(p, root).encode())
+                with open(p, "rb") as f:
+                    h.update(f.read())
+        return h.hexdigest()
+
+    def _leg(td, path, tag, window):
+        state = os.path.join(td, f"state_{tag}")
+        q = JobQueue(os.path.join(state, "jobs"))
+        job_ids = []
+        for i in range(n_jobs):
+            rec = serve_protocol.validate_submission({
+                "type": "event_batch",
+                "input_path": path, "input_key": "frames",
+                "output_path": path, "output_key": f"ev_{tag}_{i}",
+                "tmp_folder": os.path.join(td, f"tmp_{tag}_{i}"),
+                "config_dir": os.path.join(td, f"configs_{tag}_{i}"),
+                "threshold": 0.5,
+                "configs": {"global": dict(gconf)},
+                "tenant": f"t{i % n_tenants}",
+            })
+            job_ids.append(q.submit(rec))
+        before = dict(obs_metrics.snapshot()["counters"])
+        t0 = time.perf_counter()
+        daemon = ServeDaemon(state, config={
+            "microbatch_window_s": float(window),
+            "microbatch_max_jobs": int(max_jobs),
+            "max_queue_depth": None, "tenant_quota": None,
+        })
+        daemon.start()
+        try:
+            results_dir = os.path.join(state, "jobs")
+            deadline = time.monotonic() + 1800
+            while time.monotonic() < deadline:
+                n_done = sum(
+                    1 for n in os.listdir(results_dir)
+                    if n.startswith("result.")
+                )
+                if n_done >= n_jobs:
+                    break
+                time.sleep(0.05)
+            wall = time.perf_counter() - t0
+        finally:
+            _drain(daemon)
+        obs_metrics.flush()
+        after = dict(obs_metrics.snapshot()["counters"])
+        per_tenant, latencies, all_ok = {}, [], True
+        for jid in job_ids:
+            st = q.get(jid)
+            res = st["result"] or {}
+            if not res.get("ok"):
+                all_ok = False
+                continue
+            tenant = res.get("tenant") or "?"
+            per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
+            latencies.append(
+                res["finished_wall"] - st["record"]["submit_wall"]
+            )
+
+        def delta(name):
+            return after.get(name, 0.0) - before.get(name, 0.0)
+
+        return {
+            "wall": wall, "ok": all_ok, "per_tenant": per_tenant,
+            "p99": float(np.percentile(latencies, 99)),
+            "jobs_done": delta("serve.jobs_done"),
+            "batches": delta("serve.microbatch_batches"),
+            "jobs_batched": delta("serve.microbatch_jobs_batched"),
+            "splits": delta("serve.microbatch_splits"),
+        }
+
+    import shutil
+
+    # manual mkdtemp: an in-process daemon's heartbeat thread may still
+    # stamp beat files while a TemporaryDirectory teardown walks the tree
+    td = tempfile.mkdtemp()
+    try:
+        path = os.path.join(td, "burst.n5")
+        file_reader(path).create_dataset(
+            "frames", data=frames, chunks=(2, frame_hw, frame_hw)
+        )
+        # warm-up: pay the event-kernel compiles before EITHER timed leg
+        # (leg order must not hand one side the warm cache for free).
+        # Each leg dispatches its own frame-stack shapes — the solo leg
+        # one job at a time, the aggregated leg full and tail job stacks
+        # — and the pow2-padded kernels compile once per shape, an
+        # O(log stream) one-time cost by design (ctt-events); warming
+        # every shape with the leg's real frame content keeps the A/B a
+        # throughput measurement, not a compile-count one.
+        from cluster_tools_tpu.ops import events as events_ops
+
+        tail = n_jobs % max_jobs
+        for stack in {1, max_jobs, tail} - {0}:
+            events_ops.build_events(
+                np.tile(frames, (stack, 1, 1)), threshold=0.5
+            )
+        solo = _leg(td, path, "solo", 0.0)
+        mb = _leg(td, path, "mb", window_s)
+
+        # byte-identity per job vs the window-0 control: labels AND the
+        # ragged event tables, chunk-for-chunk
+        parity = solo["ok"] and mb["ok"]
+        if parity:
+            for i in range(n_jobs):
+                if _digest(
+                    os.path.join(path, f"ev_mb_{i}")
+                ) != _digest(
+                    os.path.join(path, f"ev_solo_{i}")
+                ) or _digest(
+                    os.path.join(path, f"ev_mb_{i}_events")
+                ) != _digest(
+                    os.path.join(path, f"ev_solo_{i}_events")
+                ):
+                    parity = False
+                    break
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+    jobs_per_dispatch = (
+        mb["jobs_batched"] / mb["batches"] if mb["batches"] else 0.0
+    )
+    return {
+        "ws_e2e_microbatch_jobs": int(n_jobs),
+        "ws_e2e_microbatch_tenants": int(n_tenants),
+        "ws_e2e_microbatch_window_s": float(window_s),
+        "ws_e2e_microbatch_max_jobs": int(max_jobs),
+        "ws_e2e_microbatch_solo_wall_s": round(solo["wall"], 2),
+        "ws_e2e_microbatch_wall_s": round(mb["wall"], 2),
+        "ws_e2e_microbatch_speedup": round(solo["wall"] / mb["wall"], 2),
+        "ws_e2e_microbatch_batches": int(mb["batches"]),
+        "ws_e2e_microbatch_jobs_batched": int(mb["jobs_batched"]),
+        "ws_e2e_microbatch_jobs_per_dispatch": round(jobs_per_dispatch, 1),
+        "ws_e2e_microbatch_splits": int(mb["splits"]),
+        "ws_e2e_microbatch_solo_p99_s": round(solo["p99"], 3),
+        "ws_e2e_microbatch_p99_s": round(mb["p99"], 3),
+        "ws_e2e_microbatch_p99_bounded": bool(
+            mb["p99"] <= solo["p99"] + window_s
+        ),
+        "ws_e2e_microbatch_tenant_sums_match": bool(
+            solo["per_tenant"] == mb["per_tenant"]
+            and sum(solo["per_tenant"].values()) == n_jobs
+        ),
+        "ws_e2e_microbatch_parity": bool(parity),
+    }
+
+
 def run_remote_pipeline(vol_path, shape, block_shape, target):
     """ctt-cloud contract: the WatershedWorkflow run against the local
     stub object server (tests/objstub.py, spawned as a SUBPROCESS so its

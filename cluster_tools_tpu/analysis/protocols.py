@@ -210,6 +210,9 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
         optional={
             "type": "str", "kwargs": "dict", "configs": "dict",
             "priority": "int", "daemon": "str|null", "admitted": "bool",
+            # ctt-microbatch: explicit False opts the job out of
+            # cross-tenant aggregation (absent/True = eligible)
+            "microbatch": "bool",
         },
         merge_producers=(
             # submit() stamps id/seq/submit_wall/daemon/admitted over the
@@ -272,6 +275,10 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
             "error": "str|null", "seconds": "number", "warm": "bool",
             "compile_cache": "dict", "tenant": "str|null",
             "rejected": "bool", "quarantined": "bool", "failure_log": "list",
+            # ctt-microbatch annotation: {"jobs": n, "index": i} when the
+            # job rode an aggregation window (+"split": true when it was
+            # re-dispatched individually after a batch-path failure)
+            "microbatch": "dict",
         },
         producers=(
             ("serve/jobs.py", "retract"),

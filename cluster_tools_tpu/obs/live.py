@@ -600,6 +600,26 @@ def format_watch(snap: Dict[str, Any]) -> str:
             if isinstance(val, (int, float)):
                 parts.append(f"{label} {int(val)}")
         lines.append("  serve: " + ", ".join(parts))
+    if any(k.startswith("serve.microbatch_") for k in counters):
+        # ctt-microbatch: one line of aggregation-window economics — how
+        # deep the last window filled, how many jobs rode stacked
+        # dispatches (jobs/dispatch is the amortization ratio), and how
+        # often the window degraded (splits, deadline closes)
+        gauges = snap.get("gauges", {})
+        batches = counters.get("serve.microbatch_batches", 0)
+        jobs = counters.get("serve.microbatch_jobs_batched", 0)
+        depth = gauges.get("serve.microbatch_depth")
+        parts = [
+            (f"depth {int(depth)}"
+             if isinstance(depth, (int, float)) else None),
+            f"batches {int(batches)}",
+            f"jobs batched {int(jobs)}",
+            (f"jobs/dispatch {jobs / batches:.1f}" if batches else None),
+            f"splits {int(counters.get('serve.microbatch_splits', 0))}",
+            "window timeouts "
+            f"{int(counters.get('serve.microbatch_window_timeouts', 0))}",
+        ]
+        lines.append("  batch: " + ", ".join(p for p in parts if p))
     gauges = snap.get("gauges", {})
     if (
         "serve.peers" in gauges

@@ -141,6 +141,18 @@ COUNTERS = frozenset({
     "serve.jobs_admitted",      # two-phase admissions this daemon won
     "serve.retract_races",      # retractions a peer's limbo reaper beat
     "serve.result_races",       # job results where a gen+1 re-run won
+    # ctt-microbatch: cross-tenant job aggregation in the executor loop —
+    # queued jobs sharing a microbatch signature coalesce into ONE
+    # stacked dispatch (serve/microbatch.py); accounting stays per member
+    "serve.microbatch_batches",     # stacked dispatches with >= 2 members
+    "serve.microbatch_jobs_batched",  # member jobs that rode a stacked
+                                      # dispatch (jobs/batches = the
+                                      # aggregation ratio)
+    "serve.microbatch_splits",  # members re-dispatched individually after
+                                # a batch-path failure (poison isolation:
+                                # only the culprit burns retry budget)
+    "serve.microbatch_window_timeouts",  # aggregation windows that closed
+                                         # on the deadline, not early-fill
     # ingest/ — ctt-ingest streaming ingest of a growing source
     "ingest.slabs_ingested",    # chunks committed through the chain
     "ingest.resumes",           # streams resumed from a persisted carry
@@ -166,6 +178,9 @@ GAUGES = frozenset({
     # currently executing
     "serve.queue_depth",
     "serve.running_jobs",
+    # ctt-microbatch: member count of the most recent aggregation window
+    # (1 = the window closed with a solo claim)
+    "serve.microbatch_depth",
     # ctt-fleet: live (beating, non-exiting) daemons sharing the state
     # dir, and the fleet-wide queued-job backlog (the shared-dir count —
     # identical on every daemon, unlike per-daemon serve.queue_depth

@@ -114,6 +114,16 @@ DEFAULT_SERVE_CONFIG: Dict[str, Any] = {
     # fleet identity (None = <host>-<pid>-<n>); stamps leases and names
     # the daemon.<id>.json fleet heartbeat in the state dir
     "daemon_id": None,
+    # ctt-microbatch: cross-tenant job aggregation.  After claiming a
+    # job, the executor holds it open for up to microbatch_window_s,
+    # coalescing queued jobs with the same microbatch_signature (same
+    # workflow/type/configs) into ONE stacked dispatch of at most
+    # microbatch_max_jobs members — claimed in (-priority, seq) order at
+    # window close, so a higher-priority arrival during the window beats
+    # lower-priority queue residents.  p99 latency of an aggregated job
+    # is bounded by the window; 0 disables (exact per-job dispatch).
+    "microbatch_window_s": 0.02,
+    "microbatch_max_jobs": 8,
     # ctt-hbm warm device-buffer cache budget (MB) for the daemon's
     # ExecutionContext: back-to-back jobs on the same volume reuse the
     # HBM-resident uploads instead of re-transferring.  0 disables (the

@@ -73,6 +73,32 @@ def _record(task, label: str, n_blocks: int, seconds: float) -> None:
         rec(label, n_blocks, seconds)
 
 
+def stacked_dispatch(task, compute_fn, payload, blocking, config,
+                     all_ids: List[int], fused: bool):
+    """ONE guarded device dispatch over a (possibly stacked) payload —
+    the compute core of the staged pipeline's dispatch group, shared
+    with the ctt-microbatch job-batch runner (serve/microbatch.py),
+    which lifts the same ``stack_payloads``/``unstack_results`` contract
+    from block batches to whole jobs.  Same fault site
+    (``executor.stage_compute``), same span shape, same dispatch
+    counters — obs watch and the chip-mode accounting see a job-stacked
+    dispatch exactly like an hbm-stacked one.  The hbm use_guard pins
+    evicted-entry deletes past the dispatch (a concurrent serve job's
+    eviction must not free buffers an in-flight program still reads)."""
+    from . import hbm
+
+    faults.check("executor.stage_compute", id=all_ids[0])
+    with obs_trace.span(
+        "stage_compute", kind="device", task=task.identifier,
+        blocks=len(all_ids), block_ids=list(all_ids),
+    ), hbm.use_guard():
+        result = compute_fn(payload, blocking, config)
+    obs_metrics.inc("device.dispatches")
+    if fused:
+        obs_metrics.inc("device.fused_blocks", len(all_ids))
+    return result
+
+
 def profiler_trace(config: Dict[str, Any]):
     """jax profiler context when the ``profile_dir`` config knob is set:
     dispatches inside are captured as a TensorBoard/XPlane trace
@@ -592,19 +618,11 @@ class TpuExecutor(BaseExecutor):
                 all_ids = [b for c in group for b in c]
                 t_batch0 = time.perf_counter()
                 try:
-                    faults.check("executor.stage_compute", id=group[0][0])
                     t0 = time.perf_counter()
-                    # use_guard: evictions during the dispatch defer their
-                    # .delete() until no compute is in flight (hbm.py)
-                    with obs_trace.span(
-                        "stage_compute", kind="device",
-                        task=task.identifier, blocks=len(all_ids),
-                        block_ids=all_ids,
-                    ), hbm.use_guard():
-                        result = compute_fn(payload, blocking, config)
-                    obs_metrics.inc("device.dispatches")
-                    if len(group) > 1:
-                        obs_metrics.inc("device.fused_blocks", len(all_ids))
+                    result = stacked_dispatch(
+                        task, compute_fn, payload, blocking, config,
+                        all_ids, fused=len(group) > 1,
+                    )
                     dt = time.perf_counter() - t0
                     _acc("compute", dt)
                     _record(task, f"batch_{all_ids[0]}_{all_ids[-1]}",

@@ -4,6 +4,7 @@
         [--host H] [--concurrency N] [--max-queue-depth N]
         [--tenant-quota N] [--lease-s S] [--drain-timeout-s S]
         [--max-job-gens N] [--daemon-id ID]
+        [--microbatch-window-s S] [--microbatch-max-jobs N]
 
 The daemon binds loopback (ephemeral port by default), publishes its
 endpoint to ``<state_dir>/serve.json``, and serves until SIGTERM/SIGINT,
@@ -44,6 +45,13 @@ def main(argv=None) -> int:
                         "before quarantine (<= 0 = unbounded)")
     parser.add_argument("--daemon-id", default=None,
                         help="fleet identity (default <host>-<pid>-<n>)")
+    parser.add_argument("--microbatch-window-s", type=float, default=None,
+                        help="cross-tenant aggregation window: hold a "
+                        "claimed job this long to coalesce same-signature "
+                        "queued jobs into one stacked dispatch (0 = "
+                        "per-job dispatch)")
+    parser.add_argument("--microbatch-max-jobs", type=int, default=None,
+                        help="most member jobs per stacked dispatch")
     args = parser.parse_args(argv)
 
     from .server import ServeDaemon
@@ -58,6 +66,8 @@ def main(argv=None) -> int:
         "drain_timeout_s": args.drain_timeout_s,
         "max_job_gens": args.max_job_gens,
         "daemon_id": args.daemon_id,
+        "microbatch_window_s": args.microbatch_window_s,
+        "microbatch_max_jobs": args.microbatch_max_jobs,
     })
     daemon.install_signal_handlers()
     endpoint = daemon.start()

@@ -126,6 +126,7 @@ class ServeClient:
         tenant: str = "default",
         priority: int = 0,
         write_volume: bool = True,
+        microbatch: Optional[bool] = None,
     ) -> str:
         """ctt-hier threshold sweep step: submit one ``resegment`` job
         (re-cut a built hierarchy at ``threshold``); returns the job id.
@@ -133,8 +134,10 @@ class ServeClient:
         cached hierarchy + one relabel gather per block batch.
         ``write_volume=False`` is the interactive mode: the job persists
         only the relabel table (``<output_key>_cut.npz``) for the client
-        to apply to its current view — the millisecond sweep step."""
-        out = self._request("POST", "/api/v1/jobs", {
+        to apply to its current view — the millisecond sweep step.
+        ``microbatch=False`` opts the job out of the daemon's cross-tenant
+        aggregation window (ctt-microbatch)."""
+        payload = {
             "type": "resegment",
             "hierarchy": hierarchy,
             "labels_path": labels_path,
@@ -148,7 +151,10 @@ class ServeClient:
             "configs": configs or {},
             "tenant": tenant,
             "priority": priority,
-        })
+        }
+        if microbatch is not None:
+            payload["microbatch"] = bool(microbatch)
+        out = self._request("POST", "/api/v1/jobs", payload)
         return out["job_id"]
 
     def event_batch(
@@ -165,13 +171,17 @@ class ServeClient:
         configs: Optional[Dict[str, dict]] = None,
         tenant: str = "default",
         priority: int = 0,
+        microbatch: Optional[bool] = None,
     ) -> str:
         """ctt-events front-end step: submit one ``event_batch`` job
         (label + summarize every frame of the ``(n_frames, h, w)`` stack
         at ``input_path/input_key``); returns the job id.  Against a warm
         daemon every batch after the first reuses the compiled kernels —
         the job signature is frame-count-blind — so a sustained stream
-        pays submission + IO, not compiles."""
+        pays submission + IO, not compiles.  ``microbatch=False`` opts
+        the job out of the daemon's cross-tenant aggregation window
+        (ctt-microbatch); by default same-signature bursts coalesce into
+        one stacked dispatch."""
         payload = {
             "type": "event_batch",
             "input_path": input_path,
@@ -190,6 +200,8 @@ class ServeClient:
             payload["connectivity"] = int(connectivity)
         if max_clusters is not None:
             payload["max_clusters"] = int(max_clusters)
+        if microbatch is not None:
+            payload["microbatch"] = bool(microbatch)
         out = self._request("POST", "/api/v1/jobs", payload)
         return out["job_id"]
 

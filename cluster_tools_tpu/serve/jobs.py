@@ -513,16 +513,14 @@ class JobQueue:
         if published:
             obs_metrics.inc("serve.jobs_quarantined")
 
-    def claim_next(self) -> Optional[JobClaim]:
-        """Lease the highest-priority claimable job: unleased first; a
-        job whose lease went stale — or whose owner's fleet heartbeat
-        proves it dead (the fast path) — requeues at gen+1.  A job whose
-        *burned* generations (claims that died, not voluntary releases)
-        would reach ``max_job_gens`` is quarantined instead of claimed;
-        daemons never crash on a poison job, the job parks."""
+    def _candidates(self) -> List[Tuple[dict, int, bool]]:
+        """Claimable jobs in claim order: ``(record, next_gen,
+        fleet_reclaim)`` triples.  Enumerates once (one dir scan) for
+        both the single-claim path and the ctt-microbatch multi-claim;
+        limbo records encountered along the way are reaped here."""
         jobs, admits, leases, results = self._scan()
         now = time.time()
-        candidates = []  # (record, next_gen, fleet_reclaim)
+        candidates: List[Tuple[dict, int, bool]] = []
         for jid in jobs:
             if jid in results:
                 continue
@@ -546,28 +544,84 @@ class JobQueue:
         candidates.sort(
             key=lambda c: (-int(c[0].get("priority", 0)), int(c[0]["seq"]))
         )
-        for rec, gen, reclaim in candidates:
-            jid = rec["id"]
-            if (self.max_job_gens > 0
-                    and gen - self._released_gens(jid, gen)
-                    >= self.max_job_gens):
-                self._quarantine(jid, gen, rec)
-                continue
-            claim_wall = time.time()
-            path = os.path.join(self.dir, f"lease.{jid}.g{gen}.json")
-            if publish_once(path, self._lease_payload(jid, gen, claim_wall)):
-                if gen > 0:
-                    obs_metrics.inc("serve.leases_requeued")
-                    if reclaim:
-                        # fleet fast path: recovered from a heartbeat-
-                        # proven dead peer, not mere lease staleness
-                        obs_metrics.inc("serve.jobs_reclaimed")
-                return JobClaim(
-                    job_id=jid, record=rec, gen=gen, lease_path=path,
-                    claim_wall=claim_wall,
-                )
-            # claim raced away; fall through to the next candidate
+        return candidates
+
+    def _claim_candidate(self, rec: dict, gen: int,
+                         reclaim: bool) -> Optional[JobClaim]:
+        """Attempt one exclusive lease on a candidate.  None means either
+        the retry budget parked the job (quarantine) or the publish_once
+        raced away to a peer — the caller moves on either way."""
+        jid = rec["id"]
+        if (self.max_job_gens > 0
+                and gen - self._released_gens(jid, gen)
+                >= self.max_job_gens):
+            self._quarantine(jid, gen, rec)
+            return None
+        claim_wall = time.time()
+        path = os.path.join(self.dir, f"lease.{jid}.g{gen}.json")
+        if publish_once(path, self._lease_payload(jid, gen, claim_wall)):
+            if gen > 0:
+                obs_metrics.inc("serve.leases_requeued")
+                if reclaim:
+                    # fleet fast path: recovered from a heartbeat-
+                    # proven dead peer, not mere lease staleness
+                    obs_metrics.inc("serve.jobs_reclaimed")
+            return JobClaim(
+                job_id=jid, record=rec, gen=gen, lease_path=path,
+                claim_wall=claim_wall,
+            )
         return None
+
+    def claim_next(self) -> Optional[JobClaim]:
+        """Lease the highest-priority claimable job: unleased first; a
+        job whose lease went stale — or whose owner's fleet heartbeat
+        proves it dead (the fast path) — requeues at gen+1.  A job whose
+        *burned* generations (claims that died, not voluntary releases)
+        would reach ``max_job_gens`` is quarantined instead of claimed;
+        daemons never crash on a poison job, the job parks."""
+        for rec, gen, reclaim in self._candidates():
+            claim = self._claim_candidate(rec, gen, reclaim)
+            if claim is not None:
+                return claim
+        return None
+
+    def claim_batch(self, predicate, max_n: int) -> List[JobClaim]:
+        """ctt-microbatch multi-claim: lease up to ``max_n`` claimable
+        jobs for which ``predicate(record, next_gen)`` holds, in claim
+        order (-priority, seq), over ONE directory scan.  Every member
+        gets its own ordinary ``publish_once`` lease — exactly the
+        single-claim artifact, so exactly-once execution, peer failover,
+        renewal, and quarantine accounting are untouched; the *batch* is
+        purely the caller's in-memory grouping and never exists on disk."""
+        claims: List[JobClaim] = []
+        if max_n <= 0:
+            return claims
+        for rec, gen, reclaim in self._candidates():
+            if len(claims) >= max_n:
+                break
+            try:
+                if not predicate(rec, gen):
+                    continue
+            except Exception:
+                continue
+            claim = self._claim_candidate(rec, gen, reclaim)
+            if claim is not None:
+                claims.append(claim)
+        return claims
+
+    def count_matching(self, predicate) -> int:
+        """Lease-free count of claimable jobs matching
+        ``predicate(record, next_gen)`` — the aggregation window's
+        early-fill probe (close the window as soon as enough batchmates
+        are queued instead of sleeping out the deadline)."""
+        n = 0
+        for rec, gen, _ in self._candidates():
+            try:
+                if predicate(rec, gen):
+                    n += 1
+            except Exception:
+                continue
+        return n
 
     def renew(self, claim: JobClaim) -> None:
         atomic_write_bytes(

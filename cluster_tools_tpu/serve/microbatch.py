@@ -1,0 +1,201 @@
+"""ctt-microbatch runner: many member jobs, ONE stacked device dispatch.
+
+The ``stack_payloads``/``unstack_results`` contract (runtime/executor.py,
+ctt-hbm) aggregates *block batches* of one job into one device program.
+This module lifts the same contract one grain up: the serve daemon hands
+it several already-claimed member jobs with the same
+``protocol.microbatch_signature`` — each with its OWN task instance,
+lease, and result record — and the runner executes their volume passes
+as one stacked read → ONE dispatch → per-member writes:
+
+  * :func:`plan_member` replays exactly the setup half of
+    ``BlockTask._run_blocks_phase`` (config merge, blocking, block list,
+    done-status probe) and declines anything the stacked path cannot own
+    byte-identically — multi-host topology, empty block lists (e.g. the
+    resegment table-only mode), partially-done resumes, tasks without
+    the split protocol.  Declined members run the ordinary solo
+    ``build()`` path in the daemon, so ineligibility is never a failure.
+  * :func:`run_stacked` isolates faults at the member grain: prepare and
+    read errors (including ``executor.block`` fault-site hits — the same
+    per-block chaos seam the solo executors check) drop only that
+    member; a failure of the stacked compute itself fails every member.
+    Either way the daemon re-dispatches failed members individually
+    (``serve.microbatch_splits``), so one poisoned job burns its own
+    retry budget and its batchmates still publish ok results.
+
+The batch never exists on disk: member status files, leases, and results
+are the ordinary per-job artifacts, written per member — a peer daemon
+observing the state dir mid-batch sees N independent leased jobs, and a
+member failover behaves exactly like today's single-job failover.
+"""
+
+from __future__ import annotations
+
+import json
+import traceback
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import faults
+from ..obs import trace as obs_trace
+from ..runtime import config as cfg
+from ..runtime.executor import stacked_dispatch
+from ..utils.blocking import Blocking
+
+__all__ = ["MemberPlan", "plan_member", "stack_key", "run_stacked"]
+
+# the split batch protocol + the stack contract — all five or solo
+STACK_METHODS = (
+    "read_batch", "compute_batch", "write_batch",
+    "stack_payloads", "unstack_results",
+)
+
+
+@dataclass
+class MemberPlan:
+    """One member job's resolved volume pass: everything
+    ``BlockTask._run_blocks_phase`` would have computed before its first
+    dispatch, held so the stacked runner can read/write per member while
+    dispatching once."""
+
+    task: Any
+    blocking: Blocking
+    config: Dict[str, Any]
+    block_ids: List[int]
+    error: Optional[str] = None
+    seconds: float = 0.0
+
+
+def plan_member(task) -> Optional[MemberPlan]:
+    """Resolve one member task's dispatch plan, or None when the stacked
+    path must not own it (the solo ``build()`` path runs it instead)."""
+    gconf = task.global_config()
+    _, num = cfg.process_topology(gconf)
+    if num > 1:
+        # multi-host barrier protocol: per-process shards + peer waits —
+        # strictly the solo lifecycle's business
+        return None
+    for name in STACK_METHODS:
+        if getattr(task, name, None) is None:
+            return None
+    tconf = task.get_task_config()
+    config = {**gconf, **tconf}
+    blocking = Blocking(tuple(task.get_shape()), task.get_block_shape(gconf))
+    block_ids = task.get_block_list(blocking, gconf)
+    if not block_ids:
+        # nothing to stack (e.g. resegment write_volume: false runs its
+        # whole job in prepare/finalize)
+        return None
+    if task.output().read().get("done"):
+        # partial progress from a prior generation: the resumable solo
+        # path owns done-set arithmetic and retries
+        return None
+    return MemberPlan(
+        task=task, blocking=blocking, config=config, block_ids=block_ids,
+    )
+
+
+def stack_key(plan: MemberPlan) -> Tuple:
+    """Members stack only when one device program serves them all: same
+    task class, same block geometry, and the same merged runtime config
+    (a member whose config_dir carried stray pre-existing keys falls out
+    into its own group and runs solo — never silently mis-stacked)."""
+    try:
+        conf = json.dumps(plan.config, sort_keys=True, default=repr)
+    except (TypeError, ValueError):
+        conf = repr(sorted(plan.config))
+    return (
+        type(plan.task).__name__,
+        tuple(plan.blocking.block_shape),
+        getattr(plan.task, "hierarchy_path", None),
+        conf,
+    )
+
+
+def run_stacked(
+    plans: List[MemberPlan],
+) -> Tuple[List[MemberPlan], List[MemberPlan]]:
+    """Execute member plans as one stacked dispatch; returns
+    ``(ok, failed)`` plans (failed carry ``plan.error``).  Per-member
+    prepare/read/write failures isolate to that member; a stacked
+    compute failure fails all — the caller re-dispatches failed members
+    individually either way."""
+    payloads, survivors, failed = [], [], []
+    for plan in plans:
+        t0 = obs_trace.monotonic()
+        try:
+            plan.task.prepare(plan.blocking, plan.config)
+            for bid in plan.block_ids:
+                # the solo executors' per-block chaos seam, checked at
+                # the member grain: a fail/kill fault aimed at a block id
+                # only this member owns fires here — before its payload
+                # can join the stack
+                faults.check("executor.block", id=bid)
+            with obs_trace.span(
+                "stage_read", kind="host_io", task=plan.task.identifier,
+                blocks=len(plan.block_ids), block_ids=list(plan.block_ids),
+            ):
+                payloads.append(plan.task.read_batch(
+                    plan.block_ids, plan.blocking, plan.config
+                ))
+        except Exception:
+            plan.error = traceback.format_exc()
+            failed.append(plan)
+            continue
+        plan.seconds += obs_trace.monotonic() - t0
+        survivors.append(plan)
+    if not survivors:
+        return [], failed
+
+    leader = survivors[0]
+    counts = [len(p.block_ids) for p in survivors]
+    all_ids = [b for p in survivors for b in p.block_ids]
+    t0 = obs_trace.monotonic()
+    try:
+        payload = (
+            leader.task.stack_payloads(payloads, leader.blocking,
+                                       leader.config)
+            if len(survivors) > 1 else payloads[0]
+        )
+        result = stacked_dispatch(
+            leader.task, leader.task.compute_batch, payload,
+            leader.blocking, leader.config, all_ids,
+            fused=len(survivors) > 1,
+        )
+        results = (
+            leader.task.unstack_results(result, counts, leader.blocking,
+                                        leader.config)
+            if len(survivors) > 1 else [result]
+        )
+    except Exception:
+        tb = traceback.format_exc()
+        for plan in survivors:
+            plan.error = tb
+        return [], failed + survivors
+    compute_share = (obs_trace.monotonic() - t0) / len(survivors)
+
+    ok = []
+    for plan, res in zip(survivors, results):
+        t0 = obs_trace.monotonic()
+        try:
+            with obs_trace.span(
+                "stage_write", kind="host_io", task=plan.task.identifier,
+                blocks=len(plan.block_ids),
+                block_ids=list(plan.block_ids),
+            ):
+                plan.task.write_batch(res, plan.blocking, plan.config)
+            plan.task.finalize(plan.blocking, plan.config, plan.block_ids)
+            plan.seconds += compute_share + (obs_trace.monotonic() - t0)
+            # the member's ordinary completion record: same schema as the
+            # solo lifecycle's, so resumes/status readers can't tell a
+            # batched member from a solo run
+            plan.task._write_status(
+                plan.task.output(), plan.block_ids, set(plan.block_ids),
+                [], [plan.seconds], True,
+            )
+        except Exception:
+            plan.error = traceback.format_exc()
+            failed.append(plan)
+            continue
+        ok.append(plan)
+    return ok, failed

@@ -85,6 +85,19 @@ carry per slab so a drain suspend or daemon death resumes mid-stream::
 
 Normalizes over ``cluster_tools_tpu.ingest.runner:IngestTask``.
 
+ctt-microbatch — cross-tenant job aggregation.  Every submission accepts
+an optional ``"microbatch": false`` key (preserved on the job record) to
+opt a job out of the daemon's aggregation window; by default, queued
+jobs whose :func:`microbatch_signature` matches (same workflow + job
+type + configs + pinned artifacts) may be coalesced into ONE stacked
+device dispatch by the executing daemon.  The batch is an in-daemon
+execution detail: every member keeps its own job/lease/result records,
+admission and quotas are judged per member, and results are
+byte-identical to per-job dispatch.  A member of a stacked dispatch
+carries a ``"microbatch": {"jobs": n, "index": i}`` annotation on its
+result record (``"split": true`` when it was re-dispatched individually
+after a batch failure).
+
 Every request except the bare ``/healthz`` liveness probe must carry the
 daemon's auth token (``X-CTT-Serve-Token: <token>`` or ``Authorization:
 Bearer <token>``), published only through the mode-0600 ``serve.json``
@@ -113,7 +126,8 @@ state that executes it.
 from __future__ import annotations
 
 import importlib
-from typing import Any, Dict, Tuple
+import json
+from typing import Any, Dict, Optional, Tuple
 
 SCHEMA_VERSION = 1
 
@@ -289,6 +303,11 @@ def validate_submission(payload: Any) -> Dict[str, Any]:
     a malformed submission is a client bug, not a degraded default."""
     if not isinstance(payload, dict):
         raise ProtocolError("submission must be a JSON object")
+    # capture the aggregation opt-out before the typed normalizers rebuild
+    # the payload (they only keep their own fields)
+    microbatch = payload.get("microbatch")
+    if microbatch is not None and not isinstance(microbatch, bool):
+        raise ProtocolError("'microbatch' must be a boolean")
     job_type = payload.get("type", "workflow")
     if job_type not in JOB_TYPES:
         raise ProtocolError(
@@ -327,7 +346,7 @@ def validate_submission(payload: Any) -> Dict[str, Any]:
         priority = int(payload.get("priority", 0))
     except (TypeError, ValueError):
         raise ProtocolError("'priority' must be an integer") from None
-    return {
+    record = {
         "schema": SCHEMA_VERSION,
         "type": payload.get("type", "workflow"),
         "workflow": workflow.strip(),
@@ -336,6 +355,9 @@ def validate_submission(payload: Any) -> Dict[str, Any]:
         "tenant": tenant,
         "priority": priority,
     }
+    if microbatch is not None:
+        record["microbatch"] = microbatch
+    return record
 
 
 def resolve_workflow(spec: str):
@@ -421,3 +443,41 @@ def job_signature(record: Dict[str, Any]) -> Tuple:
         if isinstance(bs, (list, tuple)):
             block_shape = tuple(int(b) for b in bs)
     return (record["workflow"], block_shape)
+
+
+# job types whose compute stage is safe to stack across jobs: both speak
+# the split batch protocol, and everything their compute reads beyond the
+# stacked payload is pinned by the signature below (configs JSON; the
+# hierarchy artifact for resegment).  "workflow" stays out — arbitrary
+# Task classes make no stacking promise — and "ingest" is long-lived.
+MICROBATCH_TYPES = ("event_batch", "resegment")
+
+
+def microbatch_signature(record: Dict[str, Any]) -> Optional[Tuple]:
+    """The aggregation key of a job (ctt-microbatch), or None when the
+    job must dispatch alone.
+
+    Strictly finer than :func:`job_signature`: two jobs may only share a
+    stacked dispatch when their compute stages are interchangeable —
+    same workflow/type, byte-identical configs (``compute_batch`` reads
+    kernel knobs from the merged config, so "same compiled program" is
+    not enough), and for ``resegment`` the same hierarchy artifact (the
+    cut table lives on the task instance, derived from hierarchy +
+    threshold).  Block geometry rides the configs.  Inputs/outputs stay
+    per member: the stack contract concatenates read payloads, so member
+    volumes only need to share the block shape, never the data."""
+    if record.get("microbatch") is False:
+        return None
+    if record.get("type") not in MICROBATCH_TYPES:
+        return None
+    configs = record.get("configs") or {}
+    try:
+        conf_key = json.dumps(configs, sort_keys=True)
+    except (TypeError, ValueError):
+        return None
+    artifact = None
+    if record.get("type") == "resegment":
+        kwargs = record.get("kwargs") or {}
+        artifact = kwargs.get("hierarchy_path") if isinstance(
+            kwargs, dict) else None
+    return (job_signature(record), conf_key, artifact)

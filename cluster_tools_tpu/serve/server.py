@@ -15,7 +15,12 @@ wiring) and runs three kinds of threads over the durable
     classes — admission is gated on filesystem permissions instead;
   * ``concurrency`` executor threads that claim leased jobs in priority
     order and run ``runtime.build([task], context=<warm context>)`` —
-    byte-identical to a fresh-process build, minus the setup cost;
+    byte-identical to a fresh-process build, minus the setup cost.  With
+    ``microbatch_window_s > 0`` a claim first holds an aggregation
+    window (ctt-microbatch): queued jobs sharing its microbatch
+    signature coalesce — across tenants — into ONE stacked dispatch
+    (serve/microbatch.py), results split back per member job, faults and
+    accounting stay per member;
   * per-running-job lease-renewal threads (the runtime/queue.py cadence),
     so a daemon killed mid-job leaves a lease that goes stale and
     requeues on the next daemon over the same state dir.
@@ -332,17 +337,70 @@ class ServeDaemon:
                 self._wake.wait(timeout=self.jobs.lease_s / 4.0)
                 self._wake.clear()
                 continue
+            claims = self._gather_batch(claim)
             with self._state_lock:
-                self._running_jobs += 1
+                self._running_jobs += len(claims)
             self._publish_gauges()
             try:
-                self._run_job(claim)
+                if len(claims) == 1:
+                    self._run_job(claims[0])
+                else:
+                    self._run_job_batch(claims)
             finally:
                 with self._state_lock:
-                    self._running_jobs -= 1
+                    self._running_jobs -= len(claims)
                 self._publish_gauges()
 
-    def _run_job(self, claim: JobClaim) -> None:
+    def _gather_batch(self, first: JobClaim) -> list:
+        """ctt-microbatch aggregation window: hold the first claim open
+        for up to ``microbatch_window_s``, then multi-claim queued jobs
+        sharing its :func:`protocol.microbatch_signature` into one batch
+        of at most ``microbatch_max_jobs`` members.
+
+        Members are claimed at window CLOSE in (-priority, seq) order,
+        so a higher-priority arrival during the window joins this batch
+        ahead of lower-priority queue residents.  The window closes
+        early once enough batchmates are queued
+        (``serve.microbatch_window_timeouts`` counts deadline closes).
+        Only fresh (gen 0) jobs batch: a requeued job re-runs SOLO, so a
+        shared crash can never burn a batchmate's retry budget twice —
+        after a mid-batch daemon death every member resumes individually,
+        exactly like today's single-job failover."""
+        window = float(self.config.get("microbatch_window_s", 0.0) or 0.0)
+        max_jobs = int(self.config.get("microbatch_max_jobs", 1) or 1)
+        sig = protocol.microbatch_signature(first.record)
+        if (window <= 0.0 or max_jobs <= 1 or sig is None
+                or first.gen != 0 or self.draining):
+            return [first]
+
+        def matches(rec, gen):
+            return gen == 0 and protocol.microbatch_signature(rec) == sig
+
+        deadline = obs_trace.monotonic() + window
+        filled = False
+        while obs_trace.monotonic() < deadline:
+            if self.draining:
+                # a drain only finishes what is claimed — never widen it
+                return [first]
+            if self.jobs.count_matching(matches) >= max_jobs - 1:
+                filled = True
+                break
+            time.sleep(max(min(0.005, window / 4.0), 1e-4))
+        if not filled:
+            obs_metrics.inc("serve.microbatch_window_timeouts")
+        claims = [first] + self.jobs.claim_batch(matches, max_jobs - 1)
+        claims.sort(key=lambda c: (
+            -int(c.record.get("priority", 0) or 0),
+            int(c.record.get("seq", 0) or 0),
+        ))
+        obs_metrics.set_gauge("serve.microbatch_depth", len(claims))
+        if len(claims) > 1:
+            obs_metrics.inc("serve.microbatch_batches")
+            obs_metrics.inc("serve.microbatch_jobs_batched", len(claims))
+        return claims
+
+    def _run_job(self, claim: JobClaim,
+                 microbatch_note: Optional[Dict[str, Any]] = None) -> None:
         rec = claim.record
         stop = threading.Event()
         renewer = threading.Thread(
@@ -405,7 +463,7 @@ class ServeDaemon:
             )
         else:
             obs_metrics.inc("serve.jobs_failed")
-        won = self.jobs.complete(claim, {
+        result = {
             "ok": ok,
             "error": (error or "")[-4000:] or None,
             "seconds": seconds,
@@ -415,13 +473,136 @@ class ServeDaemon:
                 "misses": delta("compile_cache.cache_misses"),
             },
             "tenant": rec.get("tenant"),
-        })
+        }
+        if microbatch_note:
+            result["microbatch"] = dict(microbatch_note)
+        won = self.jobs.complete(claim, result)
         if not won:
             # a peer presumed us dead mid-run (stale lease or dead fleet
             # beat) and re-ran the job at gen+1; first writer won and ours
             # is the duplicate — correct by design, but worth counting
             obs_metrics.inc("serve.result_races")
         obs_metrics.flush()  # results readable => counters scrapeable
+
+    def _run_job_batch(self, claims: list) -> None:
+        """ctt-microbatch: run same-signature member jobs as ONE stacked
+        dispatch (serve/microbatch.py), keeping every per-member
+        contract: own lease (renewed for the whole batch), own result
+        record, per-member warm/cold and tenant accounting.  Members the
+        runner cannot stack run the ordinary solo path; members that
+        FAIL any stacked stage are re-dispatched individually
+        (``serve.microbatch_splits``) so only the true culprit burns
+        budget and publishes a failure."""
+        stops, renewers = [], []
+        for claim in claims:
+            stop = threading.Event()
+            r = threading.Thread(
+                target=self._renew_loop, args=(claim, stop),
+                name="ctt-serve-lease", daemon=True,
+            )
+            r.start()
+            stops.append(stop)
+            renewers.append(r)
+        try:
+            self._run_job_batch_inner(claims)
+        finally:
+            for stop in stops:
+                stop.set()
+            for r in renewers:
+                r.join(timeout=5.0)
+
+    def _run_job_batch_inner(self, claims: list) -> None:
+        from . import microbatch
+
+        n = len(claims)
+        index = {c.job_id: i for i, c in enumerate(claims)}
+        warm_by_job = {
+            c.job_id: protocol.job_signature(c.record)
+            in self._warm_signatures
+            for c in claims
+        }
+        before = obs_metrics.snapshot()["counters"]
+        t0 = obs_trace.monotonic()
+
+        solo: list = []       # (claim, split) — split=True burns a split
+        groups: Dict[Any, list] = {}
+        plan_claims: Dict[int, JobClaim] = {}
+        with obs_trace.span(
+            "serve_job_batch", kind="host", jobs=n,
+            job_ids=[c.job_id for c in claims],
+            tenants=sorted({
+                str(c.record.get("tenant")) for c in claims
+            }),
+        ):
+            for claim in claims:
+                try:
+                    plan = microbatch.plan_member(
+                        self._instantiate(claim.record)
+                    )
+                except Exception:
+                    plan = None  # the solo path reports the real error
+                if plan is None:
+                    solo.append((claim, False))
+                    continue
+                plan_claims[id(plan)] = claim
+                groups.setdefault(microbatch.stack_key(plan), []).append(
+                    plan
+                )
+            ok_plans, failed_plans = [], []
+            for plans in groups.values():
+                ok_p, failed_p = microbatch.run_stacked(plans)
+                ok_plans.extend(ok_p)
+                failed_plans.extend(failed_p)
+        seconds = obs_trace.monotonic() - t0
+        after = obs_metrics.snapshot()["counters"]
+        compile_delta = {
+            "hits": after.get("compile_cache.cache_hits", 0.0)
+            - before.get("compile_cache.cache_hits", 0.0),
+            "misses": after.get("compile_cache.cache_misses", 0.0)
+            - before.get("compile_cache.cache_misses", 0.0),
+        }
+
+        for i, plan in enumerate(ok_plans):
+            claim = plan_claims[id(plan)]
+            rec = claim.record
+            warm = warm_by_job[claim.job_id]
+            self._warm_signatures.add(protocol.job_signature(rec))
+            obs_metrics.inc("serve.jobs_done")
+            if rec.get("type") == "resegment":
+                obs_metrics.inc("hier.resegment_jobs")
+            obs_metrics.inc(
+                "serve.warm_compile_jobs" if warm
+                else "serve.cold_compile_jobs"
+            )
+            won = self.jobs.complete(claim, {
+                "ok": True,
+                "error": None,
+                "seconds": plan.seconds or seconds / n,
+                "warm": warm,
+                # compile accounting is per dispatch, and the batch IS
+                # one dispatch: the whole delta rides the first member,
+                # so summing members' results equals the solo totals
+                "compile_cache": compile_delta if i == 0
+                else {"hits": 0.0, "misses": 0.0},
+                "tenant": rec.get("tenant"),
+                "microbatch": {"jobs": n, "index": index[claim.job_id]},
+            })
+            if not won:
+                obs_metrics.inc("serve.result_races")
+        obs_metrics.flush()
+
+        for plan in failed_plans:
+            solo.append((plan_claims[id(plan)], True))
+        # failed/ineligible members re-dispatch through the EXACT solo
+        # path (own build, own spans, own fault surface): a poisoned
+        # member fails alone here while its batchmates' ok results are
+        # already published above
+        for claim, split in solo:
+            note = {"jobs": n, "index": index[claim.job_id]}
+            if split:
+                obs_metrics.inc("serve.microbatch_splits")
+                note["split"] = True
+            self._run_job(claim, microbatch_note=note)
 
     def _instantiate(self, rec: Dict[str, Any]):
         cls = protocol.resolve_workflow(rec["workflow"])

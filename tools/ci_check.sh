@@ -1547,6 +1547,193 @@ if [ "$events_rc" -ne 0 ]; then
     exit "$events_rc"
 fi
 
+echo "== ctt-microbatch smoke (12-job mixed-tenant burst -> stacked dispatch, byte-identity vs window-0, kill-poison fails alone) =="
+# the microbatch gate: a short-window daemon must coalesce a 12-job
+# mixed-tenant event_batch burst into stacked dispatches (>= 2x
+# aggregation on ctt_serve_microbatch_batches_total), the outputs must
+# be byte-identical to a window-0 daemon, and an executor.block:kill
+# poisoned member must burn its own retry budget alone — its
+# batchmates from the same window publish ok.
+microbatch_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$microbatch_tmp" <<'PY'
+import hashlib, os, signal, subprocess, sys, time
+
+td = sys.argv[1]
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+       "CTT_HEARTBEAT_S": "0.2"}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+from scipy import ndimage
+from cluster_tools_tpu.serve import ServeClient
+from cluster_tools_tpu.utils import file_reader
+
+gconf = {"block_shape": [2, 16, 16], "target": "local"}
+
+
+def frames(seed, n=4):
+    rng = np.random.default_rng(seed)
+    raw = ndimage.gaussian_filter(
+        rng.random((n, 16, 16)), (0.0, 1.0, 1.0)
+    ).astype("float32")
+    return np.where(raw > np.quantile(raw, 0.9), raw, 0.0).astype("float32")
+
+
+def write_frames(tag, seed, n=4):
+    path = os.path.join(td, f"{tag}.n5")
+    file_reader(path).create_dataset("frames", data=frames(seed, n=n),
+                                     chunks=(2, 16, 16))
+    return path
+
+
+def digest(root):
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def spawn(state_dir, *extra_args, extra_env=None):
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.serve",
+         "--state-dir", state_dir, "--concurrency", "1", *extra_args],
+        env={**env, **(extra_env or {})},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        assert daemon.poll() is None, daemon.stderr.read()
+        try:
+            client = ServeClient(state_dir=state_dir)
+            client.healthz()
+            return daemon, client
+        except Exception:
+            time.sleep(0.1)
+    daemon.kill()
+    raise AssertionError("daemon never became healthy")
+
+
+def stop(daemon):
+    if daemon.poll() is None:
+        daemon.send_signal(signal.SIGTERM)
+        try:
+            daemon.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+            daemon.wait(timeout=30)
+
+
+def submit(client, path, tag, tenant, priority=0):
+    return client.event_batch(
+        input_path=path, input_key="frames",
+        output_path=path, output_key=f"ev_{tag}",
+        tmp_folder=os.path.join(td, f"tmp_{tag}"),
+        config_dir=os.path.join(td, f"configs_{tag}"),
+        threshold=0.1, configs={"global": dict(gconf)},
+        tenant=tenant, priority=priority,
+    )
+
+
+# -- leg 1: short window, 12-job mixed-tenant burst -> stacked dispatch
+burst_path = write_frames("burst", seed=7)
+daemon, client = spawn(os.path.join(td, "state_mb"),
+                       "--microbatch-window-s", "2.0",
+                       "--microbatch-max-jobs", "12")
+try:
+    jobs = [submit(client, burst_path, f"mb{i}", tenant=f"t{i % 4}")
+            for i in range(12)]
+    for j in jobs:
+        st = client.wait(j, timeout_s=300)
+        assert st["result"]["ok"], st
+        assert st["result"].get("microbatch"), (
+            "burst member missing the microbatch annotation", st)
+    text = client.metrics_text()
+    vals = {
+        parts[0]: float(parts[1])
+        for parts in (ln.split() for ln in text.splitlines())
+        if len(parts) == 2 and not parts[0].startswith("#")
+    }
+    batches = vals.get("ctt_serve_microbatch_batches_total", 0)
+    batched = vals.get("ctt_serve_microbatch_jobs_batched_total", 0)
+    assert batches >= 1, "no stacked dispatch under a 12-job burst"
+    assert batched / batches >= 2, (
+        f"aggregation below 2x: {batched} jobs over {batches} batches")
+finally:
+    stop(daemon)
+
+# -- leg 2: window 0 = exact per-job dispatch; outputs byte-identical
+daemon, client = spawn(os.path.join(td, "state_solo"),
+                       "--microbatch-window-s", "0")
+try:
+    solo = [submit(client, burst_path, f"solo{i}", tenant=f"t{i % 4}")
+            for i in range(12)]
+    for j in solo:
+        st = client.wait(j, timeout_s=300)
+        assert st["result"]["ok"], st
+        assert "microbatch" not in st["result"], (
+            "window-0 daemon must not aggregate", st)
+finally:
+    stop(daemon)
+for i in range(12):
+    a = digest(os.path.join(burst_path, f"ev_mb{i}"))
+    b = digest(os.path.join(burst_path, f"ev_solo{i}"))
+    assert a == b, f"stacked output not byte-identical for job {i}"
+
+# -- leg 3: executor.block:kill poison — the culprit (6 frames = blocks
+# 0..2, fault targets id 2) kills the daemon mid-batch; across respawns
+# the batchmates (2 frames = block 0 only) publish ok while only the
+# culprit burns its retry budget and quarantines
+culprit_path = write_frames("culprit", seed=11, n=6)
+mate_path = write_frames("mates", seed=13, n=2)
+kill_state = os.path.join(td, "state_kill")
+kill_args = ("--lease-s", "5", "--max-job-gens", "2",
+             "--microbatch-window-s", "2.0", "--microbatch-max-jobs", "3")
+poison = {"CTT_FAULTS": "executor.block:kill:ids=2"}
+daemon, client = spawn(kill_state, *kill_args, extra_env=poison)
+culprit = submit(client, culprit_path, "culprit", tenant="bad")
+mates = [submit(client, mate_path, f"mate{i}", tenant=f"t{i}", priority=5)
+         for i in range(2)]
+assert daemon.wait(timeout=120) == 17, "poisoned batch never killed m0"
+daemon, client = spawn(kill_state, *kill_args, extra_env=poison)
+assert daemon.wait(timeout=120) == 17, "gen-1 solo culprit never killed m1"
+daemon, client = spawn(kill_state, *kill_args)
+try:
+    deadline = time.monotonic() + 120
+    res = None
+    while time.monotonic() < deadline:
+        st = client.status(culprit)
+        if st["state"] == "failed":
+            res = st["result"]
+            break
+        time.sleep(0.2)
+    assert res is not None, "poison member never quarantined"
+    assert res.get("quarantined") is True, res
+    for j in mates:
+        st = client.wait(j, timeout_s=180)
+        assert st["result"]["ok"], f"batchmate lost to the kill: {st}"
+finally:
+    stop(daemon)
+print("microbatch smoke ok:",
+      f"{batched:.0f} jobs over {batches:.0f} stacked dispatches,",
+      "byte-identical to window-0, kill-poisoned culprit failed alone")
+PY
+microbatch_rc=$?
+rm -rf "$microbatch_tmp"
+if [ "$microbatch_rc" -ne 0 ]; then
+    echo "microbatch smoke failed (rc=$microbatch_rc): the aggregation" \
+         "window under-batched a mixed-tenant burst, broke byte-identity" \
+         "vs per-job dispatch, or let a kill-poisoned member hurt its" \
+         "batchmates" >&2
+    exit "$microbatch_rc"
+fi
+
 echo "== ctt-ingest chaos smoke (stream a growing volume through the daemon, SIGKILL mid-stream -> successor resumes from carry, byte-identical) =="
 # the ingest gate: the control plane (manifest, slab markers, carry
 # records, frontier) lives on the flaky stub object store while the
