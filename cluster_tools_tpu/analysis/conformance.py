@@ -26,6 +26,7 @@ import os
 import re
 from typing import Dict, List, Tuple
 
+from ..utils.store_backend import backend_for
 from .protocols import (
     ArtifactSchema,
     check_value_type,
@@ -69,12 +70,11 @@ def _check_record(
 
 
 def _check_jsonl(
-    path: str, rel: str, schema: ArtifactSchema,
+    backend, path: str, rel: str, schema: ArtifactSchema,
     problems: List[str], warnings: List[str],
 ) -> None:
     try:
-        with open(path, "rb") as f:
-            raw = f.read()
+        raw = backend.read_bytes(path)
     except OSError as e:
         problems.append(f"{rel}: unreadable: {e}")
         return
@@ -103,56 +103,76 @@ def _check_jsonl(
             problems.append(f"{rel}: line {i + 1} has no \"type\"")
 
 
+def _walk_files(backend, root):
+    """Depth-first (dirs after their files, both sorted) ``(path, rel)``
+    pairs under ``root`` through the store backend — the one walk that
+    serves POSIX dirs and object-store prefixes (``http(s)://``/``s3://``)
+    alike, so conformance judges a diskless fleet's surviving state dir
+    exactly like a local one."""
+    prefix = root.rstrip("/")
+    stack = [prefix]
+    while stack:
+        d = stack.pop()
+        subdirs = []
+        for name in sorted(backend.listdir(d)):
+            path = backend.join(d, name)
+            if backend.isdir(path):
+                subdirs.append(path)
+                continue
+            yield path, path[len(prefix):].lstrip("/")
+        # reversed push: pop() then visits subdirs in sorted order
+        stack.extend(reversed(subdirs))
+
+
 def conformance_report(
     root: str,
 ) -> Tuple[List[str], List[str], int]:
-    """(problems, warnings, recognized_artifact_count) for one dir tree."""
+    """(problems, warnings, recognized_artifact_count) for one dir tree
+    (POSIX path or object-store prefix)."""
     problems: List[str] = []
     warnings: List[str] = []
     recognized = 0
     job_seqs: Dict[int, int] = {}  # filename seq -> record seq (or -1)
-    if not os.path.isdir(root):
+    backend = backend_for(root)
+    if not backend.isdir(root):
         return [f"{root}: not a directory"], warnings, 0
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames.sort()
-        for name in sorted(filenames):
-            if ".tmp" in name:
-                continue  # staging debris of a killed atomic writer
-            rel = os.path.relpath(os.path.join(dirpath, name), root)
-            schema = schema_for_filename(name)
-            if schema is None:
-                problems.append(
-                    f"{rel}: unknown file — no registered artifact "
-                    "pattern matches (analysis/protocols.py)"
+    for path, rel in _walk_files(backend, root):
+        name = os.path.basename(rel)
+        if ".tmp" in name:
+            continue  # staging debris of a killed atomic writer
+        schema = schema_for_filename(name)
+        if schema is None:
+            problems.append(
+                f"{rel}: unknown file — no registered artifact "
+                "pattern matches (analysis/protocols.py)"
+            )
+            continue
+        recognized += 1
+        if schema.jsonl:
+            _check_jsonl(backend, path, rel, schema, problems,
+                         warnings)
+            continue
+        try:
+            rec = json.loads(backend.read_bytes(path).decode("utf-8"))
+        except OSError as e:
+            problems.append(f"{rel}: unreadable: {e}")
+            continue
+        except ValueError:
+            if schema.torn_ok:
+                warnings.append(
+                    f"{rel}: torn record (killed writer) — readers "
+                    "age it from mtime; tolerated"
                 )
-                continue
-            recognized += 1
-            path = os.path.join(dirpath, name)
-            if schema.jsonl:
-                _check_jsonl(path, rel, schema, problems, warnings)
-                continue
-            try:
-                with open(path, "rb") as f:
-                    rec = json.loads(f.read().decode("utf-8"))
-            except OSError as e:
-                problems.append(f"{rel}: unreadable: {e}")
-                continue
-            except ValueError:
-                if schema.torn_ok:
-                    warnings.append(
-                        f"{rel}: torn record (killed writer) — readers "
-                        "age it from mtime; tolerated"
-                    )
-                else:
-                    problems.append(f"{rel}: unparsable JSON")
-                continue
-            _check_record(rel, rec, schema, problems)
-            m = _SERVE_JOB_ID_RE.match(name)
-            if m and isinstance(rec, dict):
-                seq = rec.get("seq")
-                job_seqs[int(m.group(1))] = (
-                    int(seq) if isinstance(seq, int) else -1
-                )
+            else:
+                problems.append(f"{rel}: unparsable JSON")
+            continue
+        _check_record(rel, rec, schema, problems)
+        m = _SERVE_JOB_ID_RE.match(name)
+        if m and isinstance(rec, dict):
+            seq = rec.get("seq")
+            job_seqs[int(m.group(1))] = (
+                int(seq) if isinstance(seq, int) else -1
+            )
     # serve-job density: ids are a dense sequence from j000001 — the fleet
     # admission recount and the stats index frontier both rely on it
     if job_seqs:

@@ -311,6 +311,26 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
         ),
         torn_ok=True,  # read_peers degrades a torn beat to {"torn": True}
     ),
+    ArtifactSchema(
+        name="supervisor_state",
+        pattern=r"^supervisor\.[A-Za-z0-9_.-]+\.json$",
+        description="ctt-diskless supervisor decision record, "
+        "observational only (never a scaling input)",
+        required={
+            "id": "str", "pid": "int", "wall": "number", "mono": "number",
+            "interval_s": "number", "seq": "int", "exiting": "bool",
+            "target_daemons": "int",
+        },
+        optional={
+            "host": "str", "active": "int", "action": "str",
+            "reason": "str",
+        },
+        producers=(("serve/supervisor.py", "_publish_state"),),
+        consumers=(),  # by design: a restarted supervisor reads beats
+        torn_ok=True,  # best-effort PUT, the beat convention
+        closed=True,
+        doc_in_trace=False,  # field list lives in serve/supervisor.py
+    ),
     # -- ctt-ingest control dir (the growing source's prefix) ---------------
     ArtifactSchema(
         name="ingest_manifest",
@@ -379,6 +399,7 @@ PRODUCER_MODULES = frozenset({
     "serve/jobs.py",
     "serve/fleet.py",
     "serve/server.py",
+    "serve/supervisor.py",
     "serve/admission.py",
     "obs/heartbeat.py",
     "obs/metrics.py",

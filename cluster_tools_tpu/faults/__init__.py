@@ -39,6 +39,11 @@ Sites (each named where the corresponding code path lives):
       per continuation page: the ctt-ingest watcher's poll primitive —
       chaos here models eventually-visible listings, which the per-page
       retry and the watcher's monotone frontier must absorb.
+  ``store.remote_auth``  — utils/store_backend.py request signing
+      (ctt-diskless): fires once per signed request, before the
+      Authorization header is computed — chaos models credential
+      hiccups (expired STS tokens, clock drift 403s), which surface as
+      retryable auth errors riding the same request-level retry.
   ``executor.block`` (ctx ``id``: block id) / ``executor.batch`` /
       ``executor.stage_read`` / ``executor.stage_compute`` /
       ``executor.stage_write``  — runtime/executor.py dispatch paths.
@@ -59,6 +64,11 @@ Sites (each named where the corresponding code path lives):
       ``torn`` truncates the ``daemon.<id>.json`` beat, and peer liveness
       readers must degrade to mtime ageing instead of crashing or
       misdeclaring the writer dead)  — serve/fleet.py (ctt-fleet).
+  ``fleet.supervisor`` (ctx ``id``: supervisor id; the supervisor's
+      decision round, before it observes the fleet — ``kill`` SIGKILLs
+      the supervisor mid-burst, the ctt-diskless chaos gate: a restarted
+      supervisor must re-adopt the fleet from beats alone)
+      — serve/supervisor.py.
 
 Actions: ``io_error`` (OSError EIO), ``fail`` (FaultInjected), ``kill``
 (``os._exit(KILL_EXIT_CODE)`` — a hard crash, no cleanup), ``stall``
@@ -128,6 +138,9 @@ SITE_DOCS: Dict[str, str] = {
         "utils/store_backend.py object-store PUT/DELETE round trip",
     "store.remote_list":
         "utils/store_backend.py listing GET page (the ctt-ingest poll)",
+    "store.remote_auth":
+        "utils/store_backend.py request signing (credential hiccups "
+        "surface as retryable auth errors)",
     "executor.block": "runtime/executor.py per-block dispatch (ctx `id`)",
     "executor.batch": "runtime/executor.py block-batch dispatch",
     "executor.stage_read": "runtime/executor.py pipelined read stage",
@@ -148,6 +161,9 @@ SITE_DOCS: Dict[str, str] = {
     "sched.requeue": "runtime/queue.py expired-lease takeover",
     "fleet.write":
         "serve/fleet.py daemon beat payloads (`torn`: mtime ageing)",
+    "fleet.supervisor":
+        "serve/supervisor.py decision round (`kill`: supervisor dies "
+        "mid-burst, successor re-adopts from beats)",
 }
 
 KNOWN_SITES = frozenset(SITE_DOCS)

@@ -643,6 +643,25 @@ def format_watch(snap: Dict[str, Any]) -> str:
             if isinstance(val, (int, float)):
                 parts.append(f"{label} {int(val)}")
         lines.append("  fleet: " + ", ".join(parts))
+    if (
+        "fleet.target_daemons" in gauges
+        or any(k.startswith("serve.supervisor_") for k in counters)
+    ):
+        # ctt-diskless: one line of elastic-fleet actuation — the daemon
+        # count the supervisor is converging toward, plus its action
+        # ledger (spawns, drains, and beats-only re-adoptions after a
+        # supervisor restart)
+        parts = []
+        for label, key, store in (
+            ("target", "fleet.target_daemons", gauges),
+            ("spawned", "serve.supervisor_spawns", counters),
+            ("drained", "serve.supervisor_drains", counters),
+            ("adopted", "serve.supervisor_adoptions", counters),
+        ):
+            val = store.get(key)
+            if isinstance(val, (int, float)):
+                parts.append(f"{label} {int(val)}")
+        lines.append("  supervisor: " + ", ".join(parts))
     if any(k.startswith("device.") for k in counters):
         # ctt-hbm: one line of device-pipeline health — bytes that crossed
         # to HBM vs uploads the warm buffer cache absorbed, dispatch

@@ -45,6 +45,12 @@ COUNTERS = frozenset({
     "store.remote_retries",
     "store.remote_bytes_read",
     "store.remote_bytes_written",
+    # ctt-diskless: S3 multipart uploads taken for oversized payloads
+    # (one count per whole upload, not per part), and requests the store
+    # rejected 401/403 — each such rejection surfaces as a retryable
+    # auth error riding the same request-level retry
+    "store.remote_multipart_uploads",
+    "store.remote_auth_retries",
     # utils/compile_cache.py — jax.monitoring persistent-cache events
     "compile_cache.cache_hits",
     "compile_cache.cache_misses",
@@ -158,6 +164,13 @@ COUNTERS = frozenset({
     "ingest.resumes",           # streams resumed from a persisted carry
     "ingest.poll_rounds",       # source listing scans (one per poll)
     "ingest.carry_bytes_persisted",  # carry-record bytes published
+    # serve/supervisor.py — ctt-diskless elastic-fleet actor
+    "serve.supervisor_spawns",  # daemon processes forked on scale-up
+    "serve.supervisor_drains",  # surplus daemons SIGTERMed into a drain
+    "serve.supervisor_adoptions",  # running daemons a (re)started
+                                   # supervisor found via beats without
+                                   # having spawned them — the
+                                   # SIGKILL-the-supervisor recovery path
 })
 
 # -- gauges (metrics.set_gauge) ---------------------------------------------
@@ -187,6 +200,9 @@ GAUGES = frozenset({
     # history before the fleet)
     "serve.peers",
     "fleet.queue_depth",
+    # serve/supervisor.py — ctt-diskless: the clamped daemon count the
+    # supervisor is converging the fleet toward
+    "fleet.target_daemons",
     # ingest/ — slabs landed (incl. out-of-order parked) but not yet
     # committed through the chain: the watcher/ingester gap
     "ingest.slabs_pending",

@@ -152,17 +152,22 @@ DEFAULT_TASK_CONFIG: Dict[str, Any] = {
 
 
 def _config_path(config_dir: str, name: str) -> str:
-    return os.path.join(config_dir, f"{name}.config")
+    from ..utils.store_backend import backend_for
+
+    backend = backend_for(config_dir)
+    return backend.join(config_dir, f"{name}.config")
 
 
 def write_config(config_dir: str, name: str, conf: Dict[str, Any]) -> str:
-    from ..utils.store_backend import atomic_write_bytes
+    from ..utils.store_backend import backend_for
 
-    os.makedirs(config_dir, exist_ok=True)
+    backend = backend_for(config_dir)
+    backend.makedirs(config_dir)
     path = _config_path(config_dir, name)
     # config dirs are shared state (serve daemons rewrite configs between
-    # jobs, workers re-read them) — a reader must never see a torn file
-    atomic_write_bytes(
+    # jobs, workers re-read them) — a reader must never see a torn file;
+    # backend writes are atomic on POSIX and single-object PUTs remotely
+    backend.write_bytes(
         path, json.dumps(conf, indent=2, sort_keys=True).encode()
     )
     return path
@@ -177,11 +182,14 @@ def write_global_config(config_dir: str, conf: Optional[Dict[str, Any]] = None) 
 def read_config(config_dir: Optional[str], name: str) -> Dict[str, Any]:
     if config_dir is None:
         return {}
+    from ..utils.store_backend import backend_for
+
+    backend = backend_for(config_dir)
     path = _config_path(config_dir, name)
-    if not os.path.exists(path):
+    try:
+        return json.loads(backend.read_bytes(path).decode())
+    except FileNotFoundError:
         return {}
-    with open(path) as f:
-        return json.load(f)
 
 
 def global_config(config_dir: Optional[str]) -> Dict[str, Any]:

@@ -15,6 +15,14 @@ they share the queue, enforce admission limits jointly, and fail over a
 dead peer's jobs within one heartbeat staleness window.  Flags override
 ``<state_dir>/serve.config`` which overrides
 ``runtime.config.DEFAULT_SERVE_CONFIG``.
+
+The state dir may be an **object-store prefix** (``http(s)://`` or
+``s3://``, ctt-diskless): every shared-state file — queue records,
+leases, beats, endpoint, config — then rides signed store requests and
+the daemon holds zero POSIX shared state.  To autoscale such a fleet,
+run ``python -m cluster_tools_tpu.serve.supervisor`` over the same
+prefix: it acts on :func:`serve.fleet.scale_advice`, spawning and
+draining daemons between a floor and a ceiling.
 """
 
 from __future__ import annotations

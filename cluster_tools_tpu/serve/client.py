@@ -12,13 +12,13 @@ explicitly.  Everything else is four tiny HTTP calls over loopback
 from __future__ import annotations
 
 import json
-import os
 import time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, Optional
 
 from ..obs import trace as obs_trace
+from ..utils import store_backend
 from .server import ENDPOINT_NAME
 
 __all__ = ["QuotaRejected", "ServeClient", "read_endpoint"]
@@ -33,8 +33,12 @@ class JobFailed(RuntimeError):
 
 
 def read_endpoint(state_dir: str) -> Dict[str, Any]:
-    with open(os.path.join(state_dir, ENDPOINT_NAME)) as f:
-        return json.load(f)
+    # routes through the store backend so ``http(s)://``/``s3://`` state
+    # dirs (ctt-diskless) resolve exactly like POSIX ones; on a remote
+    # store the credential that reads the prefix IS the authorization
+    backend = store_backend.backend_for(state_dir)
+    raw = backend.read_bytes(backend.join(state_dir, ENDPOINT_NAME))
+    return json.loads(raw.decode())
 
 
 class ServeClient:

@@ -54,7 +54,7 @@ from .. import faults
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import trace as obs_trace
 from ..runtime.queue import STALE_INTERVALS
-from ..utils.store import atomic_write_bytes
+from ..utils.store_backend import backend_for
 
 __all__ = [
     "FleetBeat",
@@ -85,7 +85,9 @@ def default_daemon_id() -> str:
 
 
 def beat_path(state_dir: str, daemon_id: str) -> str:
-    return os.path.join(state_dir, f"daemon.{daemon_id}.json")
+    # backend join: the state dir may be an object-store prefix
+    # (ctt-diskless) — beats then ride PUTs like every other state file
+    return backend_for(state_dir).join(state_dir, f"daemon.{daemon_id}.json")
 
 
 class FleetBeat:
@@ -108,6 +110,7 @@ class FleetBeat:
     ):
         self.state_dir = state_dir
         self.id = daemon_id
+        self._backend = backend_for(state_dir)
         self.path = beat_path(state_dir, daemon_id)
         try:
             self.interval_s = float(interval_s) if interval_s else 0.0
@@ -144,8 +147,9 @@ class FleetBeat:
             payload = json.dumps(rec, sort_keys=True).encode()
         torn = faults.mangle("fleet.write", payload, id=self.id)
         try:
-            atomic_write_bytes(self.path, torn if torn is not None else
-                               payload)
+            self._backend.write_bytes(
+                self.path, torn if torn is not None else payload
+            )
         except OSError:
             # best-effort, the heartbeat convention: a full disk costs a
             # spurious fast-path miss (peers fall back to lease ageing)
@@ -178,8 +182,11 @@ def read_peers(state_dir: str) -> Dict[str, Dict[str, Any]]:
     degrades to ``{"id": ..., "torn": True}`` with no ``wall`` stamp —
     callers age it from file mtime (:meth:`FleetView.is_dead` does)."""
     peers: Dict[str, Dict[str, Any]] = {}
+    backend = backend_for(state_dir)
     try:
-        names = os.listdir(state_dir)
+        # backend-routed: paginated continuation listing on a remote
+        # state dir — >1 page of peers scans complete, never truncated
+        names = backend.listdir(state_dir)
     except OSError:
         return peers
     for name in names:
@@ -187,13 +194,17 @@ def read_peers(state_dir: str) -> Dict[str, Dict[str, Any]]:
         if not m:
             continue
         pid = m.group(1)
-        path = os.path.join(state_dir, name)
+        path = backend.join(state_dir, name)
         try:
-            with open(path) as f:
-                rec = json.load(f)
+            rec = json.loads(backend.read_bytes(path).decode())
             if not isinstance(rec, dict):
                 rec = {"torn": True}
+        except FileNotFoundError:
+            continue  # beat vanished between listing and read
         except (OSError, ValueError):
+            # torn payload — or a transient remote read failure, which
+            # degrades the same safe way: mtime ageing of a FRESH beat
+            # never declares its writer dead
             rec = {"torn": True}
         rec.setdefault("id", pid)
         peers[pid] = rec
@@ -210,9 +221,26 @@ class FleetView:
         self.state_dir = state_dir
         self.self_id = self_id
         self.cache_ttl_s = float(cache_ttl_s)
+        self._backend = backend_for(state_dir)
+        self._remote = self._backend.is_remote
         self._lock = threading.Lock()
         self._cached: Optional[Dict[str, Dict[str, Any]]] = None
         self._cached_mono = -1.0
+        # first-seen-torn tracking (monotonic): the store-clock-skew
+        # guard for remote torn-beat ageing — see _beat_age_s
+        self._torn_seen: Dict[str, float] = {}
+        try:
+            self._clock_skew = float(
+                os.getenv("CTT_SCHED_CLOCK_SKEW_S") or 0.0
+            )
+        except (TypeError, ValueError):
+            self._clock_skew = 0.0
+
+    def _now(self) -> float:
+        # the injected-clock seam shared with runtime/queue.py and
+        # JobQueue: skew shifts every staleness judgement this reader
+        # makes, never the stamps writers publish
+        return time.time() + self._clock_skew  # ctt: noqa[CTT008] wall by design: beat stamps are cross-process wall times (mtime-ageing contract), not durations
 
     def peers(self, refresh: bool = False) -> Dict[str, Dict[str, Any]]:
         now = obs_trace.monotonic()
@@ -231,6 +259,7 @@ class FleetView:
 
     def _beat_age_s(self, daemon_id: str, rec: Dict[str, Any],
                     now: float) -> Optional[float]:
+        path = beat_path(self.state_dir, daemon_id)
         stamp = None
         try:
             stamp = float(rec["wall"])
@@ -238,12 +267,23 @@ class FleetView:
             pass
         if stamp is None:
             # torn beat: age from mtime, the torn-lease convention
-            try:
-                stamp = os.path.getmtime(
-                    beat_path(self.state_dir, daemon_id)
-                )
-            except OSError:
+            # (POSIX getmtime / remote Last-Modified HEAD)
+            mtime = self._backend.mtime(path)
+            if mtime is None:
                 return None
+            age = max(0.0, now - mtime)
+            if self._remote:
+                # Last-Modified carries the STORE's wall clock; cap the
+                # age by how long THIS process has actually watched the
+                # beat be torn (monotonic) so a store clock running
+                # behind can only delay a death verdict, never hasten it
+                now_mono = obs_trace.monotonic()
+                with self._lock:
+                    first = self._torn_seen.setdefault(path, now_mono)
+                age = min(age, max(0.0, now_mono - first))
+            return age
+        with self._lock:
+            self._torn_seen.pop(path, None)
         return max(0.0, now - stamp)
 
     def is_dead(self, daemon_id: str,
@@ -262,7 +302,7 @@ class FleetView:
         if rec.get("exiting"):
             return True
         if now is None:
-            now = time.time()
+            now = self._now()
         age = self._beat_age_s(daemon_id, rec, now)
         if age is None:
             return None  # beat vanished between scan and stat: unknown
@@ -277,7 +317,7 @@ class FleetView:
     def live(self, now: Optional[float] = None) -> Dict[str, Dict[str, Any]]:
         """The beating (non-dead, non-exiting) peers, id -> record."""
         if now is None:
-            now = time.time()
+            now = self._now()
         return {
             pid: rec for pid, rec in self.peers().items()
             if self.is_dead(pid, now=now) is False
@@ -295,7 +335,7 @@ def scale_advice(state_dir: str,
     numbers are reported and the action is ``hold``."""
     if view is None:
         view = FleetView(state_dir)
-    now = time.time()
+    now = view._now()
     live = view.live(now=now)
     capacity = 0
     draining = 0

@@ -66,7 +66,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..runtime.queue import STALE_INTERVALS, publish_once
 from ..utils.retry import backoff_delay_s
-from ..utils.store import atomic_write_bytes
+from ..utils.store_backend import backend_for
 
 __all__ = ["JobClaim", "JobQueue"]
 
@@ -100,8 +100,27 @@ class JobQueue:
     def __init__(self, root: str, lease_s: Optional[float] = None,
                  daemon_id: Optional[str] = None, fleet=None,
                  max_job_gens: Optional[int] = None):
-        os.makedirs(root, exist_ok=True)
+        # ctt-diskless: every file operation routes through the store
+        # backend, so ``root`` may be a POSIX dir OR an object-store
+        # prefix (``http(s)://``, ``s3://``) — listings then ride the
+        # paginated continuation GETs, existence probes are HEADs, and
+        # torn-record ageing falls back to Last-Modified
+        self._backend = backend_for(root)
+        self._join = self._backend.join
+        self._remote = self._backend.is_remote
+        self._backend.makedirs(root)
         self.dir = root
+        # remote torn-record ageing depends on the STORE's wall clock
+        # (Last-Modified); guard against skew by also tracking when THIS
+        # process first observed each torn record — see _stamp_age_s
+        self._torn_lock = threading.Lock()
+        self._torn_seen: Dict[str, float] = {}
+        try:
+            self._clock_skew = float(
+                os.getenv("CTT_SCHED_CLOCK_SKEW_S") or 0.0
+            )
+        except (TypeError, ValueError):
+            self._clock_skew = 0.0
         try:
             self.lease_s = float(lease_s) if lease_s else 0.0
         except (TypeError, ValueError):
@@ -139,6 +158,13 @@ class JobQueue:
         self._idx_lease_gen: Dict[str, int] = {}  # highest gen seen per jid
         self._idx_refreshed = -1e30  # monotonic stamp of the last refresh
 
+    def _now(self) -> float:
+        # the injected-clock seam shared with runtime/queue.py: skewing
+        # CTT_SCHED_CLOCK_SKEW_S shifts every staleness judgement this
+        # reader makes, without touching the authoritative stamps writers
+        # publish
+        return time.time() + self._clock_skew  # ctt: noqa[CTT008] wall by design: lease stamps are cross-process wall times (mtime-ageing contract), not durations
+
     def _index_advance_locked(self) -> None:
         """Advance the dense-id frontier: probe job.j<seq+1>.json forward
         until the first missing record.  Exact (no TTL): density means a
@@ -152,14 +178,14 @@ class JobQueue:
                 # distinguish "not published yet" (stop: the frontier)
                 # from "present but unreadable" (advance with defaults —
                 # a stalled frontier would hide every later job forever)
-                if not os.path.exists(
-                    os.path.join(self.dir, f"job.{jid}.json")
+                if not self._backend.exists(
+                    self._join(self.dir, f"job.{jid}.json")
                 ):
                     return
                 rec = {}
             self._idx_max_seq += 1
-            if not os.path.exists(
-                os.path.join(self.dir, f"result.{jid}.json")
+            if not self._backend.exists(
+                self._join(self.dir, f"result.{jid}.json")
             ):
                 self._idx_unfinished[jid] = {
                     "seq": int(rec.get("seq", self._idx_max_seq)),
@@ -175,24 +201,24 @@ class JobQueue:
         cached gen).  Work is bounded by the admission queue depth."""
         if now_mono - self._idx_refreshed < self.STATS_TTL_S:
             return
-        now = time.time()
+        now = self._now()
         for jid in list(self._idx_unfinished):
-            if os.path.exists(
-                os.path.join(self.dir, f"result.{jid}.json")
+            if self._backend.exists(
+                self._join(self.dir, f"result.{jid}.json")
             ):
                 del self._idx_unfinished[jid]
                 self._idx_lease_gen.pop(jid, None)
                 continue
             gen = self._idx_lease_gen.get(jid, -1)
-            while os.path.exists(
-                os.path.join(self.dir, f"lease.{jid}.g{gen + 1}.json")
+            while self._backend.exists(
+                self._join(self.dir, f"lease.{jid}.g{gen + 1}.json")
             ):
                 gen += 1
             running = False
             if gen >= 0:
                 self._idx_lease_gen[jid] = gen
                 state, _ = self._lease_state(
-                    os.path.join(self.dir, f"lease.{jid}.g{gen}.json"),
+                    self._join(self.dir, f"lease.{jid}.g{gen}.json"),
                     gen, now,
                 )
                 running = state == "live"
@@ -218,7 +244,10 @@ class JobQueue:
         leases: Dict[str, tuple] = {}
         results: set = set()
         try:
-            names = os.listdir(self.dir)
+            # backend-routed: POSIX os.listdir, or the paginated remote
+            # continuation (?limit=&marker=) — a >1-page state dir scans
+            # complete, never silently truncated
+            names = self._backend.listdir(self.dir)
         except OSError:
             names = []
         for name in names:
@@ -239,19 +268,20 @@ class JobQueue:
                 jid, g = m.group(1), int(m.group(2))
                 cur = leases.get(jid)
                 if cur is None or g > cur[0]:
-                    leases[jid] = (g, os.path.join(self.dir, name))
+                    leases[jid] = (g, self._join(self.dir, name))
         return sorted(jobs), admits, leases, results
 
     def _read_json(self, path: str) -> Optional[dict]:
         try:
-            with open(path) as f:
-                rec = json.load(f)
+            rec = json.loads(self._backend.read_bytes(path).decode())
             return rec if isinstance(rec, dict) else None
-        except (OSError, json.JSONDecodeError):
+        except (OSError, ValueError):
+            # absent, transient remote trouble, or torn JSON: all read as
+            # "no parseable record" — the mtime-ageing fallback covers torn
             return None
 
     def _record(self, job_id: str) -> Optional[dict]:
-        return self._read_json(os.path.join(self.dir, f"job.{job_id}.json"))
+        return self._read_json(self._join(self.dir, f"job.{job_id}.json"))
 
     def _owner_dead(self, owner: Optional[str]) -> bool:
         """Fast-path liveness (ctt-fleet): True only on positive evidence
@@ -260,6 +290,18 @@ class JobQueue:
         if not owner or self.fleet is None or owner == self.daemon_id:
             return False
         return self.fleet.is_dead(owner) is True
+
+    def _observed_age_s(self, path: str) -> float:
+        """Seconds since THIS process first saw ``path`` torn — monotonic,
+        so immune to every wall clock involved."""
+        now_mono = obs_trace.monotonic()
+        with self._torn_lock:
+            first = self._torn_seen.setdefault(path, now_mono)
+            return max(0.0, now_mono - first)
+
+    def _forget_torn(self, path: str) -> None:
+        with self._torn_lock:
+            self._torn_seen.pop(path, None)
 
     def _stamp_age_s(self, path: str, rec: Optional[dict],
                      now: float) -> float:
@@ -271,10 +313,22 @@ class JobQueue:
                 stamp = None
         if stamp is None:
             # torn record: age from mtime, the runtime/queue.py convention
-            try:
-                stamp = os.path.getmtime(path)
-            except OSError:
+            # (POSIX getmtime, or Last-Modified from a HEAD on a remote
+            # state dir)
+            mtime = self._backend.mtime(path)
+            if mtime is None:
                 return 0.0
+            age = max(0.0, now - mtime)
+            if self._remote:
+                # the remote mtime is stamped by the STORE's wall clock;
+                # a store clock running behind would inflate the age and
+                # expire a live lease early.  Cap by the locally-observed
+                # torn window (monotonic): a record can never be older to
+                # us than the time we have actually watched it be torn —
+                # skew can only delay expiry (safe), never hasten it.
+                age = min(age, self._observed_age_s(path))
+            return age
+        self._forget_torn(path)
         return max(0.0, now - stamp)
 
     def _lease_age_s(self, path: str, now: float) -> float:
@@ -320,7 +374,7 @@ class JobQueue:
             if not admitted:
                 rec["admitted"] = False
             if publish_once(
-                os.path.join(self.dir, f"job.{job_id}.json"),
+                self._join(self.dir, f"job.{job_id}.json"),
                 json.dumps(rec, sort_keys=True).encode(),
             ):
                 with self._idx_lock:
@@ -333,7 +387,7 @@ class JobQueue:
         """Publish the admit marker for a provisional record (first
         writer wins; a duplicate admit is a no-op)."""
         return publish_once(
-            os.path.join(self.dir, f"admit.{job_id}.json"),
+            self._join(self.dir, f"admit.{job_id}.json"),
             json.dumps({
                 "id": job_id,
                 "wall": time.time(),
@@ -346,7 +400,7 @@ class JobQueue:
         429 path of two-phase admission, and the limbo reaper's verdict
         for a submitter that died between the two phases)."""
         published = publish_once(
-            os.path.join(self.dir, f"result.{job_id}.json"),
+            self._join(self.dir, f"result.{job_id}.json"),
             json.dumps({
                 "id": job_id,
                 "ok": False,
@@ -391,7 +445,7 @@ class JobQueue:
         """Admitted, unfinished jobs with no live (or in-backoff) lease,
         in claim order (-priority, seq)."""
         jobs, admits, leases, results = self._scan()
-        now = time.time()
+        now = self._now()
         out = []
         for jid in jobs:
             if jid in results:
@@ -475,7 +529,7 @@ class JobQueue:
         released = 0
         for g in range(gens):
             lease = self._read_json(
-                os.path.join(self.dir, f"lease.{jid}.g{g}.json")
+                self._join(self.dir, f"lease.{jid}.g{g}.json")
             )
             if lease is not None and lease.get("released"):
                 released += 1
@@ -489,11 +543,11 @@ class JobQueue:
         failure_log = []
         for g in range(gens):
             lease = self._read_json(
-                os.path.join(self.dir, f"lease.{jid}.g{g}.json")
+                self._join(self.dir, f"lease.{jid}.g{g}.json")
             )
             failure_log.append(lease or {"gen": g, "torn": True})
         published = publish_once(
-            os.path.join(self.dir, f"result.{jid}.json"),
+            self._join(self.dir, f"result.{jid}.json"),
             json.dumps({
                 "id": jid,
                 "ok": False,
@@ -519,7 +573,7 @@ class JobQueue:
         both the single-claim path and the ctt-microbatch multi-claim;
         limbo records encountered along the way are reaped here."""
         jobs, admits, leases, results = self._scan()
-        now = time.time()
+        now = self._now()
         candidates: List[Tuple[dict, int, bool]] = []
         for jid in jobs:
             if jid in results:
@@ -558,7 +612,7 @@ class JobQueue:
             self._quarantine(jid, gen, rec)
             return None
         claim_wall = time.time()
-        path = os.path.join(self.dir, f"lease.{jid}.g{gen}.json")
+        path = self._join(self.dir, f"lease.{jid}.g{gen}.json")
         if publish_once(path, self._lease_payload(jid, gen, claim_wall)):
             if gen > 0:
                 obs_metrics.inc("serve.leases_requeued")
@@ -624,7 +678,7 @@ class JobQueue:
         return n
 
     def renew(self, claim: JobClaim) -> None:
-        atomic_write_bytes(
+        self._backend.write_bytes(
             claim.lease_path,
             self._lease_payload(claim.job_id, claim.gen, claim.claim_wall),
         )
@@ -637,7 +691,7 @@ class JobQueue:
         backoff, so any peer (or this daemon, post-drain) claims gen+1
         at once and resumes from the persisted carry.  Released
         generations are excluded from the quarantine budget."""
-        atomic_write_bytes(
+        self._backend.write_bytes(
             claim.lease_path,
             self._lease_payload(
                 claim.job_id, claim.gen, claim.claim_wall, released=True
@@ -656,7 +710,7 @@ class JobQueue:
             "finished_wall": time.time(),
         })
         published = publish_once(
-            os.path.join(self.dir, f"result.{claim.job_id}.json"),
+            self._join(self.dir, f"result.{claim.job_id}.json"),
             json.dumps(rec, sort_keys=True).encode(),
         )
         if published:
@@ -671,13 +725,13 @@ class JobQueue:
         if rec is None:
             return None
         result = self._read_json(
-            os.path.join(self.dir, f"result.{job_id}.json")
+            self._join(self.dir, f"result.{job_id}.json")
         )
         if result is not None:
             state = "done" if result.get("ok") else "failed"
         else:
             _, _, leases, _ = self._scan()
-            now = time.time()
+            now = self._now()
             if job_id in leases and self._lease_state(
                 leases[job_id][1], leases[job_id][0], now
             )[0] == "live":

@@ -53,6 +53,7 @@ import os
 import secrets
 import signal
 import socket
+import tempfile
 import threading
 import time
 import traceback
@@ -65,6 +66,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..runtime import config as cfg
 from ..runtime.workflow import ExecutionContext, build
+from ..utils import store_backend
 from . import fleet as fleet_mod
 from . import protocol
 from .admission import AdmissionController
@@ -97,7 +99,12 @@ def _write_private(path: str, payload: bytes) -> None:
 class ServeDaemon:
     def __init__(self, state_dir: str,
                  config: Optional[Dict[str, Any]] = None):
-        os.makedirs(state_dir, exist_ok=True)
+        # ctt-diskless: the state dir may be an object-store prefix
+        # (``http(s)://``, ``s3://``) — every shared-state file then rides
+        # the store backend and the daemon holds ZERO local shared state
+        self._backend = store_backend.backend_for(state_dir)
+        self._remote_state = self._backend.is_remote
+        self._backend.makedirs(state_dir)
         self.state_dir = state_dir
         conf = cfg.serve_config(state_dir)
         if config:
@@ -105,11 +112,18 @@ class ServeDaemon:
         self.config = conf
         # telemetry: join the ambient run when CTT_TRACE_DIR is set (CI,
         # bench), else trace into the state dir so /metrics and heartbeats
-        # are always live for scrapes
+        # are always live for scrapes.  Telemetry is per-process scratch,
+        # not shared state — with a remote state dir it goes to local tmp
         if not obs_trace.enabled() and not os.environ.get(obs_trace.ENV_DIR):
+            trace_dir = (
+                os.path.join(
+                    tempfile.gettempdir(), f"ctt-serve-trace-{os.getpid()}"
+                )
+                if self._remote_state
+                else os.path.join(state_dir, "trace")
+            )
             obs_trace.enable(
-                os.path.join(state_dir, "trace"),
-                f"serve_{os.getpid()}", export_env=False,
+                trace_dir, f"serve_{os.getpid()}", export_env=False,
             )
         # hbm_cache_mb: the daemon's warm device-buffer cache (ctt-hbm) —
         # the "HBM stays warm across jobs" half of the amortization story;
@@ -134,7 +148,8 @@ class ServeDaemon:
             state_dir, self.daemon_id, info_fn=self._beat_info,
         )
         self.jobs = JobQueue(
-            os.path.join(state_dir, "jobs"), lease_s=conf.get("lease_s"),
+            self._backend.join(state_dir, "jobs"),
+            lease_s=conf.get("lease_s"),
             daemon_id=self.daemon_id, fleet=self.fleet,
             max_job_gens=conf.get("max_job_gens"),
         )
@@ -209,10 +224,18 @@ class ServeDaemon:
             "run_id": obs_trace.current_run_id(),
             "token": self.token,
         }
-        _write_private(
-            os.path.join(self.state_dir, ENDPOINT_NAME),
-            json.dumps(endpoint, sort_keys=True).encode(),
-        )
+        payload = json.dumps(endpoint, sort_keys=True).encode()
+        if self._remote_state:
+            # on an object store the credential that reads the state dir
+            # IS the trust boundary (there is no POSIX mode to narrow);
+            # holding store keys already implies submit rights
+            self._backend.write_bytes(
+                self._backend.join(self.state_dir, ENDPOINT_NAME), payload
+            )
+        else:
+            _write_private(
+                os.path.join(self.state_dir, ENDPOINT_NAME), payload
+            )
         self._publish_gauges()
         return endpoint
 

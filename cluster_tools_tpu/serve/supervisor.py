@@ -1,0 +1,389 @@
+"""ctt-diskless supervisor: ACT on :func:`serve.fleet.scale_advice`.
+
+``scale_advice`` is advice only — the fleet never forks daemons.  This
+module is the actor: a :class:`Supervisor` polls the shared state dir
+(POSIX or an object-store prefix — ``http(s)://``/``s3://``), compares
+fleet-wide backlog against live capacity, and converges the daemon count
+toward a clamped target by spawning real ``python -m
+cluster_tools_tpu.serve`` processes or draining surplus ones (SIGTERM —
+the daemon's drain path: in-flight jobs finish, queued jobs stay
+durable).
+
+The supervisor is **stateless by construction**: every input to a
+scaling decision lives in the state dir (fleet beats, job records), and
+the supervisor's own ``supervisor.<id>.json`` record is published there
+too — purely observational output, never read back for decisions.  A
+supervisor SIGKILLed mid-burst and restarted re-adopts the running fleet
+from beats alone (counted in ``serve.supervisor_adoptions``) and resumes
+scaling as if it had never died.  The in-memory child-process table is a
+*preference* (drain own children first, cheap reaping), not a source of
+truth.
+
+Pacing: at most ONE spawn or drain per poll round, and an own child
+that is alive but not yet beating counts as *pending* capacity (for
+``spawn_grace_s`` after its spawn) — a daemon takes longer to publish
+its first beat than a poll round, and spawning again before the beat
+lands would overshoot the ceiling.  Capacity changes take a heartbeat
+cadence to show up in beats; acting faster than the feedback loop
+oscillates.
+
+Cross-host scope: the default drain path signals by pid and therefore
+only reaches daemons on the supervisor's own host (own children, or a
+pid the beat proves is local).  Multi-host fleets inject ``drain_fn``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import faults
+from ..obs import heartbeat as obs_heartbeat
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..utils.store_backend import backend_for
+from .fleet import FleetView, scale_advice
+from .jobs import JobQueue
+
+__all__ = ["Supervisor", "default_supervisor_id", "main"]
+
+
+def default_supervisor_id() -> str:
+    """``sup-<host>-<pid>``: unique per supervisor process; a restarted
+    supervisor gets a fresh id and its predecessor's state record simply
+    ages out (the record is observational, never a decision input)."""
+    host = socket.gethostname().split(".")[0] or "host"
+    return f"sup-{host}-{os.getpid()}"
+
+
+class Supervisor:
+    """Elastic-fleet actor over one shared state dir.
+
+    ``spawn_fn(daemon_id) -> handle`` and ``drain_fn(daemon_id, rec)``
+    are injection seams (tests drive scaling without real processes);
+    the defaults spawn ``python -m cluster_tools_tpu.serve`` children
+    and SIGTERM by beat pid.  ``poll_once()`` is the whole control step
+    — public so tests and the CLI ``--once`` mode can single-step it.
+    """
+
+    def __init__(
+        self,
+        state_dir: str,
+        min_daemons: int = 1,
+        max_daemons: int = 3,
+        poll_s: Optional[float] = None,
+        daemon_args: Optional[List[str]] = None,
+        spawn_fn: Optional[Callable[[str], Any]] = None,
+        drain_fn: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+        supervisor_id: Optional[str] = None,
+    ):
+        self._backend = backend_for(state_dir)
+        self._backend.makedirs(state_dir)
+        self.state_dir = state_dir
+        self.id = supervisor_id or default_supervisor_id()
+        self.min_daemons = max(int(min_daemons), 0)
+        self.max_daemons = max(int(max_daemons), self.min_daemons)
+        try:
+            self.poll_s = float(poll_s) if poll_s else 0.0
+        except (TypeError, ValueError):
+            self.poll_s = 0.0
+        if self.poll_s <= 0:
+            self.poll_s = obs_heartbeat.interval_s()
+        self.daemon_args = list(daemon_args or [])
+        self._spawn_fn = spawn_fn
+        self._drain_fn = drain_fn
+        # own children this incarnation: daemon_id -> subprocess handle.
+        # Convenience only — a restarted supervisor has an empty table
+        # and still manages the fleet correctly through beats.
+        self._procs: Dict[str, Any] = {}
+        self._spawn_times: Dict[str, float] = {}  # daemon_id -> monotonic
+        # how long an own child may live un-beating before it stops
+        # counting as pending capacity (hung-startup escape hatch)
+        self.spawn_grace_s = 30.0
+        # flicker damping: a daemon seen live this recently still counts
+        # as capacity even when its current beat reads stale — on a
+        # loaded host a beat delayed one staleness window is overwhelming
+        # likely a scheduling hiccup, and replacing it would overshoot.
+        # Reaped children and ``exiting`` beats bypass the grace (positive
+        # death evidence), so only genuinely ambiguous silence is damped.
+        self.flicker_grace_s = max(2.0 * self.poll_s, 5.0)
+        self._seen_live: Dict[str, float] = {}  # daemon_id -> monotonic
+        self._known: set = set()  # daemon ids already counted (adoption)
+        self._spawn_seq = 0
+        self._seq = 0
+        self._exiting = False
+        self._stop = threading.Event()
+        # queue accounting reuses the daemon's own stats path (dense-seq
+        # index, paginated listings on remote stores)
+        self._jobs = JobQueue(self._backend.join(state_dir, "jobs"))
+
+    # -- control step --------------------------------------------------------
+
+    def poll_once(self) -> Dict[str, Any]:
+        """One decision round: observe (beats + queue), compute the
+        clamped target, act (at most one spawn OR one drain), publish
+        the supervisor state record.  Returns the advice dict augmented
+        with ``target``/``acted`` for callers that introspect."""
+        faults.check("fleet.supervisor", id=self.id)
+        self._reap()
+        view = FleetView(self.state_dir)
+        stats = self._jobs.stats()
+        live = view.live()
+        for daemon_id in live:
+            if daemon_id not in self._known:
+                self._known.add(daemon_id)
+                if daemon_id not in self._procs:
+                    # running daemon we never spawned: a restarted
+                    # supervisor re-adopting its predecessor's fleet
+                    obs_metrics.inc("serve.supervisor_adoptions")
+        advice = scale_advice(self.state_dir, stats=stats, view=view)
+        active = int(advice["daemons"]) - int(advice["draining"])
+        target = active
+        if advice["action"] == "spawn":
+            target = active + 1
+        elif advice["action"] == "drain":
+            target = active - 1
+        target = min(max(target, self.min_daemons), self.max_daemons)
+        obs_metrics.set_gauge("fleet.target_daemons", target)
+        # pending: own children provably alive (poll() is None) whose
+        # first beat has not landed yet — already-bought capacity, so a
+        # faster-than-heartbeat poll cadence cannot overshoot the ceiling
+        now = time.monotonic()
+        for daemon_id in live:
+            self._seen_live[daemon_id] = now
+            self._spawn_times.pop(daemon_id, None)
+        for daemon_id, rec in view.peers().items():
+            if rec.get("exiting"):
+                # a clean exit is positive death evidence: no flicker
+                # grace (a drained daemon must not suppress a spawn)
+                self._seen_live.pop(daemon_id, None)
+        pending = 0
+        for daemon_id, proc in self._procs.items():
+            if daemon_id in live or daemon_id in self._seen_live:
+                continue  # beating (or flicker-covered below)
+            poll = getattr(proc, "poll", None)
+            if poll is None or poll() is not None:
+                continue  # opaque handle (tests) or exited: beats decide
+            born = self._spawn_times.get(daemon_id)
+            if born is not None and now - born <= self.spawn_grace_s:
+                pending += 1
+        # flicker: recently-live daemons whose beat went stale this very
+        # moment — damped capacity, not a death verdict (a SIGKILLed
+        # daemon stops beating for good and ages past the grace)
+        flicker = 0
+        for daemon_id, seen in list(self._seen_live.items()):
+            if daemon_id in live:
+                continue
+            if now - seen <= self.flicker_grace_s:
+                flicker += 1
+            else:
+                del self._seen_live[daemon_id]
+        acted = "hold"
+        if target > active + pending + flicker:
+            self._spawn_one()
+            acted = "spawn"
+        elif target < active:
+            acted = "drain" if self._drain_one(live) else "hold"
+        advice = dict(advice)
+        advice["target"] = target
+        advice["acted"] = acted
+        self._publish_state(advice)
+        obs_metrics.flush()
+        return advice
+
+    def _reap(self) -> None:
+        for daemon_id, proc in list(self._procs.items()):
+            poll = getattr(proc, "poll", None)
+            if poll is not None and poll() is not None:
+                del self._procs[daemon_id]
+                self._spawn_times.pop(daemon_id, None)
+                # a reaped child is positive death evidence: no flicker
+                # grace, its replacement can spawn this round
+                self._seen_live.pop(daemon_id, None)
+
+    def _spawn_one(self) -> None:
+        daemon_id = f"{self.id}-d{self._spawn_seq}"
+        self._spawn_seq += 1
+        if self._spawn_fn is not None:
+            handle = self._spawn_fn(daemon_id)
+        else:
+            handle = subprocess.Popen(
+                [
+                    sys.executable, "-m", "cluster_tools_tpu.serve",
+                    "--state-dir", self.state_dir,
+                    "--daemon-id", daemon_id,
+                ]
+                + self.daemon_args
+            )
+        self._procs[daemon_id] = handle
+        self._spawn_times[daemon_id] = time.monotonic()
+        self._known.add(daemon_id)
+        obs_metrics.inc("serve.supervisor_spawns")
+
+    def _drain_one(self, live: Dict[str, Dict[str, Any]]) -> bool:
+        """SIGTERM one surplus daemon (its drain path, not a kill).
+        Prefers own children; falls back to a live peer whose beat pid
+        is reachable on this host.  Returns whether anyone was told."""
+        victims = [
+            d for d, rec in live.items() if not rec.get("draining")
+        ]
+        victims.sort(key=lambda d: (d not in self._procs, d))
+        for daemon_id in victims:
+            rec = live[daemon_id]
+            if self._drain_fn is not None:
+                self._drain_fn(daemon_id, rec)
+            else:
+                try:
+                    pid = int(rec.get("pid") or 0)
+                except (TypeError, ValueError):
+                    pid = 0
+                if pid <= 0:
+                    continue
+                if daemon_id not in self._procs:
+                    try:
+                        os.kill(pid, 0)  # local-host guard: pid exists?
+                    except OSError:
+                        continue  # foreign host (or gone): not ours
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    continue
+            obs_metrics.inc("serve.supervisor_drains")
+            return True
+        return False
+
+    # -- state record ---------------------------------------------------------
+
+    def _publish_state(self, advice: Dict[str, Any]) -> None:
+        """``supervisor.<id>.json``: the heartbeat-shaped observational
+        record (analysis/protocols.py ``supervisor_state`` schema).
+        Best-effort, the beat convention — a failed PUT costs one stale
+        observation, never a scaling decision."""
+        rec = {
+            "id": self.id,
+            "pid": os.getpid(),
+            "host": socket.gethostname().split(".")[0] or "host",
+            "wall": time.time(),
+            "mono": obs_trace.monotonic(),
+            "interval_s": self.poll_s,
+            "seq": self._seq,
+            "exiting": self._exiting,
+            "target_daemons": int(advice.get("target", 0)),
+            "active": int(advice.get("daemons", 0))
+            - int(advice.get("draining", 0)),
+            "action": str(advice.get("acted", "hold")),
+            "reason": str(advice.get("reason", "")),
+        }
+        self._seq += 1
+        try:
+            self._backend.write_bytes(
+                self._backend.join(
+                    self.state_dir, f"supervisor.{self.id}.json"
+                ),
+                json.dumps(rec, sort_keys=True).encode(),
+            )
+        except OSError:
+            pass
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        def _stop(signum, frame):
+            self._stop.set()
+
+        signal.signal(signal.SIGTERM, _stop)
+        signal.signal(signal.SIGINT, _stop)
+
+    def run(self) -> int:
+        """Poll until SIGTERM/SIGINT.  Exiting leaves the fleet RUNNING
+        — daemons are durable state-dir citizens, and the next
+        supervisor (or the restarted same one) re-adopts them from
+        beats; that asymmetry is what makes SIGKILLing the supervisor
+        harmless."""
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except OSError:
+                # store hiccup mid-poll: skip the round, the next one
+                # re-observes from scratch (no carried state to corrupt)
+                pass
+            self._stop.wait(self.poll_s)
+        self._exiting = True
+        try:
+            self._publish_state({"target": 0, "acted": "exit",
+                                 "reason": "supervisor stopped"})
+        except OSError:
+            pass
+        obs_metrics.flush()
+        return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m cluster_tools_tpu.serve.supervisor",
+        description="ctt-diskless: act on fleet scale advice — spawn or "
+        "drain serve daemons over a shared (object-store or POSIX) "
+        "state dir",
+    )
+    parser.add_argument("--state-dir", required=True,
+                        help="shared state dir; POSIX path or "
+                        "http(s):// / s3:// object-store prefix")
+    parser.add_argument("--min", type=int, default=1, dest="min_daemons",
+                        help="daemon floor (default 1)")
+    parser.add_argument("--max", type=int, default=3, dest="max_daemons",
+                        help="daemon ceiling (default 3)")
+    parser.add_argument("--poll-s", type=float, default=None,
+                        help="decision cadence (default: heartbeat "
+                        "interval)")
+    parser.add_argument("--once", action="store_true",
+                        help="single decision round, then exit (smoke "
+                        "and debugging)")
+    parser.add_argument("--daemon-arg", action="append", default=[],
+                        help="extra arg passed through to each spawned "
+                        "daemon (repeatable), e.g. --daemon-arg "
+                        "--concurrency --daemon-arg 2")
+    args = parser.parse_args(argv)
+
+    # telemetry mirrors the daemon: join the ambient run when
+    # CTT_TRACE_DIR is set, else trace locally (tmp for remote state
+    # dirs — telemetry is per-process scratch, not shared state)
+    if not obs_trace.enabled() and not os.environ.get(obs_trace.ENV_DIR):
+        backend = backend_for(args.state_dir)
+        trace_dir = (
+            os.path.join(tempfile.gettempdir(),
+                         f"ctt-supervisor-trace-{os.getpid()}")
+            if backend.is_remote
+            else os.path.join(args.state_dir, "trace")
+        )
+        obs_trace.enable(trace_dir, f"supervisor_{os.getpid()}",
+                         export_env=False)
+
+    sup = Supervisor(
+        args.state_dir,
+        min_daemons=args.min_daemons,
+        max_daemons=args.max_daemons,
+        poll_s=args.poll_s,
+        daemon_args=args.daemon_arg,
+    )
+    sup.install_signal_handlers()
+    print(f"[supervisor] {sup.id} over {args.state_dir} "
+          f"(min {sup.min_daemons}, max {sup.max_daemons}, "
+          f"poll {sup.poll_s:.2f}s)", flush=True)
+    if args.once:
+        advice = sup.poll_once()
+        print(json.dumps(advice, sort_keys=True), flush=True)
+        return 0
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

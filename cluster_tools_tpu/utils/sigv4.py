@@ -1,0 +1,187 @@
+"""ctt-diskless: AWS Signature Version 4 request signing (stdlib only).
+
+The object-store backend (``utils/store_backend.py``) signs every HTTP
+request with SigV4 so the serve fleet can live on a real S3-compatible
+store instead of the unauthenticated stub.  This module owns the pure
+signing math and the credential resolution; the backend owns *when* to
+sign (``s3://`` paths always, ``http(s)://`` origins when
+``CTT_S3_SIGN`` opts in).
+
+Credential resolution order (:func:`resolve_credentials`):
+
+  1. environment — ``AWS_ACCESS_KEY_ID`` + ``AWS_SECRET_ACCESS_KEY``
+     (+ optional ``AWS_SESSION_TOKEN``);
+  2. shared credentials file — ``AWS_SHARED_CREDENTIALS_FILE`` (default
+     ``~/.aws/credentials``), profile ``AWS_PROFILE`` (default
+     ``default``), the standard ini layout.
+
+Returns None when neither yields a key pair: the backend then sends
+unsigned requests, and a signing store rejects them with 403 — which the
+backend surfaces as a *retryable* auth error (``store.remote_auth_retries``),
+never as a silent fallback.
+
+Canonicalization notes (kept bit-compatible with the verifying twin in
+``tests/objstub.py``, which re-derives the signature from the raw
+request):
+
+  * the canonical URI is the percent-encoded request path exactly as
+    sent (the backend's ``_key`` quoting IS the encoding);
+  * query params are normalized ``k=v`` pairs (a bare ``uploads`` flag
+    becomes ``uploads=``) sorted lexicographically;
+  * signed headers are ``host``, ``x-amz-content-sha256``,
+    ``x-amz-date`` (+ ``x-amz-security-token`` with session creds).
+"""
+
+from __future__ import annotations
+
+import configparser
+import hashlib
+import hmac
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+__all__ = [
+    "Credentials",
+    "SigV4Signer",
+    "canonical_query",
+    "default_region",
+    "resolve_credentials",
+]
+
+_ALGORITHM = "AWS4-HMAC-SHA256"
+
+
+@dataclass(frozen=True)
+class Credentials:
+    access_key: str
+    secret_key: str
+    session_token: Optional[str] = None
+
+
+def default_region() -> str:
+    return (
+        os.environ.get("AWS_REGION")
+        or os.environ.get("AWS_DEFAULT_REGION")
+        or "us-east-1"
+    )
+
+
+def resolve_credentials() -> Optional[Credentials]:
+    """Env first, then the shared credentials file; None when absent."""
+    access = os.environ.get("AWS_ACCESS_KEY_ID")
+    secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
+    if access and secret:
+        return Credentials(
+            access, secret, os.environ.get("AWS_SESSION_TOKEN") or None
+        )
+    path = os.environ.get("AWS_SHARED_CREDENTIALS_FILE") or os.path.join(
+        os.path.expanduser("~"), ".aws", "credentials"
+    )
+    if not os.path.exists(path):
+        return None
+    profile = os.environ.get("AWS_PROFILE") or "default"
+    parser = configparser.ConfigParser()
+    try:
+        parser.read(path)
+        section = parser[profile]
+        access = section.get("aws_access_key_id")
+        secret = section.get("aws_secret_access_key")
+        token = section.get("aws_session_token")
+    except (configparser.Error, KeyError):
+        return None
+    if not access or not secret:
+        return None
+    return Credentials(access, secret, token or None)
+
+
+def canonical_query(query: Optional[str]) -> str:
+    """Normalized, sorted query string for the canonical request.  Our
+    queries are pre-encoded (``_key`` quoting / literal params), so
+    canonicalization is normalize-bare-flags + sort — applied identically
+    by the signer and the stub's verifier."""
+    if not query:
+        return ""
+    params = []
+    for param in query.split("&"):
+        if not param:
+            continue
+        params.append(param if "=" in param else param + "=")
+    return "&".join(sorted(params))
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(secret_key: str, datestamp: str, region: str,
+                service: str) -> bytes:
+    """The SigV4 derived key chain (exposed for the stub's verifier)."""
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+class SigV4Signer:
+    def __init__(self, creds: Credentials, region: Optional[str] = None,
+                 service: str = "s3"):
+        self.creds = creds
+        self.region = region or default_region()
+        self.service = service
+
+    def sign_headers(
+        self,
+        method: str,
+        key: str,
+        query: Optional[str],
+        payload: Optional[bytes],
+        host: str,
+        amz_date: Optional[str] = None,
+    ) -> Dict[str, str]:
+        """Headers to attach to one request: ``host``, ``x-amz-date``,
+        ``x-amz-content-sha256``, ``authorization`` (+ session token).
+        ``key`` is the percent-encoded request path as sent on the wire;
+        ``query`` the raw (pre-encoded) query string or None."""
+        if amz_date is None:
+            amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        datestamp = amz_date[:8]
+        payload_hash = hashlib.sha256(payload or b"").hexdigest()
+        headers = {
+            "host": host,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        if self.creds.session_token:
+            headers["x-amz-security-token"] = self.creds.session_token
+        signed_names = ";".join(sorted(headers))
+        canonical = "\n".join([
+            method.upper(),
+            key,
+            canonical_query(query),
+            "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+            signed_names,
+            payload_hash,
+        ])
+        scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
+        string_to_sign = "\n".join([
+            _ALGORITHM,
+            amz_date,
+            scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+        key_bytes = signing_key(
+            self.creds.secret_key, datestamp, self.region, self.service
+        )
+        signature = hmac.new(
+            key_bytes, string_to_sign.encode(), hashlib.sha256
+        ).hexdigest()
+        out = dict(headers)
+        out["authorization"] = (
+            f"{_ALGORITHM} "
+            f"Credential={self.creds.access_key}/{scope}, "
+            f"SignedHeaders={signed_names}, "
+            f"Signature={signature}"
+        )
+        return out

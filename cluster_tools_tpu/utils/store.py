@@ -79,6 +79,7 @@ Object-store backend (ctt-cloud):
 from __future__ import annotations
 
 import gzip
+import io
 import os
 import struct
 import threading
@@ -1105,64 +1106,53 @@ class RaggedDataset:
     META = ".ragged.json"
 
     def __init__(self, path: str):
-        if is_remote_path(path):
-            # ragged scratch serializes straight through np.save/np.load;
-            # it lives in the LOCAL tmp_folder by construction, so a remote
-            # path here is a caller bug, not a missing feature
-            raise NotImplementedError(
-                "ragged datasets are POSIX-only (scratch data stays local)"
-            )
+        # ctt-diskless: ragged scratch may live on an object-store prefix
+        # — chunks serialize through an in-memory .npy buffer and ride
+        # backend PUTs/GETs (oversized chunks take the multipart path)
+        self._backend = backend_for(path)
         self.path = path
-        meta = _read_json(os.path.join(path, self.META))
+        meta = _read_json(self._backend.join(path, self.META))
         self.grid_shape = tuple(meta["grid_shape"])
         self.dtype = np.dtype(meta["dtype"])
-        self.attrs = Attributes(os.path.join(path, ".zattrs"))
+        self.attrs = Attributes(self._backend.join(path, ".zattrs"))
 
     @classmethod
     def create(cls, path: str, grid_shape: Sequence[int], dtype) -> "RaggedDataset":
-        if is_remote_path(path):
-            raise NotImplementedError(
-                "ragged datasets are POSIX-only (scratch data stays local)"
-            )
-        os.makedirs(path, exist_ok=True)
+        backend = backend_for(path)
+        backend.makedirs(path)
         _write_json(
-            os.path.join(path, cls.META),
+            backend.join(path, cls.META),
             {"grid_shape": list(grid_shape), "dtype": np.dtype(dtype).str},
         )
         return cls(path)
 
     @classmethod
     def exists(cls, path: str) -> bool:
-        if is_remote_path(path):
-            return False  # ragged data never lives remote (see __init__)
-        return os.path.exists(os.path.join(path, cls.META))
+        backend = backend_for(path)
+        return backend.exists(backend.join(path, cls.META))
 
     def _chunk_path(self, grid_pos) -> str:
         if isinstance(grid_pos, (int, np.integer)):
             grid_pos = np.unravel_index(int(grid_pos), self.grid_shape)
-        return os.path.join(self.path, ".".join(str(p) for p in grid_pos) + ".npy")
+        return self._backend.join(
+            self.path, ".".join(str(p) for p in grid_pos) + ".npy"
+        )
 
     def read_chunk(self, grid_pos) -> Optional[np.ndarray]:
         p = self._chunk_path(grid_pos)
-        if not os.path.exists(p):
+        try:
+            raw = self._backend.read_bytes(p)
+        except FileNotFoundError:
             return None
-        return np.load(p)
+        return np.load(io.BytesIO(raw), allow_pickle=False)
 
     def write_chunk(self, grid_pos, data: np.ndarray) -> None:
         p = self._chunk_path(grid_pos)
-        tmp = p + f".tmp{os.getpid()}.npy"
-        try:
-            np.save(tmp, np.asarray(data, dtype=self.dtype))
-            if _FSYNC:
-                with open(tmp, "rb+") as f:
-                    os.fsync(f.fileno())
-            os.replace(tmp, p)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(data, dtype=self.dtype))
+        # backend write: atomic tmp+replace on POSIX (fsync per _FSYNC),
+        # single PUT — or multipart above the threshold — on a store
+        self._backend.write_bytes(p, buf.getvalue())
 
 
 class Group:

@@ -65,12 +65,37 @@ sent) is returned short on purpose: the chunk decode classifies it as
 :class:`CorruptChunk`, exactly like a torn POSIX write, so the same
 retry/heal machinery applies.
 
+Authentication (ctt-diskless): requests carry an AWS Signature V4
+``Authorization`` header (``utils/sigv4.py``) when the origin demands it
+— always for ``s3://bucket/key`` paths (mapped path-style onto
+``CTT_S3_ENDPOINT``), and for plain ``http(s)://`` origins when
+``CTT_S3_SIGN=1`` opts in.  Credentials come from the environment
+(``AWS_ACCESS_KEY_ID``/``AWS_SECRET_ACCESS_KEY``) or the shared
+credentials file; with none resolvable the request goes out unsigned and
+a signing store answers 401/403, which surfaces as a *retryable*
+``OSError`` under the ``store.remote_auth_retries`` counter — loud after
+the backoff gives up, never a silent downgrade.  The signing step has
+its own fault site (``store.remote_auth``) so chaos runs can exercise
+credential trouble separately from wire trouble.
+
+Large PUTs (ctt-diskless): payloads above ``CTT_REMOTE_MULTIPART_MB``
+ride the S3 multipart protocol — initiate (``POST ?uploads``), parallel
+part PUTs on the range pool with per-part retry, complete (``POST
+?uploadId=``), abort on failure — counted by
+``store.remote_multipart_uploads``.  ``publish_once`` stays a single
+create-only PUT (the claim must be atomic).
+
 Knobs (env, read once per process):
 
-  ``CTT_REMOTE_THREADS``    chunk fan-out + multipart pool width (default 16)
-  ``CTT_REMOTE_TIMEOUT_S``  per-request socket timeout (default 30)
-  ``CTT_REMOTE_RANGE_MB``   objects larger than this split into parallel
-                            range GETs (default 8; 0 = never split)
+  ``CTT_REMOTE_THREADS``      chunk fan-out + multipart pool width (default 16)
+  ``CTT_REMOTE_TIMEOUT_S``    per-request socket timeout (default 30)
+  ``CTT_REMOTE_RANGE_MB``     objects larger than this split into parallel
+                              range GETs (default 8; 0 = never split)
+  ``CTT_REMOTE_MULTIPART_MB`` PUT payloads above this upload multipart
+                              (default 8; 0 = never)
+  ``CTT_S3_SIGN``             =1: SigV4-sign plain http(s) origins too
+  ``CTT_S3_ENDPOINT``         gateway origin for ``s3://`` paths (default
+                              ``https://s3.<region>.amazonaws.com``)
 """
 
 from __future__ import annotations
@@ -79,6 +104,7 @@ import errno
 import http.client
 import json
 import os
+import re
 import shutil
 import threading
 import urllib.parse
@@ -87,6 +113,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import faults
 from ..obs import metrics as obs_metrics
+from . import sigv4
 
 __all__ = [
     "StoreBackend", "PosixBackend", "HttpBackend", "CorruptChunk",
@@ -285,13 +312,31 @@ class HttpBackend(StoreBackend):
     is_remote = True
     retry_counter = "store.remote_retries"
 
-    def __init__(self, origin: str):
+    def __init__(self, origin: str, alias: Optional[str] = None,
+                 alias_prefix: str = ""):
         parsed = urllib.parse.urlsplit(origin)
         if parsed.scheme not in ("http", "https"):
             raise ValueError(f"unsupported remote scheme in {origin!r}")
         self.origin = f"{parsed.scheme}://{parsed.netloc}"
         self._scheme = parsed.scheme
         self._netloc = parsed.netloc
+        # ``s3://bucket`` paths ride a plain HTTP gateway path-style:
+        # alias is the virtual origin ("s3://bucket"), alias_prefix the
+        # key prefix it maps to ("/bucket")
+        self._alias = alias
+        self._alias_prefix = alias_prefix
+        # signing is ARMED per origin (s3:// always; http(s) by opt-in);
+        # a signer only exists when credentials resolve — armed-but-
+        # credential-less sends unsigned and lets the store say 403
+        self._sign = alias is not None or (
+            os.environ.get("CTT_S3_SIGN", "").lower()
+            in ("1", "true", "on", "yes")
+        )
+        self._signer: Optional[sigv4.SigV4Signer] = None
+        if self._sign:
+            creds = sigv4.resolve_credentials()
+            if creds is not None:
+                self._signer = sigv4.SigV4Signer(creds)
         self._tls = threading.local()
         self._pool_lock = threading.Lock()
         # two PERSISTENT pools (threads keep their keep-alive connections
@@ -307,6 +352,9 @@ class HttpBackend(StoreBackend):
         self.range_bytes = int(
             _env_pos_float("CTT_REMOTE_RANGE_MB", 8.0) * 1024 * 1024
         )
+        self.multipart_bytes = int(
+            _env_pos_float("CTT_REMOTE_MULTIPART_MB", 8.0) * 1024 * 1024
+        )
 
     # -- connection plumbing -------------------------------------------------
 
@@ -321,7 +369,9 @@ class HttpBackend(StoreBackend):
 
     def _key(self, path: str) -> str:
         """The request target for a full URL of this origin."""
-        if path.startswith(self.origin):
+        if self._alias and path.startswith(self._alias):
+            key = self._alias_prefix + path[len(self._alias):]
+        elif path.startswith(self.origin):
             key = path[len(self.origin):]
         else:
             key = urllib.parse.urlsplit(path).path
@@ -389,7 +439,7 @@ class HttpBackend(StoreBackend):
         request kinds with their own chaos semantics (listing GETs)."""
         if site is None:
             site = (
-                "store.remote_write" if method in ("PUT", "DELETE")
+                "store.remote_write" if method in ("PUT", "DELETE", "POST")
                 else "store.remote_read"
             )
         faults.check(site, path=path)
@@ -397,14 +447,23 @@ class HttpBackend(StoreBackend):
             "store.remote_writes" if site == "store.remote_write"
             else "store.remote_reads"
         )
+        key = self._key(path)
+        send_headers = dict(headers or {})
+        if self._sign:
+            # chaos seam for credential trouble, distinct from wire chaos
+            faults.check("store.remote_auth", path=path)
+            if self._signer is not None:
+                send_headers.update(self._signer.sign_headers(
+                    method, key, query, body, host=self._netloc,
+                ))
         _note_inflight(1)
         try:
             conn = self._connection()
             try:
-                target = self._key(path) + (f"?{query}" if query else "")
+                target = key + (f"?{query}" if query else "")
                 conn.request(
                     method, target, body=body,
-                    headers=dict(headers or {}),
+                    headers=send_headers,
                 )
                 resp = conn.getresponse()
             except (http.client.HTTPException, ConnectionError, OSError) as e:
@@ -447,6 +506,17 @@ class HttpBackend(StoreBackend):
         # body on a keep-alive socket): reconnect rather than risk the
         # next request landing on poisoned connection state
         self._drop_connection()
+        if status in (401, 403):
+            # auth rejection is RETRYABLE (plain OSError, never
+            # FileNotFoundError): expiring session tokens and clock-skewed
+            # signatures heal across the backoff, and a genuinely unsigned
+            # request fails loudly once the retries are spent
+            obs_metrics.inc("store.remote_auth_retries")
+            raise OSError(
+                errno.EACCES,
+                f"HTTP {status} on {method} {path}: auth rejected "
+                f"(unsigned request or bad signature/credentials)",
+            )
         # everything unexpected is transient until the backoff gives up:
         # object-store gateways surface overload as 429/500/503, and a
         # hard 4xx failing loudly after 3 retries is still loud
@@ -455,23 +525,34 @@ class HttpBackend(StoreBackend):
     # -- payload bytes -------------------------------------------------------
 
     def read_bytes(self, path: str) -> bytes:
+        from .retry import io_retry
+
         split = self.range_bytes
-        if split <= 0:
-            status, _, data, _ = self._request("GET", path)
-            if status != 200:
+
+        def _first_window() -> Tuple[Optional[int], bytes]:
+            if split <= 0:
+                status, _, data, _ = self._request("GET", path)
+                if status != 200:
+                    self._raise_for(status, "GET", path)
+                return None, data
+            status, hdrs, data, truncated = self._request(
+                "GET", path, headers={"Range": f"bytes=0-{split - 1}"}
+            )
+            if status == 200:
+                return None, data  # server ignored the range; whole object
+            if status != 206:
                 self._raise_for(status, "GET", path)
-            return data
-        status, hdrs, data, truncated = self._request(
-            "GET", path, headers={"Range": f"bytes=0-{split - 1}"}
+            total = _content_range_total(hdrs.get("Content-Range"))
+            if truncated or total is None or total <= len(data):
+                # short first window: decode classifies (CorruptChunk) and
+                # the shared retry re-fetches — the torn-POSIX-chunk contract
+                return None, data
+            return total, data
+
+        total, data = io_retry(
+            _first_window, what=f"read {path}", counter=self.retry_counter
         )
-        if status == 200:
-            return data  # server ignored the range; body is the object
-        if status != 206:
-            self._raise_for(status, "GET", path)
-        total = _content_range_total(hdrs.get("Content-Range"))
-        if truncated or total is None or total <= len(data):
-            # short first window: decode classifies (CorruptChunk) and the
-            # shared retry re-fetches — same contract as a torn POSIX chunk
+        if total is None:
             return data
         # parallel multipart-style range reads for the tail
         offsets = list(range(len(data), total, split))
@@ -493,35 +574,48 @@ class HttpBackend(StoreBackend):
         GET response itself, byte-compatible with :meth:`signature`.
         Large objects keep the multipart range-read tail of
         :meth:`read_bytes` (continuation ranges are never conditional)."""
+        from .retry import io_retry
+
         split = self.range_bytes
         headers: Dict[str, str] = {}
         if etag:
             headers["If-None-Match"] = etag
         if split > 0:
             headers["Range"] = f"bytes=0-{split - 1}"
-        status, hdrs, data, truncated = self._request(
-            "GET", path, headers=headers
-        )
-        if status == 304:
-            return None, (
-                hdrs.get("ETag") or etag,
-                hdrs.get("Last-Modified"),
-                hdrs.get("Content-Length"),
+
+        def _first_window():
+            status, hdrs, data, truncated = self._request(
+                "GET", path, headers=headers
             )
-        if status not in (200, 206):
-            self._raise_for(status, "GET", path)
-        total = (
-            _content_range_total(hdrs.get("Content-Range"))
-            if status == 206 else None
+            if status == 304:
+                return None, None, (
+                    hdrs.get("ETag") or etag,
+                    hdrs.get("Last-Modified"),
+                    hdrs.get("Content-Length"),
+                )
+            if status not in (200, 206):
+                self._raise_for(status, "GET", path)
+            total = (
+                _content_range_total(hdrs.get("Content-Range"))
+                if status == 206 else None
+            )
+            sig = (
+                hdrs.get("ETag"),
+                hdrs.get("Last-Modified"),
+                str(total) if total is not None
+                else hdrs.get("Content-Length"),
+            )
+            if (status == 200 or truncated or total is None
+                    or total <= len(data)):
+                # whole object (or short first window: decode classifies
+                # and the shared retry re-fetches, the torn-chunk contract)
+                return None, data, sig
+            return total, data, sig
+
+        total, data, sig = io_retry(
+            _first_window, what=f"read {path}", counter=self.retry_counter
         )
-        sig = (
-            hdrs.get("ETag"),
-            hdrs.get("Last-Modified"),
-            str(total) if total is not None else hdrs.get("Content-Length"),
-        )
-        if status == 200 or truncated or total is None or total <= len(data):
-            # whole object (or short first window: decode classifies and
-            # the shared retry re-fetches, the torn-POSIX-chunk contract)
+        if total is None:
             return data, sig
         offsets = list(range(len(data), total, split))
         parts = list(
@@ -557,9 +651,93 @@ class HttpBackend(StoreBackend):
         )
 
     def write_bytes(self, path: str, payload: bytes) -> None:
-        status, _, _, _ = self._request("PUT", path, body=payload)
-        if status not in (200, 201, 204):
-            self._raise_for(status, "PUT", path)
+        if 0 < self.multipart_bytes < len(payload):
+            return self._write_multipart(path, payload)
+        from .retry import io_retry
+
+        def _put() -> None:
+            status, _, _, _ = self._request("PUT", path, body=payload)
+            if status not in (200, 201, 204):
+                self._raise_for(status, "PUT", path)
+
+        io_retry(_put, what=f"write {path}", counter=self.retry_counter)
+
+    def _write_multipart(self, path: str, payload: bytes) -> None:
+        """S3 multipart upload for oversized payloads (ragged ``.npy``
+        scratch chunks included): initiate → parallel part PUTs (each
+        with its own retry, riding the range pool) → complete.  A failure
+        past initiate best-effort-aborts so the store can reap parts."""
+        from .retry import io_retry
+
+        part_size = max(self.multipart_bytes, 1)
+
+        def _initiate() -> str:
+            status, _, data, _ = self._request("POST", path, query="uploads")
+            if status not in (200, 201):
+                self._raise_for(status, "POST", path)
+            m = re.search(rb"<UploadId>([^<]+)</UploadId>", data)
+            if m is None:
+                raise OSError(
+                    errno.EIO,
+                    f"multipart initiate {path}: no UploadId in response",
+                )
+            return m.group(1).decode()
+
+        upload_id = io_retry(
+            _initiate, what=f"multipart initiate {path}",
+            counter=self.retry_counter,
+        )
+        uid_query = "uploadId=" + urllib.parse.quote(upload_id, safe="")
+
+        def _put_part(numbered: Tuple[int, int]) -> Tuple[int, str]:
+            number, offset = numbered
+            chunk = payload[offset:offset + part_size]
+
+            def _put() -> Tuple[int, str]:
+                status, hdrs, _, _ = self._request(
+                    "PUT", path, body=chunk,
+                    query=f"partNumber={number}&{uid_query}",
+                )
+                if status not in (200, 201, 204):
+                    self._raise_for(status, "PUT", path)
+                return number, hdrs.get("ETag") or f'"{number}"'
+
+            return io_retry(
+                _put, what=f"multipart part {number} {path}",
+                counter=self.retry_counter,
+            )
+
+        try:
+            numbered = list(enumerate(range(0, len(payload), part_size), 1))
+            etags = list(self._pool("range").map(_put_part, numbered))
+            manifest = "".join(
+                f"<Part><PartNumber>{n}</PartNumber><ETag>{etag}</ETag></Part>"
+                for n, etag in etags
+            )
+            xml = (
+                "<CompleteMultipartUpload>"
+                + manifest
+                + "</CompleteMultipartUpload>"
+            ).encode()
+
+            def _complete() -> None:
+                status, _, _, _ = self._request(
+                    "POST", path, body=xml, query=uid_query
+                )
+                if status not in (200, 201, 204):
+                    self._raise_for(status, "POST", path)
+
+            io_retry(
+                _complete, what=f"multipart complete {path}",
+                counter=self.retry_counter,
+            )
+        except BaseException:
+            try:
+                self._request("DELETE", path, query=uid_query)
+            except OSError:
+                pass  # abort is advisory; the store reaps stale uploads
+            raise
+        obs_metrics.inc("store.remote_multipart_uploads")
 
     def publish_once(self, path: str, payload: bytes) -> bool:
         """Create-only PUT: ``If-None-Match: *`` makes the object store
@@ -637,7 +815,10 @@ class HttpBackend(StoreBackend):
 
         def _probe():
             status, hdrs, _, _ = self._request("HEAD", path)
-            if status >= 500 or status == 429:
+            # 401/403 must be LOUD here too: an existence probe answering
+            # False on an auth rejection would read as "no lease/no peer"
+            # and corrupt scheduling decisions downstream
+            if status >= 500 or status in (429, 401, 403):
                 self._raise_for(status, "HEAD", path)
             return status, hdrs
 
@@ -748,13 +929,17 @@ _REMOTE: Dict[str, HttpBackend] = {}
 
 
 def is_remote_path(path: str) -> bool:
-    return isinstance(path, str) and path.startswith(("http://", "https://"))
+    return isinstance(path, str) and path.startswith(
+        ("http://", "https://", "s3://")
+    )
 
 
 def backend_for(path: str) -> StoreBackend:
     """The backend owning ``path``: the process-wide POSIX singleton, or
     one cached :class:`HttpBackend` per remote origin (so every dataset
-    of one store shares connections, pool, and counters)."""
+    of one store shares connections, pool, and counters).  ``s3://bucket``
+    paths get an always-signing backend aimed path-style at the
+    ``CTT_S3_ENDPOINT`` gateway (default: the region's public endpoint)."""
     if not is_remote_path(path):
         return _POSIX
     parsed = urllib.parse.urlsplit(path)
@@ -762,6 +947,15 @@ def backend_for(path: str) -> StoreBackend:
     with _REMOTE_LOCK:
         backend = _REMOTE.get(origin)
         if backend is None:
-            backend = HttpBackend(origin)
+            if parsed.scheme == "s3":
+                endpoint = os.environ.get("CTT_S3_ENDPOINT") or (
+                    f"https://s3.{sigv4.default_region()}.amazonaws.com"
+                )
+                backend = HttpBackend(
+                    endpoint, alias=origin,
+                    alias_prefix=f"/{parsed.netloc}",
+                )
+            else:
+                backend = HttpBackend(origin)
             _REMOTE[origin] = backend
         return backend

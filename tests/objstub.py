@@ -34,11 +34,33 @@ are reproducible):
     but send only half the body and drop the connection — the truncated
     object read that must classify as ``CorruptChunk`` downstream.
 
+ctt-diskless twin features:
+
+  * **SigV4 verification mode** (``sigv4=(access, secret)`` /
+    ``--sigv4-access-key``/``--sigv4-secret-key``): every request must
+    carry a valid AWS Signature V4 ``Authorization`` header or it is
+    rejected 403 (``AccessDenied``) — the signature is *recomputed here
+    from the raw request*, independently of the client-side signer in
+    ``cluster_tools_tpu/utils/sigv4.py``, so canonicalization drift
+    between the two fails loudly in CI rather than silently matching.
+  * **Multipart upload**: ``POST /key?uploads`` → ``UploadId`` XML;
+    ``PUT /key?partNumber=N&uploadId=I`` stores parts (staged OUTSIDE
+    the served root, so half-done uploads never appear in listings);
+    ``POST /key?uploadId=I`` assembles parts in number order and
+    atomically publishes the object; ``DELETE /key?uploadId=I`` aborts.
+  * **Clock skew** (``clock_skew_s`` / ``--clock-skew-s``): shifts every
+    ``Last-Modified`` header by the given seconds — a store whose wall
+    clock disagrees with the readers', for exercising the remote-mtime
+    staleness guards (a skewed-to-the-past store must never make a
+    reader expire a live lease early).
+
 Run in-process (``StubObjectStore(root, ...)`` context manager) or as a
 subprocess for shell harnesses::
 
     python tests/objstub.py --root DIR --port-file F [--fail-rate 0.05]
                             [--seed 7] [--slow-s 0.05] [--slow-rate 0.0]
+                            [--sigv4-access-key AK --sigv4-secret-key SK]
+                            [--clock-skew-s -3600]
 
 The subprocess writes ``<port>`` to ``--port-file`` once listening and
 serves until SIGTERM.
@@ -48,6 +70,8 @@ from __future__ import annotations
 
 import argparse
 import email.utils
+import hashlib
+import hmac
 import json
 import os
 import random
@@ -60,6 +84,10 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 _RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)$")
+_AUTH_RE = re.compile(
+    r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d{8})/([^/]+)/([^/]+)"
+    r"/aws4_request,\s*SignedHeaders=([^,]+),\s*Signature=([0-9a-f]{64})$"
+)
 
 
 class _Policy:
@@ -133,12 +161,100 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _object_headers(self, p):
         st = os.stat(p)
+        # clock_skew_s simulates a store wall clock that disagrees with
+        # the readers' — only Last-Modified shifts (staleness input); the
+        # ETag stays a pure content-version token
         return [
             ("ETag", f'"{st.st_mtime_ns:x}-{st.st_size:x}"'),
             ("Last-Modified", email.utils.formatdate(
-                st.st_mtime, usegmt=True
+                st.st_mtime + self.server.clock_skew_s, usegmt=True
             )),
         ]
+
+    def _query(self):
+        return urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query, keep_blank_values=True
+        )
+
+    # -- sigv4 verification (independent of the client-side signer) ----------
+
+    def _reject_auth(self, reason):
+        length = int(self.headers.get("Content-Length", 0))
+        if length:
+            self.rfile.read(length)  # keep-alive hygiene before failing
+        body = (
+            f"<Error><Code>AccessDenied</Code>"
+            f"<Message>{reason}</Message></Error>"
+        ).encode()
+        self.send_response(403)
+        self.send_header("Content-Type", "application/xml")
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+        self.close_connection = True
+        return False
+
+    def _verify_sigv4(self):
+        """True when verification is off or the request signature checks
+        out; otherwise answers 403 and returns False.  Recomputes the
+        SigV4 signature from the RAW request (path, query, received
+        headers) with its own hashing code — the independent twin of the
+        client signer, so canonicalization drift fails loudly."""
+        creds = self.server.sigv4_creds
+        if creds is None:
+            return True
+        m = _AUTH_RE.match(self.headers.get("Authorization", "").strip())
+        if m is None:
+            return self._reject_auth("missing or malformed Authorization")
+        access, datestamp, region, service, signed_names, signature = (
+            m.groups()
+        )
+        if access != creds["access_key"]:
+            return self._reject_auth("unknown access key")
+        names = signed_names.split(";")
+        if not {"host", "x-amz-content-sha256", "x-amz-date"} <= set(names):
+            return self._reject_auth("required headers not signed")
+        raw_path, _, raw_query = self.path.partition("?")
+        params = [
+            p if "=" in p else p + "="
+            for p in raw_query.split("&") if p
+        ]
+        canonical = "\n".join([
+            self.command,
+            raw_path,
+            "&".join(sorted(params)),
+            "".join(
+                f"{n}:{(self.headers.get(n) or '').strip()}\n"
+                for n in names
+            ),
+            signed_names,
+            self.headers.get("x-amz-content-sha256") or "",
+        ])
+        scope = f"{datestamp}/{region}/{service}/aws4_request"
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256",
+            self.headers.get("x-amz-date") or "",
+            scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+        key = ("AWS4" + creds["secret_key"]).encode()
+        for step in (datestamp, region, service, "aws4_request"):
+            key = hmac.new(key, step.encode(), hashlib.sha256).digest()
+        expected = hmac.new(
+            key, string_to_sign.encode(), hashlib.sha256
+        ).hexdigest()
+        if not hmac.compare_digest(expected, signature):
+            return self._reject_auth("signature mismatch")
+        return True
+
+    # -- multipart upload (parts staged OUTSIDE the served root) -------------
+
+    def _mpu_dir(self, upload_id, create=False):
+        d = os.path.join(self.server.mpu_root, os.path.basename(upload_id))
+        if create:
+            os.makedirs(d, exist_ok=True)
+        return d if os.path.isdir(d) else None
 
     def _chaos(self, drain: bool = False):
         fail, slow, truncate = self.server.policy.decide(
@@ -167,6 +283,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server naming)
         failed, truncate = self._chaos()
         if failed:
+            return
+        if not self._verify_sigv4():
             return
         p = self._fs_path()
         if p is None or not os.path.exists(p):
@@ -237,6 +355,8 @@ class _Handler(BaseHTTPRequestHandler):
         failed, _ = self._chaos()
         if failed:
             return
+        if not self._verify_sigv4():
+            return
         p = self._fs_path()
         if p is None or not os.path.exists(p):
             self._send(404)
@@ -255,12 +375,33 @@ class _Handler(BaseHTTPRequestHandler):
         failed, _ = self._chaos(drain=True)
         if failed:
             return
+        if not self._verify_sigv4():
+            return
         p = self._fs_path()
         if p is None:
             self._send(404, b"not found")
             return
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b""
+        query = self._query()
+        part_number = query.get("partNumber", [None])[0]
+        upload_id = query.get("uploadId", [None])[0]
+        if part_number is not None and upload_id is not None:
+            updir = self._mpu_dir(upload_id)
+            if updir is None:
+                self._send(404, b"no such upload")
+                return
+            try:
+                number = int(part_number)
+            except ValueError:
+                self._send(400, b"bad partNumber")
+                return
+            tmp = os.path.join(updir, f"part.{number:06d}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(body)
+            os.replace(tmp, os.path.join(updir, f"part.{number:06d}"))
+            self._send(200, headers=[("ETag", f'"{number}"')])
+            return
         if self.headers.get("If-None-Match", "").strip() == "*":
             # create-only PUT: the publish_once analog — first writer
             # stores, every later writer gets 412 (body already drained,
@@ -285,9 +426,67 @@ class _Handler(BaseHTTPRequestHandler):
         os.replace(tmp, p)
         self._send(201)
 
+    def do_POST(self):  # noqa: N802
+        failed, _ = self._chaos(drain=True)
+        if failed:
+            return
+        if not self._verify_sigv4():
+            return
+        p = self._fs_path()
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        del body  # the complete manifest is advisory here: parts are
+        # assembled in partNumber order, the stub's source of truth
+        if p is None:
+            self._send(404, b"not found")
+            return
+        query = self._query()
+        if "uploads" in query:
+            upload_id = f"{time.time_ns():x}-{threading.get_ident():x}"
+            self._mpu_dir(upload_id, create=True)
+            xml = (
+                "<InitiateMultipartUploadResult>"
+                f"<UploadId>{upload_id}</UploadId>"
+                "</InitiateMultipartUploadResult>"
+            )
+            self._send(200, xml.encode(),
+                       headers=[("Content-Type", "application/xml")])
+            return
+        upload_id = query.get("uploadId", [None])[0]
+        if upload_id is not None:
+            updir = self._mpu_dir(upload_id)
+            if updir is None:
+                self._send(404, b"no such upload")
+                return
+            parts = sorted(
+                n for n in os.listdir(updir)
+                if n.startswith("part.") and not n.endswith(".tmp")
+            )
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            tmp = p + f".mpu{threading.get_ident()}"
+            with open(tmp, "wb") as out:
+                for name in parts:
+                    with open(os.path.join(updir, name), "rb") as part:
+                        shutil.copyfileobj(part, out)
+            os.replace(tmp, p)
+            shutil.rmtree(updir, ignore_errors=True)
+            self._send(200, b"<CompleteMultipartUploadResult/>",
+                       headers=[("Content-Type", "application/xml")])
+            return
+        self._send(400, b"bad request")
+
     def do_DELETE(self):  # noqa: N802
         failed, _ = self._chaos()
         if failed:
+            return
+        if not self._verify_sigv4():
+            return
+        upload_id = self._query().get("uploadId", [None])[0]
+        if upload_id is not None:
+            updir = self._mpu_dir(upload_id)
+            if updir is not None:
+                shutil.rmtree(updir, ignore_errors=True)
+            self._send(204)
             return
         p = self._fs_path()
         if p is None or not os.path.exists(p):
@@ -309,7 +508,7 @@ class StubObjectStore:
     where ``url`` is the origin (``http://127.0.0.1:<port>``)."""
 
     def __init__(self, root, fail_rate=0.0, seed=0, slow_s=0.0,
-                 slow_rate=0.0):
+                 slow_rate=0.0, sigv4=None, clock_skew_s=0.0):
         os.makedirs(root, exist_ok=True)
         self.root = os.path.abspath(root)
         self.policy = _Policy(fail_rate, seed, slow_s, slow_rate)
@@ -317,6 +516,16 @@ class StubObjectStore:
         self.httpd.daemon_threads = True
         self.httpd.root = self.root
         self.httpd.policy = self.policy
+        # sigv4: None (open store) or (access_key, secret_key) — every
+        # request must then carry a valid V4 signature or gets 403
+        self.httpd.sigv4_creds = (
+            {"access_key": sigv4[0], "secret_key": sigv4[1]}
+            if sigv4 else None
+        )
+        self.httpd.clock_skew_s = float(clock_skew_s)
+        # multipart parts stage in a sibling dir, never inside the
+        # served root (half-done uploads must not pollute listings)
+        self.httpd.mpu_root = self.root + ".mpu"
         self.port = self.httpd.server_address[1]
         self.url = f"http://127.0.0.1:{self.port}"
         self._thread = threading.Thread(
@@ -349,10 +558,18 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--slow-s", type=float, default=0.0)
     ap.add_argument("--slow-rate", type=float, default=0.0)
+    ap.add_argument("--sigv4-access-key", default=None)
+    ap.add_argument("--sigv4-secret-key", default=None)
+    ap.add_argument("--clock-skew-s", type=float, default=0.0)
     args = ap.parse_args()
+    sigv4 = (
+        (args.sigv4_access_key, args.sigv4_secret_key)
+        if args.sigv4_access_key and args.sigv4_secret_key else None
+    )
     store = StubObjectStore(
         args.root, fail_rate=args.fail_rate, seed=args.seed,
         slow_s=args.slow_s, slow_rate=args.slow_rate,
+        sigv4=sigv4, clock_skew_s=args.clock_skew_s,
     ).start()
     tmp = args.port_file + ".tmp"
     with open(tmp, "w") as f:

@@ -1,0 +1,545 @@
+"""ctt-diskless: object-store-native elastic fleet tests.
+
+Covers the diskless hardening end to end against the local stub object
+server (tests/objstub.py) in SigV4 mode:
+
+  * request signing: AWS SigV4 roundtrips verified independently by the
+    stub's own HMAC recompute; unsigned / wrong-key requests get 403 and
+    surface as RETRYABLE auth errors (never FileNotFoundError — a silent
+    auth downgrade would read as "no lease/no peer" and corrupt
+    scheduling decisions); credential resolution order (env, then the
+    shared credentials file);
+  * multipart upload: oversized payloads (incl. remote ragged ``.npy``
+    scratch) take initiate/parts/complete, survive seeded 5xx chaos via
+    the per-part retry, and never leak staged parts into listings;
+  * remote serve state dirs: the full JobQueue lifecycle and fleet
+    beats over an object-store prefix, including the paginated-listing
+    regression at ``list_page = 2`` (satellite: a fleet must not lose
+    records past the first continuation page);
+  * clock-skew robustness: a store whose clock runs BEHIND must never
+    expire a live torn lease/beat early — remote mtime ages are capped
+    by the local monotonic first-seen observation;
+  * supervisor: spawn/drain/adopt decision rounds through injected
+    spawn/drain seams, the min-floor, one action per round, and
+    statelessness (a fresh supervisor re-adopts from beats alone);
+  * conformance over a remote prefix: ``analysis conformance
+    http://...`` judges a surviving diskless state dir exactly like a
+    POSIX one.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+from objstub import StubObjectStore
+
+from cluster_tools_tpu.analysis.conformance import conformance_report
+from cluster_tools_tpu.serve.fleet import FleetBeat, FleetView, read_peers
+from cluster_tools_tpu.serve.jobs import JobQueue
+from cluster_tools_tpu.serve.supervisor import Supervisor
+from cluster_tools_tpu.utils import sigv4, store_backend
+from cluster_tools_tpu.utils.store import RaggedDataset
+
+
+@pytest.fixture(autouse=True)
+def fresh_backends():
+    """Remote backends cache per-origin (signing state, multipart
+    threshold are read at construction) — tests vary that env, so every
+    test starts from an empty cache."""
+    with store_backend._REMOTE_LOCK:
+        store_backend._REMOTE.clear()
+    yield
+    with store_backend._REMOTE_LOCK:
+        store_backend._REMOTE.clear()
+
+
+@pytest.fixture
+def traced_metrics(tmp_path):
+    from cluster_tools_tpu.obs import metrics as obs_metrics
+    from cluster_tools_tpu.obs import trace as obs_trace
+
+    was_on = obs_trace.enabled()
+    if not was_on:
+        obs_trace.enable(str(tmp_path / "trace"), "diskless_unit",
+                         export_env=False)
+    try:
+        yield obs_metrics
+    finally:
+        if not was_on:
+            obs_trace.disable()
+
+
+AK, SK = "AKIDUNITTEST", "unit-secret-key"
+
+
+@pytest.fixture
+def signed_env(monkeypatch):
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", AK)
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", SK)
+    monkeypatch.delenv("AWS_SESSION_TOKEN", raising=False)
+    monkeypatch.setenv("CTT_S3_SIGN", "1")
+
+
+@pytest.fixture
+def signed_stub(tmp_path, signed_env):
+    with StubObjectStore(str(tmp_path / "objroot"), sigv4=(AK, SK)) as srv:
+        yield srv
+
+
+# --------------------------------------------------------------------------
+# SigV4 unit surface
+
+
+class TestSigV4:
+    def test_canonical_query_sorts_and_normalizes(self):
+        assert sigv4.canonical_query(None) == ""
+        assert sigv4.canonical_query("uploads") == "uploads="
+        assert (
+            sigv4.canonical_query("uploadId=x&partNumber=2")
+            == "partNumber=2&uploadId=x"
+        )
+
+    def test_signature_is_deterministic_and_payload_bound(self):
+        signer = sigv4.SigV4Signer(
+            sigv4.Credentials(AK, SK), region="us-east-1"
+        )
+        kwargs = dict(method="PUT", key="/b/k.json", query=None,
+                      host="127.0.0.1:9", amz_date="20260807T000000Z")
+        a = signer.sign_headers(payload=b"one", **kwargs)
+        b = signer.sign_headers(payload=b"one", **kwargs)
+        c = signer.sign_headers(payload=b"two", **kwargs)
+        assert a["authorization"] == b["authorization"]
+        assert a["authorization"] != c["authorization"]
+        assert a["x-amz-content-sha256"] != c["x-amz-content-sha256"]
+
+    def test_resolve_credentials_env_then_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "envAK")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "envSK")
+        creds = sigv4.resolve_credentials()
+        assert (creds.access_key, creds.secret_key) == ("envAK", "envSK")
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID")
+        monkeypatch.delenv("AWS_SECRET_ACCESS_KEY")
+        ini = tmp_path / "credentials"
+        ini.write_text(
+            "[default]\n"
+            "aws_access_key_id = fileAK\n"
+            "aws_secret_access_key = fileSK\n"
+        )
+        monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(ini))
+        creds = sigv4.resolve_credentials()
+        assert (creds.access_key, creds.secret_key) == ("fileAK", "fileSK")
+        monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE",
+                           str(tmp_path / "absent"))
+        assert sigv4.resolve_credentials() is None
+
+
+# --------------------------------------------------------------------------
+# signed requests against the verifying stub
+
+
+class TestSignedRequests:
+    def test_signed_roundtrip(self, signed_stub):
+        backend = store_backend.backend_for(signed_stub.url)
+        key = f"{signed_stub.url}/d/hello.json"
+        backend.write_bytes(key, b'{"ok": true}')
+        assert backend.read_bytes(key) == b'{"ok": true}'
+        assert backend.exists(key)
+        assert backend.listdir(f"{signed_stub.url}/d") == ["hello.json"]
+
+    def test_unsigned_rejected_as_retryable_auth_error(
+        self, tmp_path, monkeypatch, traced_metrics
+    ):
+        # signing NOT armed: no CTT_S3_SIGN, plain http:// origin — the
+        # store demands signatures, so every verb must surface a
+        # retryable OSError (EACCES), never a silent False/missing
+        monkeypatch.delenv("CTT_S3_SIGN", raising=False)
+        monkeypatch.setenv("CTT_IO_RETRIES", "1")
+        monkeypatch.setenv("CTT_IO_BACKOFF_BASE_S", "0.001")
+        with StubObjectStore(str(tmp_path / "objroot"),
+                             sigv4=(AK, SK)) as srv:
+            backend = store_backend.backend_for(srv.url)
+            key = f"{srv.url}/d/k.json"
+            for op in (
+                lambda: backend.read_bytes(key),
+                lambda: backend.write_bytes(key, b"x"),
+                lambda: backend.exists(key),
+                lambda: backend.listdir(f"{srv.url}/d"),
+            ):
+                with pytest.raises(OSError) as exc_info:
+                    op()
+                assert not isinstance(
+                    exc_info.value, FileNotFoundError
+                ), "auth rejection must not read as absence"
+            counters = traced_metrics.snapshot()["counters"]
+            assert counters.get("store.remote_auth_retries", 0) >= 4
+
+    def test_wrong_key_rejected(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", AK)
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "not-the-secret")
+        monkeypatch.setenv("CTT_S3_SIGN", "1")
+        monkeypatch.setenv("CTT_IO_RETRIES", "1")
+        monkeypatch.setenv("CTT_IO_BACKOFF_BASE_S", "0.001")
+        with StubObjectStore(str(tmp_path / "objroot"),
+                             sigv4=(AK, SK)) as srv:
+            backend = store_backend.backend_for(srv.url)
+            with pytest.raises(OSError):
+                backend.write_bytes(f"{srv.url}/d/k.json", b"x")
+
+    def test_s3_scheme_alias(self, tmp_path, signed_env, monkeypatch):
+        with StubObjectStore(str(tmp_path / "objroot"),
+                             sigv4=(AK, SK)) as srv:
+            monkeypatch.setenv("CTT_S3_ENDPOINT", srv.url)
+            key = "s3://unit-bucket/prefix/obj.bin"
+            assert store_backend.is_remote_path(key)
+            backend = store_backend.backend_for(key)
+            backend.write_bytes(key, b"via-alias")
+            assert backend.read_bytes(key) == b"via-alias"
+            # path-style mapping: the object landed under /unit-bucket/
+            on_disk = (
+                tmp_path / "objroot" / "unit-bucket" / "prefix" / "obj.bin"
+            )
+            assert on_disk.read_bytes() == b"via-alias"
+
+
+# --------------------------------------------------------------------------
+# multipart upload
+
+
+class TestMultipart:
+    @pytest.fixture
+    def small_threshold(self, monkeypatch):
+        monkeypatch.setenv("CTT_REMOTE_MULTIPART_MB", "0.002")  # ~2 KB
+
+    def test_multipart_roundtrip_and_counter(
+        self, signed_stub, small_threshold, traced_metrics
+    ):
+        backend = store_backend.backend_for(signed_stub.url)
+        payload = os.urandom(11 * 1024)
+        key = f"{signed_stub.url}/d/big.bin"
+        backend.write_bytes(key, payload)
+        assert backend.read_bytes(key) == payload
+        counters = traced_metrics.snapshot()["counters"]
+        assert counters.get("store.remote_multipart_uploads", 0) == 1
+        # staged parts never pollute the served namespace
+        assert backend.listdir(f"{signed_stub.url}/d") == ["big.bin"]
+
+    def test_multipart_under_chaos(self, tmp_path, signed_env, monkeypatch):
+        monkeypatch.setenv("CTT_REMOTE_MULTIPART_MB", "0.002")
+        monkeypatch.setenv("CTT_IO_BACKOFF_BASE_S", "0.001")
+        with StubObjectStore(str(tmp_path / "objroot"), sigv4=(AK, SK),
+                             fail_rate=0.05, seed=11) as srv:
+            backend = store_backend.backend_for(srv.url)
+            payload = os.urandom(9 * 1024)
+            key = f"{srv.url}/d/chaos.bin"
+            backend.write_bytes(key, payload)
+            assert backend.read_bytes(key) == payload
+
+    def test_remote_ragged_dataset(
+        self, signed_stub, small_threshold, traced_metrics
+    ):
+        root = f"{signed_stub.url}/scratch/ragged"
+        ds = RaggedDataset.create(root, (2, 2), np.uint64)
+        assert RaggedDataset.exists(root)
+        big = np.arange(4096, dtype=np.uint64)  # 32 KB: multipart path
+        ds.write_chunk((0, 1), big)
+        ds.write_chunk((1, 0), np.array([7], dtype=np.uint64))
+        again = RaggedDataset(root)
+        np.testing.assert_array_equal(again.read_chunk((0, 1)), big)
+        np.testing.assert_array_equal(
+            again.read_chunk((1, 0)), np.array([7], dtype=np.uint64)
+        )
+        assert again.read_chunk((0, 0)) is None
+        counters = traced_metrics.snapshot()["counters"]
+        assert counters.get("store.remote_multipart_uploads", 0) >= 1
+
+
+# --------------------------------------------------------------------------
+# remote serve state: JobQueue + fleet beats (+ pagination regression)
+
+
+class TestRemoteServeState:
+    def test_jobqueue_lifecycle_paginated(self, signed_stub):
+        backend = store_backend.backend_for(signed_stub.url)
+        backend.list_page = 2  # satellite: multi-page listing regression
+        q = JobQueue(f"{signed_stub.url}/state/jobs", lease_s=30.0,
+                     daemon_id="d0")
+        ids = [
+            q.submit({"workflow": "w", "tenant": "t", "priority": 0})
+            for _ in range(5)
+        ]
+        assert ids == [f"j{i:06d}" for i in range(1, 6)]
+        assert q.stats()["queued"] == 5
+        assert len(q.pending()) == 5
+        claim = q.claim_next()
+        assert claim is not None
+        q.renew(claim)
+        assert q.complete(claim, {"ok": True})
+        rec = q.get(claim.job_id)
+        assert rec["result"]["ok"] is True
+        stats = q.stats()
+        assert stats["queued"] == 4 and stats["running"] == 0
+
+    def test_fleet_beats_paginated(self, signed_stub):
+        state = f"{signed_stub.url}/state"
+        backend = store_backend.backend_for(signed_stub.url)
+        backend.list_page = 2
+        for i in range(5):
+            FleetBeat(state, f"d{i}", interval_s=30.0).beat()
+        peers = read_peers(state)
+        assert sorted(peers) == [f"d{i}" for i in range(5)]
+        view = FleetView(state)
+        assert sorted(view.live()) == [f"d{i}" for i in range(5)]
+
+
+# --------------------------------------------------------------------------
+# clock skew: a store clock running behind must never expire early
+
+
+class TestClockSkew:
+    def test_torn_beat_on_skewed_store_stays_live(
+        self, tmp_path, signed_env
+    ):
+        # the store's clock runs ONE HOUR behind: Last-Modified makes
+        # every object look an hour old.  A torn beat (mtime is its only
+        # stamp) must still be judged by the local first-seen monotonic
+        # cap — never declared dead the moment it appears.
+        with StubObjectStore(str(tmp_path / "objroot"), sigv4=(AK, SK),
+                             clock_skew_s=-3600.0) as srv:
+            state = f"{srv.url}/state"
+            beat = FleetBeat(state, "d0", interval_s=30.0)
+            beat.beat()
+            backend = store_backend.backend_for(srv.url)
+            # tear the beat: unparsable JSON, mtime is all that is left
+            backend.write_bytes(beat.path, b'{"id": "d0", "wal')
+            view = FleetView(state, self_id="observer")
+            assert view.is_dead("d0") is not True
+            # and it does age out once the observation really is old
+            with view._lock:
+                first = view._torn_seen[beat.path]
+                view._torn_seen[beat.path] = first - 3600.0
+            view_peers = view.peers(refresh=True)
+            assert "d0" in view_peers
+            assert view.is_dead("d0") is True
+
+    def test_torn_lease_on_skewed_store_not_reclaimed_early(
+        self, tmp_path, signed_env
+    ):
+        with StubObjectStore(str(tmp_path / "objroot"), sigv4=(AK, SK),
+                             clock_skew_s=-3600.0) as srv:
+            q = JobQueue(f"{srv.url}/state/jobs", lease_s=5.0,
+                         daemon_id="d0")
+            q.submit({"workflow": "w", "tenant": "t"})
+            claim = q.claim_next()
+            assert claim is not None
+            # tear the live lease: a second daemon judging it by the
+            # skewed store mtime alone would reclaim instantly
+            backend = store_backend.backend_for(srv.url)
+            backend.write_bytes(claim.lease_path, b'{"daemon": "d0"')
+            q2 = JobQueue(f"{srv.url}/state/jobs", lease_s=5.0,
+                          daemon_id="d1")
+            assert q2.claim_next() is None, (
+                "torn lease on a skew-behind store must not expire early"
+            )
+
+    def test_sched_clock_skew_seam(self, signed_stub, monkeypatch):
+        # CTT_SCHED_CLOCK_SKEW_S shifts the READER clock: a huge positive
+        # skew makes a fresh lease look ancient — the seam the skew
+        # tests drive (wall stamps parse fine here, no mtime involved)
+        q = JobQueue(f"{signed_stub.url}/state/jobs", lease_s=5.0,
+                     daemon_id="d0")
+        q.submit({"workflow": "w", "tenant": "t"})
+        assert q.claim_next() is not None
+        monkeypatch.setenv("CTT_SCHED_CLOCK_SKEW_S", "9000")
+        q2 = JobQueue(f"{signed_stub.url}/state/jobs", lease_s=5.0,
+                      daemon_id="d1")
+        claim2 = q2.claim_next()
+        assert claim2 is not None and claim2.gen == 1
+
+
+# --------------------------------------------------------------------------
+# supervisor decision rounds (injected spawn/drain seams)
+
+
+def _stamp_beat(state_dir, daemon_id, concurrency=1, draining=False):
+    store_backend.backend_for(state_dir).makedirs(state_dir)
+    FleetBeat(
+        state_dir, daemon_id, interval_s=30.0,
+        info_fn=lambda: {"concurrency": concurrency, "draining": draining},
+    ).beat()
+
+
+class _Seams:
+    def __init__(self, state_dir):
+        self.state_dir = state_dir
+        self.spawned = []
+        self.drained = []
+
+    def spawn(self, daemon_id):
+        self.spawned.append(daemon_id)
+        _stamp_beat(self.state_dir, daemon_id)
+        return object()  # opaque handle without poll(): never reaped
+
+    def drain(self, daemon_id, rec):
+        self.drained.append(daemon_id)
+
+
+class TestSupervisor:
+    def _supervisor(self, state_dir, **kw):
+        seams = _Seams(state_dir)
+        sup = Supervisor(
+            state_dir, min_daemons=1, max_daemons=3, poll_s=0.05,
+            spawn_fn=seams.spawn, drain_fn=seams.drain,
+            supervisor_id="sup-test", **kw,
+        )
+        return sup, seams
+
+    def test_min_floor_spawns_from_empty(self, tmp_path):
+        sup, seams = self._supervisor(str(tmp_path / "state"))
+        advice = sup.poll_once()
+        assert advice["target"] == 1 and advice["acted"] == "spawn"
+        assert len(seams.spawned) == 1
+
+    def test_backlog_scales_up_one_per_round(self, tmp_path):
+        state = str(tmp_path / "state")
+        sup, seams = self._supervisor(state)
+        _stamp_beat(state, "d0")
+        q = JobQueue(os.path.join(state, "jobs"))
+        for _ in range(6):
+            q.submit({"workflow": "w", "tenant": "t"})
+        advice = sup.poll_once()
+        assert advice["acted"] == "spawn" and len(seams.spawned) == 1
+        advice = sup.poll_once()  # backlog still over capacity: one more
+        assert advice["acted"] == "spawn" and len(seams.spawned) == 2
+        advice = sup.poll_once()  # at max_daemons=3: clamped, holds
+        assert advice["target"] == 3
+        assert advice["acted"] == "hold" and len(seams.spawned) == 2
+
+    def test_idle_drains_to_floor(self, tmp_path):
+        state = str(tmp_path / "state")
+        sup, seams = self._supervisor(state)
+        for i in range(3):
+            _stamp_beat(state, f"d{i}")
+        advice = sup.poll_once()
+        assert advice["acted"] == "drain" and len(seams.drained) == 1
+
+    def test_restarted_supervisor_adopts_from_beats(
+        self, tmp_path, traced_metrics
+    ):
+        state = str(tmp_path / "state")
+        for i in range(2):
+            _stamp_beat(state, f"d{i}")
+        before = traced_metrics.snapshot()["counters"].get(
+            "serve.supervisor_adoptions", 0
+        )
+        sup, seams = self._supervisor(state)  # fresh: empty child table
+        sup.poll_once()
+        after = traced_metrics.snapshot()["counters"].get(
+            "serve.supervisor_adoptions", 0
+        )
+        assert after - before == 2
+        sup.poll_once()  # already known: no double-count
+        assert traced_metrics.snapshot()["counters"].get(
+            "serve.supervisor_adoptions", 0
+        ) - before == 2
+
+    def test_pending_spawn_counts_toward_target(self, tmp_path):
+        state = str(tmp_path / "state")
+
+        class _LiveHandle:
+            def poll(self):
+                return None  # provably-alive child (a real Popen would)
+
+        spawned = []
+
+        def spawn(daemon_id):  # alive, but its first beat has not landed
+            spawned.append(daemon_id)
+            return _LiveHandle()
+
+        sup = Supervisor(
+            state, min_daemons=1, max_daemons=3, poll_s=0.05,
+            spawn_fn=spawn, drain_fn=lambda d, r: None,
+            supervisor_id="sup-pend",
+        )
+        assert sup.poll_once()["acted"] == "spawn" and len(spawned) == 1
+        # un-beating child is pending capacity: no overshoot spawn
+        assert sup.poll_once()["acted"] == "hold" and len(spawned) == 1
+        _stamp_beat(state, spawned[0])  # beat lands: pending -> live
+        assert sup.poll_once()["acted"] == "hold" and len(spawned) == 1
+
+    def test_beat_flicker_does_not_trigger_replacement(self, tmp_path):
+        state = str(tmp_path / "state")
+        sup, seams = self._supervisor(state)
+        _stamp_beat(state, "d0")
+        assert sup.poll_once()["acted"] == "hold" and not seams.spawned
+        # the beat vanishes (stale read / loaded host), but d0 was seen
+        # live moments ago: damped, not replaced
+        os.unlink(os.path.join(state, "daemon.d0.json"))
+        assert sup.poll_once()["acted"] == "hold" and not seams.spawned
+        # past the flicker grace the silence is a real death: replace
+        sup.flicker_grace_s = 0.0
+        assert sup.poll_once()["acted"] == "spawn"
+        assert len(seams.spawned) == 1
+
+    def test_hung_spawn_past_grace_stops_counting(self, tmp_path):
+        state = str(tmp_path / "state")
+
+        class _LiveHandle:
+            def poll(self):
+                return None
+
+        spawned = []
+
+        def spawn(daemon_id):
+            spawned.append(daemon_id)
+            return _LiveHandle()
+
+        sup = Supervisor(
+            state, min_daemons=1, max_daemons=3, poll_s=0.05,
+            spawn_fn=spawn, drain_fn=lambda d, r: None,
+            supervisor_id="sup-hung",
+        )
+        sup.spawn_grace_s = 0.0  # a hung startup must not wedge scaling
+        assert sup.poll_once()["acted"] == "spawn" and len(spawned) == 1
+        assert sup.poll_once()["acted"] == "spawn" and len(spawned) == 2
+
+    def test_publishes_schema_conformant_state(self, tmp_path):
+        state = str(tmp_path / "state")
+        sup, _ = self._supervisor(state)
+        sup.poll_once()
+        rec = json.loads(
+            (tmp_path / "state" / "supervisor.sup-test.json").read_text()
+        )
+        for key in ("id", "pid", "wall", "mono", "interval_s", "seq",
+                    "exiting", "target_daemons"):
+            assert key in rec, key
+        assert rec["id"] == "sup-test" and rec["exiting"] is False
+
+
+# --------------------------------------------------------------------------
+# conformance over a remote prefix
+
+
+class TestRemoteConformance:
+    def test_remote_state_dir_conforms(self, signed_stub):
+        state = f"{signed_stub.url}/state"
+        q = JobQueue(f"{state}/jobs", lease_s=30.0, daemon_id="d0")
+        q.submit({"schema": 1, "workflow": "w", "tenant": "t"})
+        claim = q.claim_next()
+        q.complete(claim, {"ok": True})
+        _stamp_beat(state, "d0")
+        sup = Supervisor(state, spawn_fn=lambda d: object(),
+                         drain_fn=lambda d, r: None,
+                         supervisor_id="sup-conf")
+        sup.poll_once()
+        problems, warnings, recognized = conformance_report(state)
+        assert problems == []
+        assert recognized >= 4  # job, lease, result, beat, supervisor
+
+    def test_remote_unknown_file_flagged(self, signed_stub):
+        backend = store_backend.backend_for(signed_stub.url)
+        state = f"{signed_stub.url}/state2"
+        backend.write_bytes(f"{state}/bogus.dat", b"x")
+        problems, _, _ = conformance_report(state)
+        assert any("unknown file" in p for p in problems)

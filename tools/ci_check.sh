@@ -1948,6 +1948,323 @@ if [ "$ingest_rc" -ne 0 ]; then
     exit "$ingest_rc"
 fi
 
+echo "== ctt-diskless chaos smoke (supervisor-autoscaled 1->3->1 fleet on a SigV4 stub store, SIGKILL daemon + supervisor mid-burst -> zero loss) =="
+# the diskless gate: a serve fleet whose ONLY shared state is an object
+# store prefix (SigV4-verified requests, 5% seeded request chaos).  A
+# supervisor autoscales 1->3 under a 12-job burst; one daemon AND the
+# supervisor are SIGKILLed mid-burst; a restarted supervisor re-adopts
+# the fleet from beats alone.  Every job must publish an ok result,
+# outputs must be byte-identical to a single-daemon POSIX-state
+# reference run, the fleet must drain back to 1, /metrics must show a
+# fast-path reclaim and supervisor activity, and the surviving remote
+# state dir must pass protocol conformance.
+diskless_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+AWS_ACCESS_KEY_ID=ctt-ci-access AWS_SECRET_ACCESS_KEY=ctt-ci-secret \
+CTT_S3_SIGN=1 CTT_HEARTBEAT_S=1.0 \
+CTT_TRACE_DIR="$diskless_tmp/trace" CTT_RUN_ID=ci_diskless \
+    python - "$diskless_tmp" <<'PY'
+import hashlib, json, os, signal, subprocess, sys, time
+
+td = sys.argv[1]
+repo_root = os.environ.get("PYTHONPATH", "").split(os.pathsep)[0] or "."
+env = {**os.environ, "PALLAS_AXON_POOL_IPS": ""}
+
+import numpy as np
+
+from cluster_tools_tpu.serve import ServeClient
+from cluster_tools_tpu.serve.client import read_endpoint
+from cluster_tools_tpu.serve.fleet import FleetView, read_peers
+from cluster_tools_tpu.utils import file_reader
+
+
+def digest(root):
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def sleep_job(root_td, data_root, tag, sleep_s, phase):
+    # the calibrated-cost fixture task (deterministic input * 2 + 1):
+    # the reference run is fast while staying byte-identical
+    return {
+        "workflow": "bench_e2e_lib:SkewedCostTask",
+        "kwargs": {
+            "tmp_folder": os.path.join(root_td, f"tmp_{phase}_{tag}"),
+            "config_dir": os.path.join(root_td, f"configs_{phase}_{tag}"),
+            "input_path": f"{data_root}/{tag}.n5", "input_key": "x",
+            "output_path": f"{data_root}/{tag}.n5", "output_key": "y",
+        },
+        "configs": {
+            "global": {"block_shape": [2, 8, 8]},
+            "skewed_cost": {
+                "hot_z_end": 0, "base_s": float(sleep_s), "hot_s": 99.0,
+            },
+        },
+        "tenant": tag,
+    }
+
+
+tags = [f"k{i}" for i in range(12)]
+
+# -- single-daemon POSIX reference run (the digest oracle) ----------------
+ref_root = os.path.join(td, "ref")
+os.makedirs(ref_root)
+for tag in tags:
+    file_reader(os.path.join(ref_root, f"{tag}.n5")).create_dataset(
+        "x", data=np.ones((2, 8, 8), dtype="float32"), chunks=(2, 8, 8))
+ref = subprocess.Popen(
+    [sys.executable, "-m", "cluster_tools_tpu.serve",
+     "--state-dir", os.path.join(td, "state_ref"), "--daemon-id", "ref"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+)
+ref.stdout.readline()
+ep = json.loads(ref.stdout.readline())
+try:
+    ref_client = ServeClient(endpoint=f"http://{ep['host']}:{ep['port']}",
+                             token=ep["token"])
+    jobs = [ref_client.submit(**sleep_job(td, ref_root, t, 0.01, "ref"))
+            for t in tags]
+    for jid in jobs:
+        assert ref_client.wait(jid, timeout_s=300)["result"]["ok"]
+finally:
+    ref.kill()
+    ref.wait(timeout=30)
+
+# -- the diskless fleet: SigV4 stub store, 5% chaos, supervisor ------------
+objroot = os.path.join(td, "objroot")
+os.makedirs(objroot)
+for tag in tags:
+    file_reader(os.path.join(objroot, f"{tag}.n5")).create_dataset(
+        "x", data=np.ones((2, 8, 8), dtype="float32"), chunks=(2, 8, 8))
+port_file = os.path.join(td, "stub.port")
+stub = subprocess.Popen([
+    sys.executable, os.path.join(repo_root, "tests", "objstub.py"),
+    "--root", objroot, "--port-file", port_file,
+    "--fail-rate", "0.05", "--seed", "23",
+    "--sigv4-access-key", env["AWS_ACCESS_KEY_ID"],
+    "--sigv4-secret-key", env["AWS_SECRET_ACCESS_KEY"],
+], env=env)
+sup = sup2 = None
+sup_log = open(os.path.join(td, "supervisor.log"), "w")
+try:
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file):
+        assert stub.poll() is None, "objstub died on startup"
+        assert time.monotonic() < deadline, "objstub never came up"
+        time.sleep(0.05)
+    url = f"http://127.0.0.1:{open(port_file).read().strip()}"
+    state_url = f"{url}/state"
+
+    # acceptance: an UNSIGNED request against the SigV4 store is a
+    # retryable auth error (EACCES), never a silent miss
+    probe_env = {k: v for k, v in env.items()
+                 if k not in ("AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
+                              "CTT_S3_SIGN")}
+    probe_env["CTT_IO_RETRIES"] = "1"
+    probe_env["CTT_IO_BACKOFF_BASE_S"] = "0.001"
+    probe = subprocess.run(
+        [sys.executable, "-c", (
+            "import errno, sys\n"
+            "from cluster_tools_tpu.utils.store_backend import backend_for\n"
+            f"b = backend_for({url!r})\n"
+            "try:\n"
+            f"    b.read_bytes({url!r} + '/state/serve.json')\n"
+            "except FileNotFoundError:\n"
+            "    sys.exit(3)  # silent auth downgrade\n"
+            "except OSError as e:\n"
+            "    sys.exit(0 if e.errno == errno.EACCES else 4)\n"
+            "sys.exit(5)\n"
+        )], env=probe_env,
+    )
+    assert probe.returncode == 0, (
+        f"unsigned request not a retryable auth error (rc={probe.returncode})")
+
+    def spawn_supervisor():
+        return subprocess.Popen(
+            [sys.executable, "-m", "cluster_tools_tpu.serve.supervisor",
+             "--state-dir", state_url, "--min", "1", "--max", "3",
+             "--poll-s", "0.5",
+             "--daemon-arg=--lease-s", "--daemon-arg=5",
+             "--daemon-arg=--concurrency", "--daemon-arg=2"],
+            env=env, stdout=sup_log, stderr=sup_log,
+        )
+
+    def live_ids():
+        try:
+            return sorted(FleetView(state_url).live())
+        except OSError:
+            return []
+
+    def endpoint_client():
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                ep = read_endpoint(state_url)
+                client = ServeClient(
+                    endpoint=f"http://{ep['host']}:{ep['port']}",
+                    token=ep["token"])
+                client.healthz()
+                return client, int(ep["pid"])
+            except Exception:
+                time.sleep(0.2)
+        raise AssertionError("no healthy endpoint over the remote state dir")
+
+    sup = spawn_supervisor()
+    client, ep_pid = endpoint_client()  # min-floor daemon came up
+
+    jobs = [client.submit(**sleep_job(td, url, t, 4.0, "fleet"))
+            for t in tags]
+
+    # burst pressure scales the fleet to the ceiling (capture the
+    # observation: on a loaded host a re-read can transiently flicker)
+    n_live = 0
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and n_live != 3:
+        assert sup.poll() is None, "supervisor died during scale-up"
+        n_live = len(live_ids())
+        time.sleep(0.2)
+    assert n_live == 3, f"never scaled to 3: {live_ids()}"
+
+    # SIGKILL a non-endpoint daemon once its beat proves a job in
+    # flight, and SIGKILL the supervisor in the same breath
+    client, ep_pid = endpoint_client()
+    victim_pid = None
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline and victim_pid is None:
+        for did, rec in read_peers(state_url).items():
+            if rec.get("torn") or rec.get("exiting"):
+                continue
+            pid = int(rec.get("pid") or 0)
+            if pid and pid != ep_pid and rec.get("running_jobs", 0) >= 1:
+                victim_pid = pid
+                break
+        time.sleep(0.1)
+    assert victim_pid is not None, "no non-endpoint daemon went busy"
+    os.kill(victim_pid, signal.SIGKILL)
+    sup.kill()
+    sup.wait(timeout=30)
+    t_kill = time.time()
+
+    # a RESTARTED supervisor re-adopts the fleet from beats alone
+    sup2 = spawn_supervisor()
+
+    # zero loss: every job publishes an ok result
+    for jid in jobs:
+        done = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                client, ep_pid = endpoint_client()
+                done = client.wait(jid, timeout_s=60)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert done is not None and done["result"]["ok"], jid
+
+    from cluster_tools_tpu.serve import JobQueue
+    q = JobQueue(f"{state_url}/jobs", lease_s=5.0)
+    results = [q.get(j)["result"] for j in jobs]
+    requeued = [r for r in results if r["gen"] > 0]
+    assert requeued, "the killed daemon's job never requeued"
+
+    # byte-identity vs the single-daemon POSIX reference, reclaim incl.
+    for tag in tags:
+        assert digest(os.path.join(objroot, f"{tag}.n5", "y")) == digest(
+            os.path.join(ref_root, f"{tag}.n5", "y")
+        ), f"{tag} output differs from the single-daemon run"
+
+    # shared-run /metrics: the fleet reclaimed the killed daemon's job
+    # and the supervisors' action ledger moved (spawns + re-adoptions)
+    vals = {}
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            client, ep_pid = endpoint_client()
+            text = client.metrics_text()
+        except Exception:
+            time.sleep(0.5)
+            continue
+        vals = {
+            ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+            for ln in text.splitlines()
+            if ln and not ln.startswith("#")
+        }
+        if (vals.get("ctt_serve_jobs_reclaimed_total", 0) >= 1
+                and vals.get("ctt_serve_supervisor_spawns_total", 0) >= 1
+                and vals.get("ctt_serve_supervisor_adoptions_total", 0) >= 1):
+            break
+        time.sleep(0.5)
+    assert vals.get("ctt_serve_jobs_reclaimed_total", 0) >= 1, vals
+    assert vals.get("ctt_serve_supervisor_spawns_total", 0) >= 1, vals
+    assert vals.get("ctt_serve_supervisor_adoptions_total", 0) >= 1, vals
+
+    # idle fleet drains back to the floor
+    n_live = 99
+    deadline = time.monotonic() + 150
+    while time.monotonic() < deadline and n_live != 1:
+        assert sup2.poll() is None, "restarted supervisor died"
+        n_live = len(live_ids())
+        time.sleep(0.3)
+    assert n_live == 1, f"never drained to 1: {live_ids()}"
+
+    # protocol conformance over the SURVIVING REMOTE state dir
+    conf = subprocess.run(
+        [sys.executable, "-m", "cluster_tools_tpu.analysis",
+         "conformance", state_url], env=env,
+    )
+    assert conf.returncode == 0, (
+        f"remote-state conformance failed (rc={conf.returncode})")
+
+    print("diskless smoke ok:", json.dumps({
+        "requeued": len(requeued),
+        "reclaim_latency_s": round(
+            min(r["finished_wall"] for r in requeued) - t_kill, 2),
+        "supervisor_spawns": vals.get("ctt_serve_supervisor_spawns_total"),
+        "supervisor_adoptions": vals.get(
+            "ctt_serve_supervisor_adoptions_total"),
+    }))
+finally:
+    for proc in (sup, sup2):
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    # orphaned daemons (their supervisors were SIGKILLed): sweep by beat
+    try:
+        for did, rec in read_peers(state_url).items():
+            pid = int(rec.get("pid") or 0)
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+    except Exception:
+        pass
+    stub.terminate()
+    stub.wait(timeout=30)
+    sup_log.close()
+PY
+diskless_rc=$?
+if [ "$diskless_rc" -ne 0 ]; then
+    echo "--- supervisor log tail ---" >&2
+    tail -40 "$diskless_tmp/supervisor.log" >&2 || true
+fi
+rm -rf "$diskless_tmp"
+if [ "$diskless_rc" -ne 0 ]; then
+    echo "diskless smoke failed (rc=$diskless_rc): the supervisor-scaled" \
+         "fleet over the SigV4 object store lost a job, broke" \
+         "byte-identity, failed to re-adopt after the supervisor kill," \
+         "never autoscaled 1->3->1, or left a non-conformant remote" \
+         "state dir" >&2
+    exit "$diskless_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
