@@ -1001,7 +1001,11 @@ def bench_ws_e2e(x, block_shape):
                 "stacked dispatches, p99 "
                 f"{mb_res['ws_e2e_microbatch_p99_s']} s (bounded "
                 f"{mb_res['ws_e2e_microbatch_p99_bounded']}), parity "
-                f"{mb_res['ws_e2e_microbatch_parity']}"
+                f"{mb_res['ws_e2e_microbatch_parity']}; daemon-hist e2e "
+                f"p50 {mb_res['ws_e2e_mb_e2e_p50_s']} s / p99 "
+                f"{mb_res['ws_e2e_mb_e2e_p99_s']} s over "
+                f"{mb_res['ws_e2e_mb_e2e_samples']} samples (consistent "
+                f"{mb_res['ws_e2e_mb_e2e_hist_consistent']})"
             )
         except Exception as e:
             log(f"[ws-e2e] ctt-microbatch bench failed: {e}")

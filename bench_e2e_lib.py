@@ -1296,9 +1296,13 @@ def run_microbatch_pipeline(n_jobs=1000, n_tenants=4, window_s=0.25,
     sum exactly to the window-0 control; p99 admission-to-result of the
     aggregated leg bounded by the control's p99 + the window (the window
     may delay a job, never by more than itself); zero splits (no member
-    failed out of a batch)."""
+    failed out of a batch).  ctt-slo (BENCH_r14): the aggregated leg
+    also reports ``ws_e2e_mb_e2e_p50_s``/``ws_e2e_mb_e2e_p99_s`` from
+    the daemon's own ``serve.latency.e2e`` histograms, cross-checked
+    against the client stopwatch within the log2 bucket resolution."""
     import hashlib
 
+    from cluster_tools_tpu.obs import hist as obs_hist
     from cluster_tools_tpu.obs import metrics as obs_metrics
     from cluster_tools_tpu.serve import JobQueue, ServeDaemon
     from cluster_tools_tpu.serve import protocol as serve_protocol
@@ -1330,6 +1334,16 @@ def run_microbatch_pipeline(n_jobs=1000, n_tenants=4, window_s=0.25,
                     h.update(f.read())
         return h.hexdigest()
 
+    def _e2e_buckets(snap):
+        # ctt-slo: sum the serve.latency.e2e buckets across tenant/
+        # priority labels — fixed edges make the aggregation exact
+        acc = [0] * (len(obs_hist.EDGES) + 1)
+        for s in snap.get("hists") or []:
+            if s.get("name") == "serve.latency.e2e":
+                for i, c in enumerate(s["buckets"]):
+                    acc[i] += int(c)
+        return acc
+
     def _leg(td, path, tag, window):
         state = os.path.join(td, f"state_{tag}")
         q = JobQueue(os.path.join(state, "jobs"))
@@ -1347,6 +1361,10 @@ def run_microbatch_pipeline(n_jobs=1000, n_tenants=4, window_s=0.25,
             })
             job_ids.append(q.submit(rec))
         before = dict(obs_metrics.snapshot()["counters"])
+        # ctt-slo: the daemon runs in-process, so its latency histograms
+        # accumulate in THIS process — a before/after bucket delta
+        # isolates the leg (reset() would clobber the run's flush file)
+        hist_before = _e2e_buckets(obs_hist.snapshot())
         t0 = time.perf_counter()
         daemon = ServeDaemon(state, config={
             "microbatch_window_s": float(window),
@@ -1370,6 +1388,8 @@ def run_microbatch_pipeline(n_jobs=1000, n_tenants=4, window_s=0.25,
             _drain(daemon)
         obs_metrics.flush()
         after = dict(obs_metrics.snapshot()["counters"])
+        hist_after = _e2e_buckets(obs_hist.snapshot())
+        e2e_buckets = [b - a for a, b in zip(hist_before, hist_after)]
         per_tenant, latencies, all_ok = {}, [], True
         for jid in job_ids:
             st = q.get(jid)
@@ -1388,7 +1408,9 @@ def run_microbatch_pipeline(n_jobs=1000, n_tenants=4, window_s=0.25,
 
         return {
             "wall": wall, "ok": all_ok, "per_tenant": per_tenant,
+            "p50": float(np.percentile(latencies, 50)),
             "p99": float(np.percentile(latencies, 99)),
+            "e2e_buckets": e2e_buckets,
             "jobs_done": delta("serve.jobs_done"),
             "batches": delta("serve.microbatch_batches"),
             "jobs_batched": delta("serve.microbatch_jobs_batched"),
@@ -1445,6 +1467,19 @@ def run_microbatch_pipeline(n_jobs=1000, n_tenants=4, window_s=0.25,
     jobs_per_dispatch = (
         mb["jobs_batched"] / mb["batches"] if mb["batches"] else 0.0
     )
+
+    # ctt-slo (BENCH_r14): the aggregated leg's e2e percentiles as the
+    # DAEMON's serve.latency.e2e histograms saw them, cross-checked
+    # against the client stopwatch — both span submit->publish, so they
+    # must agree within the log2 bucket resolution (adjacent-edge
+    # ratio == 2)
+    mb_hist_p50 = obs_hist.quantile(mb["e2e_buckets"], 0.50)
+    mb_hist_p99 = obs_hist.quantile(mb["e2e_buckets"], 0.99)
+
+    def _hist_close(h, c):
+        return (h is not None and h > 0.0 and c > 0.0
+                and max(h, c) / min(h, c) <= 2.0000001)
+
     return {
         "ws_e2e_microbatch_jobs": int(n_jobs),
         "ws_e2e_microbatch_tenants": int(n_tenants),
@@ -1461,6 +1496,13 @@ def run_microbatch_pipeline(n_jobs=1000, n_tenants=4, window_s=0.25,
         "ws_e2e_microbatch_p99_s": round(mb["p99"], 3),
         "ws_e2e_microbatch_p99_bounded": bool(
             mb["p99"] <= solo["p99"] + window_s
+        ),
+        "ws_e2e_mb_e2e_p50_s": round(mb_hist_p50 or 0.0, 4),
+        "ws_e2e_mb_e2e_p99_s": round(mb_hist_p99 or 0.0, 4),
+        "ws_e2e_mb_e2e_samples": int(sum(mb["e2e_buckets"])),
+        "ws_e2e_mb_e2e_hist_consistent": bool(
+            _hist_close(mb_hist_p50, mb["p50"])
+            and _hist_close(mb_hist_p99, mb["p99"])
         ),
         "ws_e2e_microbatch_tenant_sums_match": bool(
             solo["per_tenant"] == mb["per_tenant"]

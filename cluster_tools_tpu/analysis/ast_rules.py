@@ -44,11 +44,13 @@ through runtime tests:
           only ``pass`` — swallowing a block error without recording any
           status hides failures from the retry machinery and the operator.
   CTT010  metric-name hygiene: a string literal passed to
-          ``metrics.inc``/``metrics.set_gauge`` that is not listed in
-          ``obs/registry.py`` (and matches no allowed dynamic prefix,
-          e.g. ``faults.injected.<site>``) — a typo silently creates a
-          fresh series nothing ever reads.  Non-literal names (f-strings,
-          variables) are the sanctioned dynamic path and are skipped.
+          ``metrics.inc``/``metrics.set_gauge``/``hist.observe`` that is
+          not listed in ``obs/registry.py`` (counters, gauges, and
+          histograms are checked against their own kind; dynamic
+          prefixes like ``faults.injected.<site>`` are allowed) — a typo
+          silently creates a fresh series nothing ever reads.
+          Non-literal names (f-strings, variables) are the sanctioned
+          dynamic path and are skipped.
 """
 
 from __future__ import annotations
@@ -506,18 +508,30 @@ def _check_resilience_hygiene(
 # --------------------------------------------------------------------------
 # CTT010: metric-name literals must come from obs/registry.py
 
-_METRIC_CALL_ATTRS = {"inc": "counter", "set_gauge": "gauge"}
+_METRIC_CALL_ATTRS = {"inc": "counter", "set_gauge": "gauge",
+                      "observe": "histogram"}
+# the receiver module alias each call kind must ride: `metrics.inc`,
+# `obs_metrics.set_gauge`, `hist.observe`, `obs_hist.observe` — arbitrary
+# objects with .inc()/.observe() are not metric sites
+_METRIC_RECEIVER_HINT = {"counter": "metrics", "gauge": "metrics",
+                         "histogram": "hist"}
 
 
 def _check_metric_names(
     tree: ast.Module, path: str, findings: List[Finding]
 ) -> None:
-    """Flag ``<...>metrics.inc("name")`` / ``set_gauge("name")`` literals
-    absent from the registry.  Only literal first arguments are checked —
-    computed names (``f"faults.injected.{site}"``) are the dynamic path,
-    covered by the registry's prefix list."""
+    """Flag ``<...>metrics.inc("name")`` / ``set_gauge("name")`` /
+    ``<...>hist.observe("name", v)`` literals absent from the registry.
+    Only literal first arguments are checked — computed names
+    (``f"faults.injected.{site}"``) are the dynamic path, covered by the
+    registry's prefix list."""
     from ..obs import registry as metric_registry
 
+    _known = {
+        "counter": metric_registry.is_known_counter,
+        "gauge": metric_registry.is_known_gauge,
+        "histogram": metric_registry.is_known_histogram,
+    }
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
@@ -525,21 +539,14 @@ def _check_metric_names(
         parts = name.split(".")
         if len(parts) < 2 or parts[-1] not in _METRIC_CALL_ATTRS:
             continue
-        # the receiver must be a metrics module alias (`metrics`,
-        # `obs_metrics`); arbitrary objects with .inc() are not metrics
-        if "metrics" not in parts[-2]:
+        kind = _METRIC_CALL_ATTRS[parts[-1]]
+        if _METRIC_RECEIVER_HINT[kind] not in parts[-2]:
             continue
         arg = node.args[0]
         if not isinstance(arg, ast.Constant) or not isinstance(arg.value, str):
             continue
         mname = arg.value
-        kind = _METRIC_CALL_ATTRS[parts[-1]]
-        known = (
-            metric_registry.is_known_counter(mname)
-            if kind == "counter"
-            else metric_registry.is_known_gauge(mname)
-        )
-        if not known:
+        if not _known[kind](mname):
             findings.append(Finding(
                 "CTT010", path, node.lineno,
                 f"{kind} name '{mname}' is not in obs/registry.py — a "

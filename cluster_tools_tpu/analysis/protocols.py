@@ -113,6 +113,18 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
         closed=True,
     ),
     ArtifactSchema(
+        name="hist_snapshot",
+        pattern=r"^hist\.p\d+\.json$",
+        description="ctt-slo per-process latency-histogram snapshot, "
+        "atomically replaced; fixed bucket edges make cross-process "
+        "merge exact (bucket-wise addition)",
+        required={"schema": "int", "edges": "list", "hists": "list"},
+        producers=(("obs/hist.py", "snapshot"),),
+        merge_producers=(("obs/hist.py", "flush"),),
+        consumers=(),  # load_run_hists/merge_into read per-series dicts
+        closed=True,
+    ),
+    ArtifactSchema(
         name="heartbeat",
         pattern=r"^hb\.p\d+\.json$",
         description="ctt-watch per-process liveness/progress beat",
@@ -243,13 +255,17 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
         # released=true: the owner gave the job back voluntarily (drain
         # suspend of a long-lived ingest stream) — stamped with wall=0 so
         # the lease classifies expired immediately, and excluded from the
-        # generation budget on quarantine accounting
-        optional={"released": "bool"},
+        # generation budget on quarantine accounting.
+        # dispatch_wall (ctt-slo): when this generation's execution began
+        # after any microbatch aggregation window — the claim→dispatch
+        # span is the window-wait phase ``obs journey`` reads back
+        optional={"released": "bool", "dispatch_wall": "number"},
         producers=(("serve/jobs.py", "_lease_payload"),),
         consumers=(
             ("serve/jobs.py", "_stamp_age_s"),
             ("serve/jobs.py", "_lease_state"),
             ("serve/jobs.py", "_released_gens"),
+            ("obs/journey.py", "_lease_row"),
         ),
         torn_ok=True,
         closed=True,
@@ -279,6 +295,12 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
             # job rode an aggregation window (+"split": true when it was
             # re-dispatched individually after a batch-path failure)
             "microbatch": "dict",
+            # ctt-slo phase walls: the winning generation's claim /
+            # execution-start / publish stamps, so the per-job phase
+            # breakdown (``obs journey``) reconstructs from the terminal
+            # record alone even after the leases are gone
+            "claimed_wall": "number", "dispatch_wall": "number",
+            "published_wall": "number",
         },
         producers=(
             ("serve/jobs.py", "retract"),
@@ -310,6 +332,22 @@ SCHEMAS: Tuple[ArtifactSchema, ...] = (
             ("serve/fleet.py", "is_dead"),
         ),
         torn_ok=True,  # read_peers degrades a torn beat to {"torn": True}
+    ),
+    ArtifactSchema(
+        name="fleet_snap",
+        pattern=r"^snap\.[A-Za-z0-9_.-]+\.json$",
+        description="ctt-slo per-daemon metrics+histogram snapshot, "
+        "published into the SHARED state dir on the fleet-beat cadence "
+        "— ``obs fleet`` merges every daemon's snap into one rollup",
+        required={
+            "schema": "int", "daemon": "str", "pid": "int",
+            "wall": "number", "counters": "dict", "gauges": "dict",
+            "hists": "dict",
+        },
+        producers=(("serve/server.py", "_publish_snapshot"),),
+        consumers=(("obs/slo.py", "merge_fleet"),),
+        torn_ok=True,  # best-effort beat-side write; readers skip torn
+        closed=True,
     ),
     ArtifactSchema(
         name="supervisor_state",
@@ -402,6 +440,7 @@ PRODUCER_MODULES = frozenset({
     "serve/supervisor.py",
     "serve/admission.py",
     "obs/heartbeat.py",
+    "obs/hist.py",
     "obs/metrics.py",
     "obs/trace.py",
     "utils/store_backend.py",

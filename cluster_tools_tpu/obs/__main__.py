@@ -14,17 +14,30 @@ Live (ctt-watch: incremental, tolerant of in-flight writes):
     python -m cluster_tools_tpu.obs heatmap <run_dir> [--task NAME]
     python -m cluster_tools_tpu.obs prom <run_dir>
 
+Request-grain (ctt-slo: serve state dirs, POSIX or object-store):
+
+    python -m cluster_tools_tpu.obs journey <state_dir> <job_id> [--json]
+    python -m cluster_tools_tpu.obs fleet <state_dir>
+    python -m cluster_tools_tpu.obs slo <dir> --objective SPEC [...]
+        [--fail-on-violation] [--json]
+
 ``<run_dir>`` is either ``<CTT_TRACE_DIR>/<run_id>`` or a trace dir
 containing exactly one run.  Exit codes:
 
   0  success (summarize: at least one task span; diff: no regression;
-     watch: block/task progress observed and no stall flagged)
+     watch: block/task progress observed and no stall flagged;
+     journey: timeline rendered; fleet: rollup emitted; slo: every
+     objective judged against data and none violated)
   1  nothing recorded (summarize: no task spans; watch --once: no
-     progress; heatmap: no finished blocks; prom: no run directory)
-  2  malformed trace (truncated/corrupt shard, mixed runs, bad metrics)
+     progress; heatmap: no finished blocks; prom: no run directory;
+     journey: no such job; fleet: no daemon snapshots; slo: an
+     objective matched no data)
+  2  malformed trace (truncated/corrupt shard, mixed runs, bad metrics,
+     a bad --objective spec, or foreign histogram bucket edges)
   3  diff found at least one task regressed beyond the threshold
   4  watch --fail-on-stall flagged a stale worker (heartbeat older than
-     3x its cadence: suspected dead before the deadline watchdog fires)
+     3x its cadence: suspected dead before the deadline watchdog
+     fires); slo --fail-on-violation found an objective violated
 """
 
 from __future__ import annotations
@@ -114,9 +127,37 @@ def main(argv=None) -> int:
     )
     p_prom.add_argument("run")
 
+    p_journey = sub.add_parser(
+        "journey", help="per-job phase timeline from serve state-dir "
+        "records (failover-aware, purely post-hoc)"
+    )
+    p_journey.add_argument("state_dir")
+    p_journey.add_argument("job_id")
+    p_journey.add_argument("--json", action="store_true")
+
+    p_fleet = sub.add_parser(
+        "fleet", help="fleet-wide OpenMetrics rollup of every daemon's "
+        "snap.<id>.json (counters summed, histograms exactly merged)"
+    )
+    p_fleet.add_argument("state_dir")
+
+    p_slo = sub.add_parser(
+        "slo", help="gate latency objectives against merged histograms "
+        "(exit 0 met / 1 no data / 4 violated with --fail-on-violation)"
+    )
+    p_slo.add_argument("dir")
+    p_slo.add_argument("--objective", action="append", required=True,
+                       metavar="PHASE_pNN_s=SECONDS[@label=value,...]",
+                       help="e.g. e2e_p99_s=2.0@priority=5 (repeatable)")
+    p_slo.add_argument("--fail-on-violation", action="store_true",
+                       help="exit 4 when any objective is violated")
+    p_slo.add_argument("--json", action="store_true")
+
     args = parser.parse_args(argv)
     if args.cmd in ("watch", "heatmap", "prom"):
         return _live_main(args)
+    if args.cmd in ("journey", "fleet", "slo"):
+        return _slo_main(args)
     try:
         if args.cmd == "summarize":
             summary = summarize(load_run(args.run))
@@ -151,6 +192,58 @@ def main(argv=None) -> int:
             return EXIT_REGRESSED if result["n_regressed"] else EXIT_OK
     except TraceFormatError as e:
         print(f"obs: malformed trace: {e}", file=sys.stderr)
+        return EXIT_MALFORMED
+    except OSError as e:
+        print(f"obs: {e}", file=sys.stderr)
+        return EXIT_MALFORMED
+    raise AssertionError(f"unhandled command {args.cmd}")
+
+
+def _slo_main(args) -> int:
+    from . import journey as journey_mod
+    from . import slo as slo_mod
+
+    try:
+        if args.cmd == "journey":
+            j = journey_mod.load_journey(args.state_dir, args.job_id)
+            if j is None:
+                print(f"obs: no job {args.job_id} under {args.state_dir}",
+                      file=sys.stderr)
+                return EXIT_NO_TASKS
+            if args.json:
+                print(json.dumps(j, indent=2, sort_keys=True))
+            else:
+                print(journey_mod.format_journey(j))
+            return EXIT_OK
+        if args.cmd == "fleet":
+            merged = slo_mod.load_fleet(args.state_dir)
+            if not merged["daemons"]:
+                print(f"obs: no daemon snapshots under {args.state_dir}",
+                      file=sys.stderr)
+                return EXIT_NO_TASKS
+            print(slo_mod.render_fleet(merged), end="")
+            return EXIT_OK
+        if args.cmd == "slo":
+            objectives = [slo_mod.parse_objective(s)
+                          for s in args.objective]
+            hists = slo_mod.load_hists_any(args.dir)
+            rows = slo_mod.evaluate(hists, objectives)
+            if args.json:
+                print(json.dumps(rows, indent=2, sort_keys=True))
+            else:
+                print(slo_mod.format_report(rows))
+            # contract: violated (4) outranks no-data (1) outranks met (0);
+            # without --fail-on-violation a violation only reports
+            if args.fail_on_violation and any(
+                r["status"] == "violated" for r in rows
+            ):
+                return EXIT_STALLED
+            if any(r["status"] == "no_data" for r in rows):
+                return EXIT_NO_TASKS
+            return EXIT_OK
+    except ValueError as e:
+        # bad --objective spec or foreign histogram edges (version skew)
+        print(f"obs: {e}", file=sys.stderr)
         return EXIT_MALFORMED
     except OSError as e:
         print(f"obs: {e}", file=sys.stderr)

@@ -35,6 +35,7 @@ import json
 import os
 from typing import Any, Dict, List, Optional
 
+from . import hist as hist_mod
 from .metrics import METRICS_FILE_PREFIX
 
 __all__ = [
@@ -164,6 +165,9 @@ def load_run(path: str) -> Dict[str, Any]:
         "headers": headers,
         "counters": metrics["counters"],
         "gauges": metrics["gauges"],
+        # ctt-slo: exact cross-process merge of hist.p*.json (the fixed
+        # bucket edges make it bucket-wise addition)
+        "hists": hist_mod.load_run_hists(run_dir),
     }
 
 
@@ -234,6 +238,10 @@ def summarize(run: Dict[str, Any]) -> Dict[str, Any]:
         "tasks": tasks,
         "counters": run["counters"],
         "gauges": run["gauges"],
+        # ctt-slo: the key appears only when the run recorded histograms,
+        # so the machine-readable golden stays unchanged without them
+        **({"hists": run["hists"]}
+           if (run.get("hists") or {}).get("hists") else {}),
     }
 
 
@@ -267,6 +275,21 @@ def format_summary(summary: Dict[str, Any]) -> str:
             v = counters[k]
             lines.append(f"  {k} = {v:.0f}" if float(v).is_integer()
                          else f"  {k} = {v:.3f}")
+    # ctt-slo: only when the run actually carries histograms, so existing
+    # summary output stays byte-identical for runs without them.
+    hists = (summary.get("hists") or {}).get("hists") or []
+    if hists:
+        lines.append("latency (s):")
+        for s in hists:
+            buckets = list(s["buckets"])
+            p50 = hist_mod.quantile(buckets, 0.50)
+            p99 = hist_mod.quantile(buckets, 0.99)
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(s.get("labels", {}).items()))
+            series = s["name"] + (f"{{{lbl}}}" if lbl else "")
+            lines.append(
+                f"  {series} p50={p50:.6f} p99={p99:.6f} n={int(s['count'])}"
+            )
     return "\n".join(lines)
 
 

@@ -1,0 +1,260 @@
+"""ctt-slo latency histograms: process-safe, exactly-mergeable buckets.
+
+Counters (obs.metrics) answer "how many"; request-grain SLOs need "how
+slow, at which percentile, for which tenant".  This module is the
+histogram twin of :mod:`obs.metrics`: one enabled-check + one bisect +
+one list increment per ``observe()``, flushed as ONE
+``hist.p<pid>.json`` snapshot per process into the active run's
+directory (atomic tmp+replace, the store convention).
+
+The design constraint is *exact mergeability*: every histogram in every
+process of every daemon uses the SAME fixed log2 bucket edges
+(:data:`EDGES` — ``2**e`` for e in [-20, 6], ~1 µs to 64 s, plus a
++Inf overflow bucket).  Merging two snapshots is therefore pure
+bucket-wise integer addition — no re-bucketing, no approximation — so a
+fleet-wide rollup over N daemons is bit-identical to the histogram a
+single process observing the same values would have produced.  That
+exactness is what lets ``obs slo`` gate CI on a p99 computed from
+merged per-daemon snapshots.
+
+Quantiles are Prometheus-style: linear interpolation inside the bucket
+that crosses the target rank, which bounds the error by the bucket
+width (a factor-of-2 resolution; adjacent-edge ratio == 2).
+
+Series are keyed by (name, sorted label items).  Names are registered
+in :mod:`obs.registry` (``HISTOGRAMS``) and lint rule CTT010 flags
+``hist.observe`` literals absent from it, exactly like counters.
+
+Exported in OpenMetrics histogram form (``_bucket{le=...}`` / ``_sum``
+/ ``_count``) by :func:`render_openmetrics` — the same exposition
+``obs fleet`` emits for the whole fleet.
+
+Enabled exactly when tracing is enabled (one switch: CTT_TRACE_DIR).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from bisect import bisect_left
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from . import trace
+
+__all__ = [
+    "EDGES", "observe", "snapshot", "flush", "reset",
+    "merge_into", "merge_snapshots", "quantile", "series_quantile",
+    "render_openmetrics", "load_run_hists", "HIST_FILE_PREFIX",
+]
+
+# Fixed for every histogram in the tree — exact cross-process merge
+# depends on it.  2**-20 s ~ 0.95 us .. 2**6 s = 64 s, then +Inf.
+EDGES: Tuple[float, ...] = tuple(2.0 ** e for e in range(-20, 7))
+_N_BUCKETS = len(EDGES) + 1  # trailing +Inf overflow bucket
+
+HIST_FILE_PREFIX = "hist.p"
+SCHEMA = 1
+
+_LOCK = threading.Lock()
+# (name, ((label, value), ...)) -> [buckets list, sum, count]
+_HISTS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List[Any]] = {}
+
+
+def _key(name: str, labels: Dict[str, str]) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    return name, tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def observe(name: str, value: float, **labels: Any) -> None:
+    """Record one observation (seconds) into the named series."""
+    if not trace.enabled():
+        return
+    idx = bisect_left(EDGES, value)  # EDGES[idx] is the first edge >= value
+    with _LOCK:
+        h = _HISTS.get(_key(name, labels))
+        if h is None:
+            h = [[0] * _N_BUCKETS, 0.0, 0]
+            _HISTS[_key(name, labels)] = h
+        h[0][idx] += 1
+        h[1] += float(value)
+        h[2] += 1
+
+
+def snapshot() -> Dict[str, Any]:
+    """JSON-ready snapshot: {"schema", "edges", "hists": [series...]}."""
+    with _LOCK:
+        series = [
+            {
+                "name": name,
+                "labels": dict(labels),
+                "buckets": list(h[0]),
+                "sum": h[1],
+                "count": h[2],
+            }
+            for (name, labels), h in sorted(_HISTS.items())
+        ]
+    return {"schema": SCHEMA, "edges": list(EDGES), "hists": series}
+
+
+def reset() -> None:
+    """Drop all accumulated series (test isolation helper)."""
+    with _LOCK:
+        _HISTS.clear()
+
+
+def flush() -> None:
+    """Write this process's snapshot into the active run directory.
+    Atomic (tmp + os.replace); the last write per process wins — same
+    contract as ``metrics.flush``.  A separate file from the metrics
+    snapshot because the ``metrics_snapshot`` artifact schema is closed."""
+    rdir = trace.run_dir()
+    if rdir is None:
+        return
+    snap = snapshot()
+    if not snap["hists"]:
+        return
+    os.makedirs(rdir, exist_ok=True)
+    path = os.path.join(rdir, f"{HIST_FILE_PREFIX}{os.getpid()}.json")
+    tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(snap, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# merge — the whole point of fixed edges
+
+def _check_edges(snap: Dict[str, Any]) -> None:
+    edges = snap.get("edges")
+    if edges is not None and tuple(edges) != EDGES:
+        raise ValueError(
+            "histogram snapshot has foreign bucket edges; exact merge "
+            "requires the fixed registry edges"
+        )
+
+
+def merge_into(acc: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List[Any]],
+               snap: Dict[str, Any]) -> None:
+    """Bucket-wise add one snapshot into an accumulator keyed like _HISTS."""
+    _check_edges(snap)
+    for s in snap.get("hists", []):
+        k = _key(s["name"], s.get("labels", {}))
+        h = acc.get(k)
+        if h is None:
+            h = [[0] * _N_BUCKETS, 0.0, 0]
+            acc[k] = h
+        buckets = s["buckets"]
+        for i, c in enumerate(buckets[:_N_BUCKETS]):
+            h[0][i] += int(c)
+        h[1] += float(s.get("sum", 0.0))
+        h[2] += int(s.get("count", 0))
+
+
+def merge_snapshots(snaps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge many snapshots into one (exact: bucket-wise addition)."""
+    acc: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List[Any]] = {}
+    for snap in snaps:
+        merge_into(acc, snap)
+    series = [
+        {
+            "name": name,
+            "labels": dict(labels),
+            "buckets": list(h[0]),
+            "sum": h[1],
+            "count": h[2],
+        }
+        for (name, labels), h in sorted(acc.items())
+    ]
+    return {"schema": SCHEMA, "edges": list(EDGES), "hists": series}
+
+
+# ---------------------------------------------------------------------------
+# quantiles
+
+def quantile(buckets: List[int], q: float) -> Optional[float]:
+    """Prometheus-style quantile from bucket counts (q in [0, 1]).
+
+    Linear interpolation inside the crossing bucket; the overflow
+    bucket clamps to the largest finite edge.  None when empty."""
+    total = sum(buckets)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= rank and c > 0:
+            if i >= len(EDGES):  # +Inf bucket: clamp to last finite edge
+                return EDGES[-1]
+            lo = 0.0 if i == 0 else EDGES[i - 1]
+            hi = EDGES[i]
+            frac = (rank - (cum - c)) / c
+            return lo + (hi - lo) * frac
+    return EDGES[-1]
+
+
+def series_quantile(snap: Dict[str, Any], name: str, q: float,
+                    **labels: Any) -> Optional[float]:
+    """Quantile of one (name, labels) series in a snapshot, or None."""
+    want = _key(name, labels)
+    for s in snap.get("hists", []):
+        if _key(s["name"], s.get("labels", {})) == want:
+            return quantile(list(s["buckets"]), q)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# exposition + run-dir loading
+
+def _metric_name(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return f"ctt_{out}_seconds"
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_openmetrics(snap: Dict[str, Any]) -> List[str]:
+    """OpenMetrics histogram families (no ``# EOF``; the caller owns the
+    exposition envelope).  One family per name; cumulative ``le`` counts."""
+    lines: List[str] = []
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for s in snap.get("hists", []):
+        by_name.setdefault(s["name"], []).append(s)
+    for name in sorted(by_name):
+        mname = _metric_name(name)
+        lines.append(f"# TYPE {mname} histogram")
+        lines.append(f"# HELP {mname} {name} latency (fixed log2 buckets)")
+        for s in sorted(by_name[name],
+                        key=lambda s: sorted(s.get("labels", {}).items())):
+            labels = {str(k): str(v) for k, v in s.get("labels", {}).items()}
+            cum = 0
+            for i, c in enumerate(s["buckets"]):
+                cum += int(c)
+                le = repr(EDGES[i]) if i < len(EDGES) else "+Inf"
+                lstr = _label_str(labels, 'le="%s"' % le)
+                lines.append(f"{mname}_bucket{lstr} {cum}")
+            lines.append(f"{mname}_sum{_label_str(labels)} {float(s['sum'])}")
+            lines.append(f"{mname}_count{_label_str(labels)} {int(s['count'])}")
+    return lines
+
+
+def load_run_hists(run_dir: str) -> Dict[str, Any]:
+    """Merge every ``hist.p*.json`` under a run directory (exact)."""
+    snaps = []
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        names = []
+    for fn in names:
+        if fn.startswith(HIST_FILE_PREFIX) and fn.endswith(".json"):
+            try:
+                with open(os.path.join(run_dir, fn)) as f:
+                    snaps.append(json.load(f))
+            except (OSError, ValueError):
+                continue  # torn snapshot: skip, a later flush replaces it
+    return merge_snapshots(snaps)

@@ -1,0 +1,273 @@
+"""ctt-slo job journey: one job's whole life, reconstructed from disk.
+
+``obs journey <state_dir> <job_id>`` renders a per-job timeline — when
+the job was submitted, admitted, claimed (by which daemon, at which
+generation), dispatched, and published — **purely from the durable
+records** the serve substrate already writes:
+
+  * ``job.<id>.json``       — submission record (``submit_wall``)
+  * ``admit.<id>.json``     — fleet admission marker (``wall``)
+  * ``lease.<id>.g<g>.json``— one per generation: the claiming daemon,
+    ``claim_wall``, and the ctt-slo ``dispatch_wall`` execution stamp
+  * ``result.<id>.json``    — terminal record; carries the winning
+    generation's ``claimed_wall``/``dispatch_wall``/``published_wall``
+    phase walls, ``seconds``, the microbatch membership note, and (for a
+    quarantined job) the ``failure_log`` of every burned generation
+
+No live daemon is consulted and no clocks are read: the journey of a job
+that survived a SIGKILL failover (gen 0 owner died, gen 1 finished)
+renders the same whether the fleet is still up or long gone.  Lease
+generations are dense from 0, so discovery is forward existence probes —
+and the quarantine ``failure_log`` backfills generations whose lease
+file was torn by the death that burned it.
+
+The phase breakdown mirrors the server-side histogram phases
+(:mod:`obs.registry` ``HISTOGRAMS``):
+
+    admission   = admit.wall − submit_wall        (two-phase admission)
+    queue_wait  = claimed_wall − admit.wall       (claim-order waiting)
+    window_wait = dispatch_wall − claimed_wall    (microbatch window)
+    execution   = result.seconds                  (monotonic, exact)
+    publish     = published_wall − dispatch_wall − seconds
+    e2e         = published_wall − submit_wall
+
+Walls come from different hosts' clocks, so cross-host phases are good
+to fleet clock skew (the shard-anchor contract); ``execution`` is the
+owner's monotonic delta and exact.  Negative skew artifacts clamp to 0.
+
+State dirs route through the store backend, so ``<state_dir>`` may be a
+POSIX path or an ``http(s)://`` object-store prefix (ctt-diskless).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ..utils.store_backend import backend_for
+
+__all__ = ["load_journey", "format_journey", "PHASE_ORDER"]
+
+# render order == causal order; e2e last (it spans all the others)
+PHASE_ORDER = (
+    "admission", "queue_wait", "window_wait", "execution", "publish", "e2e",
+)
+
+
+def _read_json(backend, path: str) -> Optional[dict]:
+    try:
+        rec = json.loads(backend.read_bytes(path).decode())
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None  # absent or torn: the caller treats both as "no record"
+
+
+def _as_wall(value: Any) -> Optional[float]:
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def _lease_row(lease: Optional[dict], gen: int) -> Dict[str, Any]:
+    """One generation's row from its lease record (None = torn/absent)."""
+    if lease is None:
+        return {
+            "gen": gen, "torn": True, "daemon": None, "claim_wall": None,
+            "dispatch_wall": None, "released": False,
+        }
+    return {
+        "gen": gen,
+        "torn": False,
+        "daemon": lease.get("daemon"),
+        "claim_wall": _as_wall(lease.get("claim_wall")),
+        "dispatch_wall": _as_wall(lease.get("dispatch_wall")),
+        "released": bool(lease.get("released")),
+    }
+
+
+def _generations(backend, join, root: str, job_id: str,
+                 result: Optional[dict]) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    gen = 0
+    while True:
+        path = join(root, f"lease.{job_id}.g{gen}.json")
+        if not backend.exists(path):
+            break
+        rows.append(_lease_row(_read_json(backend, path), gen))
+        gen += 1
+    if result is not None and result.get("quarantined"):
+        # the death that burned a generation can also tear its lease —
+        # the quarantine verdict carries every generation's last stamp,
+        # so backfill torn rows (and any generation past the probe) from
+        # the durable failure_log
+        for i, entry in enumerate(result.get("failure_log") or []):
+            if not isinstance(entry, dict) or entry.get("torn"):
+                entry = None
+            row = _lease_row(entry, i)
+            if i < len(rows):
+                if rows[i]["torn"] and not row["torn"]:
+                    rows[i] = row
+            else:
+                rows.append(row)
+
+    win_gen = None
+    if result is not None and not result.get("rejected") \
+            and not result.get("quarantined"):
+        try:
+            win_gen = int(result["gen"])
+        except (KeyError, TypeError, ValueError):
+            win_gen = None
+    last = len(rows) - 1
+    for row in rows:
+        if win_gen is not None and row["gen"] == win_gen:
+            row["outcome"] = ("won" if result.get("ok")
+                              else "won (published failure)")
+            # the winner's result walls are authoritative (the lease may
+            # have been overwritten by a later renewal or lost entirely)
+            cw = _as_wall(result.get("claimed_wall"))
+            dw = _as_wall(result.get("dispatch_wall"))
+            if cw is not None:
+                row["claim_wall"] = cw
+            if dw is not None:
+                row["dispatch_wall"] = dw
+        elif row["released"]:
+            row["outcome"] = "released (clean hand-back)"
+        elif result is not None and result.get("quarantined"):
+            row["outcome"] = "died (burned a generation)"
+        elif win_gen is not None or row["gen"] < last:
+            # a later generation exists (or the result belongs to one):
+            # this owner's lease expired — stale stamp or fleet-dead
+            row["outcome"] = "expired (owner presumed dead)"
+        elif result is None:
+            row["outcome"] = "in flight (no result yet)"
+        else:
+            row["outcome"] = "superseded"
+    return rows
+
+
+def _phases(rec: dict, admit: Optional[dict],
+            result: Optional[dict]) -> Dict[str, float]:
+    """The winning generation's phase breakdown; {} when the job has no
+    executed result (queued, in flight, rejected, or quarantined)."""
+    if result is None or result.get("rejected") or result.get("quarantined"):
+        return {}
+    submit_wall = _as_wall(rec.get("submit_wall"))
+    if submit_wall is None:
+        return {}
+    admit_wall = _as_wall((admit or {}).get("wall"))
+    claimed = _as_wall(result.get("claimed_wall"))
+    dispatch = _as_wall(result.get("dispatch_wall"))
+    published = _as_wall(result.get("published_wall"))
+    if published is None:
+        published = _as_wall(result.get("finished_wall"))
+    seconds = _as_wall(result.get("seconds"))
+
+    phases: Dict[str, float] = {}
+    if admit_wall is not None:
+        phases["admission"] = max(0.0, admit_wall - submit_wall)
+    start = admit_wall if admit_wall is not None else submit_wall
+    if claimed is not None:
+        phases["queue_wait"] = max(0.0, claimed - start)
+        if dispatch is not None:
+            phases["window_wait"] = max(0.0, dispatch - claimed)
+    if seconds is not None:
+        phases["execution"] = max(0.0, seconds)
+    if published is not None and dispatch is not None and seconds is not None:
+        phases["publish"] = max(0.0, published - dispatch - seconds)
+    if published is not None:
+        phases["e2e"] = max(0.0, published - submit_wall)
+    return phases
+
+
+def load_journey(state_dir: str, job_id: str) -> Optional[Dict[str, Any]]:
+    """Reconstruct one job's journey from state-dir records alone.
+    ``state_dir`` is the serve state dir (jobs under ``jobs/``) or the
+    jobs dir itself; returns None when no such job record exists."""
+    backend = backend_for(state_dir)
+    join = backend.join
+    root = state_dir
+    if not backend.exists(join(root, f"job.{job_id}.json")):
+        sub = join(root, "jobs")
+        if not backend.exists(join(sub, f"job.{job_id}.json")):
+            return None
+        root = sub
+    rec = _read_json(backend, join(root, f"job.{job_id}.json"))
+    if rec is None:
+        return None
+    admit = _read_json(backend, join(root, f"admit.{job_id}.json"))
+    result = _read_json(backend, join(root, f"result.{job_id}.json"))
+    gens = _generations(backend, join, root, job_id, result)
+
+    if result is None:
+        state = "running" if gens else "queued"
+    elif result.get("quarantined"):
+        state = "quarantined"
+    elif result.get("rejected"):
+        state = "rejected"
+    else:
+        state = "done" if result.get("ok") else "failed"
+    return {
+        "id": job_id,
+        "state": state,
+        "record": rec,
+        "admit_wall": _as_wall((admit or {}).get("wall")),
+        "generations": gens,
+        "result": result,
+        "phases": _phases(rec, admit, result),
+    }
+
+
+def format_journey(j: Dict[str, Any]) -> str:
+    """Human timeline: absolute order as ``t+<s>`` offsets from the
+    submission wall, one line per generation, then the phase breakdown."""
+    rec = j["record"]
+    t0 = _as_wall(rec.get("submit_wall"))
+
+    def rel(wall: Optional[float]) -> str:
+        if wall is None or t0 is None:
+            return "t+?"
+        return f"t+{max(0.0, wall - t0):.3f}s"
+
+    lines = [
+        f"job {j['id']}  tenant={rec.get('tenant', 'default')} "
+        f"priority={rec.get('priority', 0)} "
+        f"workflow={rec.get('workflow', '?')}  state={j['state']}"
+    ]
+    lines.append(f"  submitted    {rel(t0)}")
+    if j.get("admit_wall") is not None:
+        lines.append(f"  admitted     {rel(j['admit_wall'])}")
+    for g in j["generations"]:
+        parts = [f"  gen {g['gen']}", f"daemon={g['daemon'] or '?'}"]
+        if g.get("claim_wall") is not None:
+            parts.append(f"claimed {rel(g['claim_wall'])}")
+        if g.get("dispatch_wall") is not None:
+            parts.append(f"dispatched {rel(g['dispatch_wall'])}")
+        if g.get("torn"):
+            parts.append("(lease torn)")
+        parts.append(f"-> {g['outcome']}")
+        lines.append("  ".join(parts))
+    result = j.get("result")
+    if result is not None:
+        mb = result.get("microbatch")
+        if isinstance(mb, dict):
+            note = (f"  microbatch: rode a {mb.get('jobs', '?')}-job "
+                    f"stacked dispatch (member {mb.get('index', '?')})")
+            if mb.get("split"):
+                note += " — re-dispatched solo after a batch failure"
+            lines.append(note)
+        published = _as_wall(result.get("published_wall"))
+        if published is None:
+            published = _as_wall(result.get("finished_wall"))
+        lines.append(f"  published    {rel(published)}  "
+                     f"(gen {result.get('gen', '?')}, "
+                     f"daemon={result.get('daemon') or '?'})")
+        if result.get("error"):
+            lines.append(f"  error: {str(result['error']).splitlines()[0]}")
+    phases = j.get("phases") or {}
+    if phases:
+        lines.append("  phases:")
+        for name in PHASE_ORDER:
+            if name in phases:
+                lines.append(f"    {name:<12} {phases[name]:.3f}s")
+    return "\n".join(lines)

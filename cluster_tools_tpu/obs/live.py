@@ -39,6 +39,7 @@ import re
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import hist as hist_mod
 from .heartbeat import FILE_PREFIX as HB_PREFIX
 from .metrics import METRICS_FILE_PREFIX
 from ..runtime.queue import STALE_INTERVALS, STRAGGLER_K
@@ -262,6 +263,14 @@ class LiveRun:
             gauges.update(snap.get("gauges") or {})
         return counters, gauges
 
+    def _read_hists(self) -> Dict[str, Any]:
+        """Exact merge of every ``hist.p*.json`` snapshot (ctt-slo):
+        fixed bucket edges make the cross-process merge bucket-wise
+        addition, so the live view's percentiles equal a single merged
+        process's.  Torn snapshots are skipped (atomic-replace writers;
+        the next poll sees them whole)."""
+        return hist_mod.load_run_hists(self.run_dir)
+
     # -- derived state ------------------------------------------------------
 
     @staticmethod
@@ -382,6 +391,7 @@ class LiveRun:
         now = _now_wall()
         hbs = self._read_heartbeats()
         counters, gauges = self._read_metrics()
+        hists = self._read_hists()
         workers = self._worker_rows(hbs, now)
         tasks = self._task_rows(workers)
         stragglers = self._stragglers(workers, now)
@@ -410,6 +420,10 @@ class LiveRun:
             ],
             "counters": counters,
             "gauges": gauges,
+            # present only when a histogram snapshot exists, so poll
+            # snapshots (and the --json watch stream) of runs without
+            # latency series stay byte-identical to the pre-slo output
+            **({"hists": hists} if hists.get("hists") else {}),
         }
 
     def task_median_s(self, task: str) -> Optional[float]:
@@ -516,6 +530,43 @@ def format_heatmap(hm: dict) -> str:
     return "\n".join(lines)
 
 
+def _fmt_lat_s(seconds: float) -> str:
+    return (f"{seconds * 1e3:.1f}ms" if seconds < 1.0
+            else f"{seconds:.2f}s")
+
+
+def _format_lat_line(snap: Dict[str, Any]) -> Optional[str]:
+    """The ``lat:`` watch line (ctt-slo): e2e p50/p99 per priority class
+    from the merged histogram snapshot, tenants aggregated bucket-wise
+    (exact).  None when no e2e series exists."""
+    series = (snap.get("hists") or {}).get("hists") or []
+    by_prio: Dict[str, List[int]] = {}
+    for s in series:
+        if s.get("name") != "serve.latency.e2e":
+            continue
+        prio = str((s.get("labels") or {}).get("priority", "?"))
+        acc = by_prio.setdefault(prio, [0] * len(s["buckets"]))
+        for i, c in enumerate(s["buckets"]):
+            acc[i] += int(c)
+
+    def _prio_key(p: str):
+        try:
+            return (0, -int(p))  # numeric classes, highest first
+        except ValueError:
+            return (1, 0)
+
+    parts = []
+    for prio in sorted(by_prio, key=_prio_key):
+        p50 = hist_mod.quantile(by_prio[prio], 0.5)
+        p99 = hist_mod.quantile(by_prio[prio], 0.99)
+        if p50 is None or p99 is None:
+            continue
+        parts.append(
+            f"prio {prio} p50 {_fmt_lat_s(p50)} p99 {_fmt_lat_s(p99)}"
+        )
+    return "  lat: e2e " + ", ".join(parts) if parts else None
+
+
 def format_watch(snap: Dict[str, Any]) -> str:
     """Human watch report for one poll."""
     workers = snap["workers"]
@@ -600,6 +651,13 @@ def format_watch(snap: Dict[str, Any]) -> str:
             if isinstance(val, (int, float)):
                 parts.append(f"{label} {int(val)}")
         lines.append("  serve: " + ", ".join(parts))
+    lat = _format_lat_line(snap)
+    if lat:
+        # ctt-slo: one line of request-latency health — end-to-end
+        # p50/p99 per priority class from the merged histograms.  Only
+        # rendered when a histogram snapshot exists, so watch output for
+        # runs without latency series stays byte-identical
+        lines.append(lat)
     if any(k.startswith("serve.microbatch_") for k in counters):
         # ctt-microbatch: one line of aggregation-window economics — how
         # deep the last window filled, how many jobs rode stacked
@@ -804,6 +862,11 @@ def render_openmetrics(snap: Dict[str, Any]) -> str:
             continue
         fam = family(_metric_name(raw), "gauge", "")
         lines.append(f"{fam} {_fmt_value(val)}")
+
+    # ctt-slo latency histograms (``_bucket``/``_sum``/``_count``);
+    # empty when the run recorded none — the exposition is then
+    # byte-identical to the pre-slo output
+    lines.extend(hist_mod.render_openmetrics(snap.get("hists") or {}))
 
     workers = snap.get("workers", [])
     if workers:

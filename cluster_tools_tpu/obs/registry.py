@@ -21,8 +21,8 @@ dropped data.
 
 from __future__ import annotations
 
-__all__ = ["COUNTERS", "GAUGES", "DYNAMIC_PREFIXES", "is_known_counter",
-           "is_known_gauge"]
+__all__ = ["COUNTERS", "GAUGES", "HISTOGRAMS", "DYNAMIC_PREFIXES",
+           "is_known_counter", "is_known_gauge", "is_known_histogram"]
 
 # -- counters (metrics.inc) -------------------------------------------------
 
@@ -208,6 +208,26 @@ GAUGES = frozenset({
     "ingest.slabs_pending",
 })
 
+# -- histograms (hist.observe) ----------------------------------------------
+#
+# ctt-slo request-grain latency distributions.  Every name is a seconds
+# histogram on the FIXED log2 bucket edges of obs/hist.py (exact
+# cross-daemon merge), labeled by tenant + priority at the observe site.
+
+HISTOGRAMS = frozenset({
+    # serve/server.py — per-phase request latencies.  Phase walls are
+    # also stamped durably (job/lease/result records), so `obs journey`
+    # can reconstruct the same breakdown per job from disk.
+    "serve.latency.admission",    # submit() entry -> admit/reject decision
+    "serve.latency.queue_wait",   # admit wall -> lease claim_wall
+    "serve.latency.window_wait",  # claim_wall -> dispatch_wall (microbatch
+                                  # aggregation-window residency; ~0 when
+                                  # the window is off)
+    "serve.latency.execution",    # dispatch_wall -> build returned
+    "serve.latency.publish",      # build returned -> result record durable
+    "serve.latency.e2e",          # job submit_wall -> result published
+})
+
 # dynamic name families: one series per <suffix>, allowed by prefix
 DYNAMIC_PREFIXES = (
     "faults.injected.",  # per injection site (faults/__init__.py)
@@ -224,3 +244,7 @@ def is_known_counter(name: str) -> bool:
 
 def is_known_gauge(name: str) -> bool:
     return name in GAUGES or _matches_prefix(name)
+
+
+def is_known_histogram(name: str) -> bool:
+    return name in HISTOGRAMS or _matches_prefix(name)

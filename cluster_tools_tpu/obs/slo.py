@@ -1,0 +1,334 @@
+"""ctt-slo fleet rollup and SLO gate over merged latency histograms.
+
+Every serve daemon publishes ``snap.<daemon_id>.json`` into the shared
+state dir on its fleet-beat cadence: its counters, gauges, and latency
+histograms (:mod:`obs.hist`).  Because every histogram uses the SAME
+fixed bucket edges, the fleet-wide rollup is *exact* — bucket-wise
+integer addition — so a percentile computed here equals the percentile
+a single process observing every request would have computed (to bucket
+resolution).  Two verbs ride that exactness:
+
+  * ``obs fleet <state_dir>`` — merge every daemon's snapshot into one
+    OpenMetrics exposition: counters summed, gauges last-writer in
+    sorted-daemon order (deterministic), histogram families in
+    ``_bucket``/``_sum``/``_count`` form, plus derived
+    ``ctt_fleet_latency_p50_seconds`` / ``ctt_fleet_latency_p99_seconds``
+    gauges labeled ``phase``/``tenant``/``priority``.
+  * ``obs slo <dir> --objective e2e_p99_s=2.0@priority=5`` — evaluate
+    declared objectives against the merged histograms with a CI
+    exit-code contract (0 met / 1 no data / 4 violated, the violation
+    code gated behind ``--fail-on-violation``).
+
+Objective grammar: ``<phase>_p<NN>_s=<seconds>[@label=value[,...]]``
+where ``<phase>`` is one of the serve latency phases (``admission``,
+``queue_wait``, ``window_wait``, ``execution``, ``publish``, ``e2e``)
+and ``p<NN>`` maps digits to a quantile (``p50`` = 0.50, ``p99`` = 0.99,
+``p999`` = 0.999).  Label constraints select series; series matching the
+constraint are aggregated bucket-wise before the quantile is taken, so
+``e2e_p99_s=2.0`` with no labels gates the whole fleet across every
+tenant and priority class.
+
+``<dir>`` resolution: a serve state dir (``snap.*.json`` fleet
+snapshots) or a trace run dir (``hist.p*.json`` per-process snapshots)
+— both merge exactly.  Dirs route through the store backend, so an
+``http(s)://`` object-store prefix works too (listing rides the
+paginated continuation GETs).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+from ..utils.store_backend import backend_for
+from . import hist as hist_mod
+
+__all__ = [
+    "SNAP_RE", "PHASES", "load_fleet", "merge_fleet", "load_hists_any",
+    "render_fleet", "parse_objective", "evaluate", "format_report",
+]
+
+# matches serve/server.py _publish_snapshot (daemon ids are _ID_SAFE_RE)
+SNAP_RE = re.compile(r"^snap\.([A-Za-z0-9_.-]+)\.json$")
+
+PHASES = (
+    "admission", "queue_wait", "window_wait", "execution", "publish", "e2e",
+)
+_LATENCY_PREFIX = "serve.latency."
+
+_OBJ_RE = re.compile(
+    r"^([a-z0-9_]+)_p(\d+)_s=([0-9eE.+-]+)(?:@(.+))?$"
+)
+
+_METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _read_json(backend, path: str) -> Optional[dict]:
+    try:
+        rec = json.loads(backend.read_bytes(path).decode())
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None  # torn snapshot: the daemon's next beat replaces it
+
+
+def _read_snaps(backend, state_dir: str) -> List[dict]:
+    try:
+        names = backend.listdir(state_dir)
+    except OSError:
+        names = []
+    snaps = []
+    for fn in sorted(names):
+        if SNAP_RE.match(fn):
+            rec = _read_json(backend, backend.join(state_dir, fn))
+            if rec is not None:
+                snaps.append(rec)
+    return snaps
+
+
+def merge_fleet(snaps: List[dict]) -> Dict[str, Any]:
+    """Merge daemon snapshots: counters summed, gauges last-writer in
+    sorted-daemon order (deterministic regardless of listing order),
+    histograms bucket-wise (exact — the fixed-edges contract).  A
+    snapshot with foreign bucket edges raises ValueError: version skew
+    must fail the rollup loudly, not approximate it."""
+    ordered = sorted(snaps, key=lambda r: str(r.get("daemon", "")))
+    daemons: List[str] = []
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, Any] = {}
+    hist_snaps: List[dict] = []
+    for rec in ordered:
+        daemons.append(str(rec.get("daemon", "?")))
+        for k, v in (rec.get("counters") or {}).items():
+            try:
+                counters[k] = counters.get(k, 0.0) + float(v)
+            except (TypeError, ValueError):
+                continue
+        gauges.update(rec.get("gauges") or {})
+        if isinstance(rec.get("hists"), dict):
+            hist_snaps.append(rec["hists"])
+    return {
+        "daemons": daemons,
+        "counters": counters,
+        "gauges": gauges,
+        "hists": hist_mod.merge_snapshots(hist_snaps),
+    }
+
+
+def load_fleet(state_dir: str) -> Dict[str, Any]:
+    """Merge every ``snap.<daemon_id>.json`` under a serve state dir."""
+    return merge_fleet(_read_snaps(backend_for(state_dir), state_dir))
+
+
+def load_hists_any(path: str) -> Dict[str, Any]:
+    """Merged histogram snapshot from either source: fleet snapshots
+    (``snap.*.json``, a serve state dir) when present, else per-process
+    histogram files (``hist.p*.json``, a trace run dir)."""
+    backend = backend_for(path)
+    snaps = _read_snaps(backend, path)
+    if snaps:
+        return merge_fleet(snaps)["hists"]
+    try:
+        names = backend.listdir(path)
+    except OSError:
+        names = []
+    hist_snaps = []
+    for fn in sorted(names):
+        if fn.startswith(hist_mod.HIST_FILE_PREFIX) and fn.endswith(".json"):
+            rec = _read_json(backend, backend.join(path, fn))
+            if rec is not None:
+                hist_snaps.append(rec)
+    return hist_mod.merge_snapshots(hist_snaps)
+
+
+# ---------------------------------------------------------------------------
+# fleet exposition
+
+
+def _metric_name(raw: str) -> str:
+    return "ctt_" + _METRIC_NAME_RE.sub("_", raw)
+
+
+def _escape_label(value: Any) -> str:
+    return (
+        str(value).replace("\\", r"\\").replace('"', r'\"')
+        .replace("\n", r"\n")
+    )
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    parts = []
+    for k in sorted(labels):
+        escaped = _escape_label(labels[k])
+        parts.append('%s="%s"' % (k, escaped))
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_fleet(merged: Dict[str, Any]) -> str:
+    """OpenMetrics 1.0 text exposition of the fleet rollup: summed
+    counters (``ctt_<name>_total``), last-writer gauges, exact histogram
+    families, derived per-series p50/p99 latency gauges, and a
+    ``ctt_fleet_daemons`` gauge.  Ends with the mandatory ``# EOF``."""
+    lines: List[str] = []
+
+    folded: Dict[str, float] = {}
+    for raw, val in merged.get("counters", {}).items():
+        name = _metric_name(raw)
+        if name.endswith("_total"):
+            name = name[: -len("_total")]
+        folded[name] = folded.get(name, 0.0) + float(val)
+    for name in sorted(folded):
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name}_total {repr(folded[name])}")
+
+    for raw in sorted(merged.get("gauges", {})):
+        val = merged["gauges"][raw]
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        name = _metric_name(raw)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {repr(float(val))}")
+
+    hists = merged.get("hists") or {}
+    lines.extend(hist_mod.render_openmetrics(hists))
+
+    # derived fleet percentiles: one gauge sample per latency series,
+    # labeled by phase + the series' own labels (tenant, priority)
+    for fam, q in (("ctt_fleet_latency_p50_seconds", 0.50),
+                   ("ctt_fleet_latency_p99_seconds", 0.99)):
+        rows = []
+        for s in hists.get("hists", []):
+            name = str(s.get("name", ""))
+            if not name.startswith(_LATENCY_PREFIX):
+                continue
+            val = hist_mod.quantile(list(s["buckets"]), q)
+            if val is None:
+                continue
+            labels = {str(k): str(v)
+                      for k, v in (s.get("labels") or {}).items()}
+            labels["phase"] = name[len(_LATENCY_PREFIX):]
+            rows.append(f"{fam}{_label_str(labels)} {repr(float(val))}")
+        if rows:
+            lines.append(f"# TYPE {fam} gauge")
+            lines.append(f"# HELP {fam} fleet-wide latency quantile from "
+                         "exactly-merged histograms")
+            lines.extend(sorted(rows))
+
+    lines.append("# TYPE ctt_fleet_daemons gauge")
+    lines.append("# HELP ctt_fleet_daemons daemon snapshots merged into "
+                 "this rollup")
+    lines.append(f"ctt_fleet_daemons {repr(float(len(merged.get('daemons', []))))}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# objectives
+
+
+def parse_objective(spec: str) -> Dict[str, Any]:
+    """Parse ``<phase>_p<NN>_s=<seconds>[@label=value,...]``; raises
+    ValueError with the expected grammar on any malformed spec."""
+    m = _OBJ_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"bad objective {spec!r}: expected "
+            "<phase>_p<NN>_s=<seconds>[@label=value,...] "
+            "(e.g. e2e_p99_s=2.0@priority=5)"
+        )
+    phase, digits, threshold, labelpart = m.groups()
+    if phase not in PHASES:
+        raise ValueError(
+            f"bad objective {spec!r}: unknown phase {phase!r} "
+            f"(one of {', '.join(PHASES)})"
+        )
+    q = int(digits) / (10 ** len(digits))
+    if not 0.0 < q < 1.0:
+        raise ValueError(f"bad objective {spec!r}: p{digits} is not a "
+                         "quantile in (0, 1)")
+    try:
+        threshold_s = float(threshold)
+    except ValueError:
+        raise ValueError(
+            f"bad objective {spec!r}: threshold {threshold!r} is not a "
+            "number"
+        ) from None
+    labels: Dict[str, str] = {}
+    if labelpart:
+        for pair in labelpart.split(","):
+            if "=" not in pair:
+                raise ValueError(
+                    f"bad objective {spec!r}: label constraint {pair!r} "
+                    "is not label=value"
+                )
+            k, v = pair.split("=", 1)
+            labels[k.strip()] = v.strip()
+    return {
+        "spec": spec,
+        "phase": phase,
+        "pname": f"p{digits}",
+        "quantile": q,
+        "threshold_s": threshold_s,
+        "labels": labels,
+    }
+
+
+def evaluate(hists: Dict[str, Any],
+             objectives: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Judge each objective against the merged histogram snapshot.
+    Series matching the objective's label constraints aggregate
+    bucket-wise (exact) before the quantile; a row's ``status`` is
+    ``met`` / ``violated`` / ``no_data``."""
+    series = hists.get("hists") or []
+    rows = []
+    for obj in objectives:
+        name = _LATENCY_PREFIX + obj["phase"]
+        acc: Optional[List[int]] = None
+        count = 0
+        for s in series:
+            if s.get("name") != name:
+                continue
+            labels = {str(k): str(v)
+                      for k, v in (s.get("labels") or {}).items()}
+            if any(labels.get(k) != str(v)
+                   for k, v in obj["labels"].items()):
+                continue
+            buckets = list(s["buckets"])
+            if acc is None:
+                acc = [0] * len(buckets)
+            for i, c in enumerate(buckets[: len(acc)]):
+                acc[i] += int(c)
+            count += int(s.get("count", 0))
+        value = hist_mod.quantile(acc, obj["quantile"]) if acc else None
+        if value is None:
+            status = "no_data"
+        elif value <= obj["threshold_s"]:
+            status = "met"
+        else:
+            status = "violated"
+        rows.append({**obj, "value_s": value, "count": count,
+                     "status": status})
+    return rows
+
+
+def format_report(rows: List[Dict[str, Any]]) -> str:
+    lines = []
+    for r in rows:
+        if r["status"] == "no_data":
+            lines.append(f"slo {r['spec']}: NO DATA (no matching series)")
+            continue
+        verdict = "MET" if r["status"] == "met" else "VIOLATED"
+        lines.append(
+            f"slo {r['spec']}: {r['pname']}="
+            f"{r['value_s']:.6f}s over {r['count']} request(s) "
+            f"(threshold {r['threshold_s']:.6f}s) {verdict}"
+        )
+    n = len(rows)
+    met = sum(1 for r in rows if r["status"] == "met")
+    violated = sum(1 for r in rows if r["status"] == "violated")
+    nodata = n - met - violated
+    lines.append(
+        f"{n} objective(s): {met} met, {violated} violated, "
+        f"{nodata} without data"
+    )
+    return "\n".join(lines)

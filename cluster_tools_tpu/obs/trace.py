@@ -56,6 +56,13 @@ Run-directory file formats (everything ``obs.live`` tails)::
                                  "attrs"?}  with monotonic endpoints.
     metrics.p<pid>.json         one snapshot per process, atomically
                                 replaced on flush: {"counters", "gauges"}.
+    hist.p<pid>.json            ctt-slo latency-histogram snapshot per
+                                process, atomically replaced on flush:
+                                {"schema", "edges" (the FIXED log2 bucket
+                                edges every histogram shares — merging
+                                two snapshots is bucket-wise addition,
+                                exact), "hists": [{"name", "labels",
+                                "buckets", "sum", "count"}, ...]}.
     hb.p<pid>.json              ctt-watch heartbeat, atomically replaced
                                 every CTT_HEARTBEAT_S while the process
                                 executes blocks: liveness + role/job id +
@@ -114,7 +121,11 @@ grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
                                 stamped at claim time so peers can judge
                                 the lease even if the owner dies before
                                 its first renewal), "claim_wall", "wall",
-                                "mono"}.  Stale beyond 3 x lease_s = the
+                                "mono", optional "dispatch_wall" (ctt-slo:
+                                when this generation's execution began,
+                                after any microbatch window — re-stamped
+                                on every renewal so it survives to the
+                                post-mortem)}.  Stale beyond 3 x lease_s = the
                                 daemon died mid-job; the next daemon on
                                 the same state dir claims gen g+1 — or
                                 immediately, if the owner's fleet beat
@@ -134,7 +145,12 @@ grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
                                 {"id", "gen", "ok", "error", "seconds",
                                 "warm", "compile_cache": {"hits",
                                 "misses"}, "tenant", "pid", "daemon",
-                                "finished_wall"}.  A quarantined poison
+                                "finished_wall", plus the ctt-slo phase
+                                walls "claimed_wall"/"dispatch_wall"/
+                                "published_wall" of the winning
+                                generation — ``obs journey`` rebuilds
+                                the per-phase breakdown from this record
+                                alone}.  A quarantined poison
                                 job (retry budget exhausted) parks here
                                 with {"ok": false, "quarantined": true,
                                 "failure_log": [each burned generation's
@@ -154,6 +170,18 @@ grain; the HTTP wire schema is documented in ``serve/protocol.py``)::
                                 dead: peers expire its job leases on the
                                 spot (serve.jobs_reclaimed) instead of
                                 waiting out lease staleness.
+    snap.<id>.json              ctt-slo per-daemon telemetry snapshot,
+                                atomically replaced on the fleet-beat
+                                cadence: {"schema", "daemon", "pid",
+                                "wall", "counters", "gauges", "hists"
+                                (a hist.p-format snapshot)}.  ``obs
+                                fleet`` merges every daemon's snap over
+                                one backend listing — counters summed,
+                                gauges last-writer in sorted-daemon
+                                order, histograms bucket-wise (exact) —
+                                into one OpenMetrics rollup with
+                                fleet-wide p50/p99 latency gauges, and
+                                ``obs slo`` gates objectives against it.
 
 Hierarchy artifact (ctt-hier; lives BESIDE the labels volume —
 ``<output_path>/<output_key>_hierarchy.npz`` by default — because it is

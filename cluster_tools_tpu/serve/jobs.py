@@ -87,6 +87,9 @@ class JobClaim:
     gen: int
     lease_path: str
     claim_wall: float = field(default_factory=time.time)
+    # ctt-slo: stamped (via note_dispatch) when execution actually starts
+    # — claim_wall..dispatch_wall is the microbatch window-wait phase
+    dispatch_wall: Optional[float] = None
 
 
 class JobQueue:
@@ -501,7 +504,8 @@ class JobQueue:
             }
 
     def _lease_payload(self, job_id: str, gen: int,
-                       claim_wall: float, released: bool = False) -> bytes:
+                       claim_wall: float, released: bool = False,
+                       dispatch_wall: Optional[float] = None) -> bytes:
         # the daemon id rides the very first (claim-time) stamp, not just
         # renewals: a daemon SIGKILLed inside the claim-to-first-renewal
         # window still leaves a lease peers can fast-path expire
@@ -514,6 +518,11 @@ class JobQueue:
             "wall": time.time(),
             "mono": obs_trace.monotonic(),
         }
+        if dispatch_wall is not None:
+            # ctt-slo phase wall: when this generation's execution began
+            # (after any microbatch aggregation window) — rides every
+            # later renewal so the stamp survives to the post-mortem
+            payload["dispatch_wall"] = dispatch_wall
         if released:
             # voluntary give-back: wall=0 ages the lease past every
             # staleness and backoff window, so it classifies "expired"
@@ -680,8 +689,35 @@ class JobQueue:
     def renew(self, claim: JobClaim) -> None:
         self._backend.write_bytes(
             claim.lease_path,
-            self._lease_payload(claim.job_id, claim.gen, claim.claim_wall),
+            self._lease_payload(
+                claim.job_id, claim.gen, claim.claim_wall,
+                dispatch_wall=claim.dispatch_wall,
+            ),
         )
+
+    def note_dispatch(self, claim: JobClaim) -> None:
+        """ctt-slo: stamp the moment this generation's execution actually
+        starts (after any aggregation window) into the lease — the
+        ``dispatch_wall`` phase wall ``obs journey`` reads back from
+        disk.  Also re-stamps the lease (a free renewal)."""
+        claim.dispatch_wall = time.time()
+        try:
+            self.renew(claim)
+        except OSError:
+            # best-effort, the renewal convention: the wall still rides
+            # the claim in memory and lands in the result record
+            pass
+
+    def admit_wall(self, job_id: str) -> Optional[float]:
+        """Wall stamp of the fleet admit marker (None when absent/torn) —
+        the admission→claim boundary of the phase breakdown."""
+        rec = self._read_json(self._join(self.dir, f"admit.{job_id}.json"))
+        if rec is None:
+            return None
+        try:
+            return float(rec["wall"])
+        except (KeyError, TypeError, ValueError):
+            return None
 
     def release(self, claim: JobClaim) -> None:
         """Voluntarily hand a claimed job back (drain suspend of a
@@ -702,13 +738,22 @@ class JobQueue:
         """Publish the terminal record (first writer wins — a requeued
         duplicate of a slow-but-alive predecessor loses cleanly)."""
         rec = dict(result)
+        wall = time.time()
         rec.update({
             "id": claim.job_id,
             "gen": claim.gen,
             "pid": os.getpid(),
             "daemon": self.daemon_id,
-            "finished_wall": time.time(),
+            "finished_wall": wall,
+            # ctt-slo phase walls: the winning generation's claim /
+            # execution-start / publish stamps ride the terminal record,
+            # so the per-job phase breakdown reconstructs from it alone
+            # even after the leases are gone
+            "claimed_wall": claim.claim_wall,
+            "published_wall": wall,
         })
+        if claim.dispatch_wall is not None:
+            rec["dispatch_wall"] = claim.dispatch_wall
         published = publish_once(
             self._join(self.dir, f"result.{claim.job_id}.json"),
             json.dumps(rec, sort_keys=True).encode(),

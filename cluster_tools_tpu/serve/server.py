@@ -62,6 +62,7 @@ from typing import Any, Dict, Optional
 
 from ..ingest.runner import IngestSuspended, install_suspend_check
 from ..obs import heartbeat as obs_heartbeat
+from ..obs import hist as obs_hist
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..runtime import config as cfg
@@ -285,6 +286,12 @@ class ServeDaemon:
             if self._httpd is not None:
                 self._httpd.shutdown()
                 self._httpd.server_close()
+            # final snap BEFORE the exiting beat: the fleet rollup keeps
+            # this daemon's complete totals even after it is gone
+            try:
+                self._publish_snapshot()
+            except OSError:
+                pass  # ctt: noqa[CTT009] best-effort telemetry on the way out
             # stop the (possibly drain-restarted) beat thread and stamp
             # the final ``exiting`` heartbeat in one move; same for the
             # fleet beat — the ``exiting`` stamp lets peers fail over in
@@ -328,21 +335,33 @@ class ServeDaemon:
         # on this state dir — the same total order to judge against, so
         # k daemons cannot each admit a full quota's worth together (the
         # per-daemon lock alone only serializes this daemon's handlers)
-        with self._submit_lock:
-            job_id = self.jobs.submit(record, admitted=False)
-            ok, reason = self.admission.admit(
-                record["tenant"],
-                self.jobs.stats(before_seq=int(job_id[1:])),
+        t_adm = obs_trace.monotonic()
+        try:
+            with self._submit_lock:
+                job_id = self.jobs.submit(record, admitted=False)
+                ok, reason = self.admission.admit(
+                    record["tenant"],
+                    self.jobs.stats(before_seq=int(job_id[1:])),
+                )
+                if not ok:
+                    if not self.jobs.retract(job_id, reason):
+                        # lost the result race: a peer's limbo reaper
+                        # already parked a terminal record for this
+                        # provisional job — same outcome (rejected),
+                        # different author
+                        obs_metrics.inc("serve.retract_races")
+                    raise Rejected(reason)
+                if self.jobs.admit(job_id):
+                    obs_metrics.inc("serve.jobs_admitted")
+        finally:
+            # ctt-slo: admission latency covers the whole two-phase
+            # decision, admitted and rejected alike — a quota-edge 429
+            # that takes seconds is a tail the SLO gate must see
+            obs_hist.observe(
+                "serve.latency.admission", obs_trace.monotonic() - t_adm,
+                tenant=record["tenant"],
+                priority=int(record.get("priority", 0) or 0),
             )
-            if not ok:
-                if not self.jobs.retract(job_id, reason):
-                    # lost the result race: a peer's limbo reaper already
-                    # parked a terminal record for this provisional job —
-                    # same outcome (rejected), different author
-                    obs_metrics.inc("serve.retract_races")
-                raise Rejected(reason)
-            if self.jobs.admit(job_id):
-                obs_metrics.inc("serve.jobs_admitted")
         self._publish_gauges()
         self._wake.set()
         return {"job_id": job_id, "state": "queued"}
@@ -431,6 +450,10 @@ class ServeDaemon:
             name="ctt-serve-lease", daemon=True,
         )
         renewer.start()
+        # ctt-slo: execution starts NOW — stamp dispatch_wall into the
+        # lease (claim→dispatch is the window-wait phase; on the
+        # microbatch solo-retry path this re-stamps to the solo dispatch)
+        self.jobs.note_dispatch(claim)
         sig = protocol.job_signature(rec)
         warm = sig in self._warm_signatures
         before = obs_metrics.snapshot()["counters"]
@@ -499,13 +522,18 @@ class ServeDaemon:
         }
         if microbatch_note:
             result["microbatch"] = dict(microbatch_note)
+        t_pub = obs_trace.monotonic()
         won = self.jobs.complete(claim, result)
+        publish_s = obs_trace.monotonic() - t_pub
         if not won:
             # a peer presumed us dead mid-run (stale lease or dead fleet
             # beat) and re-ran the job at gen+1; first writer won and ours
             # is the duplicate — correct by design, but worth counting
             obs_metrics.inc("serve.result_races")
+        else:
+            self._observe_job_phases(claim, rec, seconds, publish_s)
         obs_metrics.flush()  # results readable => counters scrapeable
+        obs_hist.flush()
 
     def _run_job_batch(self, claims: list) -> None:
         """ctt-microbatch: run same-signature member jobs as ONE stacked
@@ -539,6 +567,10 @@ class ServeDaemon:
 
         n = len(claims)
         index = {c.job_id: i for i, c in enumerate(claims)}
+        for claim in claims:
+            # ctt-slo: the aggregation window is over — every member's
+            # window-wait phase ends at this shared dispatch instant
+            self.jobs.note_dispatch(claim)
         warm_by_job = {
             c.job_id: protocol.job_signature(c.record)
             in self._warm_signatures
@@ -597,10 +629,12 @@ class ServeDaemon:
                 "serve.warm_compile_jobs" if warm
                 else "serve.cold_compile_jobs"
             )
+            member_s = plan.seconds or seconds / n
+            t_pub = obs_trace.monotonic()
             won = self.jobs.complete(claim, {
                 "ok": True,
                 "error": None,
-                "seconds": plan.seconds or seconds / n,
+                "seconds": member_s,
                 "warm": warm,
                 # compile accounting is per dispatch, and the batch IS
                 # one dispatch: the whole delta rides the first member,
@@ -610,9 +644,13 @@ class ServeDaemon:
                 "tenant": rec.get("tenant"),
                 "microbatch": {"jobs": n, "index": index[claim.job_id]},
             })
+            publish_s = obs_trace.monotonic() - t_pub
             if not won:
                 obs_metrics.inc("serve.result_races")
+            else:
+                self._observe_job_phases(claim, rec, member_s, publish_s)
         obs_metrics.flush()
+        obs_hist.flush()
 
         for plan in failed_plans:
             solo.append((plan_claims[id(plan)], True))
@@ -652,9 +690,72 @@ class ServeDaemon:
 
     # -- observability -------------------------------------------------------
 
+    def _observe_job_phases(self, claim: JobClaim, rec: Dict[str, Any],
+                            exec_s: float, publish_s: float) -> None:
+        """ctt-slo: record one published job's per-phase latencies into
+        the tenant/priority-labeled histograms.  Called only by the
+        daemon that WON the result race, so a job counts exactly once
+        fleet-wide.  Cross-process phases subtract durable wall stamps
+        (the lease/record convention: good to host clock skew), clamped
+        at zero so skew can only shrink a phase, never fabricate one."""
+        tenant = str(rec.get("tenant", "default"))
+        priority = str(int(rec.get("priority", 0) or 0))
+
+        def note(name: str, value: float) -> None:
+            obs_hist.observe(name, max(0.0, float(value)),
+                             tenant=tenant, priority=priority)
+
+        try:
+            submit_wall = float(rec["submit_wall"])
+        except (KeyError, TypeError, ValueError):
+            submit_wall = None
+        start = self.jobs.admit_wall(claim.job_id)
+        if start is None:
+            start = submit_wall
+        if start is not None:
+            note("serve.latency.queue_wait", claim.claim_wall - start)
+        if claim.dispatch_wall is not None:
+            note("serve.latency.window_wait",
+                 claim.dispatch_wall - claim.claim_wall)
+        note("serve.latency.execution", exec_s)
+        note("serve.latency.publish", publish_s)
+        if submit_wall is not None:
+            published_wall = time.time()  # timestamp pair with submit_wall
+            note("serve.latency.e2e", published_wall - submit_wall)
+
+    def _publish_snapshot(self) -> None:
+        """ctt-slo fleet rollup: publish this daemon's counters, gauges,
+        and latency histograms as ``snap.<daemon_id>.json`` into the
+        SHARED state dir (atomic-replace per write, torn reads skipped
+        by the reader) — ``obs fleet`` merges every daemon's snap over
+        one backend listing, POSIX or object-store prefix alike."""
+        metrics_snap = obs_metrics.snapshot()
+        snap = {
+            "schema": 1,
+            "daemon": self.daemon_id,
+            "pid": os.getpid(),
+            "wall": time.time(),
+            "counters": metrics_snap["counters"],
+            "gauges": metrics_snap["gauges"],
+            "hists": obs_hist.snapshot(),
+        }
+        self._backend.write_bytes(
+            self._backend.join(
+                self.state_dir, f"snap.{self.daemon_id}.json"
+            ),
+            json.dumps(snap, sort_keys=True).encode(),
+        )
+
     def _beat_info(self) -> Dict[str, Any]:
         """The capacity/load fields riding each fleet beat — what
-        :func:`serve.fleet.scale_advice` and ``obs watch`` read."""
+        :func:`serve.fleet.scale_advice` and ``obs watch`` read.  Also
+        the cadence the metrics/histogram snap publication rides: one
+        snap per fleet beat keeps ``obs fleet`` at most one heartbeat
+        stale without a thread of its own."""
+        try:
+            self._publish_snapshot()
+        except OSError:
+            pass  # ctt: noqa[CTT009] best-effort telemetry: the beat must land even if the snap write hiccups
         with self._state_lock:
             running = self._running_jobs
         return {
@@ -682,6 +783,7 @@ class ServeDaemon:
         (all participating processes' counters + heartbeats), falling
         back to a process-local snapshot when tracing is off."""
         obs_metrics.flush()
+        obs_hist.flush()  # latency histograms ride the same exposition
         rdir = obs_trace.run_dir()
         from ..obs import live as obs_live
 
@@ -697,6 +799,7 @@ class ServeDaemon:
             snap = {
                 "counters": obs_metrics.snapshot()["counters"],
                 "gauges": obs_metrics.snapshot()["gauges"],
+                "hists": obs_hist.snapshot(),
                 "workers": [], "tasks": {}, "stragglers": [],
                 "malformed_lines": 0,
             }

@@ -537,6 +537,47 @@ class TestCTT010:
         )
         assert lint(src, path="cluster_tools_tpu/utils/fake.py") == []
 
+    def test_unknown_histogram_literal(self):
+        # ctt-slo: hist.observe literals are checked against HISTOGRAMS
+        src = (
+            "from cluster_tools_tpu.obs import hist\n"
+            "def f(dt):\n"
+            "    hist.observe('serve.latency.e2e_typo', dt)\n"
+        )
+        (f,) = lint(src, path="cluster_tools_tpu/serve/fake.py")
+        assert (f.rule_id, f.line) == ("CTT010", 3)
+        assert "serve.latency.e2e_typo" in f.message
+        assert "histogram" in f.message
+
+    def test_counter_name_used_as_histogram_is_flagged(self):
+        # per-kind check: observing a counter name is a typo too
+        src = (
+            "from cluster_tools_tpu.obs import hist\n"
+            "def f(dt):\n"
+            "    hist.observe('serve.jobs_done', dt)\n"
+        )
+        (f,) = lint(src, path="cluster_tools_tpu/serve/fake.py")
+        assert f.rule_id == "CTT010"
+
+    def test_negative_registered_histogram_names(self):
+        src = (
+            "from cluster_tools_tpu.obs import hist as obs_hist\n"
+            "def f(dt, tenant, prio):\n"
+            "    obs_hist.observe('serve.latency.e2e', dt, tenant=tenant,\n"
+            "                     priority=prio)\n"
+            "    obs_hist.observe('serve.latency.admission', dt)\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/serve/fake.py") == []
+
+    def test_negative_non_hist_observe_receiver(self):
+        # arbitrary objects with .observe() (e.g. prometheus_client
+        # metrics in user code) are not ctt histogram sites
+        src = (
+            "def f(summary):\n"
+            "    summary.observe('whatever')\n"
+        )
+        assert lint(src, path="cluster_tools_tpu/utils/fake.py") == []
+
     def test_real_tree_call_sites_are_all_registered(self):
         # every literal inc/set_gauge in the shipped source must pass —
         # the registry and the call sites cannot drift apart

@@ -1,0 +1,415 @@
+"""ctt-slo: latency histograms, job journeys, fleet rollup, SLO gate.
+
+Covers the request-grain observability contract:
+  * histogram bucket placement + Prometheus-style quantile math
+    (interpolation inside the crossing bucket, +Inf clamp, empty=None);
+  * the exactness theorem the subsystem stands on: merging two
+    daemons' histograms is bit-identical to one process observing the
+    union — in memory, across REAL processes via ``hist.p*.json``, and
+    at the ``snap.<daemon>.json`` fleet grain;
+  * ``obs journey`` reconstructing a SIGKILL-failover timeline (gen 0
+    owner died, gen 1 finished) purely from fabricated state-dir
+    records — no live daemon;
+  * the ``obs slo`` CLI exit-code contract (0 met / 1 no data /
+    4 violated under --fail-on-violation / 2 malformed spec);
+  * ``obs fleet`` emitting parser-grade OpenMetrics with summed
+    counters and exact histogram families (foreign edges -> exit 2);
+  * the ``obs watch`` ``lat:`` line appearing exactly when a histogram
+    snapshot exists (runs without one stay byte-identical).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cluster_tools_tpu.obs import hist, metrics, trace
+from cluster_tools_tpu.obs import journey as journey_mod
+from cluster_tools_tpu.obs import slo as slo_mod
+from cluster_tools_tpu.obs.__main__ import main as obs_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Enable tracing (histograms gate on it) for one test."""
+    metrics.reset()
+    hist.reset()
+    run_id = trace.enable(str(tmp_path / "trace"), "t_slo",
+                          export_env=False)
+    yield os.path.join(str(tmp_path / "trace"), run_id)
+    trace.disable()
+    hist.reset()
+    metrics.reset()
+
+
+def _snap_of(values_by_series):
+    """Build a histogram snapshot through the real observe path."""
+    hist.reset()
+    for (name, labels), values in values_by_series.items():
+        for v in values:
+            hist.observe(name, v, **dict(labels))
+    snap = hist.snapshot()
+    hist.reset()
+    return snap
+
+
+# exact under float addition in any order (powers of two), spanning
+# several buckets including the +Inf overflow
+_VALS_A = [0.5, 1.5, 0.25, 0.000001, 128.0]
+_VALS_B = [2.0, 0.125, 4.0, 0.5, 0.5]
+
+
+# --------------------------------------------------------------------------
+# quantile math
+
+
+def test_observe_places_buckets_and_counts(traced):
+    hist.observe("serve.latency.e2e", 0.3, tenant="a")
+    hist.observe("serve.latency.e2e", 0.3, tenant="a")
+    hist.observe("serve.latency.e2e", 100.0, tenant="a")  # > 64 s: +Inf
+    snap = hist.snapshot()
+    (s,) = snap["hists"]
+    assert s["name"] == "serve.latency.e2e"
+    assert s["labels"] == {"tenant": "a"}
+    assert s["count"] == 3
+    assert s["sum"] == pytest.approx(100.6)
+    assert sum(s["buckets"]) == 3
+    assert s["buckets"][-1] == 1  # the overflow observation
+    # 0.3 lands in the (0.25, 0.5] bucket under cumulative-le semantics
+    idx = list(hist.EDGES).index(0.5)
+    assert s["buckets"][idx] == 2
+
+
+def test_quantile_interpolates_inside_crossing_bucket():
+    buckets = [0] * (len(hist.EDGES) + 1)
+    idx = list(hist.EDGES).index(2.0)  # bucket spans (1.0, 2.0]
+    buckets[idx] = 100
+    assert hist.quantile(buckets, 0.5) == pytest.approx(1.5)
+    assert hist.quantile(buckets, 0.99) == pytest.approx(1.99)
+
+
+def test_quantile_empty_and_overflow_clamp():
+    assert hist.quantile([0] * (len(hist.EDGES) + 1), 0.99) is None
+    only_inf = [0] * (len(hist.EDGES) + 1)
+    only_inf[-1] = 10
+    assert hist.quantile(only_inf, 0.99) == hist.EDGES[-1]
+
+
+# --------------------------------------------------------------------------
+# the exactness theorem: fleet merge == single process
+
+
+def test_merge_two_snapshots_equals_single_process(traced):
+    series = ("serve.latency.e2e", (("tenant", "a"), ("priority", "5")))
+    snap_a = _snap_of({series: _VALS_A})
+    snap_b = _snap_of({series: _VALS_B})
+    single = _snap_of({series: _VALS_A + _VALS_B})
+    merged = hist.merge_snapshots([snap_a, snap_b])
+    assert merged == single  # buckets, sums, counts — bit-identical
+
+
+def test_merge_rejects_foreign_edges():
+    with pytest.raises(ValueError, match="foreign bucket edges"):
+        hist.merge_into({}, {"edges": [1.0, 2.0, 3.0], "hists": []})
+
+
+def test_two_real_processes_flush_merge_exactly(tmp_path, traced):
+    """Two REAL processes flush hist.p<pid>.json into one run dir; the
+    cross-process merge equals a single process observing the union."""
+    run_dir = str(tmp_path / "run")
+    prog = (
+        "import json, sys\n"
+        "from cluster_tools_tpu.obs import hist, trace\n"
+        "trace.enable(sys.argv[1], 'merged', export_env=False)\n"
+        "for v in json.loads(sys.argv[2]):\n"
+        "    hist.observe('serve.latency.e2e', v, tenant='a')\n"
+        "hist.flush()\n"
+    )
+    for vals in (_VALS_A, _VALS_B):
+        r = subprocess.run(
+            [sys.executable, "-c", prog, str(tmp_path), json.dumps(vals)],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == 0, r.stderr
+    run_dir = os.path.join(str(tmp_path), "merged")
+    files = [n for n in os.listdir(run_dir)
+             if n.startswith(hist.HIST_FILE_PREFIX)]
+    assert len(files) == 2, files  # one snapshot per pid
+    merged = hist.load_run_hists(run_dir)
+    single = _snap_of({
+        ("serve.latency.e2e", (("tenant", "a"),)): _VALS_A + _VALS_B,
+    })
+    assert merged == single
+
+
+def _write_snap(state_dir, daemon, counters, hists_snap, gauges=None):
+    os.makedirs(state_dir, exist_ok=True)
+    rec = {"schema": 1, "daemon": daemon, "pid": 1, "wall": 0.0,
+           "counters": counters, "gauges": gauges or {},
+           "hists": hists_snap}
+    with open(os.path.join(state_dir, f"snap.{daemon}.json"), "w") as f:
+        json.dump(rec, f)
+
+
+def test_fleet_merge_equals_single_process(tmp_path, traced):
+    """The acceptance theorem at the snap.<daemon>.json grain: two
+    daemons' snapshots merge to exactly the single-process histogram,
+    with counters summed and gauges last-writer deterministic."""
+    sd = str(tmp_path / "state")
+    key = ("serve.latency.e2e", (("priority", "0"), ("tenant", "a")))
+    _write_snap(sd, "m0", {"serve.jobs_done": 3}, _snap_of({key: _VALS_A}),
+                gauges={"serve.peers": 1})
+    _write_snap(sd, "m1", {"serve.jobs_done": 4}, _snap_of({key: _VALS_B}),
+                gauges={"serve.peers": 2})
+    merged = slo_mod.load_fleet(sd)
+    assert merged["daemons"] == ["m0", "m1"]
+    assert merged["counters"] == {"serve.jobs_done": 7.0}
+    assert merged["gauges"] == {"serve.peers": 2}  # sorted last writer
+    single = _snap_of({key: _VALS_A + _VALS_B})
+    assert merged["hists"] == single
+
+
+# --------------------------------------------------------------------------
+# journey: the SIGKILL-failover timeline, purely from disk
+
+
+def _write_failover_state(sd):
+    """gen 0 owner SIGKILLed after claiming; gen 1 claims, rides a
+    microbatch window, and publishes — the acceptance scenario."""
+    os.makedirs(sd, exist_ok=True)
+
+    def put(name, rec):
+        with open(os.path.join(sd, name), "w") as f:
+            json.dump(rec, f)
+
+    put("job.j000001.json", {
+        "id": "j000001", "seq": 1, "submit_wall": 1000.0,
+        "tenant": "acme", "priority": 5, "workflow": "event_batch",
+    })
+    put("admit.j000001.json", {"id": "j000001", "wall": 1000.01,
+                               "daemon": "m0"})
+    put("lease.j000001.g0.json", {"job": "j000001", "gen": 0,
+                                  "daemon": "m0", "claim_wall": 1000.2})
+    put("lease.j000001.g1.json", {"job": "j000001", "gen": 1,
+                                  "daemon": "m1", "claim_wall": 1001.5,
+                                  "dispatch_wall": 1001.6})
+    put("result.j000001.json", {
+        "id": "j000001", "ok": True, "gen": 1, "daemon": "m1",
+        "claimed_wall": 1001.5, "dispatch_wall": 1001.6,
+        "seconds": 0.5, "published_wall": 1002.13,
+        "finished_wall": 1002.13,
+        "microbatch": {"jobs": 4, "index": 2},
+    })
+
+
+def test_journey_reconstructs_sigkill_failover(tmp_path):
+    sd = str(tmp_path / "state")
+    _write_failover_state(sd)
+    j = journey_mod.load_journey(sd, "j000001")
+    assert j is not None and j["state"] == "done"
+
+    outcomes = {g["gen"]: g["outcome"] for g in j["generations"]}
+    assert outcomes[0] == "expired (owner presumed dead)"
+    assert outcomes[1] == "won"
+
+    phases = j["phases"]
+    assert phases["admission"] == pytest.approx(0.01, abs=1e-9)
+    assert phases["queue_wait"] == pytest.approx(1.49, abs=1e-9)
+    assert phases["window_wait"] == pytest.approx(0.1, abs=1e-9)
+    assert phases["execution"] == pytest.approx(0.5)
+    assert phases["publish"] == pytest.approx(0.03, abs=1e-9)
+    assert phases["e2e"] == pytest.approx(2.13, abs=1e-9)
+
+    text = journey_mod.format_journey(j)
+    for needle in ("gen 0", "expired (owner presumed dead)", "gen 1",
+                   "won", "microbatch: rode a 4-job stacked dispatch",
+                   "admission", "queue_wait", "window_wait", "execution",
+                   "publish", "e2e"):
+        assert needle in text, (needle, text)
+
+
+def test_journey_resolves_jobs_subdir(tmp_path):
+    sd = str(tmp_path / "state")
+    _write_failover_state(os.path.join(sd, "jobs"))
+    j = journey_mod.load_journey(sd, "j000001")
+    assert j is not None and j["phases"]["e2e"] > 0
+
+
+def test_journey_quarantine_backfills_torn_lease(tmp_path):
+    sd = str(tmp_path / "state")
+    os.makedirs(sd)
+    with open(os.path.join(sd, "job.j000002.json"), "w") as f:
+        json.dump({"id": "j000002", "submit_wall": 1000.0}, f)
+    # gen 0's lease file was torn by the death that burned it — the
+    # quarantine verdict's failure_log is the durable record
+    with open(os.path.join(sd, "result.j000002.json"), "w") as f:
+        json.dump({
+            "id": "j000002", "quarantined": True, "ok": False,
+            "failure_log": [
+                {"gen": 0, "daemon": "m0", "claim_wall": 1000.2},
+                {"gen": 1, "daemon": "m1", "claim_wall": 1001.0},
+            ],
+        }, f)
+    j = journey_mod.load_journey(sd, "j000002")
+    assert j["state"] == "quarantined"
+    assert [g["daemon"] for g in j["generations"]] == ["m0", "m1"]
+    assert all(g["outcome"] == "died (burned a generation)"
+               for g in j["generations"])
+    assert j["phases"] == {}  # no executed result: no phase breakdown
+
+
+def test_journey_cli_missing_job_exits_one(tmp_path, capsys):
+    assert obs_main(["journey", str(tmp_path), "j000042"]) == 1
+    assert "no job j000042" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# slo gate: exit-code contract
+
+
+def _latency_state(tmp_path):
+    sd = str(tmp_path / "state")
+    key = ("serve.latency.e2e", (("priority", "5"), ("tenant", "a")))
+    _write_snap(sd, "m0", {}, _snap_of({key: [0.5, 0.5, 1.5, 0.25]}))
+    return sd
+
+
+def test_slo_met_exits_zero(tmp_path, traced, capsys):
+    sd = _latency_state(tmp_path)
+    rc = obs_main(["slo", sd, "--objective", "e2e_p99_s=300",
+                   "--fail-on-violation"])
+    assert rc == 0
+    assert "MET" in capsys.readouterr().out
+
+
+def test_slo_violated_exits_four_only_with_flag(tmp_path, traced, capsys):
+    sd = _latency_state(tmp_path)
+    spec = "e2e_p99_s=0.000001@tenant=a"
+    assert obs_main(["slo", sd, "--objective", spec,
+                     "--fail-on-violation"]) == 4
+    assert "VIOLATED" in capsys.readouterr().out
+    # without the flag a violation reports but does not gate
+    assert obs_main(["slo", sd, "--objective", spec]) == 0
+
+
+def test_slo_no_matching_data_exits_one(tmp_path, traced, capsys):
+    sd = _latency_state(tmp_path)
+    assert obs_main(["slo", sd, "--objective", "admission_p50_s=1"]) == 1
+    assert "NO DATA" in capsys.readouterr().out
+
+
+def test_slo_bad_spec_exits_two(tmp_path, traced, capsys):
+    sd = _latency_state(tmp_path)
+    assert obs_main(["slo", sd, "--objective", "p99=2.0"]) == 2
+    assert "bad objective" in capsys.readouterr().err
+
+
+def test_parse_objective_grammar():
+    obj = slo_mod.parse_objective("e2e_p999_s=2.5@priority=5,tenant=a")
+    assert obj["phase"] == "e2e"
+    assert obj["pname"] == "p999"
+    assert obj["quantile"] == pytest.approx(0.999)
+    assert obj["threshold_s"] == 2.5
+    assert obj["labels"] == {"priority": "5", "tenant": "a"}
+    for bad in ("e2e_p99_s", "nope_p99_s=1", "e2e_p0_s=1", "e2e_p99_s=x"):
+        with pytest.raises(ValueError):
+            slo_mod.parse_objective(bad)
+
+
+def test_slo_label_constraint_selects_series(tmp_path, traced):
+    sd = str(tmp_path / "state")
+    fast = ("serve.latency.e2e", (("priority", "5"),))
+    slow = ("serve.latency.e2e", (("priority", "0"),))
+    _write_snap(sd, "m0", {}, _snap_of({fast: [0.25] * 10,
+                                        slow: [32.0] * 10}))
+    rows = slo_mod.evaluate(slo_mod.load_hists_any(sd), [
+        slo_mod.parse_objective("e2e_p50_s=1.0@priority=5"),
+        slo_mod.parse_objective("e2e_p50_s=1.0@priority=0"),
+    ])
+    assert [r["status"] for r in rows] == ["met", "violated"]
+
+
+# --------------------------------------------------------------------------
+# fleet exposition
+
+
+def test_fleet_cli_parses_and_sums(tmp_path, traced, capsys):
+    sd = str(tmp_path / "state")
+    key = ("serve.latency.e2e", (("tenant", "a"),))
+    _write_snap(sd, "m0", {"serve.jobs_done": 3}, _snap_of({key: _VALS_A}))
+    _write_snap(sd, "m1", {"serve.jobs_done": 4}, _snap_of({key: _VALS_B}))
+    assert obs_main(["fleet", sd]) == 0
+    text = capsys.readouterr().out
+    assert text.endswith("# EOF\n")
+    assert "ctt_serve_jobs_done_total 7.0" in text
+    assert "ctt_fleet_daemons 2.0" in text
+    assert "ctt_fleet_latency_p99_seconds" in text
+    try:
+        from prometheus_client.openmetrics.parser import (
+            text_string_to_metric_families,
+        )
+    except ImportError:
+        pytest.skip("prometheus_client not installed")
+    fams = {f.name: f for f in text_string_to_metric_families(text)}
+    assert "ctt_serve_jobs_done" in fams
+    hist_fam = fams["ctt_serve_latency_e2e_seconds"]
+    counts = [s for s in hist_fam.samples
+              if s.name.endswith("_count")]
+    assert counts and counts[0].value == len(_VALS_A) + len(_VALS_B)
+
+
+def test_fleet_cli_no_snapshots_exits_one(tmp_path, capsys):
+    assert obs_main(["fleet", str(tmp_path)]) == 1
+    assert "no daemon snapshots" in capsys.readouterr().err
+
+
+def test_fleet_cli_foreign_edges_exit_two(tmp_path, capsys):
+    sd = str(tmp_path / "state")
+    _write_snap(sd, "m0", {}, {"schema": 1, "edges": [1.0, 2.0],
+                               "hists": [{"name": "serve.latency.e2e",
+                                          "labels": {}, "buckets": [1, 0],
+                                          "sum": 0.5, "count": 1}]})
+    assert obs_main(["fleet", sd]) == 2
+    assert "foreign" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# watch lat: line
+
+
+def _watch_run(run_dir):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "spans.p1.t1.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "type": "header", "run": "w", "pid": 1, "tid": 1,
+            "host": "synth", "wall": 1000.0, "mono": 10.0,
+        }) + "\n")
+    with open(os.path.join(run_dir, "metrics.p1.json"), "w") as f:
+        json.dump({"counters": {"serve.jobs_done": 2}, "gauges": {}}, f)
+
+
+def test_watch_lat_line_only_with_histograms(tmp_path, traced):
+    from cluster_tools_tpu.obs.live import LiveRun, format_watch
+
+    run_dir = str(tmp_path / "runA")
+    _watch_run(run_dir)
+    base = format_watch(LiveRun(run_dir).poll())
+    assert "lat:" not in base  # no histograms: output unchanged
+
+    snap = _snap_of({
+        ("serve.latency.e2e", (("priority", "5"),)): [0.25] * 8,
+        ("serve.latency.e2e", (("priority", "0"),)): [1.5] * 8,
+    })
+    with open(os.path.join(run_dir, f"{hist.HIST_FILE_PREFIX}1.json"),
+              "w") as f:
+        json.dump(snap, f)
+    withlat = format_watch(LiveRun(run_dir).poll())
+    (lat_line,) = [ln for ln in withlat.splitlines() if "lat:" in ln]
+    # numeric priority classes render highest first
+    assert lat_line.index("prio 5") < lat_line.index("prio 0")
+    assert withlat.replace(lat_line + "\n", "") == base

@@ -2265,6 +2265,133 @@ if [ "$diskless_rc" -ne 0 ]; then
     exit "$diskless_rc"
 fi
 
+echo "== ctt-slo smoke (mixed-priority burst -> journey phases, fleet rollup parses, slo gate 0/4) =="
+# the request-grain observability gate: a 12-job mixed-priority burst
+# through one short-window daemon, then the three post-hoc verbs against
+# the surviving state dir alone — `obs journey` must render every phase
+# (admission/queue_wait/window_wait/execution/publish/e2e), `obs fleet`
+# must emit OpenMetrics the prometheus_client parser accepts, and
+# `obs slo` must exit 0 on a generous objective and 4 on an impossible
+# one under --fail-on-violation.
+slo_tmp="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$repo_root${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$slo_tmp" <<'PY'
+import os, signal, subprocess, sys, time
+
+td = sys.argv[1]
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+       "CTT_HEARTBEAT_S": "0.2"}
+for k in ("CTT_TRACE_DIR", "CTT_RUN_ID"):
+    env.pop(k, None)
+
+import numpy as np
+from scipy import ndimage
+from cluster_tools_tpu.serve import ServeClient
+from cluster_tools_tpu.utils import file_reader
+
+gconf = {"block_shape": [2, 16, 16], "target": "local"}
+rng = np.random.default_rng(3)
+raw = ndimage.gaussian_filter(
+    rng.random((4, 16, 16)), (0.0, 1.0, 1.0)
+).astype("float32")
+data = np.where(raw > np.quantile(raw, 0.9), raw, 0.0).astype("float32")
+path = os.path.join(td, "burst.n5")
+file_reader(path).create_dataset("frames", data=data, chunks=(2, 16, 16))
+
+state = os.path.join(td, "state")
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "cluster_tools_tpu.serve",
+     "--state-dir", state, "--concurrency", "1",
+     "--microbatch-window-s", "1.0", "--microbatch-max-jobs", "4"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+)
+deadline = time.monotonic() + 120
+client = None
+while time.monotonic() < deadline:
+    assert daemon.poll() is None, daemon.stderr.read()
+    try:
+        client = ServeClient(state_dir=state)
+        client.healthz()
+        break
+    except Exception:
+        time.sleep(0.1)
+assert client is not None, "daemon never became healthy"
+
+try:
+    jobs = [
+        client.event_batch(
+            input_path=path, input_key="frames",
+            output_path=path, output_key=f"ev_{i}",
+            tmp_folder=os.path.join(td, f"tmp_{i}"),
+            config_dir=os.path.join(td, f"configs_{i}"),
+            threshold=0.1, configs={"global": dict(gconf)},
+            tenant=f"t{i % 3}", priority=(i % 3) * 5,
+        )
+        for i in range(12)
+    ]
+    for j in jobs:
+        st = client.wait(j, timeout_s=300)
+        assert st["result"]["ok"], st
+finally:
+    # SIGTERM drain: run() teardown publishes the final snap.<id>.json
+    if daemon.poll() is None:
+        daemon.send_signal(signal.SIGTERM)
+        try:
+            daemon.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+            daemon.wait(timeout=30)
+
+obs = [sys.executable, "-m", "cluster_tools_tpu.obs"]
+
+# 1) journey: a job that rode the window renders every phase, purely
+#    from the state-dir records (the daemon is gone)
+out = subprocess.run(obs + ["journey", state, jobs[0]], env=env,
+                     capture_output=True, text=True)
+assert out.returncode == 0, (out.returncode, out.stderr)
+for phase in ("admission", "queue_wait", "window_wait",
+              "execution", "publish", "e2e"):
+    assert phase in out.stdout, (f"journey missing phase {phase}",
+                                 out.stdout)
+
+# 2) fleet: the merged rollup is parser-grade OpenMetrics
+fleet = subprocess.run(obs + ["fleet", state], env=env,
+                       capture_output=True, text=True)
+assert fleet.returncode == 0, (fleet.returncode, fleet.stderr)
+from prometheus_client.openmetrics.parser import (
+    text_string_to_metric_families,
+)
+families = {f.name for f in text_string_to_metric_families(fleet.stdout)}
+assert any("serve_latency_e2e" in name for name in families), families
+
+# 3) slo gate: generous objective met (0), impossible one violated (4)
+met = subprocess.run(
+    obs + ["slo", state, "--objective", "e2e_p99_s=300",
+           "--fail-on-violation"],
+    env=env, capture_output=True, text=True)
+assert met.returncode == 0, (met.returncode, met.stdout, met.stderr)
+assert "MET" in met.stdout, met.stdout
+violated = subprocess.run(
+    obs + ["slo", state, "--objective", "e2e_p99_s=0.000001",
+           "--fail-on-violation"],
+    env=env, capture_output=True, text=True)
+assert violated.returncode == 4, (violated.returncode, violated.stdout,
+                                  violated.stderr)
+assert "VIOLATED" in violated.stdout, violated.stdout
+
+print("slo smoke ok: journey rendered all 6 phases,",
+      f"fleet rollup parsed ({len(families)} families),",
+      "slo gate 0 on generous / 4 on impossible")
+PY
+slo_rc=$?
+rm -rf "$slo_tmp"
+if [ "$slo_rc" -ne 0 ]; then
+    echo "slo smoke failed (rc=$slo_rc): the journey timeline lost a" \
+         "phase, the fleet rollup was not parser-grade OpenMetrics, or" \
+         "the slo gate exit codes broke their 0/4 contract" >&2
+    exit "$slo_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
